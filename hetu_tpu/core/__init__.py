@@ -1,0 +1,14 @@
+from .dtype import (DataType, canonicalize_dtype, to_jnp_dtype,
+                    uint8, int8, int16, int32, int64,
+                    float16, float32, float64, bfloat16, bool_,
+                    float4, nfloat4)
+from .device import (Device, DeviceGroup, DeviceGroupUnion, DeviceType,
+                     local_device, global_device_group)
+
+__all__ = [
+    "DataType", "canonicalize_dtype", "to_jnp_dtype",
+    "uint8", "int8", "int16", "int32", "int64",
+    "float16", "float32", "float64", "bfloat16", "bool_", "float4", "nfloat4",
+    "Device", "DeviceGroup", "DeviceGroupUnion", "DeviceType",
+    "local_device", "global_device_group",
+]

@@ -1,0 +1,174 @@
+"""Device identity and device groups.
+
+TPU-native equivalent of the reference's ``Device``/``DeviceGroup``
+(``hetu/core/device.h``).  A :class:`Device` identifies one chip (or host
+CPU) by type/index/hostname; a :class:`DeviceGroup` is an *ordered* set of
+devices.  Unlike the CUDA reference, devices here are thin descriptors that
+resolve to ``jax.Device`` objects; placement/compute is delegated to XLA via
+`jax.sharding` meshes (see ``hetu_tpu.parallel.mesh``).
+
+Global-rank bookkeeping (the reference's world-rank <-> device mapping set up
+by ``SetUpDeviceMappingAndAssignLocalDeviceOnce``, ``comm_group.h:223``) maps
+onto ``jax.process_index()`` / flat device ids.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import jax
+
+
+class DeviceType(enum.Enum):
+    CPU = "cpu"
+    TPU = "tpu"
+    GPU = "gpu"  # accepted for interop; not a compute target in this build
+    UNDETERMINED = "undetermined"
+
+
+@dataclass(frozen=True, order=True)
+class Device:
+    """A single device descriptor (reference ``Device``, ``core/device.h``)."""
+    type: DeviceType = DeviceType.UNDETERMINED
+    index: int = 0
+    hostname: str = ""
+    multiplex: int = 0  # reference supports multiplexing several ranks per card
+
+    @staticmethod
+    def parse(spec: "Device | str") -> "Device":
+        """Parse 'cpu', 'tpu:3', 'host1/tpu:0' style strings."""
+        if isinstance(spec, Device):
+            return spec
+        hostname = ""
+        body = spec
+        if "/" in spec:
+            hostname, body = spec.split("/", 1)
+        if ":" in body:
+            type_str, idx_str = body.split(":", 1)
+            index = int(idx_str)
+        else:
+            type_str, index = body, 0
+        return Device(DeviceType(type_str.lower()), index, hostname)
+
+    @property
+    def is_cpu(self) -> bool:
+        return self.type == DeviceType.CPU
+
+    @property
+    def is_tpu(self) -> bool:
+        return self.type == DeviceType.TPU
+
+    def local(self) -> bool:
+        return self.hostname in ("", "localhost")
+
+    def __str__(self) -> str:
+        prefix = f"{self.hostname}/" if self.hostname else ""
+        return f"{prefix}{self.type.value}:{self.index}"
+
+    def to_jax(self) -> jax.Device:
+        """Resolve to a concrete jax.Device on this process."""
+        backend = "cpu" if self.is_cpu else None
+        devs = jax.devices(backend) if backend else jax.devices()
+        for d in devs:
+            if d.id == self.index:
+                return d
+        raise RuntimeError(f"no local jax device for {self}")
+
+
+class DeviceGroup:
+    """Ordered set of devices (reference ``DeviceGroup``)."""
+
+    def __init__(self, devices: Iterable["Device | str"] = ()):
+        self._devices: Tuple[Device, ...] = tuple(Device.parse(d) for d in devices)
+
+    @property
+    def devices(self) -> Tuple[Device, ...]:
+        return self._devices
+
+    @property
+    def num_devices(self) -> int:
+        return len(self._devices)
+
+    def empty(self) -> bool:
+        return not self._devices
+
+    def contains(self, device: "Device | str") -> bool:
+        return Device.parse(device) in self._devices
+
+    def get_index(self, device: "Device | str") -> int:
+        return self._devices.index(Device.parse(device))
+
+    def get(self, index: int) -> Device:
+        return self._devices[index]
+
+    def __len__(self) -> int:
+        return self.num_devices
+
+    def __iter__(self):
+        return iter(self._devices)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, DeviceGroup) and self._devices == other._devices
+
+    def __hash__(self) -> int:
+        return hash(self._devices)
+
+    def __repr__(self) -> str:
+        return f"DeviceGroup([{', '.join(map(str, self._devices))}])"
+
+
+class DeviceGroupUnion:
+    """Union of device groups — one group per (hetero) pipeline slot.
+
+    Mirrors the reference's ``DeviceGroupUnion`` used for heterogeneous
+    pipeline placement (``hetu/graph/distributed_states.h``).
+    """
+
+    def __init__(self, groups: Sequence[DeviceGroup]):
+        self._groups: Tuple[DeviceGroup, ...] = tuple(groups)
+
+    @property
+    def groups(self) -> Tuple[DeviceGroup, ...]:
+        return self._groups
+
+    def size(self) -> int:
+        return len(self._groups)
+
+    def get(self, i: int) -> DeviceGroup:
+        return self._groups[i]
+
+    def all_devices(self) -> DeviceGroup:
+        seen: List[Device] = []
+        for g in self._groups:
+            for d in g:
+                if d not in seen:
+                    seen.append(d)
+        return DeviceGroup(seen)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, DeviceGroupUnion) and self._groups == other._groups
+
+    def __hash__(self) -> int:
+        return hash(self._groups)
+
+    def __repr__(self) -> str:
+        return f"DeviceGroupUnion({list(self._groups)!r})"
+
+
+def local_device() -> Device:
+    """The device this process computes on (first addressable device)."""
+    d = jax.local_devices()[0]
+    dtype = DeviceType.TPU if d.platform == "tpu" else DeviceType.CPU
+    return Device(dtype, d.id, "")
+
+
+def global_device_group(device_type: Optional[DeviceType] = None) -> DeviceGroup:
+    """All devices visible to jax, as an ordered DeviceGroup."""
+    devs = []
+    for d in jax.devices():
+        dt = DeviceType.TPU if d.platform == "tpu" else DeviceType.CPU
+        if device_type is not None and dt != device_type:
+            continue
+        devs.append(Device(dt, d.id, ""))
+    return DeviceGroup(devs)

@@ -1,0 +1,125 @@
+"""Data type system.
+
+TPU-native re-expression of the reference's ``DataType`` enum
+(``hetu/core/dtype.h``): fp32/fp16/bf16/integer types plus the 4-bit
+quantization formats (fp4/nf4) the reference implements via bitsandbytes
+(``hetu/impl/kernel/Quantization.cu``).  On TPU the storage types map onto
+jnp dtypes; fp4/nf4 are *codebook* formats used by the quantized
+checkpoint/save path (see ``hetu_tpu.utils.quantization``) — they are stored
+as packed uint8 with a per-block absmax, exactly like the reference's
+bitsandbytes path, but implemented with pure XLA ops.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Union
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    UINT8 = "uint8"
+    INT8 = "int8"
+    INT16 = "int16"
+    INT32 = "int32"
+    INT64 = "int64"
+    FLOAT16 = "float16"
+    FLOAT32 = "float32"
+    FLOAT64 = "float64"
+    BFLOAT16 = "bfloat16"
+    BOOL = "bool"
+    # 4-bit quantization codebook formats (packed storage, not compute types).
+    FLOAT4 = "float4"
+    NFLOAT4 = "nfloat4"
+
+    @property
+    def is_floating_point(self) -> bool:
+        return self in (DataType.FLOAT16, DataType.FLOAT32, DataType.FLOAT64,
+                        DataType.BFLOAT16, DataType.FLOAT4, DataType.NFLOAT4)
+
+    @property
+    def is_quantized(self) -> bool:
+        return self in (DataType.FLOAT4, DataType.NFLOAT4)
+
+    def to_jnp(self):
+        """Map to the jnp dtype used for device compute/storage."""
+        if self.is_quantized:
+            # Packed 4-bit codes live in uint8 (2 codes per byte).
+            return jnp.uint8
+        return _TO_JNP[self]
+
+    @property
+    def itemsize(self) -> float:
+        """Bytes per element (reference ``DataType2Size``)."""
+        if self.is_quantized:
+            return 0.5
+        return np.dtype(_TO_JNP[self]).itemsize
+
+
+_TO_JNP = {
+    DataType.UINT8: jnp.uint8,
+    DataType.INT8: jnp.int8,
+    DataType.INT16: jnp.int16,
+    DataType.INT32: jnp.int32,
+    DataType.INT64: jnp.int64,
+    DataType.FLOAT16: jnp.float16,
+    DataType.FLOAT32: jnp.float32,
+    DataType.FLOAT64: jnp.float64,
+    DataType.BFLOAT16: jnp.bfloat16,
+    DataType.BOOL: jnp.bool_,
+}
+
+_FROM_STR = {dt.value: dt for dt in DataType}
+_ALIASES = {
+    "fp16": DataType.FLOAT16,
+    "fp32": DataType.FLOAT32,
+    "fp64": DataType.FLOAT64,
+    "bf16": DataType.BFLOAT16,
+    "half": DataType.FLOAT16,
+    "float": DataType.FLOAT32,
+    "double": DataType.FLOAT64,
+    "fp4": DataType.FLOAT4,
+    "nf4": DataType.NFLOAT4,
+    "int": DataType.INT32,
+    "long": DataType.INT64,
+}
+
+DTypeLike = Union[DataType, str, type, np.dtype, None]
+
+
+def canonicalize_dtype(dtype: DTypeLike) -> DataType:
+    """Accept DataType / str / numpy / jnp dtypes and return a DataType."""
+    if dtype is None:
+        return DataType.FLOAT32
+    if isinstance(dtype, DataType):
+        return dtype
+    if isinstance(dtype, str):
+        if dtype in _FROM_STR:
+            return _FROM_STR[dtype]
+        if dtype in _ALIASES:
+            return _ALIASES[dtype]
+        raise ValueError(f"unknown dtype string: {dtype!r}")
+    name = np.dtype(dtype).name
+    if name in _FROM_STR:
+        return _FROM_STR[name]
+    raise ValueError(f"cannot canonicalize dtype: {dtype!r}")
+
+
+def to_jnp_dtype(dtype: DTypeLike):
+    return canonicalize_dtype(dtype).to_jnp()
+
+
+# Module-level convenience names mirroring ``hetu.float32`` etc.
+uint8 = DataType.UINT8
+int8 = DataType.INT8
+int16 = DataType.INT16
+int32 = DataType.INT32
+int64 = DataType.INT64
+float16 = DataType.FLOAT16
+float32 = DataType.FLOAT32
+float64 = DataType.FLOAT64
+bfloat16 = DataType.BFLOAT16
+bool_ = DataType.BOOL
+float4 = DataType.FLOAT4
+nfloat4 = DataType.NFLOAT4

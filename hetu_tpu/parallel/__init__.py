@@ -1,0 +1,19 @@
+from .dstates import (DUPLICATE, PARTIAL, NULL_HETERO_DIM,
+                      DistributedStates, DistributedStatesUnion,
+                      DistributedStatesHierarchy, SplitPattern,
+                      deduce_comm_kind)
+from .mesh import (AXIS_DP, AXIS_CP, AXIS_TP, AXIS_PP, AXIS_EP,
+                   create_mesh, single_device_mesh, mesh_axis_size,
+                   ds_to_mesh_and_spec, ds_to_named_sharding,
+                   ds_from_partition_spec, force_virtual_cpu_devices)
+from . import comm
+
+__all__ = [
+    "DUPLICATE", "PARTIAL", "NULL_HETERO_DIM",
+    "DistributedStates", "DistributedStatesUnion", "DistributedStatesHierarchy",
+    "SplitPattern", "deduce_comm_kind",
+    "AXIS_DP", "AXIS_CP", "AXIS_TP", "AXIS_PP", "AXIS_EP",
+    "create_mesh", "single_device_mesh", "mesh_axis_size",
+    "ds_to_mesh_and_spec", "ds_to_named_sharding", "ds_from_partition_spec",
+    "force_virtual_cpu_devices", "comm",
+]

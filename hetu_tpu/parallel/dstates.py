@@ -1,0 +1,428 @@
+"""DistributedStates — the sharding spec at the heart of the framework.
+
+TPU-native re-expression of the reference's central abstraction
+(``hetu/graph/distributed_states.h/.cc``): a tensor's layout over a device
+group is a map ``{dim -> split_count}`` with two special dims,
+
+* ``-1`` — duplicate (replicated copies),
+* ``-2`` — partial (pending-reduce partial sums),
+
+plus an ``order`` list giving the significance of each split dim in the
+mixed-radix device numbering, and a ``zero`` flag marking optimizer-state
+sharding (ZeRO).
+
+Where the reference lowers DS transitions to NCCL collectives at graph
+substitution time (``executable_graph.cc:1006`` SubstituteCommOp), we lower
+to ``jax.sharding.NamedSharding`` / ``PartitionSpec`` over a
+``jax.sharding.Mesh`` and let GSPMD insert the collectives.  GSPMD has no
+user-visible *partial* state, so partial(-2) is resolved at our graph level:
+the ``check_*`` predicates below (semantics identical to
+``distributed_states.h:110-115``) decide which collective converts ds A to
+ds B, exactly as the reference's comm-op deduction does.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Special dims.
+DUPLICATE = -1
+PARTIAL = -2
+NULL_HETERO_DIM = -3  # DistributedStatesUnion sentinel (distributed_states.h:155)
+
+
+class DistributedStates:
+    """Sharding layout over an ordered device group of ``device_num`` devices."""
+
+    __slots__ = ("_device_num", "_states", "_order", "_zero")
+
+    def __init__(self, device_num: int,
+                 states: Optional[Dict[int, int]] = None,
+                 order: Optional[Sequence[int]] = None,
+                 zero: bool = False):
+        if device_num < 1:
+            raise ValueError("device_num must be >= 1")
+        self._device_num = int(device_num)
+        self._zero = bool(zero)
+        self._set_states(states or {})
+        self._set_order(list(order) if order is not None else [])
+
+    # -- construction helpers -------------------------------------------------
+
+    @staticmethod
+    def pure_duplicate(device_num: int) -> "DistributedStates":
+        return DistributedStates(device_num, {DUPLICATE: device_num})
+
+    @staticmethod
+    def split(device_num: int, dim: int) -> "DistributedStates":
+        return DistributedStates(device_num, {dim: device_num})
+
+    def _set_states(self, states: Dict[int, int]) -> None:
+        res = {k: v for k, v in states.items() if v > 1}
+        prod = 1
+        for v in res.values():
+            prod *= v
+        if prod != self._device_num:
+            raise ValueError(
+                f"states {states} imply {prod} devices, expected {self._device_num}")
+        res.setdefault(PARTIAL, 1)
+        res.setdefault(DUPLICATE, 1)
+        self._states = res
+
+    def _set_order(self, order: List[int]) -> None:
+        active = sorted(k for k, v in self._states.items() if v > 1)
+        if not order:
+            self._order = active
+        else:
+            missing = [k for k in active if k not in order]
+            if missing:
+                raise ValueError(f"order {order} missing split dims {missing}")
+            self._order = [o for o in order if self._states.get(o, 1) > 1]
+
+    # -- accessors ------------------------------------------------------------
+
+    @property
+    def device_num(self) -> int:
+        return self._device_num
+
+    @property
+    def states(self) -> Dict[int, int]:
+        return dict(self._states)
+
+    @property
+    def order(self) -> List[int]:
+        return list(self._order)
+
+    @property
+    def zero(self) -> bool:
+        return self._zero
+
+    def with_zero(self, zero: bool) -> "DistributedStates":
+        return DistributedStates(self._device_num, self._states, self._order, zero)
+
+    def get_dim(self, dim: int) -> int:
+        return self._states.get(dim, 1)
+
+    # -- basic predicates (distributed_states.cc:221-266) ---------------------
+
+    def check_equal(self, other: "DistributedStates") -> bool:
+        return (self._device_num == other._device_num
+                and self._states == other._states
+                and self._order == other._order)
+
+    def check_max_dim(self, max_dim: int) -> bool:
+        return all(o < max_dim for o in self._order)
+
+    def check_pure_duplicate(self) -> bool:
+        return self._device_num == self.get_dim(DUPLICATE)
+
+    # -- combine/reduce machinery (distributed_states.cc:102-293) -------------
+
+    def _combine_states(self, src: Sequence[int], dst: int) -> Dict[int, int]:
+        """Merge split dims ``src`` into ``dst`` (renumbering positives)."""
+        states = dict(self._states)
+        value = 1
+        for s in src:
+            if s == dst:
+                raise ValueError("cannot combine a dim into itself")
+            if s in (PARTIAL, DUPLICATE):
+                value *= states.get(s, 1)
+                states[s] = 1
+            else:
+                if s in states:
+                    value *= states.pop(s)
+                # dims after s shift forward by one
+                for key in sorted(k for k in states if k >= 0 and k > s):
+                    states[key - 1] = states.pop(key)
+        if dst in (PARTIAL, DUPLICATE):
+            states[dst] = states.get(dst, 1) * value
+        else:
+            for s in src:
+                if s >= 0 and dst > s:
+                    dst -= 1
+            states[dst] = states.get(dst, 1) * value
+        return states
+
+    def _combine_order(self, src: Sequence[int], dst: int) -> List[int]:
+        order = list(self._order)
+        inds = sorted(order.index(d) for d in (*src, dst) if d in order)
+        if inds:
+            if any(inds[i] != inds[0] + i for i in range(len(inds))):
+                raise ValueError("cannot combine non-adjacent dims in order")
+            order[inds[0]] = dst
+            del order[inds[0] + 1:inds[0] + len(inds)]
+            for i, o in enumerate(order):
+                if o > 0:
+                    shift = sum(1 for s in src if 0 <= s < o)
+                    order[i] = o - shift
+        return order
+
+    @staticmethod
+    def _norm(states: Dict[int, int], order: List[int]) -> Tuple[Dict[int, int], List[int]]:
+        s = {k: v for k, v in states.items() if v > 1}
+        o = [d for d in order if s.get(d, 1) > 1]
+        return s, o
+
+    def check_combine(self, dst_ds: "DistributedStates",
+                      src: Sequence[int], dst: int) -> bool:
+        try:
+            states = self._combine_states(src, dst)
+            order = self._combine_order(src, dst)
+        except ValueError:
+            return False
+        return (self._norm(states, order)
+                == self._norm(dst_ds._states, dst_ds._order))
+
+    def _reduce_states(self, dim: int) -> Dict[int, int]:
+        states = dict(self._states)
+        if dim in (PARTIAL, DUPLICATE):
+            states[dim] = 1
+        else:
+            states.pop(dim, None)
+        return states
+
+    def check_reduce_dim(self, dst_ds: "DistributedStates", dim: int) -> bool:
+        states = self._reduce_states(dim)
+        order = [o for o in self._order if o != dim]
+        return (self._norm(states, order)
+                == self._norm(dst_ds._states, dst_ds._order))
+
+    def get_split_dim(self, merged_ds: "DistributedStates") -> int:
+        """The (single) positive dim on which self is more split than merged."""
+        split_dim = NULL_HETERO_DIM
+        merged = merged_ds._states
+        for k, v in self._states.items():
+            if k >= 0 and v > 1 and merged.get(k, 1) < v:
+                if split_dim != NULL_HETERO_DIM:
+                    raise ValueError(
+                        f"only one gather dim supported: {self._states} vs {merged}")
+                split_dim = k
+        return split_dim
+
+    # -- collective deduction predicates (distributed_states.h:110-115) -------
+
+    def check_allreduce(self, dst_ds: "DistributedStates") -> bool:
+        return self.get_dim(PARTIAL) > 1 and self.check_combine(
+            dst_ds, [PARTIAL], DUPLICATE)
+
+    def check_scatter(self, dst_ds: "DistributedStates") -> bool:
+        try:
+            scatter_dim = dst_ds.get_split_dim(self)
+        except ValueError:
+            return False
+        return self.get_dim(DUPLICATE) > 1 and self.check_combine(
+            dst_ds, [DUPLICATE], scatter_dim)
+
+    def check_allgather(self, dst_ds: "DistributedStates") -> bool:
+        try:
+            gather_dim = self.get_split_dim(dst_ds)
+        except ValueError:
+            return False
+        if gather_dim == NULL_HETERO_DIM:
+            return False
+        return (self.get_dim(gather_dim) > 1 and dst_ds.get_dim(DUPLICATE) > 1
+                and dst_ds.check_combine(self, [DUPLICATE], gather_dim))
+
+    def check_reducescatter(self, dst_ds: "DistributedStates") -> bool:
+        try:
+            scatter_dim = dst_ds.get_split_dim(self)
+        except ValueError:
+            return False
+        return self.get_dim(PARTIAL) > 1 and self.check_combine(
+            dst_ds, [PARTIAL], scatter_dim)
+
+    def check_broadcast(self, dst_ds: "DistributedStates") -> bool:
+        return dst_ds.get_dim(DUPLICATE) > 1 and dst_ds.check_reduce_dim(
+            self, DUPLICATE)
+
+    def check_reduce(self, dst_ds: "DistributedStates") -> bool:
+        return self.get_dim(PARTIAL) > 1 and self.check_reduce_dim(
+            dst_ds, PARTIAL)
+
+    # -- device <-> shard mapping (distributed_states.cc:360-420) -------------
+
+    def get_loop_sizes(self) -> List[int]:
+        """Stride (in device indices) of each order dim."""
+        sizes = [1]
+        for o in reversed(self._order):
+            sizes.insert(0, sizes[0] * self.get_dim(o))
+        return sizes[1:] if len(sizes) > 1 else [1]
+
+    def map_device_to_state_index(self, device_index: int) -> Dict[int, int]:
+        """Which slice of each dim device ``device_index`` owns."""
+        state_index: Dict[int, int] = {}
+        for o in reversed(self._order):
+            n = self._states[o]
+            state_index[o] = device_index % n
+            device_index //= n
+        return state_index
+
+    def get_dup_group_index(self, device_index: int) -> int:
+        idx = self.map_device_to_state_index(device_index)
+        dup_group, interval = 0, 1
+        for dim in sorted(self._order, reverse=True):
+            if dim < 0:
+                break
+            dup_group += idx[dim] * interval
+            interval *= self.get_dim(dim)
+        return dup_group
+
+    def get_group_indices_by_dim(self, dim: int, device_index: int) -> List[int]:
+        """Device indices of the collective group along ``dim`` that contains
+        ``device_index`` (reference ``get_devices_by_dim``)."""
+        pos = self._order.index(dim)
+        interval = 1
+        for o in self._order[pos + 1:]:
+            interval *= self._states[o]
+        macro = interval * self.get_dim(dim)
+        start = device_index - device_index % macro + device_index % interval
+        return list(range(start, start + macro, interval))
+
+    def local_slice(self, global_shape: Sequence[int],
+                    device_index: int) -> Tuple[slice, ...]:
+        """The slice of the global tensor owned by ``device_index``.
+
+        Host-side data slicing; equivalent of the reference's
+        ``parallel_data_provider`` (``parallel_multi_ds.py:16``).
+        """
+        idx = self.map_device_to_state_index(device_index)
+        slices = []
+        for d, size in enumerate(global_shape):
+            n = self.get_dim(d)
+            if size % n != 0:
+                raise ValueError(f"dim {d} size {size} not divisible by {n}")
+            chunk = size // n
+            i = idx.get(d, 0)
+            slices.append(slice(i * chunk, (i + 1) * chunk))
+        return tuple(slices)
+
+    def local_shape(self, global_shape: Sequence[int]) -> Tuple[int, ...]:
+        return tuple(s // self.get_dim(d) for d, s in enumerate(global_shape))
+
+    # -- misc -----------------------------------------------------------------
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, DistributedStates) and self.check_equal(other)
+
+    def __hash__(self) -> int:
+        return hash((self._device_num, tuple(sorted(self._states.items())),
+                     tuple(self._order)))
+
+    def __repr__(self) -> str:
+        states = {k: v for k, v in sorted(self._states.items()) if v > 1}
+        z = ", zero" if self._zero else ""
+        return f"DS(n={self._device_num}, states={states}, order={self._order}{z})"
+
+
+def deduce_comm_kind(src: DistributedStates, dst: DistributedStates) -> str:
+    """Which collective converts ``src`` into ``dst``.
+
+    Mirrors the decision procedure of the reference's ``SubstituteCommOp``
+    (``executable_graph.cc:1006``): try the cheap structured collectives
+    first, fall back to a general resharding (batched point-to-point in the
+    reference; a generic GSPMD reshard for us).
+    """
+    if src.check_equal(dst):
+        return "identity"
+    if src.check_allreduce(dst):
+        return "all_reduce"
+    if src.check_allgather(dst):
+        return "all_gather"
+    if src.check_reducescatter(dst):
+        return "reduce_scatter"
+    if src.check_scatter(dst):
+        return "scatter"
+    if src.check_broadcast(dst):
+        return "broadcast"
+    if src.check_reduce(dst):
+        return "reduce"
+    return "reshard"  # generic (BatchedISendIRecv in the reference)
+
+
+class SplitPattern:
+    """Contiguous vs. non-contiguous split (distributed_states.h:139)."""
+
+    def __init__(self, contiguous: bool = True):
+        self._contiguous = bool(contiguous)
+
+    @property
+    def is_contiguous(self) -> bool:
+        return self._contiguous
+
+    def check_equal(self, other: "SplitPattern") -> bool:
+        return self._contiguous == other._contiguous
+
+    def __repr__(self) -> str:
+        return f"SplitPattern({'contig' if self._contiguous else 'noncontig'})"
+
+
+class DistributedStatesUnion:
+    """Per-pipeline list of DS for heterogeneous strategies.
+
+    ``hetero_dim`` is the tensor dim along which the union members differ
+    (-3/NULL when homogeneous); mirrors ``distributed_states.h:157-240``.
+    """
+
+    def __init__(self, ds_list: Sequence[DistributedStates],
+                 hetero_dim: int = NULL_HETERO_DIM,
+                 split_pattern: Optional[SplitPattern] = None):
+        self._ds_list = list(ds_list)
+        self._hetero_dim = hetero_dim
+        self._split_pattern = split_pattern or SplitPattern(True)
+
+    @property
+    def ds_list(self) -> List[DistributedStates]:
+        return list(self._ds_list)
+
+    @property
+    def hetero_dim(self) -> int:
+        return self._hetero_dim
+
+    @property
+    def split_pattern(self) -> SplitPattern:
+        return self._split_pattern
+
+    def is_hetero(self) -> bool:
+        return self._hetero_dim != NULL_HETERO_DIM
+
+    def size(self) -> int:
+        return len(self._ds_list)
+
+    def get(self, i: int) -> DistributedStates:
+        return self._ds_list[i]
+
+    def get_default_ds(self) -> DistributedStates:
+        if not self._ds_list:
+            raise ValueError("empty DS union")
+        return self._ds_list[0]
+
+    def check_equal(self, other: "DistributedStatesUnion") -> bool:
+        return (self._hetero_dim == other._hetero_dim
+                and len(self._ds_list) == len(other._ds_list)
+                and all(a.check_equal(b)
+                        for a, b in zip(self._ds_list, other._ds_list)))
+
+    def __repr__(self) -> str:
+        h = f", hetero_dim={self._hetero_dim}" if self.is_hetero() else ""
+        return f"DSUnion({self._ds_list!r}{h})"
+
+
+class DistributedStatesHierarchy:
+    """Per-strategy list of DS unions (``tensor.h:255`` ds_hierarchy)."""
+
+    def __init__(self, unions: Sequence[DistributedStatesUnion] = ()):
+        self._unions = list(unions)
+
+    def add(self, union: DistributedStatesUnion) -> None:
+        self._unions.append(union)
+
+    def get(self, strategy_id: int) -> DistributedStatesUnion:
+        return self._unions[strategy_id]
+
+    def size(self) -> int:
+        return len(self._unions)
+
+    def __repr__(self) -> str:
+        return f"DSHierarchy({self._unions!r})"

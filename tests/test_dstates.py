@@ -1,0 +1,188 @@
+"""Unit tests for the DistributedStates sharding spec.
+
+Covers the collective-deduction predicate table the reference defines at
+``hetu/graph/distributed_states.h:110-115`` and the device<->shard mapping
+(``distributed_states.cc:360-420``), plus our DS <-> jax.sharding lowering.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from hetu_tpu.parallel import (DUPLICATE, PARTIAL, DistributedStates,
+                               DistributedStatesUnion, deduce_comm_kind,
+                               ds_from_partition_spec, ds_to_mesh_and_spec,
+                               ds_to_named_sharding, create_mesh)
+
+
+class TestBasics:
+    def test_construction_and_get_dim(self):
+        ds = DistributedStates(8, {0: 2, 1: 4})
+        assert ds.get_dim(0) == 2
+        assert ds.get_dim(1) == 4
+        assert ds.get_dim(DUPLICATE) == 1
+        assert ds.get_dim(5) == 1
+        assert ds.order == [0, 1]
+
+    def test_device_num_mismatch(self):
+        with pytest.raises(ValueError):
+            DistributedStates(8, {0: 2, 1: 2})
+
+    def test_pure_duplicate(self):
+        ds = DistributedStates.pure_duplicate(4)
+        assert ds.check_pure_duplicate()
+        assert ds.get_dim(DUPLICATE) == 4
+
+    def test_custom_order(self):
+        ds = DistributedStates(8, {0: 2, DUPLICATE: 4}, order=[-1, 0])
+        assert ds.order == [-1, 0]
+
+    def test_equality_and_hash(self):
+        a = DistributedStates(4, {0: 2, DUPLICATE: 2})
+        b = DistributedStates(4, {0: 2, -1: 2})
+        assert a == b
+        assert hash(a) == hash(b)
+
+
+class TestPredicates:
+    """The check_* table (distributed_states.h:110-115)."""
+
+    def test_allreduce(self):
+        # partial over 4 -> duplicate over 4: allreduce
+        src = DistributedStates(4, {PARTIAL: 4})
+        dst = DistributedStates(4, {DUPLICATE: 4})
+        assert src.check_allreduce(dst)
+        assert deduce_comm_kind(src, dst) == "all_reduce"
+
+    def test_allreduce_with_dp(self):
+        # dp split on dim0 + tp partial -> dp split + dup (the classic
+        # row-parallel-linear output reduction)
+        src = DistributedStates(8, {0: 2, PARTIAL: 4}, order=[0, -2])
+        dst = DistributedStates(8, {0: 2, DUPLICATE: 4}, order=[0, -1])
+        assert src.check_allreduce(dst)
+        assert deduce_comm_kind(src, dst) == "all_reduce"
+
+    def test_allgather(self):
+        # split dim1 over 4 -> duplicate: allgather
+        src = DistributedStates(4, {1: 4})
+        dst = DistributedStates(4, {DUPLICATE: 4})
+        assert src.check_allgather(dst)
+        assert deduce_comm_kind(src, dst) == "all_gather"
+
+    def test_allgather_partial_dims(self):
+        # dp2 x tp2 split dims (0,1) -> gather dim1 within TP groups,
+        # keeping dp split: the SP allgather before a column-parallel matmul
+        src = DistributedStates(4, {0: 2, 1: 2}, order=[0, 1])
+        dst = DistributedStates(4, {0: 2, DUPLICATE: 2}, order=[0, -1])
+        assert src.check_allgather(dst)
+        assert deduce_comm_kind(src, dst) == "all_gather"
+
+    def test_reducescatter(self):
+        # partial over 4 -> split dim0 over 4: reduce-scatter (ZeRO grad path)
+        src = DistributedStates(4, {PARTIAL: 4})
+        dst = DistributedStates(4, {0: 4})
+        assert src.check_reducescatter(dst)
+        assert deduce_comm_kind(src, dst) == "reduce_scatter"
+
+    def test_scatter(self):
+        src = DistributedStates(4, {DUPLICATE: 4})
+        dst = DistributedStates(4, {0: 4})
+        assert src.check_scatter(dst)
+        assert deduce_comm_kind(src, dst) == "scatter"
+
+    def test_identity(self):
+        a = DistributedStates(4, {0: 4})
+        assert deduce_comm_kind(a, a) == "identity"
+
+    def test_generic_reshard(self):
+        # split dim0 -> split dim1 has no single collective
+        src = DistributedStates(4, {0: 4})
+        dst = DistributedStates(4, {1: 4})
+        assert deduce_comm_kind(src, dst) == "reshard"
+
+    def test_no_false_positive_allreduce(self):
+        src = DistributedStates(4, {0: 4})
+        dst = DistributedStates(4, {DUPLICATE: 4})
+        assert not src.check_allreduce(dst)
+
+
+class TestDeviceMapping:
+    def test_map_device_to_state_index(self):
+        # order [0, 1]: dim0 outermost (stride 4), dim1 innermost
+        ds = DistributedStates(8, {0: 2, 1: 4})
+        idx = ds.map_device_to_state_index(5)  # 5 = 1*4 + 1
+        assert idx[0] == 1 and idx[1] == 1
+        idx = ds.map_device_to_state_index(3)
+        assert idx[0] == 0 and idx[1] == 3
+
+    def test_loop_sizes(self):
+        ds = DistributedStates(8, {0: 2, 1: 4})
+        assert ds.get_loop_sizes() == [4, 1]
+
+    def test_group_indices_by_dim(self):
+        ds = DistributedStates(8, {0: 2, 1: 4})
+        # TP group (dim 1) containing device 5: {4,5,6,7}
+        assert ds.get_group_indices_by_dim(1, 5) == [4, 5, 6, 7]
+        # DP group (dim 0) containing device 5: {1, 5}
+        assert ds.get_group_indices_by_dim(0, 5) == [1, 5]
+
+    def test_dup_group_index(self):
+        ds = DistributedStates(8, {0: 2, DUPLICATE: 4}, order=[0, -1])
+        assert ds.get_dup_group_index(0) == 0
+        assert ds.get_dup_group_index(3) == 0
+        assert ds.get_dup_group_index(4) == 1
+
+    def test_local_slice(self):
+        ds = DistributedStates(8, {0: 2, 1: 4})
+        sl = ds.local_slice((8, 16), 5)
+        assert sl == (slice(4, 8), slice(4, 8))
+        assert ds.local_shape((8, 16)) == (4, 4)
+
+
+class TestJaxLowering:
+    def test_ds_to_named_sharding_roundtrip(self, devices8):
+        ds = DistributedStates(8, {0: 2, 1: 4})
+        sharding = ds_to_named_sharding(ds, devices8)
+        x = np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+        arr = jax.device_put(x, sharding)
+        # each device must hold exactly the slice local_slice predicts
+        for shard in arr.addressable_shards:
+            dev_index = devices8.index(shard.device)
+            sl = ds.local_slice((8, 16), dev_index)
+            np.testing.assert_array_equal(np.asarray(shard.data), x[sl])
+
+    def test_ds_to_named_sharding_with_dup(self, devices8):
+        # dp2 x dup4, order [0, -1]: devices {0..3} and {4..7} hold halves
+        ds = DistributedStates(8, {0: 2, DUPLICATE: 4}, order=[0, -1])
+        sharding = ds_to_named_sharding(ds, devices8)
+        x = np.arange(4 * 2, dtype=np.float32).reshape(4, 2)
+        arr = jax.device_put(x, sharding)
+        for shard in arr.addressable_shards:
+            dev_index = devices8.index(shard.device)
+            sl = ds.local_slice((4, 2), dev_index)
+            np.testing.assert_array_equal(np.asarray(shard.data), x[sl])
+
+    def test_ds_from_partition_spec(self):
+        mesh = create_mesh({"dp": 2, "tp": 4})
+        ds = ds_from_partition_spec(mesh, P("dp", "tp"))
+        assert ds.get_dim(0) == 2 and ds.get_dim(1) == 4
+        ds_combined = ds_from_partition_spec(mesh, P(("dp", "tp"),))
+        assert ds_combined.get_dim(0) == 8
+        ds2 = ds_from_partition_spec(mesh, P("dp", None))
+        assert ds2.get_dim(0) == 2
+        assert ds2.get_dim(DUPLICATE) == 4
+        ds3 = ds_from_partition_spec(mesh, P(None, "tp"),
+                                     partial_axes=["dp"])
+        assert ds3.get_dim(1) == 4
+        assert ds3.get_dim(PARTIAL) == 2
+
+
+class TestUnion:
+    def test_union(self):
+        u = DistributedStatesUnion(
+            [DistributedStates(4, {0: 4}), DistributedStates(4, {0: 2, -1: 2})],
+            hetero_dim=0)
+        assert u.is_hetero()
+        assert u.size() == 2
+        assert u.get(0).get_dim(0) == 4
