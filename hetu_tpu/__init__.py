@@ -1,0 +1,37 @@
+"""hetu_tpu — a TPU-native distributed deep-learning framework.
+
+A from-scratch JAX/XLA/Pallas re-design with the capabilities of Hetu
+(PKU DAIR Lab; reference mounted at /root/reference): define-and-run graphs
+with a compiled-plan pool, DistributedStates sharding annotations lowered to
+GSPMD, DP/TP/SP/PP/CP/EP parallelism, ZeRO, elastic hot-switching,
+ds-aware safetensors checkpointing, and Hetu-style nn/optim Python APIs.
+"""
+from . import core
+from .core import (DataType, uint8, int8, int16, int32, int64, float16,
+                   float32, float64, bfloat16, bool_, float4, nfloat4,
+                   Device, DeviceGroup, DeviceGroupUnion)
+from . import parallel
+from .parallel import (DistributedStates, DistributedStatesUnion,
+                       DistributedStatesHierarchy, create_mesh)
+from .graph import (Tensor, SymbolicDim, Graph, EagerGraph,
+                    DefineAndRunGraph, RunLevel, graph, run_level,
+                    get_default_graph, placeholder, parameter, variable,
+                    parallel_placeholder, parallel_parameter)
+from .graph.ctor import (ConstantInitializer, UniformInitializer,
+                         NormalInitializer, TruncatedNormalInitializer,
+                         XavierUniformInitializer, XavierNormalInitializer,
+                         HeUniformInitializer, HeNormalInitializer,
+                         ProvidedInitializer)
+from . import ops
+from .ops.functional import *  # noqa: F401,F403
+
+from . import nn   # noqa: E402
+from . import optim  # noqa: E402
+
+__version__ = "0.1.0"
+
+
+def gradients(loss, xs):
+    """Reverse-mode autodiff entry (reference hetu.gradients -> Graph::Gradients)."""
+    g = loss.graph or get_default_graph()
+    return g.make_gradients(loss, list(xs))

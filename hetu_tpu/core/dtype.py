@@ -20,6 +20,9 @@ import numpy as np
 
 class DataType(enum.Enum):
     UINT8 = "uint8"
+    UINT16 = "uint16"
+    UINT32 = "uint32"
+    UINT64 = "uint64"
     INT8 = "int8"
     INT16 = "int16"
     INT32 = "int32"
@@ -47,6 +50,12 @@ class DataType(enum.Enum):
         if self.is_quantized:
             # Packed 4-bit codes live in uint8 (2 codes per byte).
             return jnp.uint8
+        import jax
+        if not jax.config.jax_enable_x64:
+            if self == DataType.INT64:
+                return jnp.int32
+            if self == DataType.FLOAT64:
+                return jnp.float32
         return _TO_JNP[self]
 
     @property
@@ -59,6 +68,9 @@ class DataType(enum.Enum):
 
 _TO_JNP = {
     DataType.UINT8: jnp.uint8,
+    DataType.UINT16: jnp.uint16,
+    DataType.UINT32: jnp.uint32,
+    DataType.UINT64: jnp.uint64,
     DataType.INT8: jnp.int8,
     DataType.INT16: jnp.int16,
     DataType.INT32: jnp.int32,

@@ -1,0 +1,19 @@
+from .tensor import Tensor, SymbolicDim
+from .graph import (Graph, EagerGraph, DefineAndRunGraph, OpNode, RunLevel,
+                    graph, run_level, get_default_graph)
+from .ctor import (placeholder, parameter, variable, parallel_placeholder,
+                   parallel_parameter, Initializer, ConstantInitializer,
+                   UniformInitializer, NormalInitializer,
+                   TruncatedNormalInitializer, XavierUniformInitializer,
+                   XavierNormalInitializer, HeUniformInitializer,
+                   HeNormalInitializer, ProvidedInitializer)
+
+__all__ = [
+    "Tensor", "SymbolicDim", "Graph", "EagerGraph", "DefineAndRunGraph",
+    "OpNode", "RunLevel", "graph", "run_level", "get_default_graph",
+    "placeholder", "parameter", "variable", "parallel_placeholder",
+    "parallel_parameter", "Initializer", "ConstantInitializer",
+    "UniformInitializer", "NormalInitializer", "TruncatedNormalInitializer",
+    "XavierUniformInitializer", "XavierNormalInitializer",
+    "HeUniformInitializer", "HeNormalInitializer", "ProvidedInitializer",
+]

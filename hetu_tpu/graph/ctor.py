@@ -1,0 +1,193 @@
+"""Tensor constructors: placeholders, parameters, variables.
+
+Mirrors the reference's tensor ctors incl. ``parallel_placeholder`` /
+``parallel_parameter`` (``python/hetu/_binding/graph/tensor_ctor.cc:144``)
+and the initializer hierarchy (``hetu/graph/init/initializer.h``).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from ..core.dtype import canonicalize_dtype
+from .graph import Graph, get_default_graph
+from .tensor import Tensor
+
+_seed_counter = [0]
+
+
+def _next_key(seed: Optional[int] = None) -> jax.Array:
+    if seed is None:
+        _seed_counter[0] += 1
+        seed = _seed_counter[0]
+    return jax.random.PRNGKey(seed)
+
+
+# -- initializers (reference Initializer hierarchy) -------------------------
+
+class Initializer:
+    def __call__(self, shape, dtype) -> jax.Array:
+        raise NotImplementedError
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, lr: Union[float, Sequence[float]] = 0.1, seed=None):
+        self.range = (-lr, lr) if np.isscalar(lr) else tuple(lr)
+        self.seed = seed
+
+    def __call__(self, shape, dtype):
+        return jax.random.uniform(_next_key(self.seed), shape, jnp.float32,
+                                  self.range[0], self.range[1]).astype(dtype)
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, mean: float = 0.0, stddev: float = 0.01, seed=None):
+        self.mean, self.stddev, self.seed = mean, stddev, seed
+
+    def __call__(self, shape, dtype):
+        return (self.mean + self.stddev * jax.random.normal(
+            _next_key(self.seed), shape, jnp.float32)).astype(dtype)
+
+
+class TruncatedNormalInitializer(NormalInitializer):
+    def __call__(self, shape, dtype):
+        return (self.mean + self.stddev * jax.random.truncated_normal(
+            _next_key(self.seed), -2.0, 2.0, shape, jnp.float32)).astype(dtype)
+
+
+class XavierUniformInitializer(Initializer):
+    def __init__(self, gain: float = 1.0, seed=None):
+        self.gain, self.seed = gain, seed
+
+    def __call__(self, shape, dtype):
+        fan_in, fan_out = _fans(shape)
+        limit = self.gain * float(np.sqrt(6.0 / (fan_in + fan_out)))
+        return jax.random.uniform(_next_key(self.seed), shape, jnp.float32,
+                                  -limit, limit).astype(dtype)
+
+
+class XavierNormalInitializer(Initializer):
+    def __init__(self, gain: float = 1.0, seed=None):
+        self.gain, self.seed = gain, seed
+
+    def __call__(self, shape, dtype):
+        fan_in, fan_out = _fans(shape)
+        std = self.gain * float(np.sqrt(2.0 / (fan_in + fan_out)))
+        return (std * jax.random.normal(_next_key(self.seed), shape,
+                                        jnp.float32)).astype(dtype)
+
+
+class HeUniformInitializer(Initializer):
+    def __init__(self, seed=None):
+        self.seed = seed
+
+    def __call__(self, shape, dtype):
+        fan_in, _ = _fans(shape)
+        limit = float(np.sqrt(6.0 / fan_in))
+        return jax.random.uniform(_next_key(self.seed), shape, jnp.float32,
+                                  -limit, limit).astype(dtype)
+
+
+class HeNormalInitializer(Initializer):
+    def __init__(self, seed=None):
+        self.seed = seed
+
+    def __call__(self, shape, dtype):
+        fan_in, _ = _fans(shape)
+        std = float(np.sqrt(2.0 / fan_in))
+        return (std * jax.random.normal(_next_key(self.seed), shape,
+                                        jnp.float32)).astype(dtype)
+
+
+class ProvidedInitializer(Initializer):
+    def __init__(self, data):
+        self.data = data
+
+    def __call__(self, shape, dtype):
+        arr = jnp.asarray(self.data, dtype=dtype)
+        assert tuple(arr.shape) == tuple(shape), \
+            f"provided data shape {arr.shape} != {shape}"
+        return arr
+
+
+def _fans(shape):
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+# -- constructors -----------------------------------------------------------
+
+def placeholder(dtype=None, shape: Sequence = (), name: str = "",
+                graph: Optional[Graph] = None) -> Tensor:
+    g = graph or get_default_graph()
+    t = Tensor(shape, dtype, name=name or "placeholder", graph=g)
+    g.add_placeholder(t)
+    return t
+
+
+def parameter(init: Union[Initializer, Any], shape: Sequence = None,
+              dtype=None, name: str = "", trainable: bool = True,
+              requires_grad: Optional[bool] = None,
+              graph: Optional[Graph] = None) -> Tensor:
+    g = graph or get_default_graph()
+    if not isinstance(init, Initializer):
+        data = np.asarray(init)
+        shape = data.shape if shape is None else shape
+        init = ProvidedInitializer(data)
+    dt = canonicalize_dtype(dtype)
+    if requires_grad is None:
+        requires_grad = trainable
+    t = Tensor(shape, dt, name=name or "param", graph=g,
+               trainable=trainable, requires_grad=requires_grad)
+    jdt = dt.to_jnp()
+    g.add_variable(t, lambda init=init, shape=tuple(
+        int(s) for s in shape), jdt=jdt: init(shape, jdt))
+    return t
+
+
+variable = parameter
+
+
+def parallel_placeholder(dtype, global_shape: Sequence, ds_hierarchy=None,
+                         pspec: Optional[PartitionSpec] = None,
+                         name: str = "", graph: Optional[Graph] = None) -> Tensor:
+    """Placeholder with sharding annotation (tensor_ctor.cc:144)."""
+    t = placeholder(dtype, global_shape, name, graph)
+    if ds_hierarchy is not None:
+        t.set_ds_hierarchy(ds_hierarchy)
+    if pspec is not None:
+        t.pspec = pspec
+    return t
+
+
+def parallel_parameter(init: Union[Initializer, Any], global_shape: Sequence,
+                       ds_hierarchy=None, pspec: Optional[PartitionSpec] = None,
+                       dtype=None, name: str = "", trainable: bool = True,
+                       graph: Optional[Graph] = None) -> Tensor:
+    """Parameter with sharding annotation: initialized at global shape and
+    device_put with its NamedSharding, so each device materializes only its
+    shard (XLA handles the scatter — the analogue of deferred sharded init)."""
+    t = parameter(init, global_shape, dtype, name, trainable, graph=graph)
+    if ds_hierarchy is not None:
+        t.set_ds_hierarchy(ds_hierarchy)
+    if pspec is not None:
+        t.pspec = pspec
+    return t

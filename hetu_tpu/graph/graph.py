@@ -1,0 +1,660 @@
+"""Graph layer: eager + define-and-run graphs with a compiled-plan pool.
+
+TPU-native re-expression of the reference's graph stack
+(``hetu/graph/graph.h:21-27`` graph types, ``define_and_run_graph.cc:912``
+plan matching, ``executable_graph.cc:1788`` CrucialRun):
+
+* ``EagerGraph``     — ops execute immediately on jax arrays
+  (reference ``eager_graph.h:8``).
+* ``DefineAndRunGraph`` — user builds a symbolic op DAG once;
+  ``run(fetches, feed_dict, ...)`` matches (strategy_id, fetches,
+  feed shapes) against an **executable-plan pool** and on miss traces the
+  DAG into a pure jax function, jit-compiles it with sharding annotations,
+  and caches it — the exact analogue of Hetu's ExecGraphPlan + shape-plan
+  pools (``define_and_run_graph.h:23``, ``.cc:912-1068``), with XLA playing
+  the role of the ExecutableGraph runtime.
+
+Autodiff is reverse-mode via ``jax.grad`` over the traced DAG rather than
+per-op DoGradient (``graph.cc:117``); grad-reduce insertion for partial(-2)
+grads is subsumed by GSPMD once activations/params carry shardings.
+
+Run levels mirror ``graph.h:29-35``: TOPO / ALLOC / COMPUTE_ONLY / GRAD /
+UPDATE — GRAD accumulates gradients across ``run`` calls into persistent
+device buffers; UPDATE folds them into the parameter update.
+"""
+from __future__ import annotations
+
+import enum
+import itertools
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple,
+                    Union)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.dtype import canonicalize_dtype
+from .tensor import SymbolicDim, Tensor, concrete_shape
+
+_op_ids = itertools.count()
+
+
+class RunLevel(enum.Enum):
+    TOPO = "topo"
+    ALLOC = "alloc"
+    COMPUTE_ONLY = "compute_only"
+    GRAD = "grad"
+    UPDATE = "update"
+
+
+class OpNode:
+    """A graph node (reference ``OpDef``, ``operator.h:304``)."""
+
+    __slots__ = ("id", "op_type", "impl", "inputs", "outputs", "attrs",
+                 "name")
+
+    def __init__(self, op_type: str, impl: Optional[Callable],
+                 inputs: List[Tensor], attrs: Dict[str, Any], name: str):
+        self.id = next(_op_ids)
+        self.op_type = op_type
+        self.impl = impl
+        self.inputs = inputs
+        self.outputs: List[Tensor] = []
+        self.attrs = attrs
+        self.name = name or f"{op_type}_{self.id}"
+
+    def __repr__(self):
+        return f"OpNode({self.name}, inputs={[t.name for t in self.inputs]})"
+
+
+class Graph:
+    """Base graph: op/tensor registry + tracing evaluator."""
+
+    def __init__(self, name: str = "graph"):
+        self.name = name
+        self.ops: List[OpNode] = []
+        self.cur_strategy_id: int = 0
+        self.num_strategy: int = 1
+        self.mesh: Optional[Mesh] = None
+        # variable/optimizer state: tensor.id -> jax.Array (device resident)
+        self._var_data: Dict[int, jax.Array] = {}
+        self._var_tensors: Dict[int, Tensor] = {}
+        self._placeholders: Dict[int, Tensor] = {}
+        self._grad_accum: Dict[int, jax.Array] = {}
+        self._rng_tensor: Optional[Tensor] = None
+        self._rng_seed = np.random.randint(0, 2**31 - 1)
+        self._run_counter = 0
+
+    # -- construction -------------------------------------------------------
+
+    def set_num_strategy(self, n: int) -> None:
+        self.num_strategy = n
+
+    def _lift_constant(self, value, dtype=None) -> Tensor:
+        arr = jnp.asarray(value, dtype=canonicalize_dtype(dtype).to_jnp()
+                          if dtype is not None else None)
+        t = Tensor(arr.shape, arr.dtype, name="const", graph=self)
+        node = OpNode("constant", None, [], {"value": arr}, t.name)
+        node.outputs = [t]
+        t.producer = node
+        self.ops.append(node)
+        return t
+
+    def as_tensor(self, value) -> Tensor:
+        if isinstance(value, Tensor):
+            return value
+        return self._lift_constant(value)
+
+    def make_op(self, op_type: str, impl: Callable,
+                inputs: Sequence[Any], attrs: Optional[Dict[str, Any]] = None,
+                name: str = "", num_outputs: int = 1) -> Union[Tensor, List[Tensor]]:
+        attrs = dict(attrs or {})
+        in_tensors = [self.as_tensor(x) for x in inputs]
+        node = OpNode(op_type, impl, in_tensors, attrs, name)
+        # shape/dtype inference via abstract evaluation (replaces the
+        # reference's per-op DoInferMeta, operator.h:423).  Unbound symbolic
+        # dims get a provisional binding — recorded shapes are advisory; the
+        # real shapes come from the feed arrays at trace time (shape plans).
+        for t in in_tensors:
+            for d in t.shape:
+                if isinstance(d, SymbolicDim) and not d.is_bound:
+                    d.set(16)
+        in_structs = [jax.ShapeDtypeStruct(t.concrete_shape(), t.dtype.to_jnp())
+                      for t in in_tensors]
+        out_struct = jax.eval_shape(lambda *xs: impl(*xs, **attrs), *in_structs)
+        flat_outs, treedef = jax.tree_util.tree_flatten(out_struct)
+        outputs = []
+        for i, s in enumerate(flat_outs):
+            t = Tensor(s.shape, s.dtype, producer=node,
+                       name=f"{node.name}:{i}" if len(flat_outs) > 1 else node.name,
+                       graph=self,
+                       requires_grad=any(x.requires_grad for x in in_tensors))
+            outputs.append(t)
+        node.outputs = outputs
+        node.attrs["_treedef"] = treedef
+        self.ops.append(node)
+        self._post_make_op(node)
+        return outputs[0] if num_outputs == 1 and len(outputs) == 1 else outputs
+
+    def _post_make_op(self, node: OpNode) -> None:
+        pass
+
+    # -- variables / placeholders -------------------------------------------
+
+    def add_variable(self, t: Tensor, init_fn: Callable[[], jax.Array]) -> None:
+        node = OpNode("variable", None, [], {"init_fn": init_fn}, t.name)
+        node.outputs = [t]
+        t.producer = node
+        t.graph = self
+        self.ops.append(node)
+        self._var_tensors[t.id] = t
+
+    def add_placeholder(self, t: Tensor) -> None:
+        node = OpNode("placeholder", None, [], {}, t.name)
+        node.outputs = [t]
+        t.producer = node
+        t.graph = self
+        self.ops.append(node)
+        self._placeholders[t.id] = t
+
+    def next_rng_tensor(self) -> Tensor:
+        """The per-run RNG key tensor (auto-fed with a fresh key each run);
+        stochastic ops (dropout) fold a per-op salt into it.  Replaces the
+        reference's per-device RNG state (hetu/impl/random/)."""
+        if self._rng_tensor is None:
+            t = Tensor((2,), "uint32", name="_rng", graph=self)
+            self.add_placeholder(t)
+            self._rng_tensor = t
+        return self._rng_tensor
+
+    def _fresh_rng_key(self) -> np.ndarray:
+        self._run_counter += 1
+        return np.asarray(
+            jax.random.PRNGKey(self._rng_seed + self._run_counter),
+            dtype=np.uint32)
+
+    def _materialize_var(self, t: Tensor) -> jax.Array:
+        if t.id not in self._var_data:
+            init_fn = t.producer.attrs["init_fn"]
+            val = init_fn()
+            sharding = self._sharding_for(t)
+            if sharding is not None:
+                val = jax.device_put(val, sharding)
+            self._var_data[t.id] = val
+        return self._var_data[t.id]
+
+    def get_tensor_value(self, t: Tensor):
+        if t.id in self._var_data:
+            return self._var_data[t.id]
+        if t.id in self._var_tensors:
+            return self._materialize_var(t)
+        raise ValueError(f"{t.name} has no stored value; fetch it via run()")
+
+    def reset_variable(self, t: Tensor, value) -> None:
+        sharding = self._sharding_for(t)
+        val = jnp.asarray(value, dtype=t.dtype.to_jnp())
+        if sharding is not None:
+            val = jax.device_put(val, sharding)
+        self._var_data[t.id] = val
+
+    # -- sharding -----------------------------------------------------------
+
+    def _pspec_for(self, t: Tensor) -> Optional[PartitionSpec]:
+        return getattr(t, "pspec", None)
+
+    def _sharding_for(self, t: Tensor) -> Optional[NamedSharding]:
+        if self.mesh is None:
+            return None
+        spec = self._pspec_for(t)
+        if spec is None:
+            return None
+        return NamedSharding(self.mesh, spec)
+
+    # -- evaluation engine ---------------------------------------------------
+
+    def _topo_from(self, targets: Sequence[Tensor]) -> List[OpNode]:
+        """Reverse-DFS topo sort (reference Graph::TopoSort, graph.h:960)."""
+        visited: Dict[int, bool] = {}
+        order: List[OpNode] = []
+
+        def visit(node: OpNode):
+            if node.id in visited:
+                return
+            visited[node.id] = True
+            for t in node.inputs:
+                if t.producer is not None:
+                    visit(t.producer)
+            order.append(node)
+
+        for t in targets:
+            if t.producer is not None:
+                visit(t.producer)
+        return order
+
+    def _eval_targets(self, targets: Sequence[Tensor],
+                      env: Dict[int, Any]) -> List[Any]:
+        """Evaluate target tensors given env (tensor.id -> concrete value).
+
+        Pure w.r.t. env: used both eagerly and under jit tracing.
+        """
+        base_env = dict(env)  # leaf values only (placeholders/variables)
+        env = dict(env)
+        for node in self._topo_from(targets):
+            if all(t.id in env for t in node.outputs):
+                continue
+            if node.op_type == "constant":
+                env[node.outputs[0].id] = node.attrs["value"]
+            elif node.op_type in ("variable", "placeholder"):
+                if node.outputs[0].id not in env:
+                    raise ValueError(
+                        f"{node.op_type} {node.name} not fed/materialized")
+            elif node.op_type == "gradients":
+                self._eval_gradients_node(node, env, base_env)
+            else:
+                args = [env[t.id] for t in node.inputs]
+                attrs = {k: v for k, v in node.attrs.items()
+                         if not k.startswith("_")}
+                out = node.impl(*args, **attrs)
+                flat = jax.tree_util.tree_leaves(out)
+                for t, v in zip(node.outputs, flat):
+                    spec = self._pspec_for(t)
+                    if spec is not None and self.mesh is not None:
+                        v = jax.lax.with_sharding_constraint(
+                            v, NamedSharding(self.mesh, spec))
+                    env[t.id] = v
+        return [env[t.id] for t in targets]
+
+    def _eval_gradients_node(self, node: OpNode, env: Dict[int, Any],
+                             base_env: Optional[Dict[int, Any]] = None) -> None:
+        """Reverse-mode autodiff (reference Graph::Gradients, graph.cc:117).
+
+        Implemented as jax.grad over the traced forward closure from the
+        requested vars to the loss; multi-consumer grad summation and
+        partial-grad reduction fall out of jax's vjp + GSPMD.  The closure
+        re-evaluates the forward from *leaf* values only (base_env), so the
+        differentiated variables actually flow into the loss.
+        """
+        loss_t: Tensor = node.attrs["loss"]
+        xs: List[Tensor] = node.attrs["xs"]
+        leaf_env = base_env if base_env is not None else env
+
+        def loss_fn(var_vals: Dict[int, Any]):
+            inner_env = {k: v for k, v in leaf_env.items()
+                         if k not in var_vals}
+            inner_env.update(var_vals)
+            (loss_val,) = self._eval_targets([loss_t], inner_env)
+            return jnp.sum(loss_val) if loss_val.ndim > 0 else loss_val
+
+        var_vals = {t.id: env[t.id] for t in xs}
+        grads = jax.grad(loss_fn)(var_vals)
+        for t_out, t_x in zip(node.outputs, xs):
+            env[t_out.id] = grads[t_x.id]
+
+    def make_gradients(self, loss: Tensor, xs: Sequence[Tensor]) -> List[Tensor]:
+        node = OpNode("gradients", None, [loss] + list(xs),
+                      {"loss": loss, "xs": list(xs)}, f"grad_{loss.name}")
+        outputs = []
+        for x in xs:
+            g = Tensor(x.shape, x.dtype, producer=node,
+                       name=f"grad_{x.name}", graph=self, is_grad=True)
+            if hasattr(x, "pspec"):
+                g.pspec = x.pspec
+            outputs.append(g)
+        node.outputs = outputs
+        self.ops.append(node)
+        return outputs
+
+    @property
+    def trainable_variables(self) -> List[Tensor]:
+        return [t for t in self._var_tensors.values() if t.trainable]
+
+
+class EagerGraph(Graph):
+    """Immediate execution (reference ``eager_graph.h:8``)."""
+
+    def _post_make_op(self, node: OpNode) -> None:
+        env: Dict[int, Any] = {}
+        for t in node.inputs:
+            env[t.id] = t.get_data() if t._data is not None else \
+                self.get_tensor_value(t) if t.id in self._var_tensors else None
+            if env[t.id] is None:
+                env[t.id] = self._eval_with_deps(t)
+        args = [env[t.id] for t in node.inputs]
+        attrs = {k: v for k, v in node.attrs.items() if not k.startswith("_")}
+        out = node.impl(*args, **attrs)
+        flat = jax.tree_util.tree_leaves(out)
+        for t, v in zip(node.outputs, flat):
+            t.set_data(v)
+
+    def _eval_with_deps(self, t: Tensor):
+        env = {}
+        for node in self._topo_from([t]):
+            for it in node.inputs:
+                if it._data is not None:
+                    env[it.id] = it._data
+            for vt_id in self._var_tensors:
+                env[vt_id] = self._materialize_var(self._var_tensors[vt_id])
+        (val,) = self._eval_targets([t], env)
+        return val
+
+    def get_tensor_value(self, t: Tensor):
+        if t._data is not None:
+            return t._data
+        return super().get_tensor_value(t)
+
+    def next_rng_tensor(self) -> Tensor:
+        # eager: a fresh concrete key every call
+        return self._lift_constant(self._fresh_rng_key())
+
+
+class DefineAndRunGraph(Graph):
+    """Symbolic graph with an executable-plan pool."""
+
+    def __init__(self, name: str = "define_and_run"):
+        super().__init__(name)
+        self._plan_pool: Dict[Tuple, Any] = {}
+
+    # -- plan construction ---------------------------------------------------
+
+    def _bind_symbolic_dims(self, feed_dict: Dict[Tensor, Any]) -> None:
+        for t, v in feed_dict.items():
+            v_shape = np.shape(v)
+            if len(v_shape) != len(t.shape):
+                raise ValueError(
+                    f"feed for {t.name} has rank {len(v_shape)}, "
+                    f"expected {len(t.shape)} ({t.shape})")
+            for dim, d in zip(t.shape, v_shape):
+                if isinstance(dim, SymbolicDim):
+                    dim.set(d)
+                elif int(dim) != d:
+                    raise ValueError(
+                        f"feed for {t.name} has shape {v_shape}, "
+                        f"expected {t.shape}")
+
+    def _plan_key(self, fetches, feed_dict, num_micro_batches, run_level,
+                  update_node):
+        feed_sig = tuple(sorted(
+            (t.id, tuple(np.shape(v)), str(np.asarray(v).dtype))
+            for t, v in feed_dict.items()))
+        fetch_sig = tuple(t.id for t in fetches)
+        return (self.cur_strategy_id, fetch_sig, feed_sig,
+                num_micro_batches, run_level,
+                update_node.id if update_node is not None else None)
+
+    def _split_micro_batches(self, feeds: Dict[int, Any], n: int):
+        """Split feed arrays along dim 0 into n micro-batches
+        (reference NDArray::split at executable_graph.cc:1828).
+        Scalars (0-d feeds) are replicated; the rng key feed is folded with
+        the micro-batch index so stochastic ops differ per micro-batch."""
+        rng_id = self._rng_tensor.id if self._rng_tensor is not None else None
+        if n == 1:
+            return [feeds]
+        out = []
+        for i in range(n):
+            mb = {}
+            for tid, v in feeds.items():
+                if tid == rng_id:
+                    mb[tid] = jax.random.fold_in(v, i)
+                    continue
+                if np.ndim(v) == 0:
+                    mb[tid] = v
+                    continue
+                b = v.shape[0]
+                assert b % n == 0, f"batch {b} not divisible by {n} micro-batches"
+                chunk = b // n
+                mb[tid] = v[i * chunk:(i + 1) * chunk]
+            out.append(mb)
+        return out
+
+    def _build_executable(self, fetches: List[Tensor],
+                          feed_tensors: List[Tensor],
+                          num_micro_batches: int,
+                          run_level: RunLevel,
+                          update_node: Optional[OpNode]):
+        """Trace the DAG into a pure jitted step function.
+
+        Signature: step(var_state, opt_state, grad_accum, feeds)
+                   -> (fetch_vals, new_var_state, new_opt_state, new_grad_accum)
+        var/opt/grad_accum are donated (device-resident, updated in place) —
+        the analogue of the reference's fused param/grad buffers
+        (executable_graph.h:292-303).
+        """
+        graph = self
+
+        def step(var_state, opt_state, grad_accum, feeds_mb):
+            # feeds_mb: list of per-micro-batch dicts
+            def fwd_bwd(mb_feeds):
+                env = {**var_state, **mb_feeds}
+                if update_node is not None:
+                    grad_node = update_node.attrs["grad_node"]
+                    xs = grad_node.attrs["xs"]
+                    loss_t = grad_node.attrs["loss"]
+
+                    def loss_fn(vv):
+                        inner = {**env, **vv}
+                        (lv,) = graph._eval_targets([loss_t], inner)
+                        return (jnp.sum(lv) if lv.ndim > 0 else lv)
+
+                    var_vals = {t.id: env[t.id] for t in xs}
+                    loss_val, grads = jax.value_and_grad(loss_fn)(var_vals)
+                    # evaluate non-loss fetches too
+                    other = [f for f in fetches if f.id != loss_t.id]
+                    other_vals = graph._eval_targets(other, env) if other else []
+                    fetch_vals = []
+                    oi = 0
+                    for f in fetches:
+                        if f.id == loss_t.id:
+                            fetch_vals.append(loss_val)
+                        else:
+                            fetch_vals.append(other_vals[oi])
+                            oi += 1
+                    return fetch_vals, grads
+                fetch_vals = graph._eval_targets(fetches, env)
+                return fetch_vals, None
+
+            if update_node is None:
+                all_fetches = [fwd_bwd(mb) for mb in feeds_mb]
+                fetch_vals = [vals for vals, _ in all_fetches]
+                # return last micro-batch fetches (stacked would change shape)
+                out = [jnp.mean(jnp.stack([fv[i] for fv in fetch_vals]), axis=0)
+                       if fetch_vals[0][i].ndim == 0
+                       else fetch_vals[-1][i]
+                       for i in range(len(fetches))]
+                return out, var_state, opt_state, grad_accum
+
+            # micro-batch loop with grad accumulation
+            # (reference ComputeFunc loop, executable_graph.cc:1424)
+            acc_grads = None
+            fetch_vals = None
+            for mb in feeds_mb:
+                fv, grads = fwd_bwd(mb)
+                if acc_grads is None:
+                    acc_grads = grads
+                    fetch_vals = fv
+                else:
+                    acc_grads = {k: acc_grads[k] + g for k, g in grads.items()}
+                    fetch_vals = [a + b if b.ndim == 0 else b
+                                  for a, b in zip(fetch_vals, fv)]
+            n = len(feeds_mb)
+            acc_grads = {k: g / n for k, g in acc_grads.items()}
+            fetch_vals = [v / n if v.ndim == 0 else v for v in fetch_vals]
+
+            # fold in persistent accumulation (RunLevel.GRAD across runs)
+            if grad_accum:
+                acc_grads = {k: acc_grads[k] + grad_accum.get(k, 0.0)
+                             for k in acc_grads}
+
+            if run_level == RunLevel.GRAD:
+                return fetch_vals, var_state, opt_state, acc_grads
+
+            # UPDATE: apply optimizer
+            opt = update_node.attrs["optimizer"]
+            new_vars, new_opt = opt._apply_updates(
+                var_state, opt_state, acc_grads, update_node.attrs["xs"])
+            new_accum = {k: jnp.zeros_like(v) for k, v in grad_accum.items()} \
+                if grad_accum else {}
+            return fetch_vals, new_vars, new_opt, new_accum
+
+        jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
+        return jit_step
+
+    # -- run ----------------------------------------------------------------
+
+    def run(self, loss_or_fetches, fetches=None, feed_dict=None,
+            num_micro_batches: int = 1, cur_strategy_id: int = 0,
+            run_level: Union[str, RunLevel, None] = None,
+            save_checkpoint: bool = False):
+        """Execute the graph (reference DefineAndRunGraph::Run,
+        define_and_run_graph.cc:912).
+
+        Accepts either ``run(fetches, feed_dict=...)`` or the reference's
+        ``run(loss, fetches, feed_dict, num_micro_batches, ...)`` signature.
+        """
+        if fetches is None:
+            fetches = loss_or_fetches
+        if not isinstance(fetches, (list, tuple)):
+            fetches = [fetches]
+        fetches = list(fetches)
+        feed_dict = dict(feed_dict or {})
+        if run_level is None:
+            run_level = _run_level_ctx._current  # ambient ht.run_level(...)
+        if isinstance(run_level, str):
+            run_level = RunLevel(run_level)
+        self.cur_strategy_id = cur_strategy_id
+
+        if run_level == RunLevel.TOPO:
+            return self._topo_from([f for f in fetches if isinstance(f, Tensor)])
+
+        self._bind_symbolic_dims(feed_dict)
+
+        # find update node among fetches (optimizer.minimize output);
+        # remember its positions so returned values align with fetches
+        update_node = None
+        real_fetches = []
+        update_positions = []
+        for i, f in enumerate(fetches):
+            if isinstance(f, Tensor) and f.producer is not None \
+                    and f.producer.op_type == "update":
+                update_node = f.producer
+                update_positions.append(i)
+            else:
+                real_fetches.append(f)
+        if run_level in (RunLevel.COMPUTE_ONLY, RunLevel.ALLOC):
+            update_node = None
+
+        # materialize variables (ALLOC)
+        for t in self._var_tensors.values():
+            self._materialize_var(t)
+        if run_level == RunLevel.ALLOC:
+            return []
+
+        key = self._plan_key(real_fetches, feed_dict, num_micro_batches,
+                             run_level, update_node)
+        if key not in self._plan_pool:
+            feed_tensors = list(feed_dict.keys())
+            self._plan_pool[key] = self._build_executable(
+                real_fetches, feed_tensors, num_micro_batches, run_level,
+                update_node)
+        jit_step = self._plan_pool[key]
+
+        feeds = {}
+        for t, v in feed_dict.items():
+            arr = jnp.asarray(v, dtype=t.dtype.to_jnp())
+            sharding = self._sharding_for(t)
+            if sharding is not None:
+                arr = jax.device_put(arr, sharding)
+            feeds[t.id] = arr
+        if self._rng_tensor is not None:
+            feeds[self._rng_tensor.id] = jnp.asarray(self._fresh_rng_key())
+        feeds_mb = self._split_micro_batches(feeds, num_micro_batches)
+
+        var_state = dict(self._var_data)
+        opt_state = {}
+        if update_node is not None:
+            opt = update_node.attrs["optimizer"]
+            opt_state = opt._ensure_state(var_state, update_node.attrs["xs"],
+                                          self)
+        grad_accum = dict(self._grad_accum)
+
+        fetch_vals, new_vars, new_opt, new_accum = jit_step(
+            var_state, opt_state, grad_accum, feeds_mb)
+
+        self._var_data = dict(new_vars)
+        if update_node is not None:
+            update_node.attrs["optimizer"]._store_state(new_opt)
+        self._grad_accum = dict(new_accum)
+        # restore fetch arity: update-op positions yield None
+        out = list(fetch_vals)
+        for i in update_positions:
+            out.insert(i, None)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# graph context management (python/hetu/__init__.py:124 ht.graph())
+# ---------------------------------------------------------------------------
+
+_graph_stack: List[Graph] = []
+_default_graphs: Dict[str, Graph] = {}
+
+
+def get_default_graph() -> Graph:
+    if _graph_stack:
+        return _graph_stack[-1]
+    if "eager" not in _default_graphs:
+        _default_graphs["eager"] = EagerGraph("default_eager")
+    return _default_graphs["eager"]
+
+
+class graph:
+    """``with ht.graph("define_and_run", num_strategy=N):`` context."""
+
+    def __init__(self, kind: Union[str, Graph] = "define_and_run",
+                 create_new: bool = False, prefix: str = "default",
+                 num_strategy: int = -1, mesh: Optional[Mesh] = None):
+        if isinstance(kind, Graph):
+            self.g = kind
+        else:
+            cache_key = f"{prefix}_{kind}"
+            if create_new or cache_key not in _default_graphs:
+                g = (DefineAndRunGraph(cache_key) if kind == "define_and_run"
+                     else EagerGraph(cache_key))
+                if create_new:
+                    self.g = g
+                else:
+                    _default_graphs[cache_key] = g
+                    self.g = g
+            else:
+                self.g = _default_graphs[cache_key]
+        if num_strategy >= 1:
+            self.g.set_num_strategy(num_strategy)
+        if mesh is not None:
+            self.g.mesh = mesh
+
+    def __enter__(self) -> Graph:
+        _graph_stack.append(self.g)
+        return self.g
+
+    def __exit__(self, *exc):
+        _graph_stack.pop()
+
+
+class run_level:
+    """Context setting the ambient run level (ht.run_level(...)); consulted
+    by ``DefineAndRunGraph.run`` when no explicit run_level is passed."""
+    _current = RunLevel.UPDATE
+
+    def __init__(self, level: Union[str, RunLevel]):
+        self.level = RunLevel(level) if isinstance(level, str) else level
+
+    def __enter__(self):
+        self.prev = run_level._current
+        run_level._current = self.level
+        return self
+
+    def __exit__(self, *exc):
+        run_level._current = self.prev
+
+
+_run_level_ctx = run_level

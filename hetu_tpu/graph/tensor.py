@@ -1,0 +1,235 @@
+"""Symbolic Tensor handles for the graph frontend.
+
+TPU-native analogue of the reference's ``TensorDef`` (``hetu/graph/tensor.h:20``):
+a graph-level handle carrying shape (possibly symbolic dims), dtype, producer
+op, a ``DistributedStatesHierarchy`` sharding annotation (``tensor.h:255``)
+and trainable/grad flags.  Unlike the reference there is no storage here —
+concrete values are ``jax.Array``s owned by the executing graph; under jit
+the Tensor is just a node id in the traced plan.
+
+Symbolic dims: the reference threads ``IntSymbol`` shapes through ops for
+variable sequence lengths (``hetu/core/symbol.h``).  XLA wants static shapes,
+so symbolic dims here are named placeholders resolved per shape-plan bucket
+(see ``DefineAndRunGraph.run``), mirroring Hetu's shape-plan pool.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import DataType, canonicalize_dtype
+from ..parallel.dstates import (DistributedStates, DistributedStatesHierarchy,
+                                DistributedStatesUnion)
+
+_tensor_ids = itertools.count()
+
+
+class SymbolicDim:
+    """A named symbolic dimension (reference IntSymbol, core/symbol.h).
+
+    Carries an optional current binding so eager execution works; under
+    define-and-run the binding comes from the feed shapes at run time.
+    """
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str, value: Optional[int] = None):
+        self.name = name
+        self._value = value
+
+    def set(self, value: int) -> None:
+        self._value = int(value)
+
+    def get(self) -> int:
+        if self._value is None:
+            raise ValueError(f"symbolic dim {self.name!r} is unbound")
+        return self._value
+
+    @property
+    def is_bound(self) -> bool:
+        return self._value is not None
+
+    def __repr__(self) -> str:
+        return f"Sym({self.name}={self._value})"
+
+
+DimLike = Union[int, SymbolicDim]
+
+
+def concrete_shape(shape: Sequence[DimLike]) -> Tuple[int, ...]:
+    return tuple(d.get() if isinstance(d, SymbolicDim) else int(d)
+                 for d in shape)
+
+
+def has_symbolic(shape: Sequence[DimLike]) -> bool:
+    return any(isinstance(d, SymbolicDim) for d in shape)
+
+
+class Tensor:
+    """Graph-level tensor handle."""
+
+    def __init__(self, shape: Sequence[DimLike], dtype: Any = None,
+                 producer: Optional["OpNode"] = None,
+                 name: str = "", graph: Optional[Any] = None,
+                 trainable: bool = False,
+                 requires_grad: bool = False,
+                 is_grad: bool = False):
+        self.id = next(_tensor_ids)
+        self.shape = tuple(shape)
+        self.dtype: DataType = canonicalize_dtype(dtype)
+        self.producer = producer
+        self.name = name or f"tensor_{self.id}"
+        self.graph = graph
+        self.trainable = trainable
+        self.requires_grad = requires_grad or trainable
+        self.is_grad = is_grad
+        self.ds_hierarchy: Optional[DistributedStatesHierarchy] = None
+        # set for variables/placeholders by the owning graph
+        self._data: Optional[jnp.ndarray] = None
+
+    # -- sharding annotation ------------------------------------------------
+
+    @property
+    def ds_union(self) -> Optional[DistributedStatesUnion]:
+        if self.ds_hierarchy is None or self.ds_hierarchy.size() == 0:
+            return None
+        g = self.graph
+        sid = getattr(g, "cur_strategy_id", 0) if g is not None else 0
+        sid = min(sid, self.ds_hierarchy.size() - 1)
+        return self.ds_hierarchy.get(sid)
+
+    @property
+    def distributed_states(self) -> Optional[DistributedStates]:
+        u = self.ds_union
+        return u.get_default_ds() if u is not None else None
+
+    def set_ds_hierarchy(self, ds_hierarchy) -> None:
+        if isinstance(ds_hierarchy, DistributedStatesHierarchy):
+            self.ds_hierarchy = ds_hierarchy
+        elif isinstance(ds_hierarchy, DistributedStatesUnion):
+            self.ds_hierarchy = DistributedStatesHierarchy([ds_hierarchy])
+        elif isinstance(ds_hierarchy, DistributedStates):
+            self.ds_hierarchy = DistributedStatesHierarchy(
+                [DistributedStatesUnion([ds_hierarchy])])
+        elif isinstance(ds_hierarchy, (list, tuple)):
+            unions = [u if isinstance(u, DistributedStatesUnion)
+                      else DistributedStatesUnion([u]) for u in ds_hierarchy]
+            self.ds_hierarchy = DistributedStatesHierarchy(unions)
+        else:
+            raise TypeError(f"bad ds annotation: {ds_hierarchy!r}")
+
+    # -- shape helpers ------------------------------------------------------
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def is_symbolic(self) -> bool:
+        return has_symbolic(self.shape)
+
+    def concrete_shape(self) -> Tuple[int, ...]:
+        return concrete_shape(self.shape)
+
+    def numel(self) -> int:
+        return int(np.prod(self.concrete_shape())) if self.shape else 1
+
+    @property
+    def global_shape(self) -> Tuple[int, ...]:
+        return self.concrete_shape()
+
+    def local_shape_for(self, device_index: int) -> Tuple[int, ...]:
+        ds = self.distributed_states
+        if ds is None:
+            return self.concrete_shape()
+        return ds.local_shape(self.concrete_shape())
+
+    # -- value access (eager / after run) -----------------------------------
+
+    def numpy(self) -> np.ndarray:
+        data = self.get_data()
+        return np.asarray(data)
+
+    def get_data(self):
+        if self._data is not None:
+            return self._data
+        if self.graph is not None:
+            return self.graph.get_tensor_value(self)
+        raise ValueError(f"{self.name} has no concrete value")
+
+    def set_data(self, value) -> None:
+        self._data = value
+
+    # -- operator overloads -> ops module -----------------------------------
+
+    def _ops(self):
+        from .. import ops
+        return ops
+
+    def __add__(self, other):
+        return self._ops().add(self, other)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._ops().sub(self, other)
+
+    def __rsub__(self, other):
+        return self._ops().sub(other, self)
+
+    def __mul__(self, other):
+        return self._ops().mul(self, other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._ops().div(self, other)
+
+    def __rtruediv__(self, other):
+        return self._ops().div(other, self)
+
+    def __neg__(self):
+        return self._ops().neg(self)
+
+    def __pow__(self, e):
+        return self._ops().pow(self, e)
+
+    def __matmul__(self, other):
+        return self._ops().matmul(self, other)
+
+    def __getitem__(self, idx):
+        return self._ops().getitem(self, idx)
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return self._ops().reshape(self, shape)
+
+    def transpose(self, *perm):
+        if len(perm) == 1 and isinstance(perm[0], (list, tuple)):
+            perm = tuple(perm[0])
+        return self._ops().transpose(self, perm or None)
+
+    def sum(self, axis=None, keepdims=False):
+        return self._ops().reduce_sum(self, axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._ops().reduce_mean(self, axis, keepdims)
+
+    def to(self, dtype):
+        return self._ops().cast(self, dtype)
+
+    def __repr__(self) -> str:
+        ds = self.distributed_states
+        dss = f", ds={ds}" if ds is not None else ""
+        return (f"Tensor(name={self.name!r}, shape={self.shape}, "
+                f"dtype={self.dtype.value}{dss})")
+
+    def __hash__(self):
+        return self.id
+
+    def __eq__(self, other):
+        return isinstance(other, Tensor) and other.id == self.id

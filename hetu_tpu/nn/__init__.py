@@ -1,0 +1,13 @@
+from .module import Module, Sequential, ModuleList, ModuleDict
+from .layers import (Linear, Embedding, LayerNorm, RMSNorm, BatchNorm2d,
+                     Conv2d, MaxPool2d, AvgPool2d, Dropout, Identity, ReLU,
+                     GeLU, GELU, SiLU, Tanh, Sigmoid, LeakyReLU, Softmax,
+                     NLLLoss, CrossEntropyLoss, MSELoss, BCELoss, KLDivLoss)
+
+__all__ = [
+    "Module", "Sequential", "ModuleList", "ModuleDict",
+    "Linear", "Embedding", "LayerNorm", "RMSNorm", "BatchNorm2d", "Conv2d",
+    "MaxPool2d", "AvgPool2d", "Dropout", "Identity", "ReLU", "GeLU", "GELU",
+    "SiLU", "Tanh", "Sigmoid", "LeakyReLU", "Softmax",
+    "NLLLoss", "CrossEntropyLoss", "MSELoss", "BCELoss", "KLDivLoss",
+]

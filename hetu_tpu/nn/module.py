@@ -1,0 +1,231 @@
+"""PyTorch-like Module system.
+
+Mirrors the reference's ``python/hetu/nn/modules/module.py`` (573 LoC
+Module with named params/buffers/state_dict and container types), built on
+our graph Tensors: parameters are trainable graph variables, forward builds
+symbolic ops (define-and-run) or executes immediately (eager).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+from ..graph.tensor import Tensor
+
+
+class Module:
+    def __init__(self):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_modules", OrderedDict())
+        object.__setattr__(self, "training", True)
+
+    # -- attribute routing ---------------------------------------------------
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if isinstance(value, Tensor) and value.trainable:
+            self._parameters[name] = value
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Module):
+            self._modules[name] = value
+            object.__setattr__(self, name, value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def register_parameter(self, name: str, param: Optional[Tensor]) -> None:
+        self._parameters[name] = param
+        object.__setattr__(self, name, param)
+
+    def register_buffer(self, name: str, buf) -> None:
+        self._buffers[name] = buf
+        object.__setattr__(self, name, buf)
+
+    def add_module(self, name: str, module: "Module") -> None:
+        self._modules[name] = module
+        object.__setattr__(self, name, module)
+
+    # -- iteration -----------------------------------------------------------
+
+    def named_parameters(self, prefix: str = "", recurse: bool = True
+                         ) -> Iterator[Tuple[str, Tensor]]:
+        for name, p in self._parameters.items():
+            if p is not None:
+                yield (f"{prefix}{name}", p)
+        if recurse:
+            for mname, m in self._modules.items():
+                if m is not None:
+                    yield from m.named_parameters(f"{prefix}{mname}.", True)
+
+    def parameters(self, recurse: bool = True) -> Iterator[Tensor]:
+        for _, p in self.named_parameters(recurse=recurse):
+            yield p
+
+    def named_modules(self, prefix: str = "") -> Iterator[Tuple[str, "Module"]]:
+        yield prefix.rstrip("."), self
+        for mname, m in self._modules.items():
+            if m is not None:
+                yield from m.named_modules(f"{prefix}{mname}.")
+
+    def modules(self) -> Iterator["Module"]:
+        for _, m in self.named_modules():
+            yield m
+
+    def named_buffers(self, prefix: str = "", recurse: bool = True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}{name}", b)
+        if recurse:
+            for mname, m in self._modules.items():
+                if m is not None:
+                    yield from m.named_buffers(f"{prefix}{mname}.", True)
+
+    # -- state dict ----------------------------------------------------------
+
+    def state_dict(self) -> "OrderedDict[str, np.ndarray]":
+        out = OrderedDict()
+        for name, p in self.named_parameters():
+            out[name] = p.numpy()
+        for name, b in self.named_buffers():
+            out[name] = np.asarray(b)
+        return out
+
+    def _set_buffer_by_path(self, path: str, value) -> bool:
+        parts = path.split(".")
+        mod = self
+        for p in parts[:-1]:
+            mod = mod._modules.get(p)
+            if mod is None:
+                return False
+        if parts[-1] in mod._buffers:
+            mod._buffers[parts[-1]] = np.asarray(value)
+            object.__setattr__(mod, parts[-1], mod._buffers[parts[-1]])
+            return True
+        return False
+
+    def load_state_dict(self, state: Dict[str, Any], strict: bool = True):
+        missing, loaded = [], set()
+        for name, p in self.named_parameters():
+            if name in state:
+                p.graph.reset_variable(p, state[name])
+                loaded.add(name)
+            elif strict:
+                missing.append(name)
+        for name, _ in self.named_buffers():
+            if name in state and self._set_buffer_by_path(name, state[name]):
+                loaded.add(name)
+            elif strict and name not in state:
+                missing.append(name)
+        unexpected = [k for k in state if k not in loaded]
+        if strict and (missing or unexpected):
+            raise KeyError(f"missing={missing} unexpected={unexpected}")
+        return missing, unexpected
+
+    # -- modes ---------------------------------------------------------------
+
+    def train(self, mode: bool = True) -> "Module":
+        object.__setattr__(self, "training", mode)
+        for m in self._modules.values():
+            if m is not None:
+                m.train(mode)
+        return self
+
+    def eval(self) -> "Module":
+        return self.train(False)
+
+    def apply(self, fn: Callable[["Module"], None]) -> "Module":
+        for m in self._modules.values():
+            if m is not None:
+                m.apply(fn)
+        fn(self)
+        return self
+
+    # -- call ----------------------------------------------------------------
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        lines = [f"{type(self).__name__}({self.extra_repr()}"]
+        for name, m in self._modules.items():
+            sub = repr(m).split("\n")
+            lines.append(f"  ({name}): " + "\n  ".join(sub))
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else \
+            f"{type(self).__name__}({self.extra_repr()})"
+
+
+class Sequential(Module):
+    def __init__(self, *modules: Module):
+        super().__init__()
+        if len(modules) == 1 and isinstance(modules[0], OrderedDict):
+            for name, m in modules[0].items():
+                self.add_module(name, m)
+        else:
+            for i, m in enumerate(modules):
+                self.add_module(str(i), m)
+
+    def forward(self, x):
+        for m in self._modules.values():
+            x = m(x)
+        return x
+
+    def __iter__(self):
+        return iter(self._modules.values())
+
+    def __len__(self):
+        return len(self._modules)
+
+    def __getitem__(self, idx: int):
+        return list(self._modules.values())[idx]
+
+
+class ModuleList(Module):
+    def __init__(self, modules=()):
+        super().__init__()
+        for i, m in enumerate(modules):
+            self.add_module(str(i), m)
+
+    def append(self, module: Module) -> "ModuleList":
+        self.add_module(str(len(self._modules)), module)
+        return self
+
+    def __iter__(self):
+        return iter(self._modules.values())
+
+    def __len__(self):
+        return len(self._modules)
+
+    def __getitem__(self, idx):
+        items = list(self._modules.values())
+        return items[idx]
+
+
+class ModuleDict(Module):
+    def __init__(self, modules: Optional[Dict[str, Module]] = None):
+        super().__init__()
+        if modules:
+            for name, m in modules.items():
+                self.add_module(name, m)
+
+    def __getitem__(self, key: str) -> Module:
+        return self._modules[key]
+
+    def __setitem__(self, key: str, module: Module) -> None:
+        self.add_module(key, module)
+
+    def keys(self):
+        return self._modules.keys()
+
+    def items(self):
+        return self._modules.items()
+
+    def values(self):
+        return self._modules.values()

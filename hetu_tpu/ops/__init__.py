@@ -1,0 +1,5 @@
+from .attention import sdpa, sdpa_reference
+from .functional import *  # noqa: F401,F403
+# NB: importing the .attention submodule binds `ops.attention` to the module;
+# rebind the op function explicitly (it must win).
+from .functional import attention  # noqa: F401

@@ -1,0 +1,84 @@
+"""Attention kernels: jnp reference path + Pallas flash dispatch.
+
+Reference: ``hetu/graph/ops/Attention.cc`` (wrapping vendored flash-attn2
+CUDA, varlen via cu_seqlens at ``impl/kernel/FlashAttention.cu:48-56``).
+On TPU the flash kernel is Pallas (``hetu_tpu/ops/pallas/flash_attention.py``);
+on CPU/simulation we use the jnp path (XLA fuses it adequately for tests).
+
+Layout convention follows the reference: [batch, seq, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def sdpa_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = True,
+                   softmax_scale: Optional[float] = None,
+                   bias: Optional[jax.Array] = None,
+                   segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Plain scaled-dot-product attention, numerically standard.
+
+    ``segment_ids`` ([batch, seq] int) implements packed/varlen attention —
+    tokens attend only within their segment, the TPU-native equivalent of
+    the reference's cu_seqlens varlen path (ops/Attention.h:286,371).
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(d)
+    # [b, h, sq, sk]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias
+    mask = None
+    if causal:
+        qi = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        ki = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        mask = (ki <= qi + (sk - sq))
+    if segment_ids is not None:
+        seg_mask = (segment_ids[:, :, None] == segment_ids[:, None, :])
+        seg_mask = seg_mask[:, None, :, :]
+        mask = seg_mask if mask is None else (mask[None, None] & seg_mask)
+    if mask is not None:
+        if mask.ndim == 2:
+            mask = mask[None, None]
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)  # fully-masked rows
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out
+
+
+def sdpa(q, k, v, causal: bool = True, softmax_scale: Optional[float] = None,
+         bias: Optional[jax.Array] = None,
+         segment_ids: Optional[jax.Array] = None,
+         use_flash: Optional[bool] = None) -> jax.Array:
+    """Dispatching attention entry point."""
+    if use_flash is None:
+        use_flash = _on_tpu()
+    if use_flash:
+        try:
+            from .pallas.flash_attention import flash_attention
+            if bias is None:
+                return flash_attention(q, k, v, causal=causal,
+                                       softmax_scale=softmax_scale,
+                                       segment_ids=segment_ids)
+        except Exception:
+            pass
+    return sdpa_reference(q, k, v, causal=causal,
+                          softmax_scale=softmax_scale, bias=bias,
+                          segment_ids=segment_ids)

@@ -1,0 +1,3 @@
+from .optimizer import Optimizer, SGDOptimizer, AdamOptimizer, SGD, Adam, AdamW
+
+__all__ = ["Optimizer", "SGDOptimizer", "AdamOptimizer", "SGD", "Adam", "AdamW"]

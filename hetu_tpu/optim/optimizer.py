@@ -1,0 +1,174 @@
+"""Optimizers.
+
+Reference: ``hetu/graph/optim/optimizer.h:9-100`` (SGD w/ momentum, Adam,
+``Minimize = ComputeGradients + ApplyDense``, ``MakeStates`` per-param
+optimizer-state variables, multi-zero awareness) and the Python wrappers
+(``python/hetu/optim/optimizer.py:43``).
+
+``minimize(loss)`` builds a symbolic update node executed by
+``DefineAndRunGraph.run``; under jit the whole fwd+bwd+update is one XLA
+program with donated parameter/state buffers (the analogue of the
+reference's fused param/grad buffers + fused Optimizers.cu kernels).
+ZeRO: when a parameter's DS carries the ``zero`` flag, optimizer states are
+sharded over the dup axis via GSPMD sharding annotations.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..graph.graph import DefineAndRunGraph, Graph, OpNode, get_default_graph
+from ..graph.tensor import Tensor
+
+
+class Optimizer:
+    def __init__(self, params: Optional[Sequence[Tensor]] = None,
+                 lr: float = 0.01):
+        self.lr = lr
+        self.params = list(params) if params is not None else None
+        self._state: Dict[str, Any] = {}
+
+    # -- graph API (reference Optimizer::Minimize) ---------------------------
+
+    def minimize(self, loss: Tensor,
+                 var_list: Optional[Sequence[Tensor]] = None) -> Tensor:
+        g = loss.graph or get_default_graph()
+        xs = list(var_list or self.params or g.trainable_variables)
+        assert xs, "no trainable variables to optimize"
+        grad_node_outputs = g.make_gradients(loss, xs)
+        grad_node = grad_node_outputs[0].producer
+        node = OpNode("update", None, grad_node_outputs,
+                      {"optimizer": self, "grad_node": grad_node, "xs": xs},
+                      f"update_{loss.name}")
+        t = Tensor((), "float32", producer=node, name=node.name, graph=g)
+        node.outputs = [t]
+        g.ops.append(node)
+        return t
+
+    # -- state management (reference MakeStates) -----------------------------
+
+    def _ensure_state(self, var_state: Dict[int, jax.Array],
+                      xs: Sequence[Tensor], graph: Graph) -> Dict[str, Any]:
+        if not self._state:
+            self._state = self._init_state(var_state, xs)
+            # shard optimizer states like their params (ZeRO handled by
+            # param ds; GSPMD propagates)
+            for key, tree in self._state.items():
+                if isinstance(tree, dict):
+                    for tid, arr in tree.items():
+                        t = next((x for x in xs if x.id == tid), None)
+                        if t is None:
+                            continue
+                        sharding = graph._sharding_for(t)
+                        if sharding is not None and hasattr(arr, "shape") \
+                                and arr.shape == var_state[tid].shape:
+                            tree[tid] = jax.device_put(arr, sharding)
+        return self._state
+
+    def _store_state(self, state: Dict[str, Any]) -> None:
+        self._state = dict(state)
+
+    def _init_state(self, var_state, xs) -> Dict[str, Any]:
+        return {}
+
+    def _apply_updates(self, var_state: Dict[int, jax.Array],
+                       opt_state: Dict[str, Any],
+                       grads: Dict[int, jax.Array],
+                       xs: Sequence[Tensor]):
+        raise NotImplementedError
+
+    # -- eager API (torch-style step) ----------------------------------------
+
+    def step(self, grads: Dict[int, jax.Array]) -> None:
+        assert self.params is not None, "eager step needs params list"
+        g = self.params[0].graph
+        var_state = {p.id: g.get_tensor_value(p) for p in self.params}
+        opt_state = self._ensure_state(var_state, self.params, g)
+        new_vars, new_opt = self._apply_updates(var_state, opt_state, grads,
+                                                self.params)
+        for p in self.params:
+            g._var_data[p.id] = new_vars[p.id]
+        self._store_state(new_opt)
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, params=None, lr: float = 0.01, momentum: float = 0.0,
+                 nesterov: bool = False):
+        super().__init__(params, lr)
+        self.momentum = momentum
+        self.nesterov = nesterov
+
+    def _init_state(self, var_state, xs):
+        if self.momentum == 0.0:
+            return {"_dummy": jnp.zeros(())}
+        return {"velocity": {t.id: jnp.zeros_like(var_state[t.id])
+                             for t in xs}}
+
+    def _apply_updates(self, var_state, opt_state, grads, xs):
+        new_vars = dict(var_state)
+        new_opt = dict(opt_state)
+        if self.momentum == 0.0:
+            for t in xs:
+                g = grads[t.id].astype(var_state[t.id].dtype)
+                new_vars[t.id] = var_state[t.id] - self.lr * g
+            return new_vars, new_opt
+        vel = dict(opt_state["velocity"])
+        for t in xs:
+            g = grads[t.id].astype(var_state[t.id].dtype)
+            v = self.momentum * vel[t.id] + g
+            vel[t.id] = v
+            upd = g + self.momentum * v if self.nesterov else v
+            new_vars[t.id] = var_state[t.id] - self.lr * upd
+        new_opt["velocity"] = vel
+        return new_vars, new_opt
+
+
+class AdamOptimizer(Optimizer):
+    """Adam/AdamW (reference AdamOptimizer, optimizer.h:60; fused kernel
+    impl/kernel/Optimizers.cu).  States kept in fp32 regardless of param
+    dtype (mixed-precision master states)."""
+
+    def __init__(self, params=None, lr: float = 1e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8,
+                 weight_decay: float = 0.0):
+        super().__init__(params, lr)
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self.weight_decay = weight_decay
+
+    def _init_state(self, var_state, xs):
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "m": {t.id: jnp.zeros(var_state[t.id].shape, jnp.float32)
+                  for t in xs},
+            "v": {t.id: jnp.zeros(var_state[t.id].shape, jnp.float32)
+                  for t in xs},
+        }
+
+    def _apply_updates(self, var_state, opt_state, grads, xs):
+        new_vars = dict(var_state)
+        step = opt_state["step"] + 1
+        m = dict(opt_state["m"])
+        v = dict(opt_state["v"])
+        b1, b2 = self.beta1, self.beta2
+        bc1 = 1.0 - b1 ** step.astype(jnp.float32)
+        bc2 = 1.0 - b2 ** step.astype(jnp.float32)
+        for t in xs:
+            g = grads[t.id].astype(jnp.float32)
+            p = var_state[t.id]
+            if self.weight_decay:
+                g = g + self.weight_decay * p.astype(jnp.float32)
+            m[t.id] = b1 * m[t.id] + (1 - b1) * g
+            v[t.id] = b2 * v[t.id] + (1 - b2) * (g * g)
+            m_hat = m[t.id] / bc1
+            v_hat = v[t.id] / bc2
+            upd = self.lr * m_hat / (jnp.sqrt(v_hat) + self.eps)
+            new_vars[t.id] = (p.astype(jnp.float32) - upd).astype(p.dtype)
+        return new_vars, {"step": step, "m": m, "v": v}
+
+
+# torch-style aliases
+SGD = SGDOptimizer
+Adam = AdamOptimizer
+AdamW = AdamOptimizer

@@ -1,0 +1,259 @@
+"""Define-and-run graph + training-loop tests (reference tests/test_model.py,
+test_simple_model.py pattern: loss must decrease; optimizer parity vs torch).
+"""
+import numpy as np
+import pytest
+import torch
+
+import hetu_tpu as ht
+from hetu_tpu import nn, ops, optim
+
+
+def _make_data(seed=0, n=32, d=8, classes=4):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    Y = rng.randint(0, classes, (n,))
+    return X, Y
+
+
+class TestEager:
+    def test_eager_module(self):
+        with ht.graph("eager", create_new=True):
+            lin = nn.Linear(4, 2)
+            x = np.ones((3, 4), np.float32)
+            y = lin(x)
+            w = lin.weight.numpy()
+            b = lin.bias.numpy()
+            np.testing.assert_allclose(y.numpy(), x @ w.T + b, rtol=1e-5)
+
+
+class TestDefineAndRun:
+    def test_training_loss_decreases(self):
+        X, Y = _make_data()
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (32, 8), name="x")
+            y = ht.placeholder("int32", (32,), name="y")
+            model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(),
+                                  nn.Linear(32, 4))
+            loss = ops.softmax_cross_entropy(model(x), y)
+            train_op = optim.AdamOptimizer(lr=0.03).minimize(loss)
+            losses = [float(g.run(loss, [loss, train_op], {x: X, y: Y})[0])
+                      for _ in range(25)]
+        assert losses[-1] < losses[0] * 0.5, losses
+
+    def test_micro_batches_match_full_batch(self):
+        """num_micro_batches grad accumulation == one big batch (SGD)."""
+        X, Y = _make_data(n=16)
+        results = {}
+        for nmb in (1, 4):
+            with ht.graph("define_and_run", create_new=True) as g:
+                np.random.seed(42)
+                x = ht.placeholder("float32", (16, 8), name="x")
+                y = ht.placeholder("int32", (16,), name="y")
+                w = ht.parameter(np.full((4, 8), 0.1, np.float32), name="w")
+                logits = ops.matmul(x, w, trans_b=True)
+                loss = ops.softmax_cross_entropy(logits, y)
+                train_op = optim.SGDOptimizer(lr=0.1).minimize(loss)
+                for _ in range(3):
+                    g.run(loss, [loss, train_op], {x: X, y: Y},
+                          num_micro_batches=nmb)
+                results[nmb] = np.asarray(g.get_tensor_value(w))
+        np.testing.assert_allclose(results[1], results[4], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_run_level_grad_then_update(self):
+        """RunLevel.GRAD accumulates without updating; UPDATE flushes
+        (reference graph.h:29-35 run levels)."""
+        X, Y = _make_data(n=16)
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (16, 8), name="x")
+            y = ht.placeholder("int32", (16,), name="y")
+            w = ht.parameter(np.full((4, 8), 0.1, np.float32), name="w")
+            loss = ops.softmax_cross_entropy(ops.matmul(x, w, trans_b=True), y)
+            train_op = optim.SGDOptimizer(lr=0.1).minimize(loss)
+            w0 = np.asarray(g.get_tensor_value(w)).copy()
+            g.run(loss, [loss, train_op], {x: X, y: Y}, run_level="grad")
+            w1 = np.asarray(g.get_tensor_value(w))
+            np.testing.assert_array_equal(w0, w1)  # no update yet
+            g.run(loss, [loss, train_op], {x: X, y: Y}, run_level="update")
+            w2 = np.asarray(g.get_tensor_value(w))
+            assert not np.allclose(w0, w2)
+
+    def test_plan_pool_caching(self):
+        X, Y = _make_data(n=8)
+        batch = ht.SymbolicDim("batch")
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (batch, 8), name="x")
+            w = ht.parameter(np.eye(8, dtype=np.float32), name="w")
+            out = ops.matmul(x, w)
+            g.run([out], feed_dict={x: X})
+            assert len(g._plan_pool) == 1
+            g.run([out], feed_dict={x: X})
+            assert len(g._plan_pool) == 1  # same plan reused
+            g.run([out], feed_dict={x: X[:4]})  # different shape -> new plan
+            assert len(g._plan_pool) == 2
+
+    def test_feed_shape_mismatch_raises(self):
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (8, 4), name="x")
+            out = ops.reduce_sum(x)
+            with pytest.raises(ValueError, match="expected"):
+                g.run([out], feed_dict={x: np.ones((8, 5), np.float32)})
+
+    def test_symbolic_seq_len(self):
+        """Symbolic dims bound from feeds (reference IntSymbol shape plans)."""
+        sym = ht.SymbolicDim("seq")
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (2, sym, 4), name="x")
+            out = ops.reduce_sum(x, axis=1)
+            for s in (3, 7):
+                X = np.ones((2, s, 4), np.float32)
+                (val,) = g.run([out], feed_dict={x: X})
+                np.testing.assert_allclose(np.asarray(val),
+                                           np.full((2, 4), float(s)))
+        assert len(g._plan_pool) == 2
+
+
+class TestOptimizerParity:
+    def _run_hetu(self, opt_fn, steps=5):
+        X, Y = _make_data(n=16)
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (16, 8), name="x")
+            y = ht.placeholder("int32", (16,), name="y")
+            w = ht.parameter(np.full((4, 8), 0.05, np.float32), name="w")
+            loss = ops.softmax_cross_entropy(ops.matmul(x, w, trans_b=True), y)
+            train_op = opt_fn().minimize(loss)
+            for _ in range(steps):
+                g.run(loss, [loss, train_op], {x: X, y: Y})
+            return np.asarray(g.get_tensor_value(w))
+
+    def _run_torch(self, opt_fn, steps=5):
+        X, Y = _make_data(n=16)
+        w = torch.full((4, 8), 0.05, requires_grad=True)
+        opt = opt_fn([w])
+        for _ in range(steps):
+            opt.zero_grad()
+            loss = torch.nn.functional.cross_entropy(
+                torch.tensor(X) @ w.T, torch.tensor(Y))
+            loss.backward()
+            opt.step()
+        return w.detach().numpy()
+
+    def test_sgd_matches_torch(self):
+        ours = self._run_hetu(lambda: optim.SGDOptimizer(lr=0.1))
+        ref = self._run_torch(lambda p: torch.optim.SGD(p, lr=0.1))
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_sgd_momentum_matches_torch(self):
+        ours = self._run_hetu(lambda: optim.SGDOptimizer(lr=0.1, momentum=0.9))
+        ref = self._run_torch(lambda p: torch.optim.SGD(p, lr=0.1, momentum=0.9))
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_adam_matches_torch(self):
+        ours = self._run_hetu(lambda: optim.AdamOptimizer(lr=0.01))
+        ref = self._run_torch(lambda p: torch.optim.Adam(p, lr=0.01))
+        np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-4)
+
+
+class TestModule:
+    def test_named_parameters_and_state_dict(self):
+        with ht.graph("define_and_run", create_new=True) as g:
+            class Net(nn.Module):
+                def __init__(self):
+                    super().__init__()
+                    self.fc1 = nn.Linear(4, 8)
+                    self.fc2 = nn.Linear(8, 2)
+
+                def forward(self, x):
+                    return self.fc2(ops.relu(self.fc1(x)))
+
+            net = Net()
+            names = dict(net.named_parameters()).keys()
+            assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight",
+                                  "fc2.bias"}
+            sd = net.state_dict()
+            assert sd["fc1.weight"].shape == (8, 4)
+            sd2 = {k: np.zeros_like(v) for k, v in sd.items()}
+            net.load_state_dict(sd2)
+            assert np.all(net.state_dict()["fc1.weight"] == 0)
+
+    def test_train_eval_mode(self):
+        with ht.graph("eager", create_new=True):
+            m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.9))
+            m.eval()
+            x = np.ones((2, 4), np.float32)
+            y1 = m(x).numpy()
+            y2 = m(x).numpy()
+            np.testing.assert_array_equal(y1, y2)  # dropout off in eval
+
+
+class TestReviewRegressions:
+    """Regressions from code-review findings on the M1 frontend."""
+
+    def test_eval_then_train_plan_no_collision(self):
+        X, Y = _make_data(n=8, d=4, classes=2)
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (32, 8), name="x")
+            y = ht.placeholder("int32", (32,), name="y")
+            w = ht.parameter(np.full((4, 8), 0.1, np.float32), name="w")
+            loss = ops.softmax_cross_entropy(ops.matmul(x, w, trans_b=True), y)
+            op = optim.SGDOptimizer(lr=0.5).minimize(loss)
+            X, Y = _make_data(n=32)
+            g.run([loss], feed_dict={x: X, y: Y})  # eval plan first
+            w0 = np.asarray(g.get_tensor_value(w)).copy()
+            g.run(loss, [loss, op], {x: X, y: Y})  # train plan, same shapes
+            w1 = np.asarray(g.get_tensor_value(w))
+            assert not np.allclose(w0, w1), "train run silently did nothing"
+            g.run([loss], feed_dict={x: X, y: Y})  # eval again
+            np.testing.assert_array_equal(
+                w1, np.asarray(g.get_tensor_value(w)))
+
+    def test_dropout_masks_vary(self):
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (4, 64), name="x")
+            d1 = ops.dropout(x, 0.5, training=True)
+            d2 = ops.dropout(x, 0.5, training=True)
+            X = np.ones((4, 64), np.float32)
+            a1, a2 = g.run([d1, d2], feed_dict={x: X})
+            b1, _ = g.run([d1, d2], feed_dict={x: X})
+        assert not np.allclose(np.asarray(a1), np.asarray(a2)), \
+            "identical masks across layers"
+        assert not np.allclose(np.asarray(a1), np.asarray(b1)), \
+            "identical masks across steps"
+
+    def test_batchnorm_running_stats(self):
+        with ht.graph("eager", create_new=True):
+            bn = nn.BatchNorm2d(3)
+            x = (np.random.RandomState(0).randn(4, 3, 5, 5) * 2 + 1).astype(
+                np.float32)
+            bn(x)
+            assert not np.allclose(bn.running_mean, 0)
+            sd = bn.state_dict()
+        with ht.graph("eager", create_new=True):
+            bn2 = nn.BatchNorm2d(3)
+            bn2.load_state_dict(sd)  # buffers restored too
+            np.testing.assert_allclose(bn2.running_mean, bn.running_mean)
+            bn2.eval()
+            out = bn2(x).numpy()
+            # eval-mode output uses running stats, not batch stats
+            mean = np.asarray(sd["running_mean"]).reshape(1, 3, 1, 1)
+            var = np.asarray(sd["running_var"]).reshape(1, 3, 1, 1)
+            ref = (x - mean) / np.sqrt(var + 1e-5)
+            np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_topk_axis(self):
+        x = np.random.RandomState(0).randn(5, 3).astype(np.float32)
+        vals, idx = ops.topk(x, 2, axis=0)
+        ref = np.sort(x, axis=0)[::-1][:2]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+    def test_scalar_feed_with_micro_batches(self):
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (8, 4), name="x")
+            s = ht.placeholder("float32", (), name="scale")
+            w = ht.parameter(np.ones((4, 2), np.float32), name="w")
+            loss = ops.reduce_sum(ops.matmul(x, w)) * s
+            op = optim.SGDOptimizer(lr=0.01).minimize(loss)
+            g.run(loss, [loss, op],
+                  {x: np.ones((8, 4), np.float32), s: np.float32(2.0)},
+                  num_micro_batches=4)

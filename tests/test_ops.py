@@ -1,0 +1,275 @@
+"""Op parity tests vs torch/numpy oracles.
+
+Mirrors the reference's test pattern (``tests/test_ops.py:1-60``): build a
+small graph, compute forward + backward, ``np.allclose`` against torch.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import hetu_tpu as ht
+from hetu_tpu import ops
+
+RTOL, ATOL = 1e-4, 1e-5
+
+
+def _np(x):
+    return x.numpy() if hasattr(x, "numpy") else np.asarray(x)
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("op,top", [
+        (ops.add, torch.add), (ops.sub, torch.sub), (ops.mul, torch.mul),
+        (ops.div, torch.div), (ops.maximum, torch.maximum),
+        (ops.minimum, torch.minimum),
+    ])
+    def test_binary(self, op, top):
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 5).astype(np.float32)
+        b = rng.rand(4, 5).astype(np.float32) + 0.5
+        np.testing.assert_allclose(
+            _np(op(a, b)), top(torch.tensor(a), torch.tensor(b)).numpy(),
+            rtol=RTOL, atol=ATOL)
+
+    def test_broadcast(self):
+        a = np.random.randn(4, 5).astype(np.float32)
+        b = np.random.randn(5).astype(np.float32)
+        np.testing.assert_allclose(_np(ops.add(a, b)), a + b, rtol=RTOL)
+
+    @pytest.mark.parametrize("op,top", [
+        (ops.exp, torch.exp), (ops.tanh, torch.tanh),
+        (ops.sigmoid, torch.sigmoid), (ops.relu, torch.relu),
+        (ops.abs, torch.abs), (ops.neg, torch.neg),
+    ])
+    def test_unary(self, op, top):
+        a = np.random.RandomState(1).randn(3, 7).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(op(a)), top(torch.tensor(a)).numpy(), rtol=RTOL, atol=ATOL)
+
+    def test_gelu(self):
+        a = np.random.RandomState(2).randn(3, 7).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(ops.gelu(a)), F.gelu(torch.tensor(a), approximate="tanh").numpy(),
+            rtol=1e-3, atol=1e-4)
+
+    def test_silu_swiglu(self):
+        a = np.random.RandomState(3).randn(2, 8).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(ops.silu(a)), F.silu(torch.tensor(a)).numpy(), rtol=RTOL,
+            atol=ATOL)
+        x1, x2 = np.split(a, 2, axis=-1)
+        np.testing.assert_allclose(
+            _np(ops.swiglu(a)), F.silu(torch.tensor(x1)).numpy() * x2,
+            rtol=RTOL, atol=ATOL)
+
+
+class TestMatmul:
+    def test_matmul_variants(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 6).astype(np.float32)
+        b = rng.randn(6, 3).astype(np.float32)
+        np.testing.assert_allclose(_np(ops.matmul(a, b)), a @ b, rtol=RTOL,
+                                   atol=1e-4)
+        np.testing.assert_allclose(_np(ops.matmul(a.T, b, trans_a=True)),
+                                   a @ b, rtol=RTOL, atol=1e-4)
+        np.testing.assert_allclose(_np(ops.matmul(a, b.T, trans_b=True)),
+                                   a @ b, rtol=RTOL, atol=1e-4)
+
+    def test_linear(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(5, 8).astype(np.float32)
+        w = rng.randn(3, 8).astype(np.float32)
+        b = rng.randn(3).astype(np.float32)
+        np.testing.assert_allclose(_np(ops.linear(x, w, b)), x @ w.T + b,
+                                   rtol=RTOL, atol=1e-4)
+
+    def test_batch_matmul(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(2, 4, 6).astype(np.float32)
+        b = rng.randn(2, 6, 3).astype(np.float32)
+        np.testing.assert_allclose(_np(ops.matmul(a, b)), a @ b, rtol=RTOL,
+                                   atol=1e-4)
+
+    def test_einsum(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(2, 3, 4).astype(np.float32)
+        b = rng.randn(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(ops.einsum("bij,bjk->bik", a, b)),
+            np.einsum("bij,bjk->bik", a, b), rtol=RTOL, atol=1e-4)
+
+
+class TestNorms:
+    def test_layer_norm(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 16).astype(np.float32)
+        w = rng.rand(16).astype(np.float32)
+        b = rng.randn(16).astype(np.float32)
+        ref = F.layer_norm(torch.tensor(x), (16,), torch.tensor(w),
+                           torch.tensor(b)).numpy()
+        np.testing.assert_allclose(_np(ops.layer_norm(x, w, b)), ref,
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_rms_norm(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 16).astype(np.float32)
+        w = rng.rand(16).astype(np.float32)
+        ref = F.rms_norm(torch.tensor(x), (16,), torch.tensor(w),
+                         eps=1e-6).numpy()
+        np.testing.assert_allclose(_np(ops.rms_norm(x, w)), ref, rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_softmax(self):
+        x = np.random.RandomState(0).randn(3, 9).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(ops.softmax(x)), F.softmax(torch.tensor(x), -1).numpy(),
+            rtol=RTOL, atol=ATOL)
+
+
+class TestLosses:
+    def test_softmax_ce_sparse(self):
+        rng = np.random.RandomState(0)
+        logits = rng.randn(6, 10).astype(np.float32)
+        target = rng.randint(0, 10, (6,))
+        ref = F.cross_entropy(torch.tensor(logits),
+                              torch.tensor(target)).numpy()
+        np.testing.assert_allclose(
+            _np(ops.softmax_cross_entropy(logits, target)), ref, rtol=1e-4,
+            atol=1e-5)
+
+    def test_softmax_ce_ignore_index(self):
+        rng = np.random.RandomState(0)
+        logits = rng.randn(6, 10).astype(np.float32)
+        target = rng.randint(0, 10, (6,))
+        target[2] = -1
+        ref = F.cross_entropy(torch.tensor(logits), torch.tensor(target),
+                              ignore_index=-1).numpy()
+        np.testing.assert_allclose(
+            _np(ops.softmax_cross_entropy(logits, target, ignore_index=-1)),
+            ref, rtol=1e-4, atol=1e-5)
+
+    def test_mse_bce(self):
+        rng = np.random.RandomState(0)
+        p = rng.rand(5, 3).astype(np.float32)
+        t = rng.rand(5, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(ops.mse_loss(p, t)),
+            F.mse_loss(torch.tensor(p), torch.tensor(t)).numpy(), rtol=RTOL)
+        np.testing.assert_allclose(
+            _np(ops.binary_cross_entropy(p, t)),
+            F.binary_cross_entropy(torch.tensor(p), torch.tensor(t)).numpy(),
+            rtol=1e-3, atol=1e-4)
+
+    def test_nll(self):
+        rng = np.random.RandomState(0)
+        logits = rng.randn(6, 10).astype(np.float32)
+        lp = _np(ops.log_softmax(logits))
+        target = rng.randint(0, 10, (6,))
+        ref = F.nll_loss(torch.tensor(lp), torch.tensor(target)).numpy()
+        np.testing.assert_allclose(_np(ops.nll_loss(lp, target)), ref,
+                                   rtol=RTOL, atol=ATOL)
+
+
+class TestConvPool:
+    def test_conv2d(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32)
+        b = rng.randn(4).astype(np.float32)
+        ref = F.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                       stride=1, padding=1).numpy()
+        np.testing.assert_allclose(_np(ops.conv2d(x, w, b, 1, 1)), ref,
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_max_pool(self):
+        x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+        ref = F.max_pool2d(torch.tensor(x), 2, 2).numpy()
+        np.testing.assert_allclose(_np(ops.max_pool(x, 2, 2)), ref, rtol=RTOL)
+
+    def test_avg_pool(self):
+        x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+        ref = F.avg_pool2d(torch.tensor(x), 2, 2).numpy()
+        np.testing.assert_allclose(_np(ops.avg_pool(x, 2, 2)), ref, rtol=RTOL,
+                                   atol=ATOL)
+
+
+class TestShapes:
+    def test_reshape_transpose_concat_split(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        np.testing.assert_array_equal(_np(ops.reshape(x, (6, 4))),
+                                      x.reshape(6, 4))
+        np.testing.assert_array_equal(_np(ops.transpose(x, (1, 0, 2))),
+                                      x.transpose(1, 0, 2))
+        np.testing.assert_array_equal(_np(ops.concat([x, x], axis=1)),
+                                      np.concatenate([x, x], 1))
+        parts = ops.split(x, 2, axis=2)
+        np.testing.assert_array_equal(_np(parts[0]), x[:, :, :2])
+        np.testing.assert_array_equal(_np(parts[1]), x[:, :, 2:])
+
+    def test_embedding(self):
+        rng = np.random.RandomState(0)
+        table = rng.randn(10, 4).astype(np.float32)
+        ids = np.array([[1, 3], [7, 0]])
+        np.testing.assert_array_equal(_np(ops.embedding_lookup(table, ids)),
+                                      table[ids])
+
+    def test_triu_pad(self):
+        x = np.ones((4, 4), np.float32)
+        np.testing.assert_array_equal(_np(ops.triu(x)), np.triu(x))
+        np.testing.assert_array_equal(
+            _np(ops.pad(x, [(1, 1), (0, 0)])),
+            np.pad(x, [(1, 1), (0, 0)]))
+
+
+class TestAttention:
+    def test_sdpa_vs_torch(self):
+        rng = np.random.RandomState(0)
+        b, s, h, d = 2, 16, 4, 8
+        q = rng.randn(b, s, h, d).astype(np.float32)
+        k = rng.randn(b, s, h, d).astype(np.float32)
+        v = rng.randn(b, s, h, d).astype(np.float32)
+        # torch expects [b, h, s, d]
+        tq, tk, tv = (torch.tensor(x.transpose(0, 2, 1, 3))
+                      for x in (q, k, v))
+        ref = F.scaled_dot_product_attention(tq, tk, tv, is_causal=True)
+        ref = ref.numpy().transpose(0, 2, 1, 3)
+        out = _np(ops.attention(q, k, v, causal=True, use_flash=False))
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    def test_rotary(self):
+        rng = np.random.RandomState(0)
+        s, h, d = 8, 2, 16
+        x = rng.randn(1, s, h, d).astype(np.float32)
+        inv = 1.0 / (10000 ** (np.arange(0, d, 2) / d))
+        ang = np.outer(np.arange(s), inv)
+        cos = np.cos(np.concatenate([ang, ang], -1))[None, :, None, :]
+        sin = np.sin(np.concatenate([ang, ang], -1))[None, :, None, :]
+        out = _np(ops.rotary_embed(x, cos.astype(np.float32),
+                                   sin.astype(np.float32)))
+        # oracle: rotate_half convention (HF/llama)
+        x1, x2 = x[..., :d // 2], x[..., d // 2:]
+        rot = np.concatenate([-x2, x1], -1)
+        np.testing.assert_allclose(out, x * cos + rot * sin, rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestGradients:
+    def test_matmul_grad_vs_torch(self):
+        rng = np.random.RandomState(0)
+        a_np = rng.randn(4, 6).astype(np.float32)
+        b_np = rng.randn(6, 3).astype(np.float32)
+        with ht.graph("define_and_run", create_new=True) as g:
+            a = ht.parameter(a_np, name="a")
+            b = ht.parameter(b_np, name="b")
+            loss = ops.reduce_sum(ops.mul(ops.matmul(a, b), ops.matmul(a, b)))
+            grads = ht.gradients(loss, [a, b])
+            ga, gb = g.run([grads[0], grads[1]])
+        ta = torch.tensor(a_np, requires_grad=True)
+        tb = torch.tensor(b_np, requires_grad=True)
+        tl = ((ta @ tb) ** 2).sum()
+        tl.backward()
+        np.testing.assert_allclose(np.asarray(ga), ta.grad.numpy(), rtol=1e-3,
+                                   atol=1e-3)
+        np.testing.assert_allclose(np.asarray(gb), tb.grad.numpy(), rtol=1e-3,
+                                   atol=1e-3)
