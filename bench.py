@@ -1,0 +1,111 @@
+"""Benchmark: GPT-2 training throughput on the available accelerator.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Metric is GPT-2 (124M-class) training tokens/sec/chip (BASELINE.json north
+star).  vs_baseline reports measured MFU relative to the 40%-MFU target
+(1.0 == 40% MFU), since the reference repo publishes no raw numbers
+(BASELINE.md).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak FLOP/s for the local accelerator generation."""
+    import jax
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    return 197e12  # conservative default (also used for CPU smoke runs)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import hetu_tpu as ht
+    from hetu_tpu import optim
+    from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # GPT-2 small-class config; trimmed when benching on CPU fallback.
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=1024, sp=False,
+                        dtype="bfloat16", position="learned",
+                        activation="gelu", norm="layernorm")
+        batch, seq, steps, warmup = 8, 1024, 10, 3
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                        num_heads=8, max_seq_len=256, sp=False,
+                        dtype="float32")
+        batch, seq, steps, warmup = 4, 256, 5, 2
+
+    with ht.graph("define_and_run", create_new=True) as g:
+        ids = ht.placeholder("int32", (batch, seq), name="input_ids")
+        labels = ht.placeholder("int32", (batch, seq), name="labels")
+        model = GPTLMHeadModel(cfg)
+        loss = model(ids, labels, seq_len=seq)
+        train_op = optim.AdamOptimizer(lr=1e-4, weight_decay=0.01).minimize(loss)
+
+        rng = np.random.RandomState(0)
+        IDS = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        L = np.roll(IDS, -1, axis=1)
+
+        def _sync():
+            # block_until_ready can be a no-op under remote-relay PJRT
+            # backends; force a real host fetch of one element of every
+            # updated tensor class: a param (waits for the optimizer update)
+            arrs = list(g._var_data.values())
+            for arr in (arrs[0], arrs[-1]):
+                np.asarray(arr.ravel()[0])
+
+        for _ in range(warmup):
+            g.run(loss, [loss, train_op], {ids: IDS, labels: L})
+            _sync()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            g.run(loss, [loss, train_op], {ids: IDS, labels: L})
+        _sync()
+        dt = (time.perf_counter() - t0) / steps
+
+    n_params = sum(
+        int(np.prod(t.concrete_shape())) for t in g._var_tensors.values())
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step / dt
+    n_chips = 1  # bench runs single-chip
+    tps_per_chip = tokens_per_sec / n_chips
+    # 6*N flops/token (fwd+bwd)
+    flops_per_sec = 6.0 * n_params * tokens_per_sec
+    mfu = flops_per_sec / peak_flops_per_chip()
+    result = {
+        "metric": "gpt2_tokens_per_sec_per_chip",
+        "value": round(tps_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {
+            "step_time_s": round(dt, 4),
+            "mfu": round(mfu, 4),
+            "params": n_params,
+            "platform": jax.devices()[0].platform,
+            "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+            "batch": batch, "seq": seq,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

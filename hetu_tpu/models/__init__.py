@@ -1,0 +1,5 @@
+from .gpt import (GPTConfig, GPTModel, GPTLMHeadModel, llama_config,
+                  LLamaLMHeadModel, LLamaModel)
+
+__all__ = ["GPTConfig", "GPTModel", "GPTLMHeadModel", "llama_config",
+           "LLamaLMHeadModel", "LLamaModel"]

@@ -1,0 +1,276 @@
+"""GPT-2 / LLaMA model family on parallel layers.
+
+TPU-native re-expression of the reference's canonical LLM workloads
+(``examples/gpt/hetu_llama.py``, ``python/elastic/models/gpt/gpt_model.py``):
+transformer blocks built from column/row-parallel linears, vocab-parallel
+embedding + CE, parallel norms with SP, rotary or learned positions, and
+flash attention (Pallas on TPU).  DP/TP/SP shardings are PartitionSpec
+annotations over a named mesh; CP (ring attention) is a planned M4 module
+that will replace ``ops.attention`` here when a ``cp`` mesh axis is active.
+
+Config mirrors the reference's argparse surface (examples/gpt/train_hetu.py
+:479-588): hidden/layers/heads/seq/vocab, activation/norm variants.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .. import ops
+from ..graph.ctor import NormalInitializer, parallel_parameter
+from ..nn import (ColumnParallelLinear, Dropout, Module, ModuleList,
+                  ParallelLayerNorm, ParallelRMSNorm, RowParallelLinear,
+                  VocabParallelEmbedding, vocab_parallel_cross_entropy)
+from ..nn.parallel import sharded
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None      # GQA; None -> = num_heads
+    ffn_hidden_size: Optional[int] = None   # None -> 4h (gelu) or 8h/3 (swiglu)
+    max_seq_len: int = 1024
+    activation: str = "gelu"                # gelu (GPT) | swiglu (LLaMA)
+    norm: str = "layernorm"                 # layernorm (GPT) | rmsnorm (LLaMA)
+    position: str = "learned"               # learned (GPT) | rotary (LLaMA)
+    dropout: float = 0.0
+    sp: bool = True                         # Megatron sequence parallel
+    tie_embeddings: bool = False
+    init_std: float = 0.02
+    dtype: str = "float32"
+    dp_axis: str = "dp"
+    tp_axis: str = "tp"
+
+    def __post_init__(self):
+        assert self.hidden_size % self.num_heads == 0, \
+            f"hidden {self.hidden_size} not divisible by heads {self.num_heads}"
+        kv = self.num_kv_heads or self.num_heads
+        assert self.num_heads % kv == 0, \
+            f"num_heads {self.num_heads} not divisible by kv_heads {kv}"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def ffn_size(self) -> int:
+        if self.ffn_hidden_size:
+            return self.ffn_hidden_size
+        if self.activation == "swiglu":
+            return int(8 * self.hidden_size / 3 / 64) * 64 or 64
+        return 4 * self.hidden_size
+
+
+def llama_config(**kw) -> GPTConfig:
+    kw.setdefault("activation", "swiglu")
+    kw.setdefault("norm", "rmsnorm")
+    kw.setdefault("position", "rotary")
+    return GPTConfig(**kw)
+
+
+def _norm(config: GPTConfig, name: str):
+    if config.norm == "rmsnorm":
+        return ParallelRMSNorm(config.hidden_size, sp=config.sp,
+                               dp_axis=config.dp_axis, tp_axis=config.tp_axis,
+                               dtype=config.dtype, name=name)
+    return ParallelLayerNorm(config.hidden_size, sp=config.sp,
+                             dp_axis=config.dp_axis, tp_axis=config.tp_axis,
+                             dtype=config.dtype, name=name)
+
+
+class ParallelAttentionBlock(Module):
+    """Self-attention with TP head split (reference ParallelAttention op +
+    qkv column-parallel / out row-parallel layout)."""
+
+    def __init__(self, config: GPTConfig, layer_idx: int = 0):
+        super().__init__()
+        self.config = config
+        c = config
+        q_size = c.num_heads * c.head_dim
+        kv_size = c.kv_heads * c.head_dim
+        self.qkv = ColumnParallelLinear(
+            c.hidden_size, q_size + 2 * kv_size, bias=(c.activation == "gelu"),
+            dp_axis=c.dp_axis, tp_axis=c.tp_axis, dtype=c.dtype,
+            init=NormalInitializer(0.0, c.init_std),
+            name=f"h{layer_idx}.attn.qkv")
+        self.out = RowParallelLinear(
+            q_size, c.hidden_size, bias=(c.activation == "gelu"), sp=c.sp,
+            dp_axis=c.dp_axis, tp_axis=c.tp_axis, dtype=c.dtype,
+            init=NormalInitializer(0.0, c.init_std / math.sqrt(2 * c.num_layers)),
+            name=f"h{layer_idx}.attn.out")
+        self.dropout = Dropout(c.dropout) if c.dropout else None
+        self._rotary_cache = {}
+
+    def _rotary(self, seq_len: int):
+        if seq_len not in self._rotary_cache:
+            d = self.config.head_dim
+            inv = 1.0 / (10000.0 ** (np.arange(0, d, 2, dtype=np.float32) / d))
+            ang = np.outer(np.arange(seq_len, dtype=np.float32), inv)
+            emb = np.concatenate([ang, ang], axis=-1)
+            cos = np.cos(emb)[None, :, None, :].astype(np.float32)
+            sin = np.sin(emb)[None, :, None, :].astype(np.float32)
+            self._rotary_cache[seq_len] = (cos, sin)
+        return self._rotary_cache[seq_len]
+
+    def forward(self, x, seq_len: int):
+        c = self.config
+        qkv = self.qkv(x)  # [b, s, (nh + 2*nkv) * hd], tp-sharded on last dim
+        b_spec = P(c.dp_axis, None, c.tp_axis, None)
+        q_size = c.num_heads * c.head_dim
+        kv_size = c.kv_heads * c.head_dim
+        q = ops.getitem(qkv, (Ellipsis, slice(0, q_size)))
+        k = ops.getitem(qkv, (Ellipsis, slice(q_size, q_size + kv_size)))
+        v = ops.getitem(qkv, (Ellipsis, slice(q_size + kv_size, None)))
+        q = sharded(q.reshape((-1, seq_len, c.num_heads, c.head_dim)), b_spec)
+        k = k.reshape((-1, seq_len, c.kv_heads, c.head_dim))
+        v = v.reshape((-1, seq_len, c.kv_heads, c.head_dim))
+        if c.position == "rotary":
+            cos, sin = self._rotary(seq_len)
+            q = ops.rotary_embed(q, cos, sin)
+            k = ops.rotary_embed(k, cos, sin)
+        if c.kv_heads != c.num_heads:
+            # repeat BEFORE constraining: kv_heads may be < tp size, and a
+            # head-dim constraint there forces SPMD full rematerialization
+            k = ops.repeat_kv(k, c.num_heads // c.kv_heads)
+            v = ops.repeat_kv(v, c.num_heads // c.kv_heads)
+        k = sharded(k, b_spec)
+        v = sharded(v, b_spec)
+        attn = ops.attention(q, k, v, causal=True)
+        attn = sharded(attn, b_spec)
+        attn = attn.reshape((-1, seq_len, q_size))
+        attn = sharded(attn, P(c.dp_axis, None, c.tp_axis))
+        out = self.out(attn)
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class ParallelMLP(Module):
+    def __init__(self, config: GPTConfig, layer_idx: int = 0):
+        super().__init__()
+        c = config
+        mult = 2 if c.activation == "swiglu" else 1
+        self.up = ColumnParallelLinear(
+            c.hidden_size, c.ffn_size * mult, bias=(c.activation == "gelu"),
+            dp_axis=c.dp_axis, tp_axis=c.tp_axis, dtype=c.dtype,
+            init=NormalInitializer(0.0, c.init_std),
+            name=f"h{layer_idx}.mlp.up")
+        self.down = RowParallelLinear(
+            c.ffn_size, c.hidden_size, bias=(c.activation == "gelu"), sp=c.sp,
+            dp_axis=c.dp_axis, tp_axis=c.tp_axis, dtype=c.dtype,
+            init=NormalInitializer(0.0, c.init_std / math.sqrt(2 * c.num_layers)),
+            name=f"h{layer_idx}.mlp.down")
+        self.activation = c.activation
+        self.dropout = Dropout(c.dropout) if c.dropout else None
+
+    def forward(self, x):
+        h = self.up(x)
+        h = ops.swiglu(h) if self.activation == "swiglu" else ops.gelu(h)
+        out = self.down(h)
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class GPTBlock(Module):
+    def __init__(self, config: GPTConfig, layer_idx: int):
+        super().__init__()
+        self.ln_1 = _norm(config, f"h{layer_idx}.ln_1")
+        self.attn = ParallelAttentionBlock(config, layer_idx)
+        self.ln_2 = _norm(config, f"h{layer_idx}.ln_2")
+        self.mlp = ParallelMLP(config, layer_idx)
+
+    def forward(self, x, seq_len: int):
+        x = x + self.attn(self.ln_1(x), seq_len)
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTModel(Module):
+    """Backbone: embeddings + blocks + final norm
+    (reference LLamaModel, examples/gpt/hetu_llama.py)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        self.wte = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, dp_axis=c.dp_axis, tp_axis=c.tp_axis,
+            dtype=c.dtype, init=NormalInitializer(0.0, c.init_std), name="wte")
+        if c.position == "learned":
+            self.wpe = parallel_parameter(
+                NormalInitializer(0.0, c.init_std),
+                (c.max_seq_len, c.hidden_size), pspec=P(), dtype=c.dtype,
+                name="wpe")
+        self.drop = Dropout(c.dropout) if c.dropout else None
+        self.h = ModuleList([GPTBlock(c, i) for i in range(c.num_layers)])
+        self.ln_f = _norm(config, "ln_f")
+
+    def forward(self, input_ids, seq_len: Optional[int] = None):
+        c = self.config
+        if seq_len is None:
+            seq_len = input_ids.shape[-1]
+            if hasattr(seq_len, "get"):
+                seq_len = seq_len.get()
+        x = self.wte(input_ids)
+        if c.position == "learned":
+            pos = ops.getitem(self.wpe, slice(0, seq_len))
+            x = x + pos
+        if self.drop is not None:
+            x = self.drop(x)
+        for block in self.h:
+            x = block(x, seq_len)
+        return self.ln_f(x)
+
+
+class GPTLMHeadModel(Module):
+    """LM head + vocab-parallel CE loss (reference LLamaLMHeadModel)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        self.transformer = GPTModel(config)
+        if c.tie_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(
+                c.hidden_size, c.vocab_size, bias=False,
+                dp_axis=c.dp_axis, tp_axis=c.tp_axis, dtype=c.dtype,
+                init=NormalInitializer(0.0, c.init_std), name="lm_head")
+
+    def logits(self, input_ids, seq_len: Optional[int] = None):
+        c = self.config
+        x = self.transformer(input_ids, seq_len)
+        if self.lm_head is None:
+            logits = ops.matmul(x, self.transformer.wte.weight, trans_b=True)
+            logits = sharded(logits, P(c.dp_axis, None, c.tp_axis))
+        else:
+            logits = self.lm_head(x)
+        return logits
+
+    def forward(self, input_ids, labels=None, seq_len: Optional[int] = None):
+        c = self.config
+        logits = self.logits(input_ids, seq_len)
+        if labels is None:
+            return logits
+        loss = vocab_parallel_cross_entropy(
+            logits, labels, dp_axis=c.dp_axis, tp_axis=c.tp_axis,
+            ignore_index=-100)
+        return loss
+
+
+# Reference-compatible aliases
+LLamaLMHeadModel = GPTLMHeadModel
+LLamaModel = GPTModel

@@ -3,6 +3,18 @@ from .layers import (Linear, Embedding, LayerNorm, RMSNorm, BatchNorm2d,
                      Conv2d, MaxPool2d, AvgPool2d, Dropout, Identity, ReLU,
                      GeLU, GELU, SiLU, Tanh, Sigmoid, LeakyReLU, Softmax,
                      NLLLoss, CrossEntropyLoss, MSELoss, BCELoss, KLDivLoss)
+from .parallel import (ColumnParallelLinear, RowParallelLinear,
+                       ParallelEmbedding, VocabParallelEmbedding,
+                       ParallelLayerNorm, ParallelRMSNorm,
+                       vocab_parallel_cross_entropy, parallel_data_provider,
+                       config2ds, sharded)
+# Reference-compatible aliases (parallel_multi_ds.py exports)
+HtMultiColumnParallelLinear = ColumnParallelLinear
+HtMultiRowParallelLinear = RowParallelLinear
+HtMultiParallelEmbedding = ParallelEmbedding
+HtMultiVocabParallelEmbedding = VocabParallelEmbedding
+HtMultiParallelLayerNorm = ParallelLayerNorm
+HtMultiParallelRMSNorm = ParallelRMSNorm
 
 __all__ = [
     "Module", "Sequential", "ModuleList", "ModuleDict",
@@ -10,4 +22,11 @@ __all__ = [
     "MaxPool2d", "AvgPool2d", "Dropout", "Identity", "ReLU", "GeLU", "GELU",
     "SiLU", "Tanh", "Sigmoid", "LeakyReLU", "Softmax",
     "NLLLoss", "CrossEntropyLoss", "MSELoss", "BCELoss", "KLDivLoss",
+    "ColumnParallelLinear", "RowParallelLinear", "ParallelEmbedding",
+    "VocabParallelEmbedding", "ParallelLayerNorm", "ParallelRMSNorm",
+    "vocab_parallel_cross_entropy", "parallel_data_provider", "config2ds",
+    "sharded",
+    "HtMultiColumnParallelLinear", "HtMultiRowParallelLinear",
+    "HtMultiParallelEmbedding", "HtMultiVocabParallelEmbedding",
+    "HtMultiParallelLayerNorm", "HtMultiParallelRMSNorm",
 ]

@@ -1,0 +1,282 @@
+"""Model-parallel layers (TP / SP / vocab-parallel).
+
+TPU-native re-expression of the reference's ds-annotation-driven parallel
+modules (``python/hetu/nn/modules/parallel_multi_ds.py:7-14``:
+HtMultiColumnParallelLinear / HtMultiRowParallelLinear /
+HtMultiParallelEmbedding / HtMultiVocabParallelEmbedding /
+HtMultiParallelLayerNorm / HtMultiParallelRMSNorm).
+
+Instead of DistributedStates + deduced NCCL collectives, layers annotate
+parameters and activations with ``PartitionSpec``s over a named mesh
+(axes ``dp``/``tp``/...); GSPMD inserts the collectives the reference's
+``SubstituteCommOp`` would (allreduce after row-parallel matmul, allgather
+at SP boundaries, masked-gather+psum for vocab-parallel lookup/CE).
+The DS spec remains available per layer (``.ds()``) for parity with the
+reference's JSON ``ds_parallel_config`` IR (see :func:`config2ds`).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .. import ops
+from ..graph.ctor import (ConstantInitializer, HeUniformInitializer,
+                          Initializer, NormalInitializer, UniformInitializer,
+                          XavierNormalInitializer, parallel_parameter)
+from ..parallel.dstates import (DUPLICATE, NULL_HETERO_DIM, DistributedStates,
+                                DistributedStatesUnion)
+from .module import Module
+
+
+def sharded(t, pspec):
+    """Annotate an activation with a sharding constraint.
+
+    Returns a NEW tensor (identity op) carrying the annotation, so other
+    consumers of ``t`` keep their own layout — annotating in place would
+    silently reshard every consumer.
+    """
+    out = ops.functional._op("sharding_constraint", lambda x: x, [t])
+    out.pspec = pspec
+    return out
+
+
+class ColumnParallelLinear(Module):
+    """Y = X W^T, W [out, in] split along out across ``tp_axis``.
+
+    Output stays split on the feature dim (gather=False) or is gathered
+    (gather=True), mirroring the reference's gather_output flag.
+    With ``sp=True`` the input is expected sequence-sharded over tp and
+    GSPMD folds the allgather into the matmul (Megatron-SP).
+    """
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True,
+                 gather_output: bool = False, dp_axis: str = "dp",
+                 tp_axis: str = "tp", dtype=None, init: Optional[Initializer] = None,
+                 name: str = "colp"):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.gather_output = gather_output
+        self.dp_axis, self.tp_axis = dp_axis, tp_axis
+        self.weight = parallel_parameter(
+            init or XavierNormalInitializer(), (out_features, in_features),
+            pspec=P(tp_axis, None), dtype=dtype, name=f"{name}.weight")
+        if bias:
+            self.bias = parallel_parameter(
+                ConstantInitializer(0.0), (out_features,), pspec=P(tp_axis),
+                dtype=dtype, name=f"{name}.bias")
+        else:
+            self.register_parameter("bias", None)
+
+    def ds(self, num_devices: int, tp: int) -> DistributedStates:
+        return DistributedStates(num_devices,
+                                 {0: tp, DUPLICATE: num_devices // tp},
+                                 order=[-1, 0])
+
+    def forward(self, x):
+        out = ops.linear(x, self.weight, self.bias, trans_b=True)
+        spec = [self.dp_axis] + [None] * (out.ndim - 2)
+        spec.append(None if self.gather_output else self.tp_axis)
+        return sharded(out, P(*spec))
+
+
+class RowParallelLinear(Module):
+    """Y = X W^T, W [out, in] split along in; input feature-sharded; the
+    partial(-2) output is reduced (psum) by GSPMD — or reduce-scattered to
+    sequence shards when ``sp=True`` (Megatron-SP)."""
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True,
+                 sp: bool = False, dp_axis: str = "dp", tp_axis: str = "tp",
+                 dtype=None, init: Optional[Initializer] = None,
+                 name: str = "rowp"):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.sp = sp
+        self.dp_axis, self.tp_axis = dp_axis, tp_axis
+        self.weight = parallel_parameter(
+            init or XavierNormalInitializer(), (out_features, in_features),
+            pspec=P(None, tp_axis), dtype=dtype, name=f"{name}.weight")
+        if bias:
+            # bias is applied after the reduction -> replicated over tp
+            self.bias = parallel_parameter(
+                ConstantInitializer(0.0), (out_features,), pspec=P(),
+                dtype=dtype, name=f"{name}.bias")
+        else:
+            self.register_parameter("bias", None)
+
+    def ds(self, num_devices: int, tp: int) -> DistributedStates:
+        return DistributedStates(num_devices,
+                                 {1: tp, DUPLICATE: num_devices // tp},
+                                 order=[-1, 1])
+
+    def forward(self, x):
+        # constrain input to feature-sharded so the matmul contracts the
+        # sharded dim (partial result) and GSPMD places the psum here
+        in_spec = [self.dp_axis] + [None] * (x.ndim - 2) + [self.tp_axis]
+        x = sharded(x, P(*in_spec))
+        out = ops.linear(x, self.weight, None, trans_b=True)
+        if self.sp:
+            # reduce-scatter onto sequence shards (dim 1 of [b, s, h])
+            out_spec = [self.dp_axis, self.tp_axis] + [None] * (out.ndim - 2)
+        else:
+            out_spec = [self.dp_axis] + [None] * (out.ndim - 1)
+        out = sharded(out, P(*out_spec))
+        if self.bias is not None:
+            out = sharded(out + self.bias, P(*out_spec))
+        return out
+
+
+class ParallelEmbedding(Module):
+    """Embedding split along the hidden dim (reference
+    HtMultiParallelEmbedding)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 dp_axis: str = "dp", tp_axis: str = "tp", dtype=None,
+                 init: Optional[Initializer] = None, name: str = "embed"):
+        super().__init__()
+        self.num_embeddings, self.embedding_dim = num_embeddings, embedding_dim
+        self.dp_axis, self.tp_axis = dp_axis, tp_axis
+        self.weight = parallel_parameter(
+            init or NormalInitializer(0.0, 0.02),
+            (num_embeddings, embedding_dim), pspec=P(None, tp_axis),
+            dtype=dtype, name=f"{name}.weight")
+
+    def forward(self, ids):
+        out = ops.embedding_lookup(self.weight, ids)
+        spec = [self.dp_axis] + [None] * (out.ndim - 2) + [self.tp_axis]
+        return sharded(out, P(*spec))
+
+
+class VocabParallelEmbedding(Module):
+    """Embedding split along the vocab dim (reference
+    HtMultiVocabParallelEmbedding): each shard holds a vocab range; GSPMD
+    lowers the lookup to masked local gather + psum over tp."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 dp_axis: str = "dp", tp_axis: str = "tp", dtype=None,
+                 init: Optional[Initializer] = None, name: str = "vocab_embed"):
+        super().__init__()
+        self.num_embeddings, self.embedding_dim = num_embeddings, embedding_dim
+        self.dp_axis, self.tp_axis = dp_axis, tp_axis
+        self.weight = parallel_parameter(
+            init or NormalInitializer(0.0, 0.02),
+            (num_embeddings, embedding_dim), pspec=P(tp_axis, None),
+            dtype=dtype, name=f"{name}.weight")
+
+    def ds(self, num_devices: int, tp: int) -> DistributedStates:
+        return DistributedStates(num_devices,
+                                 {0: tp, DUPLICATE: num_devices // tp},
+                                 order=[-1, 0])
+
+    def forward(self, ids):
+        out = ops.embedding_lookup(self.weight, ids)
+        spec = [self.dp_axis] + [None] * (out.ndim - 1)
+        return sharded(out, P(*spec))
+
+
+class ParallelLayerNorm(Module):
+    """LayerNorm with sequence-parallel support (reference
+    HtMultiParallelLayerNorm with ``sp`` flag, parallel_multi_ds.py:156-170):
+    with sp=True activations stay sequence-sharded across the TP group."""
+
+    def __init__(self, normalized_shape, sp: bool = False,
+                 dp_axis: str = "dp", tp_axis: str = "tp", eps: float = 1e-5,
+                 dtype=None, name: str = "ln"):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.sp, self.eps = sp, eps
+        self.dp_axis, self.tp_axis = dp_axis, tp_axis
+        self.weight = parallel_parameter(ConstantInitializer(1.0),
+                                         tuple(normalized_shape), pspec=P(),
+                                         dtype=dtype, name=f"{name}.weight")
+        self.bias = parallel_parameter(ConstantInitializer(0.0),
+                                       tuple(normalized_shape), pspec=P(),
+                                       dtype=dtype, name=f"{name}.bias")
+
+    def forward(self, x):
+        out = ops.layer_norm(x, self.weight, self.bias, self.eps)
+        if self.sp and out.ndim >= 2:
+            spec = [self.dp_axis, self.tp_axis] + [None] * (out.ndim - 2)
+            return sharded(out, P(*spec))
+        return out
+
+
+class ParallelRMSNorm(Module):
+    """RMSNorm with sequence-parallel support (HtMultiParallelRMSNorm)."""
+
+    def __init__(self, dim: int, sp: bool = False, dp_axis: str = "dp",
+                 tp_axis: str = "tp", eps: float = 1e-6, dtype=None,
+                 name: str = "rmsnorm"):
+        super().__init__()
+        self.sp, self.eps = sp, eps
+        self.dp_axis, self.tp_axis = dp_axis, tp_axis
+        self.weight = parallel_parameter(ConstantInitializer(1.0), (dim,),
+                                         pspec=P(), dtype=dtype,
+                                         name=f"{name}.weight")
+
+    def forward(self, x):
+        out = ops.rms_norm(x, self.weight, self.eps)
+        if self.sp and out.ndim >= 2:
+            spec = [self.dp_axis, self.tp_axis] + [None] * (out.ndim - 2)
+            return sharded(out, P(*spec))
+        return out
+
+
+def vocab_parallel_cross_entropy(logits, target, dp_axis: str = "dp",
+                                 tp_axis: str = "tp", reduction: str = "mean",
+                                 ignore_index: Optional[int] = None):
+    """CE over vocab-sharded logits (reference
+    ops/VocabParallelCrossEntropyLoss.cc): keep logits sharded on the vocab
+    dim through the log-softmax so the max/sum reductions become psums over
+    tp instead of materializing the full vocab."""
+    spec = [dp_axis] + [None] * (logits.ndim - 2) + [tp_axis]
+    logits = sharded(logits, P(*spec))
+    loss = ops.softmax_cross_entropy(logits, target, reduction=reduction,
+                                     ignore_index=ignore_index)
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# host-side data slicing + JSON ds config IR (reference config2ds)
+# ---------------------------------------------------------------------------
+
+def parallel_data_provider(global_data: np.ndarray, ds: DistributedStates,
+                           device_index: int) -> np.ndarray:
+    """Slice the local shard of a global host array
+    (reference parallel_data_provider, parallel_multi_ds.py:16)."""
+    return global_data[ds.local_slice(global_data.shape, device_index)]
+
+
+def config2ds(config: Dict) -> Tuple[DistributedStatesUnion, List[List[int]]]:
+    """Parse one reference-style JSON ds config entry into a DS union +
+    device-id groups (reference config2ds, parallel_multi_ds.py:88-122).
+
+    Keys: ``type`` (placeholder|variable), ``split`` {dim: [per-union counts]},
+    ``dup`` [counts], ``device_group_union`` [[ids...]], ``zero``.
+    """
+    ds_list, dg_list = [], []
+    if config["type"] == "placeholder":
+        hetero_dim = 0
+    elif config["type"] == "variable":
+        hetero_dim = -1
+    else:
+        raise ValueError(f"unsupported type {config['type']!r}")
+    hetero_sum = len(config["device_group_union"])
+    if hetero_sum == 1:
+        hetero_dim = NULL_HETERO_DIM
+    for i in range(hetero_sum):
+        num_devices = len(config["device_group_union"][i]) * hetero_sum
+        split = {int(k): v[i] for k, v in config.get("split", {}).items()}
+        states = {DUPLICATE: config["dup"][i], **split}
+        zero = False
+        if config["type"] == "placeholder":
+            order = sorted(split.keys()) + [-1]
+        else:
+            order = [-1] + sorted(split.keys())
+            zero = bool(config.get("zero", False))
+        ds_list.append(DistributedStates(num_devices, states, order, zero))
+        dg_list.append(list(config["device_group_union"][i]))
+    return DistributedStatesUnion(ds_list, hetero_dim), dg_list

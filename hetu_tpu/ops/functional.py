@@ -540,6 +540,17 @@ def dropout(x, p=0.5, training=True, rng_key=None):
                {"p": p, "salt": _dropout_salt[0]})
 
 
+def repeat_kv(x, n_rep: int):
+    """Repeat KV heads for GQA: [b, s, kv_heads, d] -> [b, s, kv_heads*n_rep, d]."""
+    if n_rep == 1:
+        return x
+    def _impl(x, n=1):
+        b, s, h, d = x.shape
+        return jnp.broadcast_to(x[:, :, :, None, :],
+                                (b, s, h, n, d)).reshape(b, s, h * n, d)
+    return _op("repeat_kv", _impl, [x], {"n": n_rep})
+
+
 # ---------------------------------------------------------------------------
 # rotary embedding (impl/kernel/Rotary.cu)
 # ---------------------------------------------------------------------------

@@ -1,3 +1,5 @@
-from .optimizer import Optimizer, SGDOptimizer, AdamOptimizer, SGD, Adam, AdamW
+from .optimizer import (Optimizer, SGDOptimizer, AdamOptimizer,
+                        AdamWOptimizer, SGD, Adam, AdamW)
 
-__all__ = ["Optimizer", "SGDOptimizer", "AdamOptimizer", "SGD", "Adam", "AdamW"]
+__all__ = ["Optimizer", "SGDOptimizer", "AdamOptimizer", "AdamWOptimizer",
+           "SGD", "Adam", "AdamW"]

@@ -25,10 +25,13 @@ from ..graph.tensor import Tensor
 
 class Optimizer:
     def __init__(self, params: Optional[Sequence[Tensor]] = None,
-                 lr: float = 0.01):
+                 lr: float = 0.01, zero: bool = False, dp_axis: str = "dp"):
         self.lr = lr
         self.params = list(params) if params is not None else None
+        self.zero = zero          # ZeRO: shard optimizer states over dp
+        self.dp_axis = dp_axis
         self._state: Dict[str, Any] = {}
+        self._shardings: Dict[int, Any] = {}  # tid -> NamedSharding of states
 
     # -- graph API (reference Optimizer::Minimize) ---------------------------
 
@@ -49,23 +52,50 @@ class Optimizer:
 
     # -- state management (reference MakeStates) -----------------------------
 
+    def _state_sharding(self, t: Tensor, arr, graph: Graph):
+        """Sharding for a per-param optimizer state: the param's own
+        sharding, plus ZeRO dp-sharding of dim 0 when enabled (reference
+        `zero` ds flag, distributed_states.h:69)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = graph.mesh
+        if mesh is None:
+            return None
+        base = graph._pspec_for(t)
+        spec = list(base) if base is not None else []
+        spec += [None] * (arr.ndim - len(spec))
+        if self.zero and self.dp_axis in mesh.axis_names and arr.ndim > 0:
+            dp = mesh.shape[self.dp_axis]
+            used = {a for entry in spec if entry
+                    for a in (entry if isinstance(entry, tuple) else (entry,))}
+            if (self.dp_axis not in used and arr.shape[0] % dp == 0
+                    and spec[0] is None):
+                spec[0] = self.dp_axis
+        if not any(spec):
+            return None
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
     def _ensure_state(self, var_state: Dict[int, jax.Array],
                       xs: Sequence[Tensor], graph: Graph) -> Dict[str, Any]:
         if not self._state:
             self._state = self._init_state(var_state, xs)
-            # shard optimizer states like their params (ZeRO handled by
-            # param ds; GSPMD propagates)
             for key, tree in self._state.items():
                 if isinstance(tree, dict):
                     for tid, arr in tree.items():
                         t = next((x for x in xs if x.id == tid), None)
-                        if t is None:
+                        if t is None or not hasattr(arr, "shape") \
+                                or arr.shape != var_state[tid].shape:
                             continue
-                        sharding = graph._sharding_for(t)
-                        if sharding is not None and hasattr(arr, "shape") \
-                                and arr.shape == var_state[tid].shape:
+                        sharding = self._state_sharding(t, arr, graph)
+                        if sharding is not None:
                             tree[tid] = jax.device_put(arr, sharding)
+                            self._shardings[tid] = sharding
         return self._state
+
+    def _c(self, tid: int, arr):
+        """Re-assert the optimizer-state sharding inside the jitted update
+        (XLA would otherwise choose output shardings freely)."""
+        sh = self._shardings.get(tid)
+        return jax.lax.with_sharding_constraint(arr, sh) if sh is not None else arr
 
     def _store_state(self, state: Dict[str, Any]) -> None:
         self._state = dict(state)
@@ -95,8 +125,8 @@ class Optimizer:
 
 class SGDOptimizer(Optimizer):
     def __init__(self, params=None, lr: float = 0.01, momentum: float = 0.0,
-                 nesterov: bool = False):
-        super().__init__(params, lr)
+                 nesterov: bool = False, **kw):
+        super().__init__(params, lr, **kw)
         self.momentum = momentum
         self.nesterov = nesterov
 
@@ -117,7 +147,7 @@ class SGDOptimizer(Optimizer):
         vel = dict(opt_state["velocity"])
         for t in xs:
             g = grads[t.id].astype(var_state[t.id].dtype)
-            v = self.momentum * vel[t.id] + g
+            v = self._c(t.id, self.momentum * vel[t.id] + g)
             vel[t.id] = v
             upd = g + self.momentum * v if self.nesterov else v
             new_vars[t.id] = var_state[t.id] - self.lr * upd
@@ -130,10 +160,12 @@ class AdamOptimizer(Optimizer):
     impl/kernel/Optimizers.cu).  States kept in fp32 regardless of param
     dtype (mixed-precision master states)."""
 
+    decoupled_weight_decay = False  # True in AdamW (decoupled, torch-style)
+
     def __init__(self, params=None, lr: float = 1e-3, beta1: float = 0.9,
                  beta2: float = 0.999, eps: float = 1e-8,
-                 weight_decay: float = 0.0):
-        super().__init__(params, lr)
+                 weight_decay: float = 0.0, **kw):
+        super().__init__(params, lr, **kw)
         self.beta1, self.beta2, self.eps = beta1, beta2, eps
         self.weight_decay = weight_decay
 
@@ -157,18 +189,25 @@ class AdamOptimizer(Optimizer):
         for t in xs:
             g = grads[t.id].astype(jnp.float32)
             p = var_state[t.id]
-            if self.weight_decay:
-                g = g + self.weight_decay * p.astype(jnp.float32)
-            m[t.id] = b1 * m[t.id] + (1 - b1) * g
-            v[t.id] = b2 * v[t.id] + (1 - b2) * (g * g)
+            if self.weight_decay and not self.decoupled_weight_decay:
+                g = g + self.weight_decay * p.astype(jnp.float32)  # Adam-L2
+            m[t.id] = self._c(t.id, b1 * m[t.id] + (1 - b1) * g)
+            v[t.id] = self._c(t.id, b2 * v[t.id] + (1 - b2) * (g * g))
             m_hat = m[t.id] / bc1
             v_hat = v[t.id] / bc2
             upd = self.lr * m_hat / (jnp.sqrt(v_hat) + self.eps)
+            if self.weight_decay and self.decoupled_weight_decay:
+                upd = upd + self.lr * self.weight_decay * p.astype(jnp.float32)
             new_vars[t.id] = (p.astype(jnp.float32) - upd).astype(p.dtype)
         return new_vars, {"step": step, "m": m, "v": v}
+
+
+class AdamWOptimizer(AdamOptimizer):
+    """AdamW: decoupled weight decay (torch.optim.AdamW semantics)."""
+    decoupled_weight_decay = True
 
 
 # torch-style aliases
 SGD = SGDOptimizer
 Adam = AdamOptimizer
-AdamW = AdamOptimizer
+AdamW = AdamWOptimizer

@@ -154,6 +154,13 @@ class TestOptimizerParity:
         ref = self._run_torch(lambda p: torch.optim.Adam(p, lr=0.01))
         np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-4)
 
+    def test_adamw_decoupled_matches_torch(self):
+        ours = self._run_hetu(
+            lambda: optim.AdamWOptimizer(lr=0.01, weight_decay=0.1))
+        ref = self._run_torch(
+            lambda p: torch.optim.AdamW(p, lr=0.01, weight_decay=0.1))
+        np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-4)
+
 
 class TestModule:
     def test_named_parameters_and_state_dict(self):
