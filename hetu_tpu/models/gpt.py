@@ -5,8 +5,8 @@ TPU-native re-expression of the reference's canonical LLM workloads
 transformer blocks built from column/row-parallel linears, vocab-parallel
 embedding + CE, parallel norms with SP, rotary or learned positions, and
 flash attention (Pallas on TPU).  DP/TP/SP shardings are PartitionSpec
-annotations over a named mesh; CP (ring attention) is a planned M4 module
-that will replace ``ops.attention`` here when a ``cp`` mesh axis is active.
+annotations over a named mesh; CP (ring attention over the ``cp_axis``)
+dispatches to ``ops.parallel_attention`` when ``config.cp_axis`` is set.
 
 Config mirrors the reference's argparse surface (examples/gpt/train_hetu.py
 :479-588): hidden/layers/heads/seq/vocab, activation/norm variants.
@@ -47,6 +47,7 @@ class GPTConfig:
     dtype: str = "float32"
     dp_axis: str = "dp"
     tp_axis: str = "tp"
+    cp_axis: Optional[str] = None   # context parallel (ring attention) axis
 
     def __post_init__(self):
         assert self.hidden_size % self.num_heads == 0, \
@@ -83,9 +84,11 @@ def _norm(config: GPTConfig, name: str):
     if config.norm == "rmsnorm":
         return ParallelRMSNorm(config.hidden_size, sp=config.sp,
                                dp_axis=config.dp_axis, tp_axis=config.tp_axis,
+                               seq_axis=config.cp_axis,
                                dtype=config.dtype, name=name)
     return ParallelLayerNorm(config.hidden_size, sp=config.sp,
                              dp_axis=config.dp_axis, tp_axis=config.tp_axis,
+                             seq_axis=config.cp_axis,
                              dtype=config.dtype, name=name)
 
 
@@ -101,12 +104,14 @@ class ParallelAttentionBlock(Module):
         kv_size = c.kv_heads * c.head_dim
         self.qkv = ColumnParallelLinear(
             c.hidden_size, q_size + 2 * kv_size, bias=(c.activation == "gelu"),
-            dp_axis=c.dp_axis, tp_axis=c.tp_axis, dtype=c.dtype,
+            dp_axis=c.dp_axis, tp_axis=c.tp_axis, seq_axis=c.cp_axis,
+            dtype=c.dtype,
             init=NormalInitializer(0.0, c.init_std),
             name=f"h{layer_idx}.attn.qkv")
         self.out = RowParallelLinear(
             q_size, c.hidden_size, bias=(c.activation == "gelu"), sp=c.sp,
-            dp_axis=c.dp_axis, tp_axis=c.tp_axis, dtype=c.dtype,
+            dp_axis=c.dp_axis, tp_axis=c.tp_axis, seq_axis=c.cp_axis,
+            dtype=c.dtype,
             init=NormalInitializer(0.0, c.init_std / math.sqrt(2 * c.num_layers)),
             name=f"h{layer_idx}.attn.out")
         self.dropout = Dropout(c.dropout) if c.dropout else None
@@ -126,7 +131,7 @@ class ParallelAttentionBlock(Module):
     def forward(self, x, seq_len: int):
         c = self.config
         qkv = self.qkv(x)  # [b, s, (nh + 2*nkv) * hd], tp-sharded on last dim
-        b_spec = P(c.dp_axis, None, c.tp_axis, None)
+        b_spec = P(c.dp_axis, c.cp_axis, c.tp_axis, None)
         q_size = c.num_heads * c.head_dim
         kv_size = c.kv_heads * c.head_dim
         q = ops.getitem(qkv, (Ellipsis, slice(0, q_size)))
@@ -146,10 +151,15 @@ class ParallelAttentionBlock(Module):
             v = ops.repeat_kv(v, c.num_heads // c.kv_heads)
         k = sharded(k, b_spec)
         v = sharded(v, b_spec)
-        attn = ops.attention(q, k, v, causal=True)
+        if c.cp_axis:
+            attn = ops.parallel_attention(
+                q, k, v, causal=True, cp_axis=c.cp_axis,
+                batch_axis=c.dp_axis, head_axis=c.tp_axis)
+        else:
+            attn = ops.attention(q, k, v, causal=True)
         attn = sharded(attn, b_spec)
         attn = attn.reshape((-1, seq_len, q_size))
-        attn = sharded(attn, P(c.dp_axis, None, c.tp_axis))
+        attn = sharded(attn, P(c.dp_axis, c.cp_axis, c.tp_axis))
         out = self.out(attn)
         if self.dropout is not None:
             out = self.dropout(out)
@@ -163,12 +173,14 @@ class ParallelMLP(Module):
         mult = 2 if c.activation == "swiglu" else 1
         self.up = ColumnParallelLinear(
             c.hidden_size, c.ffn_size * mult, bias=(c.activation == "gelu"),
-            dp_axis=c.dp_axis, tp_axis=c.tp_axis, dtype=c.dtype,
+            dp_axis=c.dp_axis, tp_axis=c.tp_axis, seq_axis=c.cp_axis,
+            dtype=c.dtype,
             init=NormalInitializer(0.0, c.init_std),
             name=f"h{layer_idx}.mlp.up")
         self.down = RowParallelLinear(
             c.ffn_size, c.hidden_size, bias=(c.activation == "gelu"), sp=c.sp,
-            dp_axis=c.dp_axis, tp_axis=c.tp_axis, dtype=c.dtype,
+            dp_axis=c.dp_axis, tp_axis=c.tp_axis, seq_axis=c.cp_axis,
+            dtype=c.dtype,
             init=NormalInitializer(0.0, c.init_std / math.sqrt(2 * c.num_layers)),
             name=f"h{layer_idx}.mlp.down")
         self.activation = c.activation
@@ -207,6 +219,7 @@ class GPTModel(Module):
         c = config
         self.wte = VocabParallelEmbedding(
             c.vocab_size, c.hidden_size, dp_axis=c.dp_axis, tp_axis=c.tp_axis,
+            seq_axis=c.cp_axis,
             dtype=c.dtype, init=NormalInitializer(0.0, c.init_std), name="wte")
         if c.position == "learned":
             self.wpe = parallel_parameter(
@@ -247,7 +260,8 @@ class GPTLMHeadModel(Module):
         else:
             self.lm_head = ColumnParallelLinear(
                 c.hidden_size, c.vocab_size, bias=False,
-                dp_axis=c.dp_axis, tp_axis=c.tp_axis, dtype=c.dtype,
+                dp_axis=c.dp_axis, tp_axis=c.tp_axis, seq_axis=c.cp_axis,
+                dtype=c.dtype,
                 init=NormalInitializer(0.0, c.init_std), name="lm_head")
 
     def logits(self, input_ids, seq_len: Optional[int] = None):
@@ -255,7 +269,7 @@ class GPTLMHeadModel(Module):
         x = self.transformer(input_ids, seq_len)
         if self.lm_head is None:
             logits = ops.matmul(x, self.transformer.wte.weight, trans_b=True)
-            logits = sharded(logits, P(c.dp_axis, None, c.tp_axis))
+            logits = sharded(logits, P(c.dp_axis, c.cp_axis, c.tp_axis))
         else:
             logits = self.lm_head(x)
         return logits
@@ -267,7 +281,7 @@ class GPTLMHeadModel(Module):
             return logits
         loss = vocab_parallel_cross_entropy(
             logits, labels, dp_axis=c.dp_axis, tp_axis=c.tp_axis,
-            ignore_index=-100)
+            seq_axis=c.cp_axis, ignore_index=-100)
         return loss
 
 

@@ -43,6 +43,21 @@ def sharded(t, pspec):
     return out
 
 
+def _norm_out_spec(out, sp, dp_axis, tp_axis, seq_axis):
+    """Post-norm activation spec: SP shards seq over tp (within each cp
+    shard when CP is active); plain CP keeps seq on cp only."""
+    if out.ndim < 2:
+        return out
+    if sp:
+        seq_entry = (seq_axis, tp_axis) if seq_axis else tp_axis
+        return sharded(out, P(dp_axis, seq_entry,
+                              *([None] * (out.ndim - 2))))
+    if seq_axis:
+        return sharded(out, P(dp_axis, seq_axis,
+                              *([None] * (out.ndim - 2))))
+    return out
+
+
 class ColumnParallelLinear(Module):
     """Y = X W^T, W [out, in] split along out across ``tp_axis``.
 
@@ -54,12 +69,14 @@ class ColumnParallelLinear(Module):
 
     def __init__(self, in_features: int, out_features: int, bias: bool = True,
                  gather_output: bool = False, dp_axis: str = "dp",
-                 tp_axis: str = "tp", dtype=None, init: Optional[Initializer] = None,
+                 tp_axis: str = "tp", seq_axis: Optional[str] = None,
+                 dtype=None, init: Optional[Initializer] = None,
                  name: str = "colp"):
         super().__init__()
         self.in_features, self.out_features = in_features, out_features
         self.gather_output = gather_output
         self.dp_axis, self.tp_axis = dp_axis, tp_axis
+        self.seq_axis = seq_axis  # CP: keep seq dim sharded (dim 1 of 3D)
         self.weight = parallel_parameter(
             init or XavierNormalInitializer(), (out_features, in_features),
             pspec=P(tp_axis, None), dtype=dtype, name=f"{name}.weight")
@@ -79,6 +96,8 @@ class ColumnParallelLinear(Module):
         out = ops.linear(x, self.weight, self.bias, trans_b=True)
         spec = [self.dp_axis] + [None] * (out.ndim - 2)
         spec.append(None if self.gather_output else self.tp_axis)
+        if self.seq_axis and out.ndim >= 3:
+            spec[1] = self.seq_axis
         return sharded(out, P(*spec))
 
 
@@ -89,12 +108,14 @@ class RowParallelLinear(Module):
 
     def __init__(self, in_features: int, out_features: int, bias: bool = True,
                  sp: bool = False, dp_axis: str = "dp", tp_axis: str = "tp",
+                 seq_axis: Optional[str] = None,
                  dtype=None, init: Optional[Initializer] = None,
                  name: str = "rowp"):
         super().__init__()
         self.in_features, self.out_features = in_features, out_features
         self.sp = sp
         self.dp_axis, self.tp_axis = dp_axis, tp_axis
+        self.seq_axis = seq_axis
         self.weight = parallel_parameter(
             init or XavierNormalInitializer(), (out_features, in_features),
             pspec=P(None, tp_axis), dtype=dtype, name=f"{name}.weight")
@@ -115,13 +136,20 @@ class RowParallelLinear(Module):
         # constrain input to feature-sharded so the matmul contracts the
         # sharded dim (partial result) and GSPMD places the psum here
         in_spec = [self.dp_axis] + [None] * (x.ndim - 2) + [self.tp_axis]
+        if self.seq_axis and x.ndim >= 3:
+            in_spec[1] = self.seq_axis
         x = sharded(x, P(*in_spec))
         out = ops.linear(x, self.weight, None, trans_b=True)
         if self.sp:
-            # reduce-scatter onto sequence shards (dim 1 of [b, s, h])
-            out_spec = [self.dp_axis, self.tp_axis] + [None] * (out.ndim - 2)
+            # reduce-scatter onto sequence shards (dim 1 of [b, s, h]);
+            # with CP the seq dim carries both axes (cp outer, tp inner)
+            seq_entry = (self.seq_axis, self.tp_axis) if self.seq_axis \
+                else self.tp_axis
+            out_spec = [self.dp_axis, seq_entry] + [None] * (out.ndim - 2)
         else:
             out_spec = [self.dp_axis] + [None] * (out.ndim - 1)
+            if self.seq_axis and out.ndim >= 3:
+                out_spec[1] = self.seq_axis
         out = sharded(out, P(*out_spec))
         if self.bias is not None:
             out = sharded(out + self.bias, P(*out_spec))
@@ -155,11 +183,13 @@ class VocabParallelEmbedding(Module):
     lowers the lookup to masked local gather + psum over tp."""
 
     def __init__(self, num_embeddings: int, embedding_dim: int,
-                 dp_axis: str = "dp", tp_axis: str = "tp", dtype=None,
+                 dp_axis: str = "dp", tp_axis: str = "tp",
+                 seq_axis: Optional[str] = None, dtype=None,
                  init: Optional[Initializer] = None, name: str = "vocab_embed"):
         super().__init__()
         self.num_embeddings, self.embedding_dim = num_embeddings, embedding_dim
         self.dp_axis, self.tp_axis = dp_axis, tp_axis
+        self.seq_axis = seq_axis
         self.weight = parallel_parameter(
             init or NormalInitializer(0.0, 0.02),
             (num_embeddings, embedding_dim), pspec=P(tp_axis, None),
@@ -173,6 +203,8 @@ class VocabParallelEmbedding(Module):
     def forward(self, ids):
         out = ops.embedding_lookup(self.weight, ids)
         spec = [self.dp_axis] + [None] * (out.ndim - 1)
+        if self.seq_axis and out.ndim >= 3:
+            spec[1] = self.seq_axis
         return sharded(out, P(*spec))
 
 
@@ -182,13 +214,15 @@ class ParallelLayerNorm(Module):
     with sp=True activations stay sequence-sharded across the TP group."""
 
     def __init__(self, normalized_shape, sp: bool = False,
-                 dp_axis: str = "dp", tp_axis: str = "tp", eps: float = 1e-5,
+                 dp_axis: str = "dp", tp_axis: str = "tp",
+                 seq_axis: Optional[str] = None, eps: float = 1e-5,
                  dtype=None, name: str = "ln"):
         super().__init__()
         if isinstance(normalized_shape, int):
             normalized_shape = (normalized_shape,)
         self.sp, self.eps = sp, eps
         self.dp_axis, self.tp_axis = dp_axis, tp_axis
+        self.seq_axis = seq_axis
         self.weight = parallel_parameter(ConstantInitializer(1.0),
                                          tuple(normalized_shape), pspec=P(),
                                          dtype=dtype, name=f"{name}.weight")
@@ -198,41 +232,43 @@ class ParallelLayerNorm(Module):
 
     def forward(self, x):
         out = ops.layer_norm(x, self.weight, self.bias, self.eps)
-        if self.sp and out.ndim >= 2:
-            spec = [self.dp_axis, self.tp_axis] + [None] * (out.ndim - 2)
-            return sharded(out, P(*spec))
-        return out
+        return _norm_out_spec(out, self.sp, self.dp_axis, self.tp_axis,
+                              self.seq_axis)
 
 
 class ParallelRMSNorm(Module):
     """RMSNorm with sequence-parallel support (HtMultiParallelRMSNorm)."""
 
     def __init__(self, dim: int, sp: bool = False, dp_axis: str = "dp",
-                 tp_axis: str = "tp", eps: float = 1e-6, dtype=None,
+                 tp_axis: str = "tp", seq_axis: Optional[str] = None,
+                 eps: float = 1e-6, dtype=None,
                  name: str = "rmsnorm"):
         super().__init__()
         self.sp, self.eps = sp, eps
         self.dp_axis, self.tp_axis = dp_axis, tp_axis
+        self.seq_axis = seq_axis
         self.weight = parallel_parameter(ConstantInitializer(1.0), (dim,),
                                          pspec=P(), dtype=dtype,
                                          name=f"{name}.weight")
 
     def forward(self, x):
         out = ops.rms_norm(x, self.weight, self.eps)
-        if self.sp and out.ndim >= 2:
-            spec = [self.dp_axis, self.tp_axis] + [None] * (out.ndim - 2)
-            return sharded(out, P(*spec))
-        return out
+        return _norm_out_spec(out, self.sp, self.dp_axis, self.tp_axis,
+                              self.seq_axis)
 
 
 def vocab_parallel_cross_entropy(logits, target, dp_axis: str = "dp",
-                                 tp_axis: str = "tp", reduction: str = "mean",
+                                 tp_axis: str = "tp",
+                                 seq_axis: Optional[str] = None,
+                                 reduction: str = "mean",
                                  ignore_index: Optional[int] = None):
     """CE over vocab-sharded logits (reference
     ops/VocabParallelCrossEntropyLoss.cc): keep logits sharded on the vocab
     dim through the log-softmax so the max/sum reductions become psums over
     tp instead of materializing the full vocab."""
     spec = [dp_axis] + [None] * (logits.ndim - 2) + [tp_axis]
+    if seq_axis and logits.ndim >= 3:
+        spec[1] = seq_axis
     logits = sharded(logits, P(*spec))
     loss = ops.softmax_cross_entropy(logits, target, reduction=reduction,
                                      ignore_index=ignore_index)

@@ -590,6 +590,36 @@ def attention(q, k, v, causal=True, softmax_scale=None, use_flash=None):
                {"causal": causal, "softmax_scale": softmax_scale})
 
 
+def parallel_attention(q, k, v, causal=True, softmax_scale=None,
+                       cp_axis: str = "cp", batch_axis: str = "dp",
+                       head_axis: str = "tp"):
+    """Context-parallel (ring) attention op (reference ParallelAttentionOp,
+    ops/ParallelAttention.h:425): sequence sharded over ``cp_axis``, KV
+    ring via ppermute, online LSE correction.  Requires the owning graph to
+    carry a mesh with the cp axis; otherwise falls back to plain attention.
+    """
+    g = _graph_of(q, k, v)
+    mesh = getattr(g, "mesh", None)
+    if mesh is None or cp_axis not in mesh.axis_names:
+        raise ValueError(
+            f"parallel_attention requires a graph mesh with axis "
+            f"{cp_axis!r}; got mesh={mesh}. Use ops.attention for non-CP "
+            f"runs instead of silently dropping context parallelism.")
+    if mesh.shape[cp_axis] == 1:
+        # degenerate ring: identical semantics, skip the shard_map
+        return attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+    from ..parallel.ring_attention import ring_attention_sharded
+
+    def _impl(q, k, v, causal=True, softmax_scale=None):
+        return ring_attention_sharded(q, k, v, mesh, axis_name=cp_axis,
+                                      causal=causal,
+                                      softmax_scale=softmax_scale,
+                                      batch_axis=batch_axis,
+                                      head_axis=head_axis)
+    return _op("parallel_attention", _impl, [q, k, v],
+               {"causal": causal, "softmax_scale": softmax_scale})
+
+
 # ---------------------------------------------------------------------------
 # AMP helpers (ops/CheckFinite, update_scale)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,410 @@
+"""Flash attention — Pallas TPU kernel (fwd + bwd), LSE-returning.
+
+TPU-native replacement for the reference's vendored flash-attn2 CUDA kernels
+(``hetu/impl/kernel/FlashAttention.cu``, ``hetu/graph/ops/Attention.cc``).
+Design follows the FlashAttention-2 online-softmax algorithm, blocked for
+the MXU: the kv loop is the innermost grid dimension with VMEM scratch
+accumulators carried across it (TPU grid iterations are sequential).
+
+Returns (out, lse); the log-sum-exp output is what ring attention's online
+correction needs (reference ``AttnCommRing::ExecCorr``,
+``ops/ParallelAttention.h:361``) and what the backward recompute uses.
+
+Layout: [batch, seq, heads, head_dim] (reference convention).  Internally
+[b*h, s, d].  Causal masking is block-skipped (fully-masked kv blocks are
+not computed).  ``segment_ids`` gives packed/varlen semantics (the
+cu_seqlens path of the reference, ``ops/Attention.h:286``).
+
+On CPU the kernel runs in interpret mode so the whole path is testable on
+the simulated mesh (SURVEY.md §4 takeaway).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+LANES = 128      # last-dim tile width
+SUBLANES = 8     # second-to-last tile width (f32/int32)
+
+
+def _padded_segs(segment_ids, b, h, sq, sk):
+    """Broadcast [b, s] segment ids into TPU-tileable layouts:
+    q side [bh, sq, LANES], kv side [bh, SUBLANES, sk] (stock-kernel trick)."""
+    if segment_ids is None:
+        q_segs = jnp.zeros((b * h, sq, LANES), jnp.int32)
+        kv_segs = jnp.zeros((b * h, SUBLANES, sk), jnp.int32)
+        return q_segs, kv_segs
+    flat_q = jnp.repeat(segment_ids[:, None, :], h, axis=1).reshape(b * h, sq)
+    q_segs = jnp.broadcast_to(flat_q[:, :, None], (b * h, sq, LANES))
+    if sq == sk:
+        flat_kv = flat_q
+    else:
+        raise NotImplementedError(
+            "segment_ids with sq != sk needs a separate kv_segment_ids")
+    kv_segs = jnp.broadcast_to(flat_kv[:, None, :], (b * h, SUBLANES, sk))
+    return q_segs, kv_segs
+
+
+def _block_sizes(s: int, d: int, dtype) -> Tuple[int, int]:
+    """Pick q/kv block sizes.  Blocks must divide s AND satisfy TPU tiling
+    (last-two-dims rule); a block equal to the full dim is always legal, so
+    sequences with no nice divisor fall back to a single block."""
+    for cand in (512, 256, 128):
+        if s % cand == 0:
+            return cand, cand
+    return s, s
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,  # inputs
+                o_ref, lse_ref,                              # outputs
+                acc_ref, m_ref, l_ref,                       # scratch
+                *, scale: float, causal: bool, bq: int, bk: int,
+                num_kv: int, use_segs: bool):
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # block-level causal skip: kv block strictly after q block -> no work
+    run = True
+    if causal:
+        run = kv_idx * bk <= q_idx * bq + bq - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]                       # [bq, d]
+        k = k_ref[0]                       # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            rows = q_idx * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = kv_idx * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows, s, DEFAULT_MASK_VALUE)
+        if use_segs:
+            qs = q_seg_ref[0, :, 0]        # [bq] (lane-padded layout)
+            ks = kv_seg_ref[0, 0, :]       # [bk] (sublane-padded layout)
+            seg_ok = qs[:, None] == ks[None, :]
+            s = jnp.where(seg_ok, s, DEFAULT_MASK_VALUE)
+        m_prev = m_ref[:, 0]               # [bq]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_cur[:, None])
+        alpha = jnp.exp(m_prev - m_cur)
+        l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_cur[:, None], m_ref.shape)
+
+    @pl.when(kv_idx == num_kv - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
+        m = m_ref[:, 0]
+        lse = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(safe_l))
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
+
+
+def _flash_fwd(q, k, v, scale, causal, segment_ids):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    bq, _ = _block_sizes(sq, d, q.dtype)
+    _, bk = _block_sizes(sk, d, q.dtype)
+    num_q, num_kv = sq // bq, sk // bk
+
+    use_segs = segment_ids is not None
+    q_segs, kv_segs = _padded_segs(segment_ids, b, h, sq, sk)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+        num_kv=num_kv, use_segs=use_segs)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, bq, LANES), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, SUBLANES, bk), lambda bh, i, j: (bh, 0, j)),
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q_segs, kv_segs, qr, kr, vr)
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    lse = lse[:, :, 0].reshape(b, h, sq)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref, dq_acc,
+                   *, scale, causal, bq, bk, num_kv, use_segs):
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = kv_idx * bk <= q_idx * bq + bq - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_idx * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = kv_idx * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows, s, DEFAULT_MASK_VALUE)
+        if use_segs:
+            seg_ok = q_seg_ref[0, :, 0][:, None] == kv_seg_ref[0, 0, :][None, :]
+            s = jnp.where(seg_ok, s, DEFAULT_MASK_VALUE)
+        lse = lse_ref[0, :, 0]
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = delta_ref[0, :, 0]
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kv_idx == num_kv - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, bq, bk, num_q, use_segs):
+    q_idx = pl.program_id(2)
+    kv_idx = pl.program_id(1)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        # q block strictly before kv block -> fully masked
+        run = q_idx * bq + bq - 1 >= kv_idx * bk
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_idx * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = kv_idx * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows, s, DEFAULT_MASK_VALUE)
+        if use_segs:
+            seg_ok = q_seg_ref[0, :, 0][:, None] == kv_seg_ref[0, 0, :][None, :]
+            s = jnp.where(seg_ok, s, DEFAULT_MASK_VALUE)
+        lse = lse_ref[0, :, 0]
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = delta_ref[0, :, 0]
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(q_idx == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(scale, causal, segment_ids, res, g):
+    q, k, v, out, lse = res
+    do = g[0] if isinstance(g, (tuple, list)) else g
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    dor = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    outr = out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    lser = lse.reshape(b * h, sq)
+    # delta = rowsum(do * o)  [bh, sq] -> lane-padded [bh, sq, LANES]
+    delta = jnp.sum(dor.astype(jnp.float32) * outr.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[:, :, None], (b * h, sq, LANES))
+    lser = jnp.broadcast_to(lser[:, :, None], (b * h, sq, LANES))
+    bq, _ = _block_sizes(sq, d, q.dtype)
+    _, bk = _block_sizes(sk, d, q.dtype)
+    num_q, num_kv = sq // bq, sk // bk
+
+    use_segs = segment_ids is not None
+    q_segs, kv_segs = _padded_segs(segment_ids, b, h, sq, sk)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+        num_kv=num_kv, use_segs=use_segs)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, bq, LANES), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, SUBLANES, bk), lambda bh, i, j: (bh, 0, j)),
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q_segs, kv_segs, qr, kr, vr, dor, lser, delta)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+        num_q=num_q, use_segs=use_segs)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, num_kv, num_q),
+        in_specs=[
+            pl.BlockSpec((1, bq, LANES), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, SUBLANES, bk), lambda bh, j, i: (bh, 0, j)),
+            pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda bh, j, i: (bh, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q_segs, kv_segs, qr, kr, vr, dor, lser, delta)
+
+    dq = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    dk = dk.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    dv = dv.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, segment_ids, scale, causal, use_segs):
+    out, _ = _flash_fwd(q, k, v, scale, causal,
+                        segment_ids if use_segs else None)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, segment_ids, scale, causal, use_segs):
+    segs = segment_ids if use_segs else None
+    out, lse = _flash_fwd(q, k, v, scale, causal, segs)
+    return out, (q, k, v, segment_ids, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, use_segs, res, g):
+    q, k, v, segment_ids, out, lse = res
+    segs = segment_ids if use_segs else None
+    dq, dk, dv = _flash_bwd(scale, causal, segs, (q, k, v, out, lse), g)
+    dsegs = np.zeros(segment_ids.shape, jax.dtypes.float0)
+    return dq, dk, dv, dsegs
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    softmax_scale: Optional[float] = None,
+                    segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Flash attention on [b, s, h, d]; differentiable (works under jit —
+    segment_ids is a real traced argument with zero cotangent)."""
+    scale = softmax_scale if softmax_scale is not None \
+        else 1.0 / math.sqrt(q.shape[-1])
+    use_segs = segment_ids is not None
+    if segment_ids is None:
+        segment_ids = jnp.zeros((q.shape[0], q.shape[1]), jnp.int32)
+    return _flash(q, k, v, segment_ids, scale, causal, use_segs)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             softmax_scale: Optional[float] = None,
+                             segment_ids: Optional[jax.Array] = None):
+    """Forward-only variant returning (out, lse) — the ring-attention
+    building block."""
+    scale = softmax_scale if softmax_scale is not None \
+        else 1.0 / math.sqrt(q.shape[-1])
+    return _flash_fwd(q, k, v, scale, causal, segment_ids)

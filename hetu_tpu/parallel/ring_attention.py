@@ -1,0 +1,207 @@
+"""Ring attention — context parallelism over a mesh axis.
+
+TPU-native re-expression of the reference's ``AttnCommRing``
+(``hetu/graph/ops/ParallelAttention.h:342``, ``.cc:611,781``): the sequence
+is sharded over the ``cp`` mesh axis; KV blocks circulate the ring
+(``lax.ppermute`` — the reference's ``BatchedISendIRecv`` ring exchange)
+while each rank runs blockwise flash attention on its local Q against the
+visiting KV, merging partial results with online log-sum-exp correction
+(the reference's ``ExecCorr``).  XLA overlaps the ppermute with the
+per-round kernels the way the reference overlaps its comm/attn CUDA
+streams via events.
+
+Per-pair mask classes mirror ``AttnMask`` CAUSAL/FULL/EMPTY
+(``ParallelAttention.h:25``) for the NORMAL (contiguous) split pattern;
+the backward ring piggybacks dKV accumulators around the ring exactly one
+full cycle so they land home (reference grad piggyback, ``.cc:781``).
+
+Usage: inside ``shard_map`` with the sequence dim sharded over
+``axis_name``; or via :func:`ring_attention_sharded` which wraps the
+shard_map for [b, s, h, d] inputs.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.pallas.flash_attention import (_flash_bwd, _flash_fwd,
+                                          flash_attention_with_lse)
+
+
+def _merge(acc, o_r, lse_r):
+    """Online LSE merge of one round's (normalized out, lse) into the
+    accumulator (reference ExecCorr, ParallelAttention.h:361).
+
+    m/denom/lse live in [b, h, s]; the out accumulator in [b, s, h, d].
+    """
+    m, denom, out = acc
+    m_new = jnp.maximum(m, lse_r)
+    # where lse_r == -inf (empty round) the contribution vanishes;
+    # exp(-inf - -inf) would be nan, so guard the all-empty case
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    c_old = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    c_new = jnp.where(jnp.isfinite(lse_r), jnp.exp(lse_r - m_safe), 0.0)
+    denom_new = denom * c_old + c_new
+    to_out = lambda c: c.transpose(0, 2, 1)[..., None]  # [b,h,s]->[b,s,h,1]
+    out_new = out * to_out(c_old) + o_r * to_out(c_new)
+    return m_new, denom_new, out_new
+
+
+def _pair_fwd(q, k, v, scale, mask_kind):
+    """(out, lse) of one (q-rank, kv-rank) pair; mask_kind 0=causal 1=full
+    2=empty."""
+    b, s, h, d = q.shape
+
+    def causal_fn(_):
+        o, lse = _flash_fwd(q, k, v, scale, True, None)
+        return o.astype(jnp.float32), lse  # branch dtypes must match empty_fn
+
+    def full_fn(_):
+        o, lse = _flash_fwd(q, k, v, scale, False, None)
+        return o.astype(jnp.float32), lse
+
+    def empty_fn(_):
+        return (jnp.zeros((b, s, h, d), jnp.float32),
+                jnp.full((b, h, s), -jnp.inf, jnp.float32))
+
+    return lax.switch(mask_kind, [causal_fn, full_fn, empty_fn], None)
+
+
+def _pair_bwd(q, k, v, do, out, lse, scale, mask_kind):
+    """dq, dk, dv of one pair given global lse; empty pairs short-circuit."""
+    def causal_fn(_):
+        return _flash_bwd(scale, True, None, (q, k, v, out, lse), do)
+
+    def full_fn(_):
+        return _flash_bwd(scale, False, None, (q, k, v, out, lse), do)
+
+    def empty_fn(_):
+        return (jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v))
+
+    return lax.switch(mask_kind, [causal_fn, full_fn, empty_fn], None)
+
+
+def _mask_kind(my_rank, kv_rank, causal: bool):
+    """NORMAL split pattern: earlier ranks' KV fully visible, own rank
+    causal, later ranks empty (ParallelAttention.h:25 CAUSAL/FULL/EMPTY)."""
+    if not causal:
+        return jnp.int32(1)
+    return jnp.where(kv_rank == my_rank, 0,
+                     jnp.where(kv_rank < my_rank, 1, 2)).astype(jnp.int32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attn(q, k, v, axis_name, scale, causal):
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, scale, causal)
+    return out
+
+
+def _ring_fwd_impl(q, k, v, axis_name, scale, causal):
+    cp = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def body(r, carry):
+        (k_cur, v_cur), acc = carry
+        kv_rank = (my - r) % cp
+        kind = _mask_kind(my, kv_rank, causal)
+        o_r, lse_r = _pair_fwd(q, k_cur, v_cur, scale, kind)
+        acc = _merge(acc, o_r, lse_r)
+        # rotate KV to the next rank (skippable on last round, but keeping
+        # it makes the loop uniform; XLA overlaps it with the next round)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt), acc
+
+    init_acc = (jnp.full((b, h, s), -jnp.inf, jnp.float32),   # m
+                jnp.zeros((b, h, s), jnp.float32),            # denom
+                jnp.zeros((b, s, h, d), jnp.float32))         # out (bqhd)
+    # note: out accum uses [b, s, h, d] but m/denom use [b, h, s]; transpose
+    # lse-space corrections into out-space on the fly inside _merge
+    (_, _), (m, denom, out_acc) = lax.fori_loop(
+        0, cp, body, ((k, v), init_acc))
+    safe = jnp.where(denom == 0.0, 1.0, denom)
+    # denom is [b, h, s]; out_acc is [b, s, h, d]
+    out = out_acc / safe.transpose(0, 2, 1)[..., None]
+    lse = jnp.where(denom == 0.0, -jnp.inf, m + jnp.log(safe))
+    return out.astype(q.dtype), lse
+
+
+def _ring_fwd_rule(q, k, v, axis_name, scale, causal):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, scale, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd_rule(axis_name, scale, causal, res, do):
+    q, k, v, out, lse = res
+    cp = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def body(r, carry):
+        (k_cur, v_cur), (dk_cur, dv_cur), dq_acc = carry
+        kv_rank = (my - r) % cp
+        kind = _mask_kind(my, kv_rank, causal)
+        dq_c, dk_c, dv_c = _pair_bwd(q, k_cur, v_cur, do, out, lse,
+                                     scale, kind)
+        dq_acc = dq_acc + dq_c.astype(jnp.float32)
+        dk_cur = dk_cur + dk_c.astype(jnp.float32)
+        dv_cur = dv_cur + dv_c.astype(jnp.float32)
+        # rotate KV and its grad accumulators together (grad piggyback):
+        # after cp shifts they arrive back at the owning rank
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = lax.ppermute(dv_cur, axis_name, perm)
+        return (k_nxt, v_nxt), (dk_nxt, dv_nxt), dq_acc
+
+    init = ((k, v), (jnp.zeros(k.shape, jnp.float32),
+                     jnp.zeros(v.shape, jnp.float32)),
+            jnp.zeros(q.shape, jnp.float32))
+    (_, (dk, dv), dq) = lax.fori_loop(0, cp, body, init)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_attn.defvjp(_ring_fwd_rule, _ring_bwd_rule)
+
+
+def ring_attention(q, k, v, axis_name: str = "cp", causal: bool = True,
+                   softmax_scale: Optional[float] = None) -> jax.Array:
+    """Ring attention on sequence-sharded [b, s_local, h, d] inputs.
+
+    Must be called inside shard_map/pjit with ``axis_name`` in scope.
+    """
+    scale = softmax_scale if softmax_scale is not None \
+        else 1.0 / math.sqrt(q.shape[-1])
+    return _ring_attn(q, k, v, axis_name, scale, causal)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name: str = "cp",
+                           causal: bool = True,
+                           softmax_scale: Optional[float] = None,
+                           batch_axis: Optional[str] = "dp",
+                           head_axis: Optional[str] = "tp") -> jax.Array:
+    """Convenience wrapper: shard_map ring attention over a mesh for global
+    [b, s, h, d] arrays (seq sharded over ``axis_name``; batch over
+    ``batch_axis``; heads over ``head_axis`` — the reference's TP head
+    split + CP combination)."""
+    from jax.sharding import PartitionSpec as P
+    from .comm import shard_map
+
+    def axis_or_none(name):
+        return name if (name and name in mesh.axis_names) else None
+
+    spec = P(axis_or_none(batch_axis), axis_name, axis_or_none(head_axis),
+             None)
+
+    fn = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name, causal,
+                                       softmax_scale),
+        mesh, (spec, spec, spec), spec)
+    return fn(q, k, v)

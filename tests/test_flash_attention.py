@@ -1,0 +1,131 @@
+"""Pallas flash-attention kernel tests (interpret mode on CPU).
+
+Oracle: the jnp reference SDPA (itself validated against torch in
+test_ops.py::TestAttention).  Covers fwd/bwd, causal/full, packed
+segment-ids (varlen), LSE output, GQA-shaped inputs, odd block sizes.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu.ops.attention import sdpa_reference
+from hetu_tpu.ops.pallas.flash_attention import (flash_attention,
+                                                flash_attention_with_lse)
+
+
+def _mk(b=2, s=128, h=2, d=64, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rng.randn(b, s, h, d), dtype)
+                 for _ in range(3))
+
+
+class TestFlashForward:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        q, k, v = _mk()
+        out = flash_attention(q, k, v, causal=causal)
+        ref = sdpa_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_odd_seq_blocks(self):
+        # seq 96 -> block sizes fall back to smaller powers of two
+        q, k, v = _mk(s=96)
+        out = flash_attention(q, k, v, causal=True)
+        ref = sdpa_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_segment_ids_packing(self):
+        q, k, v = _mk()
+        b, s = q.shape[0], q.shape[1]
+        segs = jnp.asarray(np.repeat(np.arange(4), s // 4)[None].repeat(b, 0))
+        out = flash_attention(q, k, v, causal=True, segment_ids=segs)
+        ref = sdpa_reference(q, k, v, causal=True, segment_ids=segs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_lse(self):
+        q, k, v = _mk()
+        out, lse = flash_attention_with_lse(q, k, v, causal=True)
+        assert lse.shape == (2, 2, 128)
+        # oracle LSE from dense logits
+        d = q.shape[-1]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(1.0 * d)
+        qi = jnp.arange(128)[:, None]
+        ki = jnp.arange(128)[None, :]
+        logits = jnp.where(ki <= qi, logits, -jnp.inf)
+        ref_lse = jax.nn.logsumexp(logits, axis=-1)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestFlashBackward:
+    def test_grads_match_reference(self):
+        q, k, v = _mk()
+
+        def loss_fa(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(sdpa_reference(q, k, v, causal=True) ** 2)
+
+        g1 = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-3,
+                                       err_msg=f"d{name}")
+
+    def test_grads_with_segments(self):
+        q, k, v = _mk(s=64)
+        segs = jnp.asarray(np.repeat(np.arange(2), 32)[None].repeat(2, 0))
+
+        def loss_fa(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=True, segment_ids=segs) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                sdpa_reference(q, k, v, causal=True, segment_ids=segs) ** 2)
+
+        g1 = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-3,
+                                       err_msg=f"d{name}")
+
+
+class TestReviewRegressions:
+    def test_segment_ids_under_jit(self):
+        """segment_ids must be a traced arg (works inside jit/graph step)."""
+        q, k, v = _mk(s=64)
+        segs = jnp.asarray(np.repeat(np.arange(2), 32)[None].repeat(2, 0))
+        f = jax.jit(lambda q, k, v, s: flash_attention(
+            q, k, v, causal=True, segment_ids=s))
+        out = f(q, k, v, segs)
+        ref = sdpa_reference(q, k, v, causal=True, segment_ids=segs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+        # and grads under jit
+        g = jax.jit(jax.grad(lambda q, k, v, s: jnp.sum(
+            flash_attention(q, k, v, segment_ids=s) ** 2)))(q, k, v, segs)
+        assert np.all(np.isfinite(np.asarray(g)))
+
+    def test_irregular_seq_len(self):
+        """Sequences with no power-of-two block fall back to one full block."""
+        q, k, v = _mk(s=72)
+        out = flash_attention(q, k, v, causal=True)
+        ref = sdpa_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_bfloat16(self):
+        q, k, v = _mk(s=128, dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, causal=True)
+        ref = sdpa_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=5e-2, atol=5e-2)
