@@ -1,5 +1,6 @@
 from .gpt import (GPTConfig, GPTModel, GPTLMHeadModel, llama_config,
                   LLamaLMHeadModel, LLamaModel)
+from .gpt_pipeline import GPTPipelineModel, block_fn
 
 __all__ = ["GPTConfig", "GPTModel", "GPTLMHeadModel", "llama_config",
-           "LLamaLMHeadModel", "LLamaModel"]
+           "LLamaLMHeadModel", "LLamaModel", "GPTPipelineModel", "block_fn"]

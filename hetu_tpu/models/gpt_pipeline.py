@@ -1,0 +1,191 @@
+"""Pipeline-parallel GPT: transformer blocks as stacked pp-sharded stages.
+
+The 3D/4D-parallel counterpart of ``models/gpt.py`` (reference: the same
+LLaMA blocks placed across pipeline stages via per-op DeviceGroupUnion,
+``examples/gpt/hetu_llama.py`` + GPipe/1F1B in ``executable_graph.cc``).
+Embedding and LM head live outside the pipeline body (computed under plain
+GSPMD, replicated over pp); the homogeneous block stack runs through
+``pipeline_spmd``.  dp/tp shardings inside blocks are expressed with
+``with_sharding_constraint`` on the auto axes.
+
+Functional-style block (pure params pytree) because the pipeline body must
+be a jax-transformable function of stacked parameters.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .. import ops as _ops
+from ..graph.ctor import NormalInitializer, parallel_parameter
+from ..nn import Module, VocabParallelEmbedding, vocab_parallel_cross_entropy
+from ..nn.parallel import ParallelRMSNorm, sharded
+from ..ops.attention import sdpa
+from ..parallel.pipeline import pipeline_spmd
+from .gpt import GPTConfig, llama_config
+
+
+def _rotary_tables(seq_len: int, d: int):
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2, dtype=np.float32) / d))
+    ang = np.outer(np.arange(seq_len, dtype=np.float32), inv)
+    emb = np.concatenate([ang, ang], axis=-1)
+    return (jnp.asarray(np.cos(emb)[None, :, None, :]),
+            jnp.asarray(np.sin(emb)[None, :, None, :]))
+
+
+def _apply_rotary(x, cos, sin):
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    return x * cos.astype(x.dtype) + rot * sin.astype(x.dtype)
+
+
+def _rms(x, w, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    out = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (out * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def block_fn(params, x, *, cfg: GPTConfig, mesh=None):
+    """One LLaMA-style block (rmsnorm/rotary/swiglu), pure function.
+
+    params: dict of this layer's weights; x: [b, s, h].
+    """
+    from jax.sharding import NamedSharding
+    c = cfg
+
+    def _wsc(v, spec):
+        if mesh is None:
+            return v
+        return lax.with_sharding_constraint(v, NamedSharding(mesh, spec))
+    b, s, hdim = x.shape
+    cos, sin = _rotary_tables(s, c.head_dim)
+
+    h = _rms(x, params["ln1"])
+    qkv = jnp.einsum("bsh,oh->bso", h, params["qkv"])
+    qkv = _wsc(qkv, P(c.dp_axis, None, c.tp_axis))
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, c.num_heads, c.head_dim)
+    k = k.reshape(b, s, c.num_heads, c.head_dim)
+    v = v.reshape(b, s, c.num_heads, c.head_dim)
+    q = _apply_rotary(q, cos, sin)
+    k = _apply_rotary(k, cos, sin)
+    spec4 = P(c.dp_axis, None, c.tp_axis, None)
+    q = _wsc(q, spec4)
+    k = _wsc(k, spec4)
+    v = _wsc(v, spec4)
+    attn = sdpa(q, k, v, causal=True)
+    attn = attn.reshape(b, s, c.num_heads * c.head_dim)
+    attn = _wsc(attn, P(c.dp_axis, None, c.tp_axis))
+    attn_out = jnp.einsum("bso,ho->bsh", attn, params["attn_out"])
+    attn_out = _wsc(attn_out, P(c.dp_axis, None, None))
+    x = x + attn_out
+
+    h = _rms(x, params["ln2"])
+    up = jnp.einsum("bsh,oh->bso", h, params["mlp_up"])
+    up = _wsc(up, P(c.dp_axis, None, c.tp_axis))
+    u1, u2 = jnp.split(up, 2, axis=-1)
+    act = jax.nn.silu(u1) * u2
+    down = jnp.einsum("bso,ho->bsh", act, params["mlp_down"])
+    down = _wsc(down, P(c.dp_axis, None, None))
+    return x + down
+
+
+class GPTPipelineModel(Module):
+    """LLaMA-family LM with pp-stacked blocks + dp/tp inside stages.
+
+    ``num_stages`` must equal the mesh's pp size; layers are split into
+    equal ranges per stage (reference layer-range placement).
+    """
+
+    def __init__(self, config: GPTConfig, num_stages: int,
+                 pp_axis: str = "pp"):
+        super().__init__()
+        assert config.num_layers % num_stages == 0
+        self.config = config
+        self.num_stages = num_stages
+        self.pp_axis = pp_axis
+        self.layers_per_stage = config.num_layers // num_stages
+        c = config
+
+        self.wte = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, dp_axis=c.dp_axis, tp_axis=c.tp_axis,
+            dtype=c.dtype, init=NormalInitializer(0.0, c.init_std), name="wte")
+        self.ln_f = ParallelRMSNorm(c.hidden_size, sp=False,
+                                    dp_axis=c.dp_axis, tp_axis=c.tp_axis,
+                                    dtype=c.dtype, name="ln_f")
+        self.lm_head = parallel_parameter(
+            NormalInitializer(0.0, c.init_std), (c.vocab_size, c.hidden_size),
+            pspec=P(c.tp_axis, None), dtype=c.dtype, name="lm_head")
+
+        # stacked per-stage block params: [S, L/S, ...] sharded over pp.
+        # tp sharding of the per-layer weight dims composes via trailing
+        # spec entries.
+        S, L = num_stages, self.layers_per_stage
+        h, f = c.hidden_size, c.ffn_size
+
+        def stacked(name, shape, pspec_tail, std):
+            return parallel_parameter(
+                NormalInitializer(0.0, std), (S, L, *shape),
+                pspec=P(pp_axis, None, *pspec_tail), dtype=c.dtype,
+                name=f"blocks.{name}")
+
+        depth_std = c.init_std / math.sqrt(2 * c.num_layers)
+        self.blk_ln1 = stacked("ln1", (h,), (None,), 0.0)
+        self.blk_qkv = stacked("qkv", (3 * h, h), (c.tp_axis, None),
+                               c.init_std)
+        self.blk_attn_out = stacked("attn_out", (h, h), (None, c.tp_axis),
+                                    depth_std)
+        self.blk_ln2 = stacked("ln2", (h,), (None,), 0.0)
+        self.blk_mlp_up = stacked("mlp_up", (2 * f, h), (c.tp_axis, None),
+                                  c.init_std)
+        self.blk_mlp_down = stacked("mlp_down", (h, f), (None, c.tp_axis),
+                                    depth_std)
+        # norms init to 1
+        g = self.blk_ln1.graph
+        g.reset_variable(self.blk_ln1, np.ones((S, L, h), np.float32))
+        g.reset_variable(self.blk_ln2, np.ones((S, L, h), np.float32))
+
+    def forward(self, input_ids, labels=None,
+                num_micro_batches: int = 1):
+        c = self.config
+        mesh = self.wte.weight.graph.mesh
+        x = self.wte(input_ids)
+
+        def _impl(x, ln1, qkv, attn_out, ln2, mlp_up, mlp_down,
+                  num_micro_batches=1):
+            stage_params = {"ln1": ln1, "qkv": qkv, "attn_out": attn_out,
+                            "ln2": ln2, "mlp_up": mlp_up,
+                            "mlp_down": mlp_down}
+
+            def stage_fn(params, x_mb):
+                # scan this stage's layer range (leading dim L/S)
+                def layer(x, layer_params):
+                    return block_fn(layer_params, x, cfg=c, mesh=mesh), None
+                out, _ = lax.scan(layer, x_mb, params)
+                return out
+
+            return pipeline_spmd(stage_fn, stage_params, x,
+                                 num_micro_batches, mesh, self.pp_axis)
+
+        x = _ops.functional._op(
+            "pipeline_transformer", _impl,
+            [x, self.blk_ln1, self.blk_qkv, self.blk_attn_out,
+             self.blk_ln2, self.blk_mlp_up, self.blk_mlp_down],
+            {"num_micro_batches": num_micro_batches})
+
+        x = self.ln_f(x)
+        logits = _ops.matmul(x, self.lm_head, trans_b=True)
+        logits = sharded(logits, P(c.dp_axis, None, c.tp_axis))
+        if labels is None:
+            return logits
+        return vocab_parallel_cross_entropy(
+            logits, labels, dp_axis=c.dp_axis, tp_axis=c.tp_axis,
+            ignore_index=-100)

@@ -6,6 +6,8 @@ from .mesh import (AXIS_DP, AXIS_CP, AXIS_TP, AXIS_PP, AXIS_EP,
                    create_mesh, single_device_mesh, mesh_axis_size,
                    ds_to_mesh_and_spec, ds_to_named_sharding,
                    ds_from_partition_spec, force_virtual_cpu_devices)
+from .pipeline import pipeline_spmd, stack_stage_params
+from .ring_attention import ring_attention, ring_attention_sharded
 from . import comm
 
 __all__ = [
@@ -16,4 +18,6 @@ __all__ = [
     "create_mesh", "single_device_mesh", "mesh_axis_size",
     "ds_to_mesh_and_spec", "ds_to_named_sharding", "ds_from_partition_spec",
     "force_virtual_cpu_devices", "comm",
+    "pipeline_spmd", "stack_stage_params",
+    "ring_attention", "ring_attention_sharded",
 ]

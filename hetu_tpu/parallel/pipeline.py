@@ -1,0 +1,123 @@
+"""Pipeline parallelism — SPMD collective-permute pipelining.
+
+TPU-native re-expression of the reference's pipeline engine
+(``hetu/graph/executable_graph.cc:1343`` GPipe / ``:1376`` PipeDream-Flush
+schedules, stage-boundary P2P ops on ``kP2PStream``): under XLA's SPMD
+model every pp rank runs the same program, so stages are expressed as
+*stacked* layer parameters sharded over the ``pp`` mesh axis, and the
+schedule is a ``lax.scan`` over ticks in which activations hop stages via
+``lax.ppermute`` (the P2P send/recv).  Micro-batches stream through the
+ring; the pipeline fills/drains over ``M + S - 1`` ticks (GPipe bubble).
+
+The backward pass is jax.grad through the scan: XLA transposes the
+ppermute into the reverse hop and reverses the schedule; with
+``jax.checkpoint`` on the stage body the activation-memory profile matches
+PipeDream-Flush (the reference hand-writes these schedules; the compiler
+derives them here).
+
+Composes with dp/tp/cp: only ``pp`` is manual (partial-manual shard_map);
+inner ops keep their GSPMD shardings on the other axes.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                  stage_params: Any,
+                  x: jax.Array,
+                  num_micro_batches: int,
+                  mesh: Mesh,
+                  pp_axis: str = "pp",
+                  remat: bool = True) -> jax.Array:
+    """Run ``x`` through S pipeline stages (S = mesh pp size).
+
+    stage_params: pytree whose leaves are stacked [S, ...] and sharded over
+    ``pp_axis`` on dim 0; ``stage_fn(local_params, x_mb)`` applies ONE
+    stage (leaves passed with the leading stage dim stripped) and must
+    preserve the activation shape (homogeneous stages — transformer
+    blocks).  x: [batch, ...], micro-batched internally along dim 0.
+    Returns [batch, ...] last-stage outputs, replicated over pp.
+    """
+    S = mesh.shape[pp_axis]
+    M = num_micro_batches
+    assert x.shape[0] % M == 0, \
+        f"batch {x.shape[0]} not divisible by {M} micro-batches"
+    if S == 1:
+        params0 = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        outs = [stage_fn(params0, mb) for mb in jnp.split(x, M, axis=0)]
+        return jnp.concatenate(outs, axis=0)
+
+    mb_size = x.shape[0] // M
+    x_mb = x.reshape(M, mb_size, *x.shape[1:])
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def pp_fn(params_local, x_mb_local):
+        params = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        stage = lax.axis_index(pp_axis)
+        T = M + S - 1
+
+        def tick(carry, t):
+            recv, out_buf = carry
+            # stage 0 consumes micro-batch t (clamped during drain)
+            inp_idx = jnp.clip(t, 0, M - 1)
+            first_in = lax.dynamic_index_in_dim(x_mb_local, inp_idx, 0,
+                                                keepdims=False)
+            x_in = jnp.where(stage == 0, first_in, recv)
+            y = body(params, x_in)
+            # the last stage finishes micro-batch t-(S-1) at this tick
+            out_idx = t - (S - 1)
+            valid = jnp.logical_and(stage == S - 1,
+                                    jnp.logical_and(out_idx >= 0,
+                                                    out_idx < M))
+            safe_idx = jnp.clip(out_idx, 0, M - 1)
+            cur = lax.dynamic_index_in_dim(out_buf, safe_idx, 0,
+                                           keepdims=False)
+            out_buf = lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(valid, y, cur), safe_idx, 0)
+            # hop to the next stage (reference P2P send/recv at stage
+            # boundaries); XLA overlaps this with the next tick's compute
+            send = lax.ppermute(y, pp_axis, fwd_perm)
+            return (send, out_buf), None
+
+        init_recv = jnp.zeros((mb_size, *x_mb_local.shape[2:]),
+                              x_mb_local.dtype)
+        out_sds = jax.eval_shape(
+            lambda p, v: stage_fn(p, v), params,
+            jax.ShapeDtypeStruct(init_recv.shape, init_recv.dtype))
+        out_buf0 = jnp.zeros((M, *out_sds.shape), out_sds.dtype)
+        (_, out_buf), _ = lax.scan(tick, (init_recv, out_buf0),
+                                   jnp.arange(T))
+        # out_buf is only valid on the last stage; broadcast it so the
+        # (replicated) out_specs is truthful
+        mask = (stage == S - 1).astype(out_buf.dtype)
+        return lax.psum(out_buf * mask, pp_axis)
+
+    fn = jax.shard_map(
+        pp_fn, mesh=mesh,
+        in_specs=(P(pp_axis), P()),
+        out_specs=P(),
+        axis_names={pp_axis}, check_vma=False)
+    out_mb = fn(stage_params, x_mb)
+    return out_mb.reshape(M * mb_size, *out_mb.shape[2:])
+
+
+def stack_stage_params(per_layer_params: list, num_stages: int):
+    """Stack L homogeneous per-layer param pytrees into [S, L/S, ...] leaves
+    (dim 0 to be sharded over pp); the reference's layer-range-to-stage
+    assignment (DeviceGroupUnion placement) specialized to equal ranges."""
+    L = len(per_layer_params)
+    assert L % num_stages == 0, \
+        f"{L} layers not divisible into {num_stages} stages"
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_layer_params)
+    return jax.tree_util.tree_map(
+        lambda p: p.reshape(num_stages, L // num_stages, *p.shape[1:]),
+        stacked)
