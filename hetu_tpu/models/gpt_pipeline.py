@@ -14,8 +14,6 @@ be a jax-transformable function of stacked parameters.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -29,7 +27,7 @@ from ..nn import Module, VocabParallelEmbedding, vocab_parallel_cross_entropy
 from ..nn.parallel import ParallelRMSNorm, sharded
 from ..ops.attention import sdpa
 from ..parallel.pipeline import pipeline_spmd
-from .gpt import GPTConfig, llama_config
+from .gpt import GPTConfig
 
 
 def _rotary_tables(seq_len: int, d: int):
@@ -109,6 +107,21 @@ class GPTPipelineModel(Module):
                  pp_axis: str = "pp"):
         super().__init__()
         assert config.num_layers % num_stages == 0
+        # block_fn implements a dense swiglu/rotary/rmsnorm MHA block; fail
+        # loudly on config fields it does not honor rather than silently
+        # building the wrong architecture
+        if config.num_kv_heads not in (None, config.num_heads):
+            raise NotImplementedError("pipelined blocks are MHA-only "
+                                      "(num_kv_heads must equal num_heads)")
+        for fld, want in (("activation", "swiglu"), ("norm", "rmsnorm"),
+                          ("position", "rotary")):
+            if getattr(config, fld) != want:
+                raise NotImplementedError(
+                    f"pipelined blocks only support {fld}={want!r}, "
+                    f"got {getattr(config, fld)!r}")
+        if config.dropout:
+            raise NotImplementedError("pipelined blocks do not support "
+                                      "dropout")
         self.config = config
         self.num_stages = num_stages
         self.pp_axis = pp_axis

@@ -1,0 +1,97 @@
+"""Blockwise quantization ops: fp4 / nf4 / int8.
+
+TPU-native re-expression of the reference's bitsandbytes-backed quantize /
+dequantize ops (``hetu/graph/ops/Quantization.h:15,79`` and the fp4/nf4
+kernels it links from ``third_party/bitsandbytes``): absmax blockwise
+quantization with 4-bit packed storage plus a per-block absmax sidecar —
+the layout the checkpoint quantized-save path
+(``python/hetu/utils/checkpoint/ht_safetensors.py:18-35``) writes.
+
+Everything here is pure jnp so it fuses under jit on TPU; 4-bit packing is
+two codes per uint8.  The fp4/nf4 codebooks are the standard public
+16-entry tables (fp4 = 1-bit sign x 2-bit exponent x 1-bit mantissa;
+nf4 = normal-float quantiles from the QLoRA paper).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# 16-entry codebooks, index = 4-bit code.
+FP4_CODE = np.array(
+    [0.0, 0.0052083333, 0.6666666667, 1.0, 0.3333333333, 0.5,
+     0.1666666667, 0.25,
+     -0.0, -0.0052083333, -0.6666666667, -1.0, -0.3333333333, -0.5,
+     -0.1666666667, -0.25], dtype=np.float32)
+
+NF4_CODE = np.array(
+    [-1.0, -0.6961928009986877, -0.5250730514526367, -0.39491748809814453,
+     -0.28444138169288635, -0.18477343022823334, -0.09105003625154495, 0.0,
+     0.07958029955625534, 0.16093020141124725, 0.24611230194568634,
+     0.33791524171829224, 0.44070982933044434, 0.5626170039176941,
+     0.7229568362236023, 1.0], dtype=np.float32)
+
+_CODES = {"fp4": FP4_CODE, "nf4": NF4_CODE}
+
+
+def _blocked(x: jnp.ndarray, blocksize: int) -> Tuple[jnp.ndarray, int]:
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % blocksize
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(-1, blocksize), pad
+
+
+def quantize_4bit(x, quant_type: str = "nf4", blocksize: int = 64
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Blockwise 4-bit quantize.  Returns (packed uint8 of length
+    ceil(n/2), absmax per block as float32)."""
+    code = jnp.asarray(_CODES[quant_type])
+    x = jnp.asarray(x, jnp.float32)
+    blocks, _pad = _blocked(x, blocksize)
+    absmax = jnp.max(jnp.abs(blocks), axis=1)
+    scale = jnp.where(absmax > 0, absmax, 1.0)
+    normed = blocks / scale[:, None]
+    # nearest codebook entry
+    idx = jnp.argmin(jnp.abs(normed[..., None] - code[None, None, :]),
+                     axis=-1).astype(jnp.uint8)
+    flat_idx = idx.reshape(-1)
+    packed = (flat_idx[0::2] << 4) | flat_idx[1::2]
+    return packed, absmax.astype(jnp.float32)
+
+
+def dequantize_4bit(packed, absmax, shape, quant_type: str = "nf4",
+                    blocksize: int = 64, dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of :func:`quantize_4bit` (original ``shape`` required)."""
+    code = jnp.asarray(_CODES[quant_type])
+    hi = (packed >> 4).astype(jnp.int32)
+    lo = (packed & 0xF).astype(jnp.int32)
+    idx = jnp.stack([hi, lo], axis=1).reshape(-1)
+    vals = code[idx]
+    scale = jnp.where(absmax > 0, absmax, 1.0)
+    vals = vals.reshape(-1, blocksize) * scale[:, None]
+    n = int(np.prod(shape)) if len(shape) else 1
+    return vals.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+def quantize_int8(x, blocksize: int = 256
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Blockwise symmetric int8 absmax quantize -> (int8 codes, absmax)."""
+    x = jnp.asarray(x, jnp.float32)
+    blocks, _pad = _blocked(x, blocksize)
+    absmax = jnp.max(jnp.abs(blocks), axis=1)
+    scale = jnp.where(absmax > 0, absmax, 1.0)
+    q = jnp.clip(jnp.round(blocks / scale[:, None] * 127.0), -127, 127)
+    return q.astype(jnp.int8).reshape(-1), absmax.astype(jnp.float32)
+
+
+def dequantize_int8(q, absmax, shape, blocksize: int = 256,
+                    dtype=jnp.float32) -> jnp.ndarray:
+    q = jnp.asarray(q, jnp.float32).reshape(-1, blocksize)
+    scale = jnp.where(absmax > 0, absmax, 1.0)
+    vals = q / 127.0 * scale[:, None]
+    n = int(np.prod(shape)) if len(shape) else 1
+    return vals.reshape(-1)[:n].reshape(shape).astype(dtype)

@@ -20,8 +20,7 @@ inner ops keep their GSPMD shardings on the other axes.
 """
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp

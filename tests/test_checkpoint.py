@@ -1,0 +1,220 @@
+"""Checkpoint subsystem tests: whole-file, quantized, split (ds-aware),
+full model+optimizer checkpoints with resharding, HF converters.
+
+Mirrors the reference's checkpoint capability surface
+(python/hetu/utils/checkpoint/ht_safetensors.py:234,446,913,18-35,100).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import hetu_tpu as ht
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.utils.checkpoint import (
+    save_model, load_model, save_split, load_split,
+    save_checkpoint, load_checkpoint,
+    hf_gpt2_to_ht, ht_to_hf_gpt2,
+    megatron_qkv_to_interleaved, interleaved_qkv_to_megatron)
+from hetu_tpu.ops.quantization import (
+    quantize_4bit, dequantize_4bit, quantize_int8, dequantize_int8)
+
+
+def _tiny_cfg(**kw):
+    d = dict(vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+             max_seq_len=16, dropout=0.0, dtype="float32")
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+class TestQuantization:
+    def test_nf4_roundtrip_accuracy(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 64).astype(np.float32) * 0.02
+        packed, absmax = quantize_4bit(x, "nf4", blocksize=64)
+        back = np.asarray(dequantize_4bit(packed, absmax, x.shape, "nf4", 64))
+        assert back.shape == x.shape
+        # nf4 quantization error should be small relative to scale
+        err = np.abs(back - x).mean() / (np.abs(x).mean() + 1e-8)
+        assert err < 0.2
+
+    def test_fp4_roundtrip_shape(self):
+        x = np.random.RandomState(1).randn(33, 17).astype(np.float32)
+        packed, absmax = quantize_4bit(x, "fp4", blocksize=64)
+        back = np.asarray(dequantize_4bit(packed, absmax, x.shape, "fp4", 64))
+        assert back.shape == x.shape
+        assert np.corrcoef(back.ravel(), x.ravel())[0, 1] > 0.9
+
+    def test_int8_roundtrip(self):
+        x = np.random.RandomState(2).randn(100).astype(np.float32)
+        q, absmax = quantize_int8(x, blocksize=256)
+        back = np.asarray(dequantize_int8(q, absmax, x.shape, 256))
+        assert np.abs(back - x).max() < 0.05
+
+
+class TestSaveLoadModel:
+    def test_roundtrip(self, tmp_path):
+        with ht.graph("define_and_run", create_new=True):
+            model = GPTLMHeadModel(_tiny_cfg())
+            ids = ht.placeholder("int32", (2, 16))
+            model.logits(ids)  # build graph so params materialize
+            state0 = model.state_dict()
+            save_model(model, str(tmp_path / "m.safetensors"))
+            # perturb then load back
+            for n, p in model.named_parameters():
+                p.graph.reset_variable(p, np.zeros(p.shape, np.float32))
+            load_model(model, str(tmp_path / "m.safetensors"))
+            state1 = model.state_dict()
+        for k in state0:
+            np.testing.assert_allclose(np.asarray(state0[k], np.float32),
+                                       np.asarray(state1[k], np.float32),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_quantized_save(self, tmp_path):
+        with ht.graph("define_and_run", create_new=True):
+            model = GPTLMHeadModel(_tiny_cfg())
+            ids = ht.placeholder("int32", (2, 16))
+            model.logits(ids)
+            state0 = model.state_dict()
+            save_model(model, str(tmp_path / "q.safetensors"), quantize="nf4")
+            load_model(model, str(tmp_path / "q.safetensors"))
+            state1 = model.state_dict()
+        # 4-bit roundtrip: correlated, not exact
+        w0 = np.asarray(state0["transformer.wte.weight"], np.float32)
+        w1 = np.asarray(state1["transformer.wte.weight"], np.float32)
+        assert np.corrcoef(w0.ravel(), w1.ravel())[0, 1] > 0.98
+
+    def test_bf16_transfer_save(self, tmp_path):
+        with ht.graph("define_and_run", create_new=True):
+            model = GPTLMHeadModel(_tiny_cfg())
+            ids = ht.placeholder("int32", (2, 16))
+            model.logits(ids)
+            save_model(model, str(tmp_path / "b.safetensors"),
+                       dtype="bfloat16")
+            load_model(model, str(tmp_path / "b.safetensors"))
+
+
+class TestSplit:
+    def test_numshard_roundtrip(self, tmp_path):
+        state = {"a": np.arange(24, dtype=np.float32).reshape(6, 4),
+                 "b": np.float32(3.5) * np.ones((3,), np.float32),
+                 "scalar": np.array(7, np.int32)}
+        save_split(state, str(tmp_path / "ck"), num_shards=4)
+        back = load_split(str(tmp_path / "ck"))
+        for k in state:
+            np.testing.assert_array_equal(back[k], state[k])
+
+    def test_sharded_jax_array_save(self, tmp_path, devices8):
+        mesh = Mesh(np.array(devices8).reshape(4, 2), ("dp", "tp"))
+        x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+        xs = jax.device_put(x, NamedSharding(mesh, P("dp", "tp")))
+        save_split({"w": xs}, str(tmp_path / "ck"))
+        back = load_split(str(tmp_path / "ck"))
+        np.testing.assert_array_equal(back["w"], np.asarray(x))
+
+    def test_reshard_on_load(self, tmp_path, devices8):
+        # save under one layout, load under another
+        mesh_a = Mesh(np.array(devices8).reshape(8, 1), ("dp", "tp"))
+        x = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
+        xa = jax.device_put(x, NamedSharding(mesh_a, P("dp", None)))
+        save_split({"w": xa}, str(tmp_path / "ck"))
+        back = load_split(str(tmp_path / "ck"))
+        mesh_b = Mesh(np.array(devices8).reshape(2, 4), ("dp", "tp"))
+        xb = jax.device_put(jnp.asarray(back["w"]),
+                            NamedSharding(mesh_b, P(None, "tp")))
+        np.testing.assert_array_equal(np.asarray(xb), np.asarray(x))
+
+
+class TestFullCheckpoint:
+    def test_model_opt_roundtrip(self, tmp_path):
+        with ht.graph("define_and_run", create_new=True) as g:
+            cfg = _tiny_cfg()
+            model = GPTLMHeadModel(cfg)
+            ids = ht.placeholder("int32", (2, 16))
+            labels = ht.placeholder("int32", (2, 16))
+            loss = model(ids, labels)
+            opt = ht.optim.AdamOptimizer(lr=1e-3)
+            train_op = opt.minimize(loss)
+            rng = np.random.RandomState(0)
+            feed = {ids: rng.randint(0, 96, (2, 16)),
+                    labels: rng.randint(0, 96, (2, 16))}
+            for _ in range(2):
+                g.run(loss, [loss, train_op], feed)
+            state0 = model.state_dict()
+            m0 = {k: np.asarray(jax.device_get(v)) for k, v in
+                  (opt._state.get("m") or {}).items()}
+            save_checkpoint(model, opt, str(tmp_path / "full"), step=2)
+
+            # wreck state, then restore
+            for n, p in model.named_parameters():
+                p.graph.reset_variable(p, np.zeros(p.shape, np.float32))
+            opt._state = {}
+            ts = load_checkpoint(model, opt, str(tmp_path / "full"))
+            assert ts["step"] == 2
+            state1 = model.state_dict()
+            for k in state0:
+                np.testing.assert_allclose(
+                    np.asarray(state0[k], np.float32),
+                    np.asarray(state1[k], np.float32), rtol=1e-6, atol=1e-6)
+            assert "m" in opt._state and len(opt._state["m"]) == len(m0)
+            for tid, arr in m0.items():
+                np.testing.assert_allclose(
+                    np.asarray(jax.device_get(opt._state["m"][tid])), arr,
+                    rtol=1e-6, atol=1e-6)
+            # training continues after restore
+            g.run(loss, [loss, train_op], feed)
+
+
+class TestConverters:
+    def test_megatron_interleave_roundtrip(self):
+        nh, hd, hid = 4, 8, 32
+        w = np.random.RandomState(0).randn(3 * nh * hd, hid).astype(np.float32)
+        inter = megatron_qkv_to_interleaved(w, nh)
+        back = interleaved_qkv_to_megatron(inter, nh)
+        np.testing.assert_array_equal(back, w)
+
+    def test_hf_gpt2_roundtrip(self):
+        h, nh, L, V, S = 32, 4, 2, 96, 16
+        rng = np.random.RandomState(0)
+        hf = {"transformer.wte.weight": rng.randn(V, h).astype(np.float32),
+              "transformer.wpe.weight": rng.randn(S, h).astype(np.float32),
+              "transformer.ln_f.weight": np.ones(h, np.float32),
+              "transformer.ln_f.bias": np.zeros(h, np.float32)}
+        for i in range(L):
+            p = f"transformer.h.{i}"
+            hf[f"{p}.ln_1.weight"] = np.ones(h, np.float32)
+            hf[f"{p}.ln_1.bias"] = np.zeros(h, np.float32)
+            hf[f"{p}.ln_2.weight"] = np.ones(h, np.float32)
+            hf[f"{p}.ln_2.bias"] = np.zeros(h, np.float32)
+            hf[f"{p}.attn.c_attn.weight"] = rng.randn(h, 3 * h).astype(
+                np.float32)
+            hf[f"{p}.attn.c_attn.bias"] = rng.randn(3 * h).astype(np.float32)
+            hf[f"{p}.attn.c_proj.weight"] = rng.randn(h, h).astype(np.float32)
+            hf[f"{p}.attn.c_proj.bias"] = rng.randn(h).astype(np.float32)
+            hf[f"{p}.mlp.c_fc.weight"] = rng.randn(h, 4 * h).astype(
+                np.float32)
+            hf[f"{p}.mlp.c_fc.bias"] = rng.randn(4 * h).astype(np.float32)
+            hf[f"{p}.mlp.c_proj.weight"] = rng.randn(4 * h, h).astype(
+                np.float32)
+            hf[f"{p}.mlp.c_proj.bias"] = rng.randn(h).astype(np.float32)
+        ht_state = hf_gpt2_to_ht(hf)
+        assert ht_state["transformer.h.0.attn.qkv.weight"].shape == (3 * h, h)
+        back = ht_to_hf_gpt2(ht_state)
+        for k, v in hf.items():
+            np.testing.assert_allclose(back[k], v, rtol=1e-6)
+
+    def test_hf_load_into_model(self, tmp_path):
+        """An hf-converted state dict loads into the real model."""
+        with ht.graph("define_and_run", create_new=True):
+            cfg = _tiny_cfg(activation="gelu", norm="layernorm",
+                            position="learned", tie_embeddings=True)
+            model = GPTLMHeadModel(cfg)
+            ids = ht.placeholder("int32", (2, 16))
+            model.logits(ids)
+            state = model.state_dict()
+            hf = ht_to_hf_gpt2(state)
+            ht_state = hf_gpt2_to_ht(hf)
+            missing, unexpected = model.load_state_dict(ht_state,
+                                                        strict=False)
+        assert not [m for m in missing if "wpe" not in m]
