@@ -201,7 +201,29 @@ class Graph:
     # -- sharding -----------------------------------------------------------
 
     def _pspec_for(self, t: Tensor) -> Optional[PartitionSpec]:
-        return getattr(t, "pspec", None)
+        spec = getattr(t, "pspec", None)
+        if spec is None or self.mesh is None:
+            return spec
+        # drop axis names the current mesh doesn't have: after a hot
+        # switch to a smaller/reshaped mesh (e.g. tp or pp removed) stale
+        # annotations on intermediates must degrade to replication on the
+        # missing axes, exactly as the reference re-deduces ds on the new
+        # topology
+        names = set(self.mesh.axis_names)
+
+        def _fix(entry):
+            if entry is None:
+                return None
+            ent = entry if isinstance(entry, tuple) else (entry,)
+            kept = tuple(n for n in ent if n in names)
+            if not kept:
+                return None
+            return kept if len(kept) > 1 else kept[0]
+
+        fixed = [_fix(e) for e in spec]
+        if all(f == e for f, e in zip(fixed, spec)):
+            return spec
+        return PartitionSpec(*fixed)
 
     def _sharding_for(self, t: Tensor) -> Optional[NamedSharding]:
         if self.mesh is None:
@@ -499,10 +521,28 @@ class DefineAndRunGraph(Graph):
         jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
         return jit_step
 
+    # -- hot switch ----------------------------------------------------------
+
+    def switch_strategy(self, new_mesh, pspec_overrides=None, optimizer=None,
+                        mode=None, dtype=None):
+        """Hot-switch params/optimizer states/grads to a new mesh and/or
+        new per-param shardings, activating a fresh strategy id (reference
+        DefineAndRunGraph plan-change -> SwitchExecGraph::SwitchParams,
+        define_and_run_graph.cc:1073-1129).  Returns a SwitchProfile."""
+        from ..parallel.switch import SwitchExecGraph, SwitchMode
+        if mode is None:
+            mode = SwitchMode.ORIGIN_PARAM if optimizer is None \
+                else SwitchMode.ORIGIN_PARAM_AND_OPTIMIZER
+        sw = SwitchExecGraph(self, new_mesh, pspec_overrides, mode, dtype)
+        prof = sw.switch(optimizer)
+        self.cur_strategy_id += 1
+        self.num_strategy = max(self.num_strategy, self.cur_strategy_id + 1)
+        return prof
+
     # -- run ----------------------------------------------------------------
 
     def run(self, loss_or_fetches, fetches=None, feed_dict=None,
-            num_micro_batches: int = 1, cur_strategy_id: int = 0,
+            num_micro_batches: int = 1, cur_strategy_id: Optional[int] = None,
             run_level: Union[str, RunLevel, None] = None,
             save_checkpoint: bool = False):
         """Execute the graph (reference DefineAndRunGraph::Run,
@@ -521,7 +561,8 @@ class DefineAndRunGraph(Graph):
             run_level = _run_level_ctx._current  # ambient ht.run_level(...)
         if isinstance(run_level, str):
             run_level = RunLevel(run_level)
-        self.cur_strategy_id = cur_strategy_id
+        if cur_strategy_id is not None:
+            self.cur_strategy_id = cur_strategy_id
 
         if run_level == RunLevel.TOPO:
             return self._topo_from([f for f in fetches if isinstance(f, Tensor)])

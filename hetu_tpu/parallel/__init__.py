@@ -8,6 +8,8 @@ from .mesh import (AXIS_DP, AXIS_CP, AXIS_TP, AXIS_PP, AXIS_EP,
                    ds_from_partition_spec, force_virtual_cpu_devices)
 from .pipeline import pipeline_spmd, stack_stage_params
 from .ring_attention import ring_attention, ring_attention_sharded
+from .switch import (SwitchMode, SwitchPlan, SwitchProfile, SwitchExecGraph,
+                     switch_state)
 from . import comm
 
 __all__ = [
@@ -20,4 +22,6 @@ __all__ = [
     "force_virtual_cpu_devices", "comm",
     "pipeline_spmd", "stack_stage_params",
     "ring_attention", "ring_attention_sharded",
+    "SwitchMode", "SwitchPlan", "SwitchProfile", "SwitchExecGraph",
+    "switch_state",
 ]

@@ -1,0 +1,272 @@
+"""Hot switching between parallelism strategies (elastic training).
+
+TPU-native re-expression of the reference's SwitchExecGraph
+(``hetu/graph/switch_exec_graph.{h,cc}``): live repartitioning of params /
+grads / optimizer states when the execution plan changes (elastic scaling,
+Malleus strategy retune).  The reference hand-builds a comm graph of
+``BufferBatchedIsendIrecv`` transfers from a ``ParamSlice``/``ParamBlock``
+intersection of the source and destination shardings
+(``switch_exec_graph.h:459,672``); here the same intersection is computed
+from ``jax.sharding`` index maps (:class:`SwitchPlan`, for introspection,
+cost accounting and tests) while the data movement itself is a single
+``jax.device_put`` per array — XLA emits the minimal
+collective-permute/all-gather plan over ICI, and async dispatch overlaps
+the transfers the way the reference overlaps its switch stream with
+compute (``executable_graph.h:307-315``).
+
+Switch modes mirror ``switch_exec_graph.h:42-48``.
+"""
+from __future__ import annotations
+
+import enum
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+class SwitchMode(enum.Enum):
+    """What to migrate (reference SWITCH_ORIGIN_PARAM / TRANSFER_PARAM /
+    ..._AND_OPTIMIZER / CURRENT_GRAD / ACCUMULATE_GRAD)."""
+    ORIGIN_PARAM = "origin_param"
+    TRANSFER_PARAM = "transfer_param"              # + dtype transfer
+    ORIGIN_PARAM_AND_OPTIMIZER = "origin_param_and_optimizer"
+    TRANSFER_PARAM_AND_OPTIMIZER = "transfer_param_and_optimizer"
+    CURRENT_GRAD = "current_grad"
+    ACCUMULATE_GRAD = "accumulate_grad"
+
+
+def _slices_key(idx) -> Tuple[Tuple[int, Optional[int]], ...]:
+    return tuple((s.start or 0, s.stop) for s in idx)
+
+
+def _overlap(a, b, shape):
+    """Intersection of two index tuples; None if empty."""
+    out = []
+    for sa, sb, dim in zip(a, b, shape):
+        lo = max(sa.start or 0, sb.start or 0)
+        hi = min(sa.stop if sa.stop is not None else dim,
+                 sb.stop if sb.stop is not None else dim)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return tuple(out)
+
+
+class SwitchPlan:
+    """ParamSlice/ParamBlock intersection of two shardings of one tensor.
+
+    ``transfers`` lists (dst_device, src_device, global_slice) triples: for
+    every slice a destination device needs, the closest source replica is
+    picked (reference placement algorithms FCFS/round-robin,
+    switch_exec_graph.h:26-32 — we use nearest-by-id which matches
+    round-robin on TPU meshes).
+    """
+
+    def __init__(self, shape: Tuple[int, ...], itemsize: int,
+                 src: NamedSharding, dst: NamedSharding):
+        self.shape = tuple(shape)
+        self.src, self.dst = src, dst
+        src_map = src.devices_indices_map(self.shape)
+        dst_map = dst.devices_indices_map(self.shape)
+        # group src replicas per distinct slice
+        owners: Dict[Tuple, List[Any]] = {}
+        for d, idx in src_map.items():
+            owners.setdefault(_slices_key(idx), []).append(d)
+        self.transfers: List[Tuple[Any, Any, Tuple]] = []
+        local_bytes = 0
+        moved_bytes = 0
+        for dd, didx in dst_map.items():
+            for skey, sdevs in owners.items():
+                sidx = tuple(slice(lo, hi) for lo, hi in skey)
+                ov = _overlap(didx, sidx, self.shape)
+                if ov is None:
+                    continue
+                # prefer a source replica already on the dst device
+                src_dev = next((d for d in sdevs if d.id == dd.id),
+                               min(sdevs, key=lambda d: abs(d.id - dd.id)))
+                n = int(np.prod([hi - lo for lo, hi in ov])) * itemsize
+                if src_dev.id == dd.id:
+                    local_bytes += n
+                else:
+                    moved_bytes += n
+                self.transfers.append((dd, src_dev, ov))
+        self.local_bytes = local_bytes
+        self.moved_bytes = moved_bytes
+
+
+class SwitchProfile:
+    """Per-switch accounting (reference SWITCH_PROFILE_LEVEL TIME/MEMORY)."""
+
+    def __init__(self):
+        self.num_tensors = 0
+        self.total_bytes = 0
+        self.moved_bytes = 0
+        self.seconds = 0.0
+
+    def as_dict(self) -> Dict[str, float]:
+        return {"num_tensors": self.num_tensors,
+                "total_bytes": self.total_bytes,
+                "moved_bytes": self.moved_bytes,
+                "seconds": self.seconds}
+
+
+def switch_state(state: Dict[Any, jax.Array],
+                 dst_shardings: Dict[Any, NamedSharding],
+                 dtype: Optional[Any] = None,
+                 profile: Optional[SwitchProfile] = None
+                 ) -> Dict[Any, jax.Array]:
+    """Reshard every array in ``state`` to its destination sharding.
+
+    All device_puts are issued before any result is waited on, so
+    transfers overlap (the reference's batched-isend-irecv buffers).
+    """
+    out: Dict[Any, jax.Array] = {}
+    t0 = time.perf_counter()
+    for key, arr in state.items():
+        dst = dst_shardings.get(key)
+        cast = dtype is not None and hasattr(arr, "dtype") \
+            and jnp.issubdtype(arr.dtype, jnp.floating) \
+            and arr.dtype != jnp.dtype(dtype)
+        if dst is None or not hasattr(arr, "shape"):
+            out[key] = arr.astype(dtype) if cast else arr
+            continue
+        if profile is not None and isinstance(arr, jax.Array):
+            profile.num_tensors += 1
+            profile.total_bytes += arr.nbytes
+            if isinstance(arr.sharding, NamedSharding):
+                plan = SwitchPlan(arr.shape, arr.dtype.itemsize,
+                                  arr.sharding, dst)
+                profile.moved_bytes += plan.moved_bytes
+        if cast:
+            # fuse cast + reshard in one compiled program: no host-side
+            # intermediate, and a narrowing cast rides the wire narrow
+            out[key] = jax.jit(lambda x, d=dtype: x.astype(d),
+                               out_shardings=dst)(arr)
+        else:
+            out[key] = jax.device_put(arr, dst)
+    for v in out.values():
+        if isinstance(v, jax.Array):
+            v.block_until_ready()
+    if profile is not None:
+        profile.seconds += time.perf_counter() - t0
+    return out
+
+
+class SwitchExecGraph:
+    """Migrate a DefineAndRunGraph (+optimizer) to a new mesh / shardings.
+
+    ``pspec_overrides`` maps param Tensor -> new PartitionSpec; params not
+    listed keep their current spec (same axis names, new mesh extents —
+    the common dp/tp ratio change).  After the switch the graph's plan
+    pool entries for the old strategy are left in place (keyed by
+    strategy id) and a new strategy id is activated, mirroring the
+    reference's ExecGraphPlan pool + SwitchParams flow
+    (``define_and_run_graph.cc:1073-1129``).
+    """
+
+    def __init__(self, graph, new_mesh: Mesh,
+                 pspec_overrides: Optional[Dict[Any, PartitionSpec]] = None,
+                 mode: SwitchMode = SwitchMode.ORIGIN_PARAM_AND_OPTIMIZER,
+                 dtype: Optional[Any] = None):
+        self.graph = graph
+        self.new_mesh = new_mesh
+        self.pspec_overrides = dict(pspec_overrides or {})
+        self.mode = mode
+        self.dtype = dtype
+        self.profile = SwitchProfile()
+
+    def _dst_sharding(self, t) -> Optional[NamedSharding]:
+        spec = self.pspec_overrides.get(t)
+        if spec is None:
+            spec = getattr(t, "pspec", None)
+        if spec is None:
+            return None
+        # drop axis names the new mesh doesn't have (e.g. pp removed)
+        def _fix(entry):
+            if entry is None:
+                return None
+            names = entry if isinstance(entry, tuple) else (entry,)
+            kept = tuple(n for n in names if n in self.new_mesh.axis_names)
+            if not kept:
+                return None
+            return kept if len(kept) > 1 else kept[0]
+        spec = PartitionSpec(*[_fix(e) for e in spec])
+        return NamedSharding(self.new_mesh, spec)
+
+    def switch(self, optimizer=None) -> SwitchProfile:
+        g = self.graph
+        param_modes = (SwitchMode.ORIGIN_PARAM, SwitchMode.TRANSFER_PARAM,
+                       SwitchMode.ORIGIN_PARAM_AND_OPTIMIZER,
+                       SwitchMode.TRANSFER_PARAM_AND_OPTIMIZER)
+        opt_modes = (SwitchMode.ORIGIN_PARAM_AND_OPTIMIZER,
+                     SwitchMode.TRANSFER_PARAM_AND_OPTIMIZER)
+        if optimizer is None and self.mode in opt_modes:
+            raise ValueError(f"mode {self.mode} migrates optimizer states "
+                             "but no optimizer was passed")
+        tensors = {tid: t for tid, t in g._var_tensors.items()}
+        dsts = {}
+        fixed_specs = {}
+        for tid, t in tensors.items():
+            sh = self._dst_sharding(t)
+            if sh is not None:
+                dsts[tid] = sh
+                fixed_specs[t] = sh.spec
+        dtype = self.dtype if self.mode in (
+            SwitchMode.TRANSFER_PARAM,
+            SwitchMode.TRANSFER_PARAM_AND_OPTIMIZER) else None
+        if self.mode in param_modes:
+            g._var_data = switch_state(g._var_data, dsts, dtype=dtype,
+                                       profile=self.profile)
+            # persist the (axis-fixed) specs so the next run builds
+            # NamedShardings valid on the new mesh
+            for t, spec in fixed_specs.items():
+                t.pspec = spec
+        # optimizer states follow their param's sharding (+ ZeRO re-deduced
+        # against the new mesh)
+        if optimizer is not None and self.mode in opt_modes \
+                and optimizer._state:
+            old_mesh = g.mesh
+            g.mesh = self.new_mesh
+            try:
+                new_state: Dict[str, Any] = {}
+                optimizer._shardings = {}
+                for slot, tree in optimizer._state.items():
+                    if not isinstance(tree, dict):
+                        # scalar slots (step counters) are committed to the
+                        # old device set after a run — move them as well
+                        if isinstance(tree, jax.Array):
+                            tree = jax.device_put(
+                                tree, NamedSharding(self.new_mesh,
+                                                    PartitionSpec()))
+                        new_state[slot] = tree
+                        continue
+                    slot_dsts = {}
+                    for tid, arr in tree.items():
+                        t = tensors.get(tid)
+                        if t is None:
+                            continue
+                        sh = optimizer._state_sharding(t, arr, g)
+                        if sh is None:
+                            # fully-replicated on the NEW device set — the
+                            # state must still leave the old mesh
+                            sh = NamedSharding(self.new_mesh,
+                                               PartitionSpec())
+                        slot_dsts[tid] = sh
+                        optimizer._shardings[tid] = sh
+                    new_state[slot] = switch_state(tree, slot_dsts,
+                                                   profile=self.profile)
+                optimizer._state = new_state
+            finally:
+                g.mesh = old_mesh
+        # grads: pending accumulations must always follow the params off
+        # the old mesh (they share the params' layouts), and the grad-only
+        # modes migrate exactly them
+        if g._grad_accum:
+            g._grad_accum = switch_state(g._grad_accum, dsts,
+                                         profile=self.profile)
+        g.mesh = self.new_mesh
+        return self.profile
