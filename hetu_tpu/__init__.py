@@ -17,6 +17,8 @@ from .graph import (Tensor, SymbolicDim, Graph, EagerGraph,
                     DefineAndRunGraph, RunLevel, graph, run_level,
                     get_default_graph, placeholder, parameter, variable,
                     parallel_placeholder, parallel_parameter)
+from .graph.amp import autocast, GradScaler
+from .graph.recompute import recompute, cpu_offload
 from .graph.ctor import (ConstantInitializer, UniformInitializer,
                          NormalInitializer, TruncatedNormalInitializer,
                          XavierUniformInitializer, XavierNormalInitializer,

@@ -402,7 +402,10 @@ class DefineAndRunGraph(Graph):
         fetch_sig = tuple(t.id for t in fetches)
         return (self.cur_strategy_id, fetch_sig, feed_sig,
                 num_micro_batches, run_level,
-                update_node.id if update_node is not None else None)
+                update_node.id if update_node is not None else None,
+                # remat/offload contexts are baked into the traced plan
+                getattr(self, "_recompute_policy", None),
+                getattr(self, "_offload", False))
 
     def _split_micro_batches(self, feeds: Dict[int, Any], n: int):
         """Split feed arrays along dim 0 into n micro-batches
@@ -443,8 +446,23 @@ class DefineAndRunGraph(Graph):
         (executable_graph.h:292-303).
         """
         graph = self
+        # activation recompute / host offload (reference recompute +
+        # activation_cpu_offload graph passes -> XLA remat policies)
+        from .recompute import offload_policy, resolve_policy
+        remat_policy = resolve_policy(getattr(self, "_recompute_policy", None))
+        if getattr(self, "_offload", False):
+            off = offload_policy()
+            remat_policy = off if off is not None else (
+                remat_policy or jax.checkpoint_policies.nothing_saveable)
+        scaler = update_node.attrs.get("grad_scaler") \
+            if update_node is not None else None
+        if scaler is not None and not scaler.enabled:
+            scaler = None
 
         def step(var_state, opt_state, grad_accum, feeds_mb):
+            scale = opt_state["_scaler"]["scale"] if scaler is not None \
+                else None
+
             # feeds_mb: list of per-micro-batch dicts
             def fwd_bwd(mb_feeds):
                 env = {**var_state, **mb_feeds}
@@ -456,10 +474,20 @@ class DefineAndRunGraph(Graph):
                     def loss_fn(vv):
                         inner = {**env, **vv}
                         (lv,) = graph._eval_targets([loss_t], inner)
-                        return (jnp.sum(lv) if lv.ndim > 0 else lv)
+                        lv = jnp.sum(lv) if lv.ndim > 0 else lv
+                        if scaler is not None:
+                            lv = scaler.scale_loss(lv, {"scale": scale})
+                        return lv
 
+                    if remat_policy is not None:
+                        loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
                     var_vals = {t.id: env[t.id] for t in xs}
                     loss_val, grads = jax.value_and_grad(loss_fn)(var_vals)
+                    if scaler is not None:
+                        loss_val = scaler.unscale_loss(
+                            loss_val, {"scale": scale})
+                        grads = scaler.unscale_grads(
+                            grads, {"scale": scale})
                     # evaluate non-loss fetches too
                     other = [f for f in fetches if f.id != loss_t.id]
                     other_vals = graph._eval_targets(other, env) if other else []
@@ -512,8 +540,22 @@ class DefineAndRunGraph(Graph):
 
             # UPDATE: apply optimizer
             opt = update_node.attrs["optimizer"]
+            opt_core = {k: v for k, v in opt_state.items() if k != "_scaler"}
             new_vars, new_opt = opt._apply_updates(
-                var_state, opt_state, acc_grads, update_node.attrs["xs"])
+                var_state, opt_core, acc_grads, update_node.attrs["xs"])
+            if scaler is not None:
+                # skip the update (params AND optimizer state) on overflow,
+                # then grow/backoff the scale (reference update_scale op)
+                from .amp import check_finite
+                finite = check_finite(acc_grads)
+
+                def _sel(new, old):
+                    return jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(finite, n, o), new, old)
+                new_vars = _sel(new_vars, var_state)
+                new_opt = _sel(new_opt, opt_core)
+                new_opt["_scaler"] = scaler.update_state(
+                    opt_state["_scaler"], finite)
             new_accum = {k: jnp.zeros_like(v) for k, v in grad_accum.items()} \
                 if grad_accum else {}
             return fetch_vals, new_vars, new_opt, new_accum
@@ -612,10 +654,16 @@ class DefineAndRunGraph(Graph):
 
         var_state = dict(self._var_data)
         opt_state = {}
+        scaler = None
         if update_node is not None:
             opt = update_node.attrs["optimizer"]
-            opt_state = opt._ensure_state(var_state, update_node.attrs["xs"],
-                                          self)
+            opt_state = dict(opt._ensure_state(
+                var_state, update_node.attrs["xs"], self))
+            scaler = update_node.attrs.get("grad_scaler")
+            if scaler is not None and not scaler.enabled:
+                scaler = None
+            if scaler is not None:
+                opt_state["_scaler"] = scaler.init_state()
         grad_accum = dict(self._grad_accum)
 
         fetch_vals, new_vars, new_opt, new_accum = jit_step(
@@ -623,6 +671,9 @@ class DefineAndRunGraph(Graph):
 
         self._var_data = dict(new_vars)
         if update_node is not None:
+            new_opt = dict(new_opt)
+            if scaler is not None and "_scaler" in new_opt:
+                scaler.store_state(new_opt.pop("_scaler"))
             update_node.attrs["optimizer"]._store_state(new_opt)
         self._grad_accum = dict(new_accum)
         # restore fetch arity: update-op positions yield None

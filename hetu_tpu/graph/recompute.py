@@ -1,0 +1,112 @@
+"""Activation recompute + host offload contexts.
+
+TPU-native re-expression of the reference's graph passes:
+
+* ``Recompute::InsertRecomputedOps`` (``hetu/graph/recompute/recompute.h:27``)
+  clones max recompute-subgraphs and rewires backward inputs — on TPU the
+  same FLOPs-for-HBM trade is XLA rematerialization: ``ht.recompute()``
+  records a ``jax.checkpoint`` policy on the current graph, and the traced
+  step function wraps its fwd/bwd closure with that policy.  Policies map
+  Hetu's "recompute everything in the marked subgraph" to XLA's
+  checkpoint-policy vocabulary.
+* ``ActivationCPUOffload::OffloadToCPU``
+  (``hetu/graph/offload/activation_cpu_offload.h:25``) inserts D2H/H2D
+  transfer ops on a dedicated offload stream — on TPU ``ht.cpu_offload()``
+  selects an offloading checkpoint policy that parks saved residuals in
+  ``pinned_host`` memory (XLA schedules the HBM<->host DMAs asynchronously,
+  playing the role of ``kOffloadStream``).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .graph import get_default_graph
+
+_POLICIES = {
+    # recompute everything (Hetu's marked-subgraph recompute, maximal)
+    "nothing_saveable": lambda: jax.checkpoint_policies.nothing_saveable,
+    # keep matmul outputs, recompute elementwise (cheap default on TPU:
+    # MXU results are expensive to recompute, VPU chains are free)
+    "dots_saveable": lambda: jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable":
+        lambda: jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "everything_saveable": lambda: jax.checkpoint_policies.everything_saveable,
+}
+
+
+def resolve_policy(name: Optional[str]):
+    if name is None:
+        return None
+    if callable(name):
+        return name
+    return _POLICIES[name]()
+
+
+class recompute:
+    """``with ht.recompute():`` — enable rematerialization for step
+    functions built from the current graph (reference
+    ``python/hetu/__init__.py:232``).
+
+    ``policy`` picks what is saved across fwd->bwd:
+    ``"nothing_saveable"`` (default; recompute all activations) |
+    ``"dots_saveable"`` | ``"dots_with_no_batch_dims_saveable"`` |
+    ``"everything_saveable"`` | any jax checkpoint policy callable.
+    """
+
+    def __init__(self, policy: str = "nothing_saveable", graph=None,
+                 multi_recompute=None):
+        # multi_recompute accepted for reference API parity (per-strategy
+        # enable flags); a falsy entry disables recompute entirely.
+        if multi_recompute is not None and not any(
+                bool(x) for x in jax.tree_util.tree_leaves(multi_recompute)):
+            policy = None
+        self.policy_name = policy
+        self.graph = graph
+
+    def __enter__(self):
+        g = self.graph or get_default_graph()
+        self._g = g
+        self._prev = getattr(g, "_recompute_policy", None)
+        g._recompute_policy = self.policy_name
+        return self
+
+    def __exit__(self, *exc):
+        self._g._recompute_policy = self._prev
+
+
+class cpu_offload:
+    """``with ht.cpu_offload():`` — offload saved activations to host
+    memory instead of recomputing (reference
+    ``python/hetu/__init__.py:243``).  Requires a backend with
+    ``pinned_host`` memory space (real TPU); on backends without it the
+    step builder falls back to plain recompute."""
+
+    def __init__(self, graph=None, multi_cpu_offload=None):
+        enabled = True
+        if multi_cpu_offload is not None and not any(
+                bool(x) for x in jax.tree_util.tree_leaves(multi_cpu_offload)):
+            enabled = False
+        self.enabled = enabled
+        self.graph = graph
+
+    def __enter__(self):
+        g = self.graph or get_default_graph()
+        self._g = g
+        self._prev = getattr(g, "_offload", False)
+        g._offload = self.enabled
+        return self
+
+    def __exit__(self, *exc):
+        self._g._offload = self._prev
+
+
+def offload_policy():
+    """Checkpoint policy parking dot outputs in host memory; None when the
+    running jax has no offload-policy support."""
+    try:
+        return jax.checkpoint_policies.offload_dot_products_to_host(
+            "device", "pinned_host")
+    except Exception:
+        return None

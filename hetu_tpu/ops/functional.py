@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.dtype import canonicalize_dtype
+from ..graph import amp
 from ..graph.graph import Graph, get_default_graph
 from ..graph.tensor import Tensor
 
@@ -36,6 +37,8 @@ def _graph_of(*xs) -> Graph:
 
 def _op(op_type: str, impl, inputs: Sequence[Any], attrs=None, name="",
         num_outputs: int = 1):
+    if amp._autocast_stack:
+        impl = amp.wrap_impl(op_type, impl)
     g = _graph_of(*inputs)
     return g.make_op(op_type, impl, inputs, attrs or {}, name,
                      num_outputs=num_outputs)

@@ -36,14 +36,16 @@ class Optimizer:
     # -- graph API (reference Optimizer::Minimize) ---------------------------
 
     def minimize(self, loss: Tensor,
-                 var_list: Optional[Sequence[Tensor]] = None) -> Tensor:
+                 var_list: Optional[Sequence[Tensor]] = None,
+                 grad_scaler=None) -> Tensor:
         g = loss.graph or get_default_graph()
         xs = list(var_list or self.params or g.trainable_variables)
         assert xs, "no trainable variables to optimize"
         grad_node_outputs = g.make_gradients(loss, xs)
         grad_node = grad_node_outputs[0].producer
         node = OpNode("update", None, grad_node_outputs,
-                      {"optimizer": self, "grad_node": grad_node, "xs": xs},
+                      {"optimizer": self, "grad_node": grad_node, "xs": xs,
+                       "grad_scaler": grad_scaler},
                       f"update_{loss.name}")
         t = Tensor((), "float32", producer=node, name=node.name, graph=g)
         node.outputs = [t]

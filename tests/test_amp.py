@@ -1,0 +1,151 @@
+"""AMP (autocast + GradScaler) and recompute/offload context tests.
+
+Mirrors the reference's dtype suites (tests/test_bf16.py, test_fp16.py)
+and the autocast/gradscaler stack (hetu/graph/autocast/*)."""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import ops, optim
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+
+
+def _tiny_cfg(**kw):
+    kw.setdefault("vocab_size", 64)
+    kw.setdefault("hidden_size", 32)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("max_seq_len", 16)
+    return GPTConfig(**kw)
+
+
+def test_autocast_casts_matmul_down_and_loss_up():
+    import jax.numpy as jnp
+    with ht.graph("define_and_run", create_new=True) as g:
+        x = ht.placeholder("float32", (4, 8), name="x")
+        w = ht.parameter(np.ones((8, 8), np.float32), name="w")
+        with ht.autocast("bfloat16"):
+            y = ops.matmul(x, w)
+        # matmul impl under autocast computes in bf16
+        env = {w.id: g._materialize_var(w),
+               x.id: jnp.ones((4, 8), jnp.float32)}
+        (out,) = g._eval_targets([y], env)
+        assert out.dtype == jnp.bfloat16
+
+
+def test_autocast_training_step_runs():
+    with ht.graph("define_and_run", create_new=True) as g:
+        cfg = _tiny_cfg(dtype="float32")
+        ids = ht.placeholder("int32", (2, 16), name="ids")
+        labels = ht.placeholder("int32", (2, 16), name="labels")
+        with ht.autocast("bfloat16"):
+            model = GPTLMHeadModel(cfg)
+            loss = model(ids, labels)
+        train_op = optim.AdamOptimizer(lr=1e-3).minimize(loss)
+        IDS = np.random.RandomState(0).randint(0, 64, (2, 16)).astype(np.int32)
+        out = g.run(loss, [loss, train_op], {ids: IDS, labels: IDS})
+        assert np.isfinite(float(np.asarray(out[0])))
+
+
+def test_grad_scaler_scales_and_recovers():
+    scaler = ht.GradScaler(init_scale=1024.0, growth_interval=1)
+    with ht.graph("define_and_run", create_new=True) as g:
+        x = ht.placeholder("float32", (4, 8), name="x")
+        w = ht.parameter(np.full((8, 4), 0.1, np.float32), name="w")
+        y = ops.reduce_mean(ops.matmul(x, w))
+        train_op = optim.SGDOptimizer(lr=0.1).minimize(
+            y, grad_scaler=scaler)
+        X = np.ones((4, 8), np.float32)
+        w0 = np.asarray(g._materialize_var(w)).copy()
+        out = g.run(y, [y, train_op], {x: X})
+        w1 = np.asarray(g.get_tensor_value(w))
+        assert not np.allclose(w0, w1)        # finite step applied
+        assert scaler.scale == 2048.0         # grew after 1 good step
+        # loss reported unscaled
+        assert abs(float(np.asarray(out[0])) - float((X @ w0).mean())) < 1e-4
+
+
+def test_grad_scaler_skips_nonfinite_step():
+    scaler = ht.GradScaler(init_scale=64.0, growth_interval=1000)
+    with ht.graph("define_and_run", create_new=True) as g:
+        x = ht.placeholder("float32", (4,), name="x")
+        w = ht.parameter(np.ones((4,), np.float32), name="w")
+        y = ops.reduce_sum(ops.mul(x, w))
+        train_op = optim.SGDOptimizer(lr=0.1).minimize(
+            y, grad_scaler=scaler)
+        w0 = np.asarray(g._materialize_var(w)).copy()
+        X = np.array([1.0, np.inf, 1.0, 1.0], np.float32)
+        g.run(y, [y, train_op], {x: X})
+        w1 = np.asarray(g.get_tensor_value(w))
+        assert np.allclose(w0, w1)            # update skipped
+        assert scaler.scale == 32.0           # backed off
+
+
+def test_recompute_context_matches_baseline():
+    def _train(ctx):
+        from hetu_tpu.graph import ctor
+        ctor._seed_counter[0] = 0  # identical param init across the two runs
+        with ht.graph("define_and_run", create_new=True) as g:
+            cfg = _tiny_cfg(dtype="float32")
+            ids = ht.placeholder("int32", (2, 16), name="ids")
+            labels = ht.placeholder("int32", (2, 16), name="labels")
+            model = GPTLMHeadModel(cfg)
+            loss = model(ids, labels)
+            opt = optim.SGDOptimizer(lr=0.0)  # lr=0: loss deterministic
+            train_op = opt.minimize(loss)
+            IDS = np.random.RandomState(1).randint(
+                0, 64, (2, 16)).astype(np.int32)
+            if ctx is None:
+                out = g.run(loss, [loss, train_op],
+                            {ids: IDS, labels: IDS})
+            else:
+                with ctx(graph=g):
+                    out = g.run(loss, [loss, train_op],
+                                {ids: IDS, labels: IDS})
+            return float(np.asarray(out[0]))
+
+    # remat must not change the math: same init -> same loss
+    base = _train(None)
+    remat = _train(ht.recompute)
+    assert abs(base - remat) < 1e-4
+
+
+def test_disabled_scaler_is_inert_across_runs():
+    # regression: a disabled scaler must not inject donated state that is
+    # never returned (second run would hit deleted buffers on TPU)
+    scaler = ht.GradScaler(enabled=False)
+    with ht.graph("define_and_run", create_new=True) as g:
+        x = ht.placeholder("float32", (4,), name="x")
+        w = ht.parameter(np.ones((4,), np.float32), name="w")
+        y = ops.reduce_sum(ops.mul(x, w))
+        train_op = optim.SGDOptimizer(lr=0.1).minimize(y, grad_scaler=scaler)
+        X = np.ones((4,), np.float32)
+        g.run(y, [y, train_op], {x: X})
+        g.run(y, [y, train_op], {x: X})  # must not raise
+
+
+def test_plan_key_includes_remat_policy():
+    with ht.graph("define_and_run", create_new=True) as g:
+        x = ht.placeholder("float32", (4,), name="x")
+        w = ht.parameter(np.ones((4,), np.float32), name="w")
+        y = ops.reduce_sum(ops.mul(x, w))
+        train_op = optim.SGDOptimizer(lr=0.1).minimize(y)
+        X = np.ones((4,), np.float32)
+        g.run(y, [y, train_op], {x: X})
+        n = len(g._plan_pool)
+        with ht.recompute(graph=g):
+            g.run(y, [y, train_op], {x: X})
+        assert len(g._plan_pool) == n + 1  # remat keyed a fresh plan
+        g.run(y, [y, train_op], {x: X})
+        assert len(g._plan_pool) == n + 1  # original plan reused
+
+
+def test_cpu_offload_context_runs():
+    with ht.graph("define_and_run", create_new=True) as g:
+        x = ht.placeholder("float32", (4, 8), name="x")
+        w = ht.parameter(np.ones((8, 4), np.float32) * 0.1, name="w")
+        y = ops.reduce_mean(ops.relu(ops.matmul(x, w)))
+        train_op = optim.SGDOptimizer(lr=0.1).minimize(y)
+        with ht.cpu_offload(graph=g):
+            out = g.run(y, [y, train_op], {x: np.ones((4, 8), np.float32)})
+        assert np.isfinite(float(np.asarray(out[0])))
