@@ -1,0 +1,12 @@
+"""Elastic training engine (Malleus).
+
+TPU-native re-expression of the reference's ``python/elastic/engine``:
+straggler profiling, heterogeneity-aware strategy solving, and a Trainer
+that live-switches the graph between parallel layouts.
+"""
+from .straggler import Straggler, StragglerWorkload
+from .strategy import Strategy, StrategyModel
+from .trainer import Trainer
+
+__all__ = ["Straggler", "StragglerWorkload", "Strategy", "StrategyModel",
+           "Trainer"]

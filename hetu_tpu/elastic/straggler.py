@@ -1,0 +1,137 @@
+"""Straggler profiling + synthetic straggler workloads.
+
+TPU-native re-expression of the reference's straggler detector
+(``python/elastic/engine/straggler.py:20``: per-GPU op timings written to
+``HETU_STRAGGLER_LOG_FILE`` by the C++ executor and read back as relative
+slowdown ratios) and its fault-injection workloads
+(``workloads/cuda/workload_heavy_compute.cu`` — spin kernels launched
+beside training; ``examples/malleus/test_straggler_workload.py``).
+
+On TPU a single XLA program is SPMD across the slice, so per-device timing
+comes from per-*host* step timing (each host drives its local devices;
+slow hosts gate their devices) merged through the coordinator KV store.
+For single-process simulation and tests, ratios can be injected via
+``HETU_TPU_STRAGGLER_RATIOS`` (comma list) or a registered
+:class:`StragglerWorkload` — the analogue of the reference's spin-kernel
+injection.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+ENV_RATIOS = "HETU_TPU_STRAGGLER_RATIOS"
+ENV_LOG_FILE = "HETU_TPU_STRAGGLER_LOG_FILE"
+
+
+class StragglerWorkload:
+    """Synthetic per-device slowdown injection (fault injection for tests;
+    reference workload_{heavy_compute,heavy_communicate,stall_communicate}).
+
+    ``ratios[i]`` is the slowdown multiplier of device i (1.0 = healthy).
+    When registered on a :class:`Straggler`, profiling reports these ratios
+    as if they had been measured.
+    """
+
+    def __init__(self, ratios: Sequence[float]):
+        self.ratios = [float(r) for r in ratios]
+
+    def perturb(self, base_seconds: float) -> List[float]:
+        return [base_seconds * r for r in self.ratios]
+
+
+class Straggler:
+    """Measure relative per-device slowdown ratios.
+
+    Usage (mirrors the reference Straggler)::
+
+        prof = Straggler(num_devices)
+        prof.begin_profile()
+        for _ in range(k): graph.run(...)   # timed steps
+        prof.end_profile(steps=k)
+        ratios = prof.read_profile()        # [1.0, 1.0, 1.7, ...]
+    """
+
+    def __init__(self, num_devices: int, kv_store=None, host_id: int = 0,
+                 devices_per_host: Optional[int] = None):
+        self.num_devices = num_devices
+        self.kv = kv_store           # coordinator KV (multi-host merge)
+        self.host_id = host_id
+        self.devices_per_host = devices_per_host or num_devices
+        self._t0: Optional[float] = None
+        self._seconds_per_step: Optional[float] = None
+        self._workload: Optional[StragglerWorkload] = None
+
+    # -- fault injection -----------------------------------------------------
+
+    def inject(self, workload: Optional[StragglerWorkload]) -> None:
+        self._workload = workload
+
+    # -- profiling -----------------------------------------------------------
+
+    def begin_profile(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def end_profile(self, steps: int = 1) -> None:
+        assert self._t0 is not None, "begin_profile not called"
+        self._seconds_per_step = (time.perf_counter() - self._t0) / max(1, steps)
+        self._t0 = None
+        if self.kv is not None:
+            self.kv.put(f"straggler/{self.host_id}",
+                        json.dumps(self._seconds_per_step))
+        log = os.environ.get(ENV_LOG_FILE)
+        if log:
+            with open(log, "a") as f:
+                f.write(json.dumps({"host": self.host_id,
+                                    "sec_per_step": self._seconds_per_step})
+                        + "\n")
+
+    def read_profile(self) -> List[float]:
+        """Relative slowdown ratio per device (min over devices == 1.0)."""
+        env = os.environ.get(ENV_RATIOS)
+        if env:
+            vals = [float(x) for x in env.split(",")]
+            assert len(vals) == self.num_devices, \
+                f"{ENV_RATIOS} has {len(vals)} entries, " \
+                f"need {self.num_devices}"
+            return self._normalize(vals)
+        if self._workload is not None:
+            base = self._seconds_per_step or 1.0
+            return self._normalize(self._workload.perturb(base))
+        if self.kv is not None:
+            # merge per-host step times: a host's devices all inherit its time
+            n_hosts = (self.num_devices + self.devices_per_host - 1) \
+                // self.devices_per_host
+            per_host: List[Optional[float]] = []
+            for h in range(n_hosts):
+                v = self.kv.get(f"straggler/{h}", timeout=5.0)
+                per_host.append(float(json.loads(v)) if v is not None
+                                else None)
+            observed = [v for v in per_host if v is not None] \
+                or [self._seconds_per_step or 1.0]
+            # a host that never reported is the straggler scenario itself:
+            # treat it as far slower than anything observed, never as healthy
+            missing = [h for h, v in enumerate(per_host) if v is None]
+            if missing:
+                import warnings
+                warnings.warn(f"straggler profile missing for hosts "
+                              f"{missing}; treating them as 10x slowest")
+                worst = max(observed) * 10.0
+                per_host = [worst if v is None else v for v in per_host]
+            vals = []
+            for i in range(self.num_devices):
+                vals.append(per_host[i // self.devices_per_host])
+            return self._normalize(vals)
+        # single-host SPMD: XLA gives no per-device skew; everything healthy
+        return [1.0] * self.num_devices
+
+    @staticmethod
+    def _normalize(vals: Sequence[float]) -> List[float]:
+        lo = min(vals)
+        if lo <= 0:
+            raise ValueError(f"non-positive straggler timing {vals}")
+        return [v / lo for v in vals]

@@ -1,0 +1,241 @@
+"""Elastic engine (Malleus) tests: straggler profiling, strategy solving,
+and Trainer-driven hot switching on the virtual 8-device mesh.
+
+Mirrors the reference's elastic flow (python/elastic/engine/*,
+examples/malleus/test_straggler_workload.py)."""
+import os
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import hetu_tpu as ht
+from hetu_tpu import optim
+from hetu_tpu.elastic import (Straggler, StragglerWorkload, Strategy,
+                              StrategyModel, Trainer)
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+
+
+# ---------------------------------------------------------------------------
+# Straggler
+# ---------------------------------------------------------------------------
+
+def test_straggler_env_injection(monkeypatch):
+    monkeypatch.setenv("HETU_TPU_STRAGGLER_RATIOS", "2.0,1.0,1.0,1.0")
+    s = Straggler(4)
+    assert s.read_profile() == [2.0, 1.0, 1.0, 1.0]
+
+
+def test_straggler_workload_injection():
+    s = Straggler(4)
+    s.inject(StragglerWorkload([1.0, 1.0, 3.0, 1.0]))
+    s.begin_profile()
+    s.end_profile(steps=1)
+    ratios = s.read_profile()
+    assert ratios[2] == pytest.approx(3.0)
+    assert min(ratios) == 1.0
+
+
+def test_straggler_healthy_default():
+    s = Straggler(8)
+    assert s.read_profile() == [1.0] * 8
+
+
+# ---------------------------------------------------------------------------
+# StrategyModel
+# ---------------------------------------------------------------------------
+
+def test_tp_grouping_quarantines_stragglers():
+    m = StrategyModel(num_devices=8, num_layers=8)
+    ratios = [1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 2.0, 2.0]
+    groups, times = m.solve_tp_arrangements(ratios, tp=2)
+    # the two slow devices must share one group, not gate two groups
+    slow_groups = [g for g in groups if 6 in g or 7 in g]
+    assert len(slow_groups) == 1
+    assert sorted(times) == [1.0, 1.0, 1.0, 2.0]
+
+
+def test_layer_partition_favors_fast_stages():
+    from hetu_tpu.elastic.strategy import _partition_layers
+    layers, tmax = _partition_layers(12, [1.0, 2.0])  # stage1 2x slower
+    assert sum(layers) == 12
+    assert layers[0] > layers[1]          # fast stage takes more layers
+    assert tmax == max(layers[0] * 1.0, layers[1] * 2.0)
+
+
+def test_micro_batch_apportionment():
+    from hetu_tpu.elastic.strategy import _apportion
+    mb = _apportion(8, [1.0, 1.0])
+    assert mb == [4, 4]
+    mb = _apportion(9, [2.0, 1.0])
+    assert sum(mb) == 9 and mb[0] > mb[1]
+
+
+def test_make_plans_homogeneous_prefers_pure_dp():
+    # healthy devices + comm overhead -> dp-only should win
+    m = StrategyModel(num_devices=8, num_layers=8, num_micro_batches=4)
+    plans = m.make_plans([1.0] * 8, top_k=0)
+    assert plans
+    best = plans[0]
+    assert best.tp == 1 and best.pp == 1 and best.dp == 8
+    assert all(sum(s) == 8 for s in best.stage_layers)
+
+
+def test_make_plans_straggler_changes_layout():
+    m = StrategyModel(num_devices=8, num_layers=8, num_micro_batches=4,
+                      tp_candidates=[2], pp_candidates=[2])
+    ratios = [1.0] * 6 + [3.0, 3.0]
+    (plan,) = m.make_plans(ratios, top_k=1)
+    assert plan.tp == 2 and plan.pp == 2 and plan.dp == 2
+    # slow pair shares one tp group; the stage holding it gets fewer layers
+    assert sorted(plan.device_order) == list(range(8))
+    slow_stage_layers = None
+    flat = plan.tp_group_times
+    for p in range(plan.dp):
+        for s in range(plan.pp):
+            if flat[p * plan.pp + s] == 3.0:
+                slow_stage_layers = plan.stage_layers[p][s]
+    assert slow_stage_layers is not None
+    assert slow_stage_layers < max(max(s) for s in plan.stage_layers)
+
+
+def test_strategy_mesh_shape():
+    s = Strategy(tp=2, pp=2, dp=2, device_order=list(range(8)),
+                 stage_layers=[[4, 4], [4, 4]], micro_batches=[2, 2],
+                 est_step_time=1.0)
+    assert s.mesh_shape == {"pp": 2, "dp": 2, "tp": 2}
+    # size-1 axes are kept: dropping them would strip axis names from param
+    # specs on a switch and break a later switch back to tp>1
+    s2 = Strategy(tp=1, pp=1, dp=8, device_order=list(range(8)),
+                  stage_layers=[[8]] * 8, micro_batches=[1] * 8,
+                  est_step_time=1.0)
+    assert s2.mesh_shape == {"pp": 1, "dp": 8, "tp": 1}
+
+
+def test_switch_to_dp_only_and_back_keeps_tp_sharding(devices8):
+    # regression for the round-trip: tp=2 -> dp-only plan -> tp=2 again must
+    # re-shard weights on tp, not leave them replicated
+    mesh = ht.create_mesh({"pp": 1, "dp": 4, "tp": 2}, devices8)
+    g, loss, train_op, opt, data = _build_training(mesh)
+    trainer = Trainer(g, loss, train_op, opt, data,
+                      StrategyModel(num_devices=8, num_layers=2,
+                                    num_micro_batches=2,
+                                    tp_candidates=[1, 2],
+                                    pp_candidates=[1]),
+                      num_micro_batches=2)
+    trainer.train_steps(1)
+
+    def tp_sharded_params():
+        return [a for a in g._var_data.values()
+                if any("tp" in ((e,) if isinstance(e, str) else (e or ()))
+                       for e in (a.sharding.spec or []))]
+
+    assert tp_sharded_params(), "model should start tp-sharded"
+    trainer.retune([1.0] * 8)          # healthy -> dp-only wins
+    assert trainer.current_strategy.tp == 1
+    trainer.train_steps(1)
+    # now force tp=2 back via candidates
+    trainer.solver.tp_candidates = [2]
+    trainer.retune([1.0] * 6 + [5.0, 5.0])
+    assert trainer.current_strategy.tp == 2
+    trainer.train_steps(1)
+    assert tp_sharded_params(), "tp sharding must survive the round trip"
+
+
+def test_straggler_kv_missing_host_treated_slow():
+    class FakeKV:
+        def __init__(self):
+            self.d = {"straggler/0": "1.0"}
+
+        def put(self, k, v):
+            self.d[k] = v
+
+        def get(self, k, timeout=None):
+            return self.d.get(k)
+
+    s = Straggler(4, kv_store=FakeKV(), host_id=0, devices_per_host=2)
+    s._seconds_per_step = 1.0
+    with pytest.warns(UserWarning, match="missing"):
+        ratios = s.read_profile()
+    # host 1 never reported -> its devices must look SLOW, not healthy
+    assert ratios[2] > 5.0 and ratios[3] > 5.0
+    assert ratios[0] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Trainer end-to-end on virtual devices
+# ---------------------------------------------------------------------------
+
+def _build_training(mesh, batch=8, seq=16):
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                    max_seq_len=seq, dtype="float32")
+    g_ctx = ht.graph("define_and_run", create_new=True, mesh=mesh)
+    g = g_ctx.__enter__()
+    ids = ht.parallel_placeholder("int32", (batch, seq), pspec=P("dp", None),
+                                  name="ids")
+    labels = ht.parallel_placeholder("int32", (batch, seq),
+                                     pspec=P("dp", None), name="labels")
+    model = GPTLMHeadModel(cfg)
+    loss = model(ids, labels)
+    opt = optim.AdamOptimizer(lr=1e-2)
+    train_op = opt.minimize(loss)
+    g_ctx.__exit__()
+    rng = np.random.RandomState(0)
+    IDS = rng.randint(0, 64, (batch, seq)).astype(np.int32)
+    L = np.roll(IDS, -1, 1)
+
+    def data_provider(step):
+        return {ids: IDS, labels: L}
+
+    return g, loss, train_op, opt, data_provider
+
+
+def test_trainer_elastic_switch(devices8, monkeypatch):
+    mesh = ht.create_mesh({"dp": 4, "tp": 2}, devices8)
+    g, loss, train_op, opt, data = _build_training(mesh)
+    solver = StrategyModel(num_devices=8, num_layers=2, num_micro_batches=2,
+                           tp_candidates=[1, 2, 4], pp_candidates=[1])
+    trainer = Trainer(g, loss, train_op, opt, data, solver,
+                      num_micro_batches=2)
+    l0 = trainer.train_steps(3)
+    # inject a straggler pair -> solver should pick tp=2 quarantine and the
+    # trainer must live-switch the mesh (device permutation)
+    monkeypatch.setenv("HETU_TPU_STRAGGLER_RATIOS",
+                       "1.0,1.0,1.0,1.0,1.0,1.0,4.0,4.0")
+    switched = trainer.retune()
+    assert switched
+    assert trainer.current_strategy is not None
+    assert g.mesh is not None
+    # training continues seamlessly on the new layout
+    l1 = trainer.train_steps(3)
+    assert all(np.isfinite(v) for v in l0 + l1)
+    assert l1[-1] < l0[0]   # still learning after the switch
+    assert trainer.history and trainer.history[-1]["switch_seconds"] >= 0
+
+
+def test_trainer_no_switch_when_healthy(devices8):
+    mesh = ht.create_mesh({"dp": 8}, devices8)
+    g, loss, train_op, opt, data = _build_training(mesh)
+    solver = StrategyModel(num_devices=8, num_layers=2, num_micro_batches=2,
+                           tp_candidates=[1, 2], pp_candidates=[1])
+    trainer = Trainer(g, loss, train_op, opt, data, solver,
+                      num_micro_batches=2)
+    trainer.train_steps(1)
+    # healthy ratios: first retune adopts the solved plan (dp8); a second
+    # retune with the same ratios must be a no-op
+    trainer.retune([1.0] * 8)
+    before = len(trainer.history)
+    assert not trainer.retune([1.0] * 8)
+    assert len(trainer.history) == before
+
+
+def test_trainer_run_with_profile_interval(devices8):
+    mesh = ht.create_mesh({"dp": 8}, devices8)
+    g, loss, train_op, opt, data = _build_training(mesh)
+    solver = StrategyModel(num_devices=8, num_layers=2, num_micro_batches=2,
+                           tp_candidates=[1], pp_candidates=[1])
+    trainer = Trainer(g, loss, train_op, opt, data, solver,
+                      num_micro_batches=2)
+    losses = trainer.run(6, profile_interval=3)
+    assert len(losses) == 6
+    assert losses[-1] < losses[0]
