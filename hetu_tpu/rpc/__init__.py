@@ -1,0 +1,12 @@
+"""Cluster coordination: rendezvous/KV/barrier/heartbeat service + launcher.
+
+TPU-native re-expression of the reference's gRPC DeviceController control
+plane (``hetu/impl/communication/protos/heturpc.proto:11-64``, Python
+servers ``python/hetu/rpc/heturpc_polling_server.py``) and the
+parallel-SSH launcher (``python/hetu/rpc/pssh_start.py``).
+"""
+from .coordinator import CoordinatorClient, CoordinatorServer
+from .launcher import HostSpec, Launcher, load_hostfile
+
+__all__ = ["CoordinatorServer", "CoordinatorClient", "Launcher", "HostSpec",
+           "load_hostfile"]

@@ -1,0 +1,173 @@
+"""Coordinator service + launcher tests.
+
+Mirrors the reference control plane (heturpc.proto surface: rendezvous,
+typed KV, barrier, heartbeat/failure detection) and the pssh launcher's
+local-simulation mode (N processes on localhost, SURVEY.md §4)."""
+import sys
+import threading
+import time
+
+import pytest
+
+from hetu_tpu.rpc import (CoordinatorClient, CoordinatorServer, HostSpec,
+                          Launcher, load_hostfile)
+
+
+def test_connect_assigns_dense_ranks():
+    with CoordinatorServer(world_size=3) as srv:
+        clients = [CoordinatorClient(srv.address, uid=f"w{i}")
+                   for i in range(3)]
+        ranks = sorted(c.connect() for c in clients)
+        assert ranks == [0, 1, 2]
+        assert clients[0].world_size == 3
+        # reconnect with same uid keeps the rank (restart scenario)
+        c2 = CoordinatorClient(srv.address, uid="w1")
+        assert c2.connect() == clients[1].rank
+        assert {c.get_hostname(r) for c in clients[:1]
+                for r in ranks} != set()
+
+
+def test_kv_store_roundtrip_and_blocking_get():
+    with CoordinatorServer() as srv:
+        a = CoordinatorClient(srv.address, uid="a")
+        b = CoordinatorClient(srv.address, uid="b")
+        a.connect(), b.connect()
+        a.put("k/int", 7)
+        a.put("k/json", {"x": [1, 2.5, "s"]})
+        assert b.get("k/int") == 7
+        assert b.get("k/json") == {"x": [1, 2.5, "s"]}
+        assert b.get("missing") is None
+        # blocking get: value published by another thread after a delay
+        def later():
+            time.sleep(0.2)
+            a.put("k/late", "here")
+        threading.Thread(target=later).start()
+        assert b.get("k/late", timeout=5.0) == "here"
+        b.remove("k/int")
+        assert b.get("k/int") is None
+
+
+def test_barrier_synchronizes_threads():
+    with CoordinatorServer(world_size=4) as srv:
+        hits = []
+
+        def worker(i):
+            c = CoordinatorClient(srv.address, uid=f"w{i}")
+            c.connect()
+            time.sleep(0.05 * i)
+            c.barrier("sync")
+            hits.append(time.time())
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        [t.start() for t in ts]
+        [t.join(timeout=10) for t in ts]
+        assert len(hits) == 4
+        assert max(hits) - min(hits) < 1.0   # all released together
+
+
+def test_barrier_timeout():
+    with CoordinatorServer(world_size=2) as srv:
+        c = CoordinatorClient(srv.address, uid="only")
+        c.connect()
+        with pytest.raises(RuntimeError, match="barrier timeout"):
+            c.barrier("never", timeout=0.3)
+
+
+def test_heartbeat_failure_detection():
+    with CoordinatorServer(world_size=2) as srv:
+        a = CoordinatorClient(srv.address, uid="a")
+        b = CoordinatorClient(srv.address, uid="b")
+        a.connect(), b.connect()
+        stop_a = a.start_heartbeat_thread(interval=0.05)
+        time.sleep(0.3)          # b never heartbeats after connect
+        alive, dead = a.alive(ttl=0.2)
+        assert a.rank in alive
+        assert b.rank in dead
+        assert srv.dead_ranks(ttl=0.2) == [b.rank]
+        stop_a.set()
+        b.exit()
+        # exited ranks are not "dead"
+        _, dead2 = a.alive(ttl=0.2)
+        assert b.rank not in dead2
+
+
+def test_jax_coordinator_exchange():
+    with CoordinatorServer(world_size=2) as srv:
+        a = CoordinatorClient(srv.address, uid="a")
+        a.connect()
+        a.commit_jax_coordinator("10.0.0.1:9911")
+        b = CoordinatorClient(srv.address, uid="b")
+        b.connect()
+        assert b.get_jax_coordinator(timeout=1.0) == "10.0.0.1:9911"
+
+
+WORKER = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+from hetu_tpu.rpc.launcher import worker_client
+c = worker_client()
+n = int(os.environ["HETU_TPU_NUM_WORKERS"])
+c.put(f"hello/{{c.rank}}", os.environ["HETU_TPU_WORKER_RANK"])
+c.barrier("all", world_size=n, timeout=30)
+vals = [c.get(f"hello/{{r}}", timeout=10) for r in range(n)]
+assert all(v is not None for v in vals), vals
+c.exit()
+"""
+
+
+def test_launcher_local_workers(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo="/root/repo"))
+    with Launcher([sys.executable, str(script)], num_workers=3) as l:
+        ok = l.monitor(poll=0.1, timeout=60)
+    assert ok == 3
+
+
+def test_launcher_restart_policy(tmp_path):
+    # worker crashes on first attempt (per-rank marker file), then succeeds
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, sys\n"
+        f"marker = {str(tmp_path)!r} + '/died-' + "
+        "os.environ['HETU_TPU_WORKER_RANK']\n"
+        "if not os.path.exists(marker):\n"
+        "    open(marker, 'w').close()\n"
+        "    sys.exit(1)\n"
+        f"sys.path.insert(0, '/root/repo')\n"
+        "from hetu_tpu.rpc.launcher import worker_client\n"
+        "c = worker_client()\n"
+        "c.exit()\n")
+    with Launcher([sys.executable, str(script)], num_workers=2,
+                  max_restart_times=2) as l:
+        ok = l.monitor(poll=0.1, timeout=60)
+    assert ok == 2
+    assert any(e["event"] == "restart" for e in l.events)
+
+
+def test_launcher_gives_up_after_budget(tmp_path):
+    script = tmp_path / "dead.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    with Launcher([sys.executable, str(script)], num_workers=1,
+                  max_restart_times=1) as l:
+        ok = l.monitor(poll=0.1, timeout=60)
+    assert ok == 0
+    assert any(e["event"] == "gave_up" for e in l.events)
+    assert sum(1 for e in l.events if e["event"] == "restart") == 1
+
+
+def test_load_hostfile(tmp_path):
+    hf = tmp_path / "hosts.yaml"
+    hf.write_text(
+        "hosts:\n"
+        "  - addr: localhost\n"
+        "    initial_workers: 4\n"
+        "  - addr: 10.0.0.2\n"
+        "    initial_workers: 2\n"
+        "max_restart_times: 3\n"
+        "heartbeat_interval: 1.5\n")
+    cfg = load_hostfile(str(hf))
+    assert cfg["max_restart_times"] == 3
+    assert cfg["heartbeat_interval"] == 1.5
+    assert [h.addr for h in cfg["hosts"]] == ["localhost", "10.0.0.2"]
+    assert sum(h.initial_workers for h in cfg["hosts"]) == 6
+    assert isinstance(cfg["hosts"][0], HostSpec)
