@@ -8,6 +8,8 @@ from .parallel import (ColumnParallelLinear, RowParallelLinear,
                        ParallelLayerNorm, ParallelRMSNorm,
                        vocab_parallel_cross_entropy, parallel_data_provider,
                        config2ds, sharded)
+from .moe import (MoELayer, Experts, TopKGate, KTop1Gate, HashGate, SAMGate,
+                  BalanceGate, make_moe_layer)
 # Reference-compatible aliases (parallel_multi_ds.py exports)
 HtMultiColumnParallelLinear = ColumnParallelLinear
 HtMultiRowParallelLinear = RowParallelLinear
@@ -29,4 +31,6 @@ __all__ = [
     "HtMultiColumnParallelLinear", "HtMultiRowParallelLinear",
     "HtMultiParallelEmbedding", "HtMultiVocabParallelEmbedding",
     "HtMultiParallelLayerNorm", "HtMultiParallelRMSNorm",
+    "MoELayer", "Experts", "TopKGate", "KTop1Gate", "HashGate", "SAMGate",
+    "BalanceGate", "make_moe_layer",
 ]

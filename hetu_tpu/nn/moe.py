@@ -1,0 +1,421 @@
+"""Mixture-of-Experts with expert parallelism (EP).
+
+TPU-native re-expression of the reference's v1 MoE stack
+(``hetu/v1/python/hetu/layers/moe_layer.py:45`` ``MoELayer``/``Expert``,
+gates ``TopGate.py``/``KTop1Gate.py``/``HashGate.py``/``SAMGate.py``/
+``BalanceGate.py``, HetuMoE).
+
+Instead of the reference's layout_transform + AllToAll CUDA ops, dispatch
+is expressed as dense one-hot einsums (GShard style) so the whole layer is
+three large batched matmuls on the MXU; expert parallelism comes from
+sharding the expert dim of the dispatched activations and the stacked
+expert weights over an ``ep`` mesh axis — GSPMD then lowers the
+dispatch/combine einsums to the same all-to-alls the reference issues
+explicitly (``v1/python/hetu/gpu_ops/AllToAll.py``).
+
+Gate families (parity with the reference):
+- :class:`TopKGate`     — GShard top-1/top-k with capacity + balance loss
+                          (``TopGate.py`` topkgating)
+- :class:`KTop1Gate`    — k prototypes, top-1 over E/k experts each
+                          (``KTop1Gate.py`` ktop1gating)
+- :class:`HashGate`     — static hash routing, no learned gate
+                          (``HashGate.py`` hashgating)
+- :class:`SAMGate`      — switch-aware: top-1 expert *group* then top-k
+                          inside the group + alignment loss (``SAMGate.py``)
+- :class:`BalanceGate`  — BASE-layer balanced assignment via Sinkhorn
+                          iterations (``BalanceGate.py``)
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .. import ops
+from ..graph.ctor import (ConstantInitializer, Initializer,
+                          NormalInitializer, XavierNormalInitializer,
+                          parallel_parameter)
+from .module import Module
+from .parallel import sharded
+
+
+# ---------------------------------------------------------------------------
+# gating maths (pure jnp; static shapes, no data-dependent control flow)
+# ---------------------------------------------------------------------------
+
+def _balance_loss(gates, mask):
+    """l_aux = E * sum_e mean_t(gates) * mean_t(mask) (TopGate.py
+    balance_loss)."""
+    num_experts = gates.shape[-1]
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask.astype(gates.dtype), axis=0)
+    return jnp.sum(me * ce) * num_experts
+
+
+def _positions_in_expert(mask, offset=None):
+    """Per-token slot index within its expert: exclusive running count of
+    earlier tokens routed to the same expert. [T, E] -> [T]."""
+    pos = jnp.cumsum(mask, axis=0) - 1
+    if offset is not None:
+        pos = pos + offset
+    return jnp.sum(pos * mask, axis=1)
+
+
+def _dispatch_combine(masks, gate_vals, capacity):
+    """Build dispatch [T, E, C] (0/1) and combine [T, E, C] (gate-weighted)
+    tensors from per-choice expert masks and gate values.
+
+    masks: list of [T, E] one-hot masks (choice order = priority order)
+    gate_vals: list of [T] gate weights per choice
+    """
+    T, E = masks[0].shape
+    dispatch = jnp.zeros((T, E, capacity), masks[0].dtype)
+    combine = jnp.zeros((T, E, capacity), gate_vals[0].dtype)
+    counts = jnp.zeros((1, E), masks[0].dtype)
+    for mask, gv in zip(masks, gate_vals):
+        loc = _positions_in_expert(mask, offset=counts)           # [T]
+        counts = counts + jnp.sum(mask, axis=0, keepdims=True)
+        keep = (loc < capacity).astype(mask.dtype)                # capacity drop
+        slot = jax.nn.one_hot(loc.astype(jnp.int32), capacity,
+                              dtype=mask.dtype)                   # [T, C]
+        d = (mask * keep[:, None])[:, :, None] * slot[:, None, :]
+        dispatch = dispatch + d
+        combine = combine + gv[:, None, None] * d.astype(gv.dtype)
+    return dispatch, combine
+
+
+def topk_gating_impl(logits, k, capacity_factor):
+    """GShard-style top-k gating (reference TopGate.py topkgating).
+
+    Returns (l_aux, combine [T,E,C], dispatch [T,E,C])."""
+    T, E = logits.shape
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    capacity = k * math.ceil(T / E * capacity_factor)
+    _, topk_idx = lax.top_k(gates, k)                             # [T, k]
+    masks, gate_vals, l_aux = [], [], 0.0
+    for i in range(k):
+        m = jax.nn.one_hot(topk_idx[:, i], E, dtype=jnp.float32)
+        masks.append(m)
+        gate_vals.append(jnp.sum(gates * m, axis=1))
+        l_aux = l_aux + _balance_loss(gates, m)
+    dispatch, combine = _dispatch_combine(masks, gate_vals, capacity)
+    return l_aux, combine, dispatch
+
+
+def ktop1_gating_impl(logits, k, capacity_factor):
+    """k prototypes each routing top-1 over E/k experts (KTop1Gate.py)."""
+    T, E = logits.shape
+    assert E % k == 0, "num_experts must divide into k prototypes"
+    Ep = E // k
+    proto = jax.nn.softmax(
+        logits.astype(jnp.float32).reshape(T, k, Ep), axis=-1)    # [T,k,Ep]
+    capacity = k * math.ceil(T / E * capacity_factor)
+    masks, gate_vals, l_aux = [], [], 0.0
+    for i in range(k):
+        g = proto[:, i, :]                                        # [T, Ep]
+        idx = jnp.argmax(g, axis=-1) + i * Ep                     # global id
+        m = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        masks.append(m)
+        gate_vals.append(jnp.max(g, axis=-1))
+        l_aux = l_aux + _balance_loss(g, m[:, i * Ep:(i + 1) * Ep])
+    dispatch, combine = _dispatch_combine(masks, gate_vals, capacity)
+    return l_aux, combine, dispatch
+
+
+def hash_gating_impl(indices, num_experts, capacity_factor):
+    """Static hash routing (HashGate.py hashgating): expert id is given
+    per token (e.g. ``token_id % E``); gate weight is 1."""
+    T = indices.shape[0]
+    capacity = math.ceil(T / num_experts * capacity_factor)
+    m = jax.nn.one_hot(indices, num_experts, dtype=jnp.float32)
+    dispatch, combine = _dispatch_combine([m], [jnp.ones((T,), jnp.float32)],
+                                          capacity)
+    return jnp.zeros((), jnp.float32), combine, dispatch
+
+
+def sam_gating_impl(logits, k, capacity_factor, num_groups):
+    """Switch-aware gating (SAMGate.py samgating): pick the top-1 expert
+    *group* (groups = EP ranks, each holding E/G local experts), then the
+    top-k experts inside that group; balance loss + alignment loss pushing
+    mass onto the chosen group."""
+    T, E = logits.shape
+    assert E % num_groups == 0
+    Eg = E // num_groups
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    grouped = gates.reshape(T, num_groups, Eg)
+    group_sum = jnp.sum(grouped, axis=-1)                         # [T, G]
+    top_group = jnp.argmax(group_sum, axis=-1)                    # [T]
+    group_mask = jax.nn.one_hot(top_group, num_groups,
+                                dtype=jnp.float32)                # [T, G]
+    # top-k inside the chosen group
+    local = jnp.einsum("tge,tg->te", grouped, group_mask)         # [T, Eg]
+    capacity = k * math.ceil(T / E * capacity_factor)
+    _, topk_local = lax.top_k(local, k)
+    base = top_group * Eg
+    masks, gate_vals, l_aux = [], [], 0.0
+    for i in range(k):
+        idx = base + topk_local[:, i]
+        m = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        masks.append(m)
+        gate_vals.append(jnp.sum(gates * m, axis=1))
+        l_aux = l_aux + _balance_loss(gates, m)
+    # alignment: reward concentration on the selected group
+    l_align = jnp.sum(group_sum * group_mask) / T
+    l_aux = l_aux - l_align
+    dispatch, combine = _dispatch_combine(masks, gate_vals, capacity)
+    return l_aux, combine, dispatch
+
+
+def balance_gating_impl(scores, capacity_factor, n_iters=10):
+    """BASE-layer balanced assignment (BalanceGate.py): Sinkhorn-normalize
+    the token-expert score matrix so every expert receives ~T/E tokens,
+    then greedily assign; gate weight = sigmoid(score)."""
+    T, E = scores.shape
+    s = scores.astype(jnp.float32)
+    logp = jax.nn.log_softmax(s, axis=-1)
+
+    def body(_, lp):
+        lp = lp - jax.nn.logsumexp(lp, axis=0, keepdims=True)  # col balance
+        lp = lp - jax.nn.logsumexp(lp, axis=1, keepdims=True)  # row stochast.
+        return lp
+
+    logp = lax.fori_loop(0, n_iters, body, logp)
+    idx = jnp.argmax(logp, axis=-1)
+    m = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+    capacity = math.ceil(T / E * capacity_factor)
+    gv = jax.nn.sigmoid(jnp.sum(s * m, axis=1))
+    dispatch, combine = _dispatch_combine([m], [gv], capacity)
+    return jnp.zeros((), jnp.float32), combine, dispatch
+
+
+# ---------------------------------------------------------------------------
+# gate modules
+# ---------------------------------------------------------------------------
+
+class _GateBase(Module):
+    """Learned router: Linear(d_model -> num_experts) + a gating impl."""
+
+    def __init__(self, embed_dim: int, num_experts: int,
+                 capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0,
+                 init: Optional[Initializer] = None, dtype=None,
+                 name: str = "gate"):
+        super().__init__()
+        self.embed_dim, self.num_experts = embed_dim, num_experts
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.wg = parallel_parameter(
+            init or XavierNormalInitializer(), (num_experts, embed_dim),
+            pspec=P(), dtype=dtype, name=f"{name}.wg")
+
+    def _cf(self):
+        return self.capacity_factor if self.training \
+            else self.eval_capacity_factor
+
+    def logits(self, x):
+        return ops.linear(x, self.wg, None, trans_b=True)
+
+
+class TopKGate(_GateBase):
+    """GShard top-k gate with capacity + balance aux loss (TopGate.py)."""
+
+    def __init__(self, embed_dim, num_experts, k: int = 1, **kw):
+        super().__init__(embed_dim, num_experts, **kw)
+        self.k = k
+
+    def forward(self, x):
+        cf, k = self._cf(), self.k
+        return ops.functional._op(
+            "topk_gate", lambda lg: topk_gating_impl(lg, k, cf),
+            [self.logits(x)], num_outputs=3)
+
+
+class KTop1Gate(_GateBase):
+    """k prototypes x top-1 gate (KTop1Gate.py)."""
+
+    def __init__(self, embed_dim, num_experts, k: int = 2, **kw):
+        super().__init__(embed_dim, num_experts, **kw)
+        self.k = k
+
+    def forward(self, x):
+        cf, k = self._cf(), self.k
+        return ops.functional._op(
+            "ktop1_gate", lambda lg: ktop1_gating_impl(lg, k, cf),
+            [self.logits(x)], num_outputs=3)
+
+
+class HashGate(Module):
+    """Static hash routing (HashGate.py): no learned parameters."""
+
+    def __init__(self, num_experts: int, capacity_factor: float = 1.0):
+        super().__init__()
+        self.num_experts, self.capacity_factor = num_experts, capacity_factor
+
+    def forward(self, x, token_ids):
+        E, cf = self.num_experts, self.capacity_factor
+        return ops.functional._op(
+            "hash_gate",
+            lambda ids: hash_gating_impl(ids.reshape(-1) % E, E, cf),
+            [token_ids], num_outputs=3)
+
+
+class SAMGate(_GateBase):
+    """Switch-aware top-group-then-top-k gate (SAMGate.py)."""
+
+    def __init__(self, embed_dim, num_experts, k: int = 2,
+                 num_groups: int = 1, **kw):
+        super().__init__(embed_dim, num_experts, **kw)
+        self.k, self.num_groups = k, num_groups
+
+    def forward(self, x):
+        cf, k, G = self._cf(), self.k, self.num_groups
+        return ops.functional._op(
+            "sam_gate", lambda lg: sam_gating_impl(lg, k, cf, G),
+            [self.logits(x)], num_outputs=3)
+
+
+class BalanceGate(_GateBase):
+    """BASE-layer balanced-assignment gate (BalanceGate.py); router weights
+    act as expert centroids."""
+
+    def __init__(self, embed_dim, num_experts, n_iters: int = 10, **kw):
+        super().__init__(embed_dim, num_experts, **kw)
+        self.n_iters = n_iters
+
+    def forward(self, x):
+        cf, n = self._cf(), self.n_iters
+        return ops.functional._op(
+            "balance_gate", lambda sc: balance_gating_impl(sc, cf, n),
+            [self.logits(x)], num_outputs=3)
+
+
+# ---------------------------------------------------------------------------
+# experts + MoE layer
+# ---------------------------------------------------------------------------
+
+class Experts(Module):
+    """E feed-forward experts with stacked weights [E, ...] so all experts
+    run as one batched matmul on the MXU (reference Expert,
+    moe_layer.py:7 — one FFN per expert, here fused)."""
+
+    def __init__(self, num_experts: int, embed_dim: int, ffn_dim: int,
+                 activation: str = "relu", ep_axis: Optional[str] = None,
+                 dtype=None, init: Optional[Initializer] = None,
+                 name: str = "experts"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.activation = activation
+        self.ep_axis = ep_axis
+        espec = P(ep_axis, None, None) if ep_axis else P()
+        self.w1 = parallel_parameter(
+            init or NormalInitializer(0.0, 0.02),
+            (num_experts, embed_dim, ffn_dim), pspec=espec,
+            dtype=dtype, name=f"{name}.w1")
+        self.w2 = parallel_parameter(
+            init or NormalInitializer(0.0, 0.02),
+            (num_experts, ffn_dim, embed_dim), pspec=espec,
+            dtype=dtype, name=f"{name}.w2")
+        self.b1 = parallel_parameter(
+            ConstantInitializer(0.0), (num_experts, 1, ffn_dim),
+            pspec=espec, dtype=dtype, name=f"{name}.b1")
+        self.b2 = parallel_parameter(
+            ConstantInitializer(0.0), (num_experts, 1, embed_dim),
+            pspec=espec, dtype=dtype, name=f"{name}.b2")
+
+    def forward(self, dispatched):
+        """dispatched: [E, C, d] -> [E, C, d]."""
+        act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+               "silu": jax.nn.silu}[self.activation]
+
+        def _impl(x, w1, b1, w2, b2):
+            h = act(jnp.einsum("ecd,edf->ecf", x, w1) + b1)
+            return jnp.einsum("ecf,efd->ecd", h, w2) + b2
+
+        return ops.functional._op(
+            "experts_ffn", _impl,
+            [dispatched, self.w1, self.b1, self.w2, self.b2])
+
+
+class MoELayer(Module):
+    """Gated mixture-of-experts layer (reference MoELayer,
+    moe_layer.py:45).
+
+    Dataflow (T = tokens, E = experts, C = capacity, d = embed):
+      gate(x)             -> l_aux, combine [T,E,C], dispatch [T,E,C]
+      dispatch^T . x      -> [E, C, d]     (sharding: E over ``ep_axis``)
+      experts             -> [E, C, d]     (batched matmuls)
+      combine . expert_out-> [T, d]
+
+    With ``ep_axis`` set, the [E, C, d] tensors are sharded over the EP
+    mesh axis while x is token-sharded — GSPMD inserts the two all-to-alls
+    the reference programs by hand (alltoall_op before/after experts).
+    """
+
+    def __init__(self, gate: Module, experts: Experts,
+                 ep_axis: Optional[str] = None,
+                 dp_axis: Optional[str] = "dp"):
+        super().__init__()
+        self.gate = gate
+        self.experts = experts
+        self.ep_axis, self.dp_axis = ep_axis, dp_axis
+
+    def forward(self, x, token_ids=None):
+        """x: [..., d] -> (out [..., d], l_aux)."""
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        xt = ops.reshape(x, (-1, d))                              # [T, d]
+        if isinstance(self.gate, HashGate):
+            if token_ids is None:
+                raise ValueError("HashGate needs token_ids")
+            l_aux, combine, dispatch = self.gate(xt, token_ids)
+        else:
+            l_aux, combine, dispatch = self.gate(xt)
+        dispatched = ops.einsum("tec,td->ecd", dispatch, xt)      # [E, C, d]
+        if self.ep_axis:
+            dispatched = sharded(dispatched, P(self.ep_axis, None, None))
+        eout = self.experts(dispatched)                           # [E, C, d]
+        if self.ep_axis:
+            eout = sharded(eout, P(self.ep_axis, None, None))
+        out = ops.einsum("tec,ecd->td", combine, eout)            # [T, d]
+        if self.dp_axis:
+            out = sharded(out, P(self.dp_axis, None))
+        out = ops.reshape(out, orig_shape)
+        return out, l_aux
+
+
+def make_moe_layer(embed_dim: int, ffn_dim: int, num_experts: int,
+                   gate_type: str = "topk", k: int = 2,
+                   capacity_factor: float = 1.0,
+                   eval_capacity_factor: Optional[float] = None,
+                   activation: str = "gelu",
+                   ep_axis: Optional[str] = None,
+                   num_groups: int = 1, dtype=None,
+                   name: str = "moe") -> MoELayer:
+    """Convenience ctor mirroring the reference example wiring
+    (``v1/examples/moe/``)."""
+    if eval_capacity_factor is None:
+        eval_capacity_factor = capacity_factor
+    kw = dict(capacity_factor=capacity_factor,
+              eval_capacity_factor=eval_capacity_factor, dtype=dtype,
+              name=f"{name}.gate")
+    if gate_type == "topk":
+        gate = TopKGate(embed_dim, num_experts, k=k, **kw)
+    elif gate_type == "ktop1":
+        gate = KTop1Gate(embed_dim, num_experts, k=k, **kw)
+    elif gate_type == "hash":
+        gate = HashGate(num_experts, capacity_factor)
+    elif gate_type == "sam":
+        gate = SAMGate(embed_dim, num_experts, k=k, num_groups=num_groups,
+                       **kw)
+    elif gate_type == "balance":
+        gate = BalanceGate(embed_dim, num_experts, **kw)
+    else:
+        raise ValueError(f"unknown gate_type {gate_type!r}")
+    experts = Experts(num_experts, embed_dim, ffn_dim,
+                      activation=activation, ep_axis=ep_axis, dtype=dtype,
+                      name=f"{name}.experts")
+    return MoELayer(gate, experts, ep_axis=ep_axis)
