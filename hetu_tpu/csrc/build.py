@@ -1,0 +1,68 @@
+"""Build + load the native planner core (ctypes, cached .so).
+
+The reference ships its solver as a pybind11 extension
+(``tools/Galvatron/csrc/dp_core.cpp``); here we compile a plain C-ABI
+shared library with g++ at first use (cached by source mtime) and bind it
+with ctypes — no pybind11 needed.  All callers must tolerate ``None``
+(compiler missing) and fall back to the pure-Python implementations.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_SRC_DIR, "_build")
+_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+
+def _compile(name: str, sources) -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    so_path = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    srcs = [os.path.join(_SRC_DIR, s) for s in sources]
+    if os.path.exists(so_path) and all(
+            os.path.getmtime(so_path) >= os.path.getmtime(s) for s in srcs):
+        return so_path
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so_path,
+           *srcs]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return so_path
+
+
+def load_native(name: str, sources) -> Optional[ctypes.CDLL]:
+    """Compile-if-stale and dlopen ``lib<name>.so``; None on any failure."""
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        lib = None
+        so = _compile(name, sources)
+        if so is not None:
+            try:
+                lib = ctypes.CDLL(so)
+            except OSError:
+                lib = None
+        _CACHE[name] = lib
+        return lib
+
+
+def load_dp_core() -> Optional[ctypes.CDLL]:
+    lib = load_native("hetu_dp_core", ["dp_core.cc"])
+    if lib is not None and not getattr(lib, "_hetu_sigs_set", False):
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        lib.hetu_dp_strategy_solve.restype = ctypes.c_double
+        lib.hetu_dp_strategy_solve.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            i32p, f64p, f64p, i32p]
+        lib.hetu_dp_pipeline_partition.restype = ctypes.c_double
+        lib.hetu_dp_pipeline_partition.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, f64p, f64p, i32p]
+        lib._hetu_sigs_set = True
+    return lib

@@ -1,0 +1,149 @@
+// Native dynamic-programming cores for the auto-parallel planner.
+//
+// TPU-native counterpart of the reference's Galvatron C++ DP solver
+// (tools/Galvatron/csrc/dp_core.cpp:23 dynamic_programming_core) and the
+// v1 pipeline partitioners (v1/python/hetu/distributed_strategies/
+// {gpipe.py,pipedream.py}).  Exposed through a plain C ABI and loaded via
+// ctypes (no pybind11 in this environment).
+//
+// Build: see hetu_tpu/csrc/build.py (g++ -O2 -shared -fPIC).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+namespace {
+constexpr double kInf = std::numeric_limits<double>::infinity();
+}
+
+extern "C" {
+
+// Per-layer strategy selection under a memory budget with inter-layer
+// transition costs.
+//
+//   layer_num   L
+//   max_mem     discretized memory budget, INCLUSIVE (a plan whose total
+//               memory equals max_mem units is feasible)
+//   strategy_num S
+//   mem_cost    [L*S]   int   memory units consumed by layer i under s
+//   intra_cost  [L*S]   double per-layer execution cost under s
+//   inter_cost  [L*S*S] double transition cost from strategy si (layer i-1)
+//                       to strategy s (layer i)
+//   res_list    [L]     int   chosen strategy per layer (output)
+//
+// Returns the minimal total cost, or +inf if the budget is infeasible.
+double hetu_dp_strategy_solve(int32_t layer_num, int32_t max_mem,
+                              int32_t strategy_num, const int32_t* mem_cost,
+                              const double* intra_cost,
+                              const double* inter_cost, int32_t* res_list) {
+  const int32_t L = layer_num, S = strategy_num;
+  const int32_t M = max_mem + 1;  // states 0..max_mem inclusive
+  // f[v][s]: best cost of layers processed so far using v memory units,
+  // with the last layer running strategy s.  Double-buffered per layer so
+  // zero-memory strategies don't read partially-updated rows.
+  std::vector<double> f(static_cast<size_t>(M) * S, 0.0);
+  std::vector<double> nf(static_cast<size_t>(M) * S, kInf);
+  // choice[i][v][s]: argmin predecessor strategy.
+  std::vector<int32_t> choice(static_cast<size_t>(L) * M * S, -1);
+
+  for (int32_t i = 0; i < L; ++i) {
+    std::fill(nf.begin(), nf.end(), kInf);
+    for (int32_t v = M - 1; v >= 0; --v) {
+      for (int32_t s = 0; s < S; ++s) {
+        const int32_t need = mem_cost[i * S + s];
+        if (v < need) continue;
+        const double* fprev = &f[static_cast<size_t>(v - need) * S];
+        const double* trans = &inter_cost[(static_cast<size_t>(i) * S) * S];
+        double best = kInf;
+        int32_t best_si = -1;
+        for (int32_t si = 0; si < S; ++si) {
+          const double c = fprev[si] + trans[si * S + s];
+          if (c < best) {
+            best = c;
+            best_si = si;
+          }
+        }
+        choice[(static_cast<size_t>(i) * M + v) * S + s] = best_si;
+        if (best_si >= 0)
+          nf[static_cast<size_t>(v) * S + s] = best + intra_cost[i * S + s];
+      }
+    }
+    f.swap(nf);
+  }
+
+  const double* last = &f[static_cast<size_t>(M - 1) * S];
+  int32_t s = static_cast<int32_t>(
+      std::min_element(last, last + S) - last);
+  double total = last[s];
+  if (!(total < kInf)) return kInf;
+
+  int32_t v = M - 1;
+  res_list[L - 1] = s;
+  for (int32_t i = L - 1; i > 0; --i) {
+    const int32_t prev = choice[(static_cast<size_t>(i) * M + v) * S + s];
+    v -= mem_cost[i * S + s];
+    s = prev;
+    res_list[i - 1] = s;
+  }
+  return total;
+}
+
+// Balanced contiguous pipeline partition: split L layers into P stages
+// minimizing the maximum stage cost (layer costs + per-boundary comm cost).
+// DP over (first t layers, k stages).  Mirrors the v1 GPipe/PipeDream
+// partition searching capability.
+//
+//   costs     [L] per-layer time
+//   comm      [L] cost of cutting AFTER layer i (activation send)
+//   boundaries[P-1] output: last layer index of stages 0..P-2
+//
+// Returns the bottleneck (max) stage cost.
+double hetu_dp_pipeline_partition(int32_t layer_num, int32_t num_stages,
+                                  const double* costs, const double* comm,
+                                  int32_t* boundaries) {
+  const int32_t L = layer_num, P = num_stages;
+  std::vector<double> prefix(L + 1, 0.0);
+  for (int32_t i = 0; i < L; ++i) prefix[i + 1] = prefix[i] + costs[i];
+
+  auto seg = [&](int32_t a, int32_t b) {  // layers [a, b)
+    double c = prefix[b] - prefix[a];
+    if (b < L) c += comm[b - 1];  // boundary after layer b-1
+    return c;
+  };
+
+  // g[t][k]: min over partitions of first t layers into k stages of the
+  // bottleneck cost.
+  std::vector<double> g(static_cast<size_t>(L + 1) * (P + 1), kInf);
+  std::vector<int32_t> cut(static_cast<size_t>(L + 1) * (P + 1), -1);
+  g[0] = 0.0;
+  for (int32_t k = 1; k <= P; ++k) {
+    for (int32_t t = k; t <= L - (P - k); ++t) {
+      double best = kInf;
+      int32_t best_j = -1;
+      for (int32_t j = k - 1; j < t; ++j) {
+        const double c =
+            std::max(g[static_cast<size_t>(j) * (P + 1) + (k - 1)],
+                     seg(j, t));
+        if (c < best) {
+          best = c;
+          best_j = j;
+        }
+      }
+      g[static_cast<size_t>(t) * (P + 1) + k] = best;
+      cut[static_cast<size_t>(t) * (P + 1) + k] = best_j;
+    }
+  }
+
+  double total = g[static_cast<size_t>(L) * (P + 1) + P];
+  int32_t t = L;
+  for (int32_t k = P; k > 1; --k) {
+    const int32_t j = cut[static_cast<size_t>(t) * (P + 1) + k];
+    boundaries[k - 2] = j - 1;  // stage k-2 ends at layer j-1
+    t = j;
+  }
+  return total;
+}
+
+}  // extern "C"

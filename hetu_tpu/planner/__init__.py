@@ -1,0 +1,28 @@
+"""Auto-parallel planner: TPU cost model + DP solvers + search engines.
+
+Covers the reference's Galvatron tool (``tools/Galvatron``) and v1
+auto-parallel strategies (``hetu/v1/python/hetu/distributed_strategies/``)
+as first-class framework components.
+"""
+from .cost_model import (CHIPS, ChipSpec, ClusterSpec, LayerSpec, Strategy,
+                         all_gather_time, all_reduce_time, all_to_all_time,
+                         embedding_layer_spec, grad_sync_time, layer_memory,
+                         layer_time, p2p_time, pipeline_time,
+                         reduce_scatter_time, transformer_layer_spec)
+from .dp_solver import solve_layer_strategies, solve_pipeline_partition
+from .search import PlanResult, SearchEngine
+from .strategies import (BaseSearching, FlexFlowSearching, GPipeSearching,
+                         OptCNNSearching, PipeDreamSearching,
+                         PipeOptSearching, SearchResult)
+
+__all__ = [
+    "CHIPS", "ChipSpec", "ClusterSpec", "LayerSpec", "Strategy",
+    "all_gather_time", "all_reduce_time", "all_to_all_time",
+    "embedding_layer_spec", "layer_memory", "layer_time", "p2p_time",
+    "pipeline_time", "reduce_scatter_time", "transformer_layer_spec",
+    "solve_layer_strategies", "solve_pipeline_partition",
+    "PlanResult", "SearchEngine",
+    "BaseSearching", "FlexFlowSearching", "GPipeSearching",
+    "OptCNNSearching", "PipeDreamSearching", "PipeOptSearching",
+    "SearchResult",
+]

@@ -1,0 +1,246 @@
+"""TPU cluster cost model for the auto-parallel planner.
+
+Counterpart of the reference's profiling-driven cost estimation
+(``tools/Galvatron/galvatron/profile_hardware/profile_hardware.py``,
+``galvatron/core/profiler.py``; v1 ``HetuSimulator``,
+``v1/python/hetu/profiler.py``) re-derived for TPU hardware: roofline
+per-layer compute (MXU peak vs HBM bandwidth) and alpha-beta collective
+costs over ICI (intra-slice) and DCN (cross-slice), matching the mental
+model of the scaling-book recipe (pick mesh -> annotate -> collectives
+ride ICI).
+
+All sizes in bytes, times in seconds.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class ChipSpec:
+    """Per-chip hardware parameters."""
+    name: str = "v5p"
+    peak_flops: float = 459e12      # bf16 FLOP/s
+    hbm_bytes: float = 95e9
+    hbm_bw: float = 2765e9          # bytes/s
+    ici_bw: float = 90e9            # bytes/s per link direction
+    ici_links: int = 6              # 3D torus: 2 per dim
+    ici_latency: float = 1e-6
+    dcn_bw: float = 25e9            # bytes/s per host
+    dcn_latency: float = 10e-6
+    mxu_efficiency: float = 0.55    # achievable fraction of peak on matmuls
+
+
+CHIPS: Dict[str, ChipSpec] = {
+    "v4": ChipSpec("v4", 275e12, 32e9, 1228e9, 45e9),
+    "v5e": ChipSpec("v5e", 197e12, 16e9, 819e9, 45e9),
+    "v5p": ChipSpec("v5p"),
+    "v6e": ChipSpec("v6e", 918e12, 32e9, 1640e9, 90e9),
+}
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    """A (possibly multi-slice) TPU cluster: ``num_chips`` per slice
+    connected by ICI, slices connected by DCN."""
+    chip: ChipSpec = dataclasses.field(default_factory=ChipSpec)
+    num_chips: int = 8
+    num_slices: int = 1
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_chips * self.num_slices
+
+    def bw_for_group(self, group_size: int) -> Tuple[float, float]:
+        """(bandwidth, latency) of the slowest hop a collective over
+        ``group_size`` chips crosses: ICI if it fits in one slice else DCN."""
+        if group_size <= self.num_chips:
+            return self.chip.ici_bw, self.chip.ici_latency
+        return self.chip.dcn_bw, self.chip.dcn_latency
+
+
+# ---------------------------------------------------------------------------
+# collective costs (alpha-beta / ring models)
+# ---------------------------------------------------------------------------
+
+def all_reduce_time(bytes_: float, n: int, cluster: ClusterSpec) -> float:
+    if n <= 1:
+        return 0.0
+    bw, lat = cluster.bw_for_group(n)
+    return 2.0 * (n - 1) / n * bytes_ / bw + 2 * (n - 1) * lat
+
+
+def all_gather_time(bytes_: float, n: int, cluster: ClusterSpec) -> float:
+    """bytes_ = full (gathered) size."""
+    if n <= 1:
+        return 0.0
+    bw, lat = cluster.bw_for_group(n)
+    return (n - 1) / n * bytes_ / bw + (n - 1) * lat
+
+
+reduce_scatter_time = all_gather_time
+
+
+def all_to_all_time(bytes_: float, n: int, cluster: ClusterSpec) -> float:
+    if n <= 1:
+        return 0.0
+    bw, lat = cluster.bw_for_group(n)
+    return (n - 1) / n * bytes_ / bw / max(1, cluster.chip.ici_links // 2) \
+        + (n - 1) * lat
+
+
+def p2p_time(bytes_: float, cluster: ClusterSpec,
+             cross_slice: bool = False) -> float:
+    bw = cluster.chip.dcn_bw if cross_slice else cluster.chip.ici_bw
+    lat = cluster.chip.dcn_latency if cross_slice else cluster.chip.ici_latency
+    return bytes_ / bw + lat
+
+
+# ---------------------------------------------------------------------------
+# layer specs
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class LayerSpec:
+    """Per-layer workload description (one transformer block, an embedding,
+    ...) — the planner's unit of placement."""
+    name: str = "layer"
+    flops: float = 0.0              # fwd FLOPs per micro-batch
+    param_bytes: float = 0.0
+    act_bytes: float = 0.0          # saved activations per micro-batch
+    act_io_bytes: float = 0.0       # HBM traffic per micro-batch (roofline)
+    boundary_bytes: float = 0.0     # activation size crossing to next layer
+    tp_shardable: bool = True       # params/flops divide by tp
+
+    def scaled(self, tp: int, dp: int = 1) -> "LayerSpec":
+        """Per-device costs under a (tp, dp) layout: tp shards params and
+        their compute; dp splits the batch (flops/activations, not
+        params)."""
+        t = tp if self.tp_shardable else 1
+        return dataclasses.replace(
+            self, flops=self.flops / t / dp,
+            param_bytes=self.param_bytes / t,
+            act_bytes=self.act_bytes / t / dp,
+            act_io_bytes=self.act_io_bytes / t / dp,
+            boundary_bytes=self.boundary_bytes / dp)
+
+
+def transformer_layer_spec(batch: int, seq: int, hidden: int,
+                           ffn: int, dtype_bytes: int = 2,
+                           name: str = "block") -> LayerSpec:
+    """Analytic cost of one pre-norm transformer block (attention + MLP),
+    per micro-batch of ``batch`` sequences.  (Head count doesn't change
+    flops/bytes at fixed hidden, so it is not a parameter.)"""
+    b, s, h, f = batch, seq, hidden, ffn
+    attn_flops = 2 * b * s * h * (3 * h) + 2 * b * s * s * h * 2 \
+        + 2 * b * s * h * h
+    mlp_flops = 2 * b * s * h * f * 2
+    params = (4 * h * h + 2 * h * f + 4 * h) * dtype_bytes
+    acts = b * s * (10 * h + 2 * f) * dtype_bytes  # checkpointable set
+    io = acts + 3 * params
+    return LayerSpec(name=name, flops=attn_flops + mlp_flops,
+                     param_bytes=params, act_bytes=acts, act_io_bytes=io,
+                     boundary_bytes=b * s * h * dtype_bytes)
+
+
+def embedding_layer_spec(batch: int, seq: int, hidden: int, vocab: int,
+                         dtype_bytes: int = 2,
+                         name: str = "embed") -> LayerSpec:
+    return LayerSpec(name=name, flops=2.0 * batch * seq * hidden,
+                     param_bytes=vocab * hidden * dtype_bytes,
+                     act_bytes=batch * seq * hidden * dtype_bytes,
+                     act_io_bytes=batch * seq * hidden * dtype_bytes,
+                     boundary_bytes=batch * seq * hidden * dtype_bytes)
+
+
+# ---------------------------------------------------------------------------
+# per-layer execution time + memory under a strategy
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Strategy:
+    """One per-layer parallel strategy candidate: (dp, tp, zero stage,
+    recompute flag).  pp is a global decision (layer->stage assignment)."""
+    dp: int = 1
+    tp: int = 1
+    zero: int = 0          # 0: none, 1: optimizer states, 2: +grads, 3: +params
+    recompute: bool = False
+
+    def __str__(self):
+        z = f"-z{self.zero}" if self.zero else ""
+        c = "-ckpt" if self.recompute else ""
+        return f"dp{self.dp}tp{self.tp}{z}{c}"
+
+
+def layer_time(layer: LayerSpec, st: Strategy, cluster: ClusterSpec,
+               include_grad_sync: bool = True,
+               dp_splits_batch: bool = True) -> float:
+    """fwd+bwd time of one layer under strategy st, the roofline max of
+    MXU time and HBM time, plus TP/DP collectives.
+
+    ``dp_splits_batch``: the layer's costs describe a fixed GLOBAL batch
+    that dp divides (v1-searcher semantics).  Pass False when the costs
+    already describe one per-replica micro-batch (SearchEngine)."""
+    chip = cluster.chip
+    sc = layer.scaled(st.tp, st.dp if dp_splits_batch else 1)
+    # fwd + bwd ~ 3x fwd flops; recompute adds one extra fwd
+    total_flops = sc.flops * (4.0 if st.recompute else 3.0)
+    compute = total_flops / (chip.peak_flops * chip.mxu_efficiency)
+    io = 3.0 * sc.act_io_bytes / chip.hbm_bw
+    t = max(compute, io)
+    if st.tp > 1 and layer.tp_shardable:
+        # Megatron TP: 2 allreduce fwd + 2 bwd on the boundary activation
+        t += 4 * all_reduce_time(sc.boundary_bytes, st.tp, cluster)
+    if include_grad_sync and st.dp > 1:
+        t += grad_sync_time(layer, st, cluster)
+    return t
+
+
+def grad_sync_time(layer: LayerSpec, st: Strategy,
+                   cluster: ClusterSpec) -> float:
+    """Once-per-step gradient synchronization cost across the DP group
+    (allreduce, or reduce-scatter + param allgather under ZeRO)."""
+    if st.dp <= 1:
+        return 0.0
+    sc = layer.scaled(st.tp)
+    gb = sc.param_bytes * 2  # fp32 grads of bf16 params
+    if st.zero >= 1:
+        return reduce_scatter_time(gb, st.dp, cluster) \
+            + all_gather_time(sc.param_bytes, st.dp, cluster)
+    return all_reduce_time(gb, st.dp, cluster)
+
+
+def layer_memory(layer: LayerSpec, st: Strategy, cluster: ClusterSpec,
+                 num_microbatches: int = 1,
+                 optimizer_mult: float = 6.0,
+                 dp_splits_batch: bool = True) -> float:
+    """HBM bytes for one layer under strategy st: params + grads +
+    optimizer states (Adam: 2 fp32 moments + fp32 master = ~6x bf16 param
+    bytes) + live activations."""
+    sc = layer.scaled(st.tp, st.dp if dp_splits_batch else 1)
+    p = sc.param_bytes
+    opt = p * optimizer_mult
+    grads = p
+    if st.zero >= 1:
+        opt /= st.dp
+    if st.zero >= 2:
+        grads /= st.dp
+    if st.zero >= 3:
+        p /= st.dp
+    act = sc.boundary_bytes if st.recompute else sc.act_bytes
+    return p + grads + opt + act * num_microbatches
+
+
+def pipeline_time(stage_times: Sequence[float], num_microbatches: int,
+                  boundary_bytes: float, cluster: ClusterSpec) -> float:
+    """1F1B / GPipe steady-state estimate: bottleneck stage dominates,
+    plus the pipeline fill of (P-1) slots and stage-boundary p2p."""
+    p = len(stage_times)
+    if p == 0:
+        return 0.0
+    bottleneck = max(stage_times)
+    fill = sum(stage_times) - bottleneck
+    hop = p2p_time(boundary_bytes, cluster)
+    return num_microbatches * bottleneck + fill + 2 * (p - 1) * hop

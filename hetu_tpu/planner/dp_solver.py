@@ -1,0 +1,159 @@
+"""Dynamic-programming solvers for the auto-parallel planner.
+
+Wraps the native core (``hetu_tpu/csrc/dp_core.cc``, the TPU counterpart
+of the reference's ``tools/Galvatron/csrc/dp_core.cpp:23``
+``dynamic_programming_core``) with ctypes, falling back to equivalent
+pure-numpy implementations when no compiler is available.
+"""
+from __future__ import annotations
+
+import ctypes
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..csrc.build import load_dp_core
+
+
+def _as_c(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ---------------------------------------------------------------------------
+# per-layer strategy selection under memory budget (knapsack-style DP)
+# ---------------------------------------------------------------------------
+
+def solve_layer_strategies(mem_cost: np.ndarray, intra_cost: np.ndarray,
+                           inter_cost: np.ndarray, max_mem: int,
+                           use_native: Optional[bool] = None
+                           ) -> Tuple[float, Optional[List[int]]]:
+    """Choose one strategy per layer minimizing total time subject to the
+    discretized memory budget (inclusive: total memory == max_mem fits).
+
+    mem_cost   [L, S] int    memory units per layer/strategy
+    intra_cost [L, S] float  per-layer time
+    inter_cost [L, S, S]     transition (resharding) time between layers
+    Returns (total_cost, per-layer strategy indices) or (inf, None).
+    """
+    L, S = mem_cost.shape
+    mem_cost = np.ascontiguousarray(mem_cost, np.int32)
+    intra_cost = np.ascontiguousarray(intra_cost, np.float64)
+    inter_cost = np.ascontiguousarray(inter_cost, np.float64)
+    assert intra_cost.shape == (L, S) and inter_cost.shape == (L, S, S)
+
+    lib = load_dp_core() if use_native is not False else None
+    if lib is not None:
+        res = np.zeros(L, np.int32)
+        total = lib.hetu_dp_strategy_solve(
+            L, int(max_mem), S, _as_c(mem_cost, ctypes.c_int32),
+            _as_c(intra_cost, ctypes.c_double),
+            _as_c(inter_cost, ctypes.c_double), _as_c(res, ctypes.c_int32))
+        if math.isinf(total):
+            return float("inf"), None
+        return float(total), res.tolist()
+    return _solve_layer_strategies_py(mem_cost, intra_cost, inter_cost,
+                                      int(max_mem))
+
+
+def _solve_layer_strategies_py(mem_cost, intra_cost, inter_cost, max_mem):
+    L, S = mem_cost.shape
+    INF = float("inf")
+    M = max_mem + 1  # states 0..max_mem inclusive
+    f = np.zeros((M, S))
+    choice = np.full((L, M, S), -1, np.int32)
+    for i in range(L):
+        nf = np.full((M, S), INF)
+        for v in range(M - 1, -1, -1):
+            for s in range(S):
+                need = mem_cost[i, s]
+                if v < need:
+                    continue
+                cand = f[v - need, :] + inter_cost[i, :, s]
+                si = int(np.argmin(cand))
+                if np.isfinite(cand[si]):
+                    choice[i, v, s] = si
+                    nf[v, s] = cand[si] + intra_cost[i, s]
+        f = nf
+    s = int(np.argmin(f[M - 1]))
+    total = f[M - 1, s]
+    if not np.isfinite(total):
+        return INF, None
+    res = [0] * L
+    v = M - 1
+    res[L - 1] = s
+    for i in range(L - 1, 0, -1):
+        prev = int(choice[i, v, s])
+        v -= mem_cost[i, s]
+        s = prev
+        res[i - 1] = s
+    return float(total), res
+
+
+# ---------------------------------------------------------------------------
+# balanced contiguous pipeline partition (bottleneck DP)
+# ---------------------------------------------------------------------------
+
+def solve_pipeline_partition(costs: Sequence[float],
+                             num_stages: int,
+                             comm: Optional[Sequence[float]] = None,
+                             use_native: Optional[bool] = None
+                             ) -> Tuple[float, List[List[int]]]:
+    """Split layers into ``num_stages`` contiguous stages minimizing the
+    bottleneck stage cost (+ cut comm cost).  Returns (bottleneck,
+    [[layer idxs] per stage]).  Capability parity with the v1 GPipe /
+    PipeDream partition search (v1/python/hetu/distributed_strategies/)."""
+    L = len(costs)
+    P = int(num_stages)
+    assert 1 <= P <= L, f"need 1 <= stages ({P}) <= layers ({L})"
+    costs_a = np.ascontiguousarray(costs, np.float64)
+    comm_a = np.ascontiguousarray(
+        comm if comm is not None else np.zeros(L), np.float64)
+
+    if P == 1:
+        return float(costs_a.sum()), [list(range(L))]
+
+    lib = load_dp_core() if use_native is not False else None
+    if lib is not None:
+        bounds = np.zeros(P - 1, np.int32)
+        bottleneck = lib.hetu_dp_pipeline_partition(
+            L, P, _as_c(costs_a, ctypes.c_double),
+            _as_c(comm_a, ctypes.c_double), _as_c(bounds, ctypes.c_int32))
+        ends = bounds.tolist() + [L - 1]
+    else:
+        bottleneck, ends = _partition_py(costs_a, comm_a, P)
+    stages, start = [], 0
+    for e in ends:
+        stages.append(list(range(start, e + 1)))
+        start = e + 1
+    return float(bottleneck), stages
+
+
+def _partition_py(costs, comm, P):
+    L = len(costs)
+    INF = float("inf")
+    prefix = np.concatenate([[0.0], np.cumsum(costs)])
+
+    def seg(a, b):  # [a, b)
+        c = prefix[b] - prefix[a]
+        if b < L:
+            c += comm[b - 1]
+        return c
+
+    g = np.full((L + 1, P + 1), INF)
+    cut = np.full((L + 1, P + 1), -1, np.int32)
+    g[0, 0] = 0.0
+    for k in range(1, P + 1):
+        for t in range(k, L - (P - k) + 1):
+            for j in range(k - 1, t):
+                c = max(g[j, k - 1], seg(j, t))
+                if c < g[t, k]:
+                    g[t, k] = c
+                    cut[t, k] = j
+    ends, t = [], L
+    for k in range(P, 1, -1):
+        j = int(cut[t, k])
+        ends.append(j - 1)
+        t = j
+    ends.reverse()
+    return float(g[L, P]), ends + [L - 1]

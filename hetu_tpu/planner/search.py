@@ -1,0 +1,199 @@
+"""Galvatron-style auto-parallel search over a TPU mesh.
+
+Capability counterpart of the reference's Galvatron search engine
+(``tools/Galvatron/galvatron/core/hybrid_parallel_config.py:13``
+``get_hybrid_parallel_configs_api`` + the C++ DP core): enumerate global
+(pp, tp, dp) decompositions of the chip grid, partition layers into
+pipeline stages, then per-layer DP over (dp, tp, zero, recompute)
+strategy candidates under the per-chip HBM budget — emitting a
+reference-style ``ds_parallel_config`` JSON
+(``examples/gpt/ds_parallel_config/generate_gpt_3d_config.py``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .cost_model import (ClusterSpec, LayerSpec, Strategy, grad_sync_time,
+                         layer_memory, layer_time, pipeline_time)
+from .dp_solver import solve_layer_strategies, solve_pipeline_partition
+
+MEM_UNITS = 64  # memory discretization granularity for the DP
+
+
+@dataclasses.dataclass
+class PlanResult:
+    """The chosen hybrid-parallel plan."""
+    time: float
+    pp: int
+    stages: List[List[int]]              # layer indices per stage
+    layer_strategies: List[Strategy]     # one per layer
+    num_microbatches: int
+    cluster: ClusterSpec
+
+    def describe(self) -> str:
+        lines = [f"pp={self.pp} m={self.num_microbatches} "
+                 f"est_step_time={self.time * 1e3:.2f}ms"]
+        for si, stage in enumerate(self.stages):
+            sts = {str(self.layer_strategies[i]) for i in stage}
+            lines.append(f"  stage{si}: layers {stage[0]}..{stage[-1]} "
+                         f"{sorted(sts)}")
+        return "\n".join(lines)
+
+    def to_ds_parallel_config(self, layer_names: Optional[Sequence[str]]
+                              = None) -> Dict:
+        """Reference-style JSON ds_parallel_config (per-layer split/dup/
+        device_group_union/zero/recompute keys, parseable by
+        :func:`hetu_tpu.nn.parallel.config2ds`)."""
+        chips = list(range(self.cluster.total_chips))
+        per_stage = len(chips) // self.pp
+        out: Dict = {"pp": self.pp, "num_layers": {}, "layers": {}}
+        for si, stage in enumerate(self.stages):
+            group = chips[si * per_stage:(si + 1) * per_stage]
+            for li in stage:
+                st = self.layer_strategies[li]
+                name = (layer_names[li] if layer_names is not None
+                        else f"blocks{li}")
+                out["layers"][name] = {
+                    "type": "variable",
+                    "split": {"0": [st.tp]},
+                    "dup": [st.dp],
+                    "device_group_union": [group],
+                    "zero": st.zero > 0,
+                    "recompute": st.recompute,
+                }
+        return out
+
+
+class SearchEngine:
+    """Search (pp, per-layer dp/tp/zero/ckpt) for a layer chain.
+
+    ``layers`` describe per-micro-batch costs; ``global_batch`` /
+    ``micro_batch`` set the schedule length per DP shard.
+    """
+
+    def __init__(self, cluster: ClusterSpec, layers: Sequence[LayerSpec],
+                 global_batch: int, micro_batch: int,
+                 mem_fraction: float = 0.9,
+                 allow_recompute: bool = True,
+                 allow_zero: bool = True,
+                 max_tp: Optional[int] = None):
+        self.cluster = cluster
+        self.layers = list(layers)
+        self.global_batch = global_batch
+        self.micro_batch = micro_batch
+        self.mem_cap = cluster.chip.hbm_bytes * mem_fraction
+        self.allow_recompute = allow_recompute
+        self.allow_zero = allow_zero
+        self.max_tp = max_tp or cluster.num_chips
+
+    # -- candidate (dp, tp) decompositions of a stage's chips --------------
+
+    def _layouts(self, chips: int) -> List[Tuple[int, int]]:
+        out = []
+        tp = 1
+        while tp <= min(chips, self.max_tp):
+            if chips % tp == 0:
+                out.append((chips // tp, tp))
+            tp *= 2
+        return out
+
+    def _mem_variants(self, dp: int, tp: int) -> List[Strategy]:
+        """Per-layer choices for a fixed (dp, tp) layout: ZeRO stage and
+        recompute flag — the per-layer degrees of freedom Galvatron's DP
+        optimizes (sdp/ckpt columns of its strategy table)."""
+        zeros = [0, 1, 2] if (self.allow_zero and dp > 1) else [0]
+        ckpts = [False, True] if self.allow_recompute else [False]
+        return [Strategy(dp=dp, tp=tp, zero=z, recompute=ck)
+                for z, ck in itertools.product(zeros, ckpts)]
+
+    # -- main search -------------------------------------------------------
+
+    def search(self, pp_options: Optional[Sequence[int]] = None
+               ) -> PlanResult:
+        total = self.cluster.total_chips
+        if pp_options is None:
+            pp_options = [p for p in (1, 2, 4, 8, 16, 32)
+                          if p <= min(total, len(self.layers))
+                          and total % p == 0]
+        best: Optional[PlanResult] = None
+        for pp in pp_options:
+            plan = self._search_pp(pp)
+            if plan is not None and (best is None or plan.time < best.time):
+                best = plan
+        if best is None:
+            raise RuntimeError(
+                "no feasible plan found: model does not fit in HBM under "
+                "any searched configuration")
+        return best
+
+    def _search_pp(self, pp: int) -> Optional[PlanResult]:
+        chips_per_stage = self.cluster.total_chips // pp
+        best: Optional[PlanResult] = None
+        for dp, tp in self._layouts(chips_per_stage):
+            plan = self._search_layout(pp, dp, tp)
+            if plan is not None and (best is None or plan.time < best.time):
+                best = plan
+        return best
+
+    def _search_layout(self, pp: int, dp: int, tp: int
+                       ) -> Optional[PlanResult]:
+        """Evaluate one global (pp, dp, tp) decomposition; per-layer DP
+        chooses the ZeRO stage + recompute flag under the HBM budget."""
+        cands = self._mem_variants(dp, tp)
+        L, S = len(self.layers), len(cands)
+        if self.global_batch < self.micro_batch * dp:
+            return None
+        m = max(1, self.global_batch // (self.micro_batch * dp))
+
+        # stage partition on per-micro-batch costs for this layout
+        base = [layer_time(l, Strategy(dp=dp, tp=tp), self.cluster,
+                           include_grad_sync=False, dp_splits_batch=False)
+                for l in self.layers]
+        comm = [l.boundary_bytes / self.cluster.chip.ici_bw
+                for l in self.layers]
+        try:
+            _, stages = solve_pipeline_partition(base, pp, comm)
+        except AssertionError:
+            return None
+
+        # per-stage DP over memory-saving variants under the HBM budget
+        unit = self.mem_cap / MEM_UNITS
+        strategies: List[Strategy] = [None] * L  # type: ignore
+        stage_times = []
+        for stage in stages:
+            mem = np.zeros((len(stage), S), np.int32)
+            intra = np.zeros((len(stage), S))
+            inter = np.zeros((len(stage), S, S))  # same layout: no reshard
+            for i, li in enumerate(stage):
+                lay = self.layers[li]
+                for s, st in enumerate(cands):
+                    need = layer_memory(lay, st, self.cluster,
+                                        num_microbatches=min(m, pp),
+                                        dp_splits_batch=False)
+                    # over-budget layers stay infeasible (> inclusive cap)
+                    mem[i, s] = min(MEM_UNITS + 1,
+                                    int(math.ceil(need / unit)))
+                    # per-micro-batch compute + the once-per-step grad
+                    # sync amortized over the schedule length
+                    intra[i, s] = layer_time(lay, st, self.cluster,
+                                             include_grad_sync=False,
+                                             dp_splits_batch=False) \
+                        + grad_sync_time(lay, st, self.cluster) / m
+            cost, picks = solve_layer_strategies(mem, intra, inter,
+                                                 MEM_UNITS)
+            if picks is None:
+                return None
+            for i, li in enumerate(stage):
+                strategies[li] = cands[picks[i]]
+            stage_times.append(cost)
+
+        boundary = max(l.boundary_bytes for l in self.layers)
+        t = pipeline_time(stage_times, m, boundary, self.cluster)
+        return PlanResult(time=t, pp=pp, stages=stages,
+                          layer_strategies=strategies, num_microbatches=m,
+                          cluster=self.cluster)

@@ -1,0 +1,249 @@
+"""v1-style auto-parallel searching strategies.
+
+Capability counterparts of the reference's
+``hetu/v1/python/hetu/distributed_strategies/``: FlexFlow MCMC search
+(``flexflow.py:12``), OptCNN per-layer partition DP (``optcnn.py:9``),
+GPipe/PipeDream pipeline partitioners (``gpipe.py:6``, ``pipedream.py:7``)
+and PipeOpt joint search (``pipeopt.py:9``) — re-expressed over the TPU
+cost model (LayerSpec chains + ClusterSpec) instead of a CUDA op graph.
+
+Every searcher returns a plain result object with the chosen layout and
+its estimated cost, so callers can hand the layout to the mesh/sharding
+layer (``hetu_tpu.parallel``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .cost_model import (ClusterSpec, LayerSpec, Strategy, all_reduce_time,
+                         layer_time, pipeline_time)
+from .dp_solver import solve_layer_strategies, solve_pipeline_partition
+
+
+@dataclasses.dataclass
+class SearchResult:
+    cost: float
+    strategies: List[Strategy]                 # per layer
+    stages: Optional[List[List[int]]] = None   # pipeline partition if any
+    meta: Optional[Dict] = None
+
+
+class BaseSearching:
+    """Shared scaffolding (v1 BaseSearchingStrategy, base.py:230)."""
+
+    def __init__(self, layers: Sequence[LayerSpec], cluster: ClusterSpec):
+        self.layers = list(layers)
+        self.cluster = cluster
+        self.num_devices = cluster.total_chips
+
+    def _device_factor_candidates(self) -> List[Strategy]:
+        """All (dp, tp) factorizations of the device count."""
+        n = self.num_devices
+        out = []
+        d = 1
+        while d <= n:
+            if n % d == 0:
+                out.append(Strategy(dp=d, tp=n // d))
+            d *= 2
+        return out
+
+    def simulate(self, strategies: Sequence[Strategy]) -> float:
+        """Step-time estimate for a per-layer strategy assignment (the
+        analogue of v1's HetuSimulator cost evaluation)."""
+        t = 0.0
+        for lay, st in zip(self.layers, strategies):
+            t += layer_time(lay, st, self.cluster)
+        return t
+
+    def searching(self) -> SearchResult:
+        raise NotImplementedError
+
+
+class OptCNNSearching(BaseSearching):
+    """OptCNN: per-layer parallelization chosen by DP with resharding
+    transition costs (optcnn.py:9)."""
+
+    def searching(self) -> SearchResult:
+        cands = self._device_factor_candidates()
+        L, S = len(self.layers), len(cands)
+        mem = np.zeros((L, S), np.int32)  # no memory constraint here
+        intra = np.zeros((L, S))
+        inter = np.zeros((L, S, S))
+        for i, lay in enumerate(self.layers):
+            for s, st in enumerate(cands):
+                intra[i, s] = layer_time(lay, st, self.cluster)
+            if i > 0:
+                prev = self.layers[i - 1]
+                for a, sa in enumerate(cands):
+                    for b, sb in enumerate(cands):
+                        if sa.tp != sb.tp:
+                            inter[i, a, b] = all_reduce_time(
+                                prev.boundary_bytes, max(sa.tp, sb.tp),
+                                self.cluster)
+        cost, picks = solve_layer_strategies(mem, intra, inter, max_mem=1)
+        assert picks is not None
+        return SearchResult(cost, [cands[p] for p in picks])
+
+
+class FlexFlowSearching(BaseSearching):
+    """FlexFlow: MCMC over per-layer strategies with a simulator in the
+    accept/reject loop (flexflow.py:12)."""
+
+    def __init__(self, layers, cluster, alpha: float = 0.05,
+                 round_budget: int = 500, seed: int = 0):
+        super().__init__(layers, cluster)
+        self.alpha = alpha
+        self.round_budget = round_budget
+        self.rng = random.Random(seed)
+
+    def searching(self) -> SearchResult:
+        cands = self._device_factor_candidates()
+        cur = [self.rng.choice(cands) for _ in self.layers]
+        cur_cost = self.simulate(cur)
+        best, best_cost = list(cur), cur_cost
+        for _ in range(self.round_budget):
+            i = self.rng.randrange(len(self.layers))
+            prop = list(cur)
+            prop[i] = self.rng.choice(cands)
+            c = self.simulate(prop)
+            # Metropolis acceptance (minimization): alpha acts as the
+            # temperature — a move that worsens cost by alpha*cur is
+            # accepted with p = 1/e, larger regressions exponentially less
+            if c < cur_cost or \
+                    self.rng.random() < math.exp(
+                        -(c - cur_cost) / (self.alpha *
+                                           max(cur_cost, 1e-12))):
+                cur, cur_cost = prop, c
+                if c < best_cost:
+                    best, best_cost = list(prop), c
+        return SearchResult(best_cost, best,
+                            meta={"rounds": self.round_budget})
+
+
+class GPipeSearching(BaseSearching):
+    """GPipe: balanced contiguous stage partition, devices split evenly
+    across stages (gpipe.py:6)."""
+
+    def __init__(self, layers, cluster, num_stages: int,
+                 num_microbatches: int = 4):
+        super().__init__(layers, cluster)
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+
+    def searching(self) -> SearchResult:
+        per_stage = max(1, self.num_devices // self.num_stages)
+        st = Strategy(dp=1, tp=per_stage)
+        costs = [layer_time(l, st, self.cluster) for l in self.layers]
+        comm = [l.boundary_bytes / self.cluster.chip.ici_bw
+                for l in self.layers]
+        bottleneck, stages = solve_pipeline_partition(
+            costs, self.num_stages, comm)
+        boundary = max(l.boundary_bytes for l in self.layers)
+        total = pipeline_time([sum(costs[i] for i in sg) for sg in stages],
+                              self.num_microbatches, boundary, self.cluster)
+        return SearchResult(total, [st] * len(self.layers), stages=stages)
+
+
+class PipeDreamSearching(BaseSearching):
+    """PipeDream: stage partition with per-stage replication — each stage
+    may be replicated across several devices with the weight-sync
+    (allreduce) cost folded in (pipedream.py:7).  Classic interval DP."""
+
+    def __init__(self, layers, cluster, num_microbatches: int = 4):
+        super().__init__(layers, cluster)
+        self.num_microbatches = num_microbatches
+
+    def searching(self) -> SearchResult:
+        L, N = len(self.layers), self.num_devices
+        base = [layer_time(l, Strategy(), self.cluster)
+                for l in self.layers]
+        prefix = np.concatenate([[0.0], np.cumsum(base)])
+        params = [l.param_bytes for l in self.layers]
+        pparam = np.concatenate([[0.0], np.cumsum(params)])
+
+        def stage_cost(a, b, m):  # layers [a,b) replicated on m devices
+            t = (prefix[b] - prefix[a]) / m
+            if m > 1:
+                t += all_reduce_time((pparam[b] - pparam[a]) * 2, m,
+                                     self.cluster)
+            return t
+
+        INF = float("inf")
+        # replication counts restricted to powers of two (keeps the DP at
+        # O(L^2 N log N) instead of O(L^2 N^2) for big clusters)
+        repl_opts = []
+        m = 1
+        while m <= N:
+            repl_opts.append(m)
+            m *= 2
+        # f[t][n]: min bottleneck using first t layers on n devices
+        f = np.full((L + 1, N + 1), INF)
+        back: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        f[0, 0] = 0.0
+        for t in range(1, L + 1):
+            for n in range(1, N + 1):
+                for j in range(t):
+                    for m in repl_opts:
+                        if m > n or not np.isfinite(f[j, n - m]):
+                            continue
+                        c = max(f[j, n - m], stage_cost(j, t, m))
+                        if c < f[t, n]:
+                            f[t, n] = c
+                            back[(t, n)] = (j, m)
+        # allow using <= N devices
+        n_best = int(np.argmin(f[L, 1:])) + 1
+        bottleneck = float(f[L, n_best])
+        # reconstruct stages + replication
+        stages, repl = [], []
+        t, n = L, n_best
+        while t > 0:
+            j, m = back[(t, n)]
+            stages.append(list(range(j, t)))
+            repl.append(m)
+            t, n = j, n - m
+        stages.reverse()
+        repl.reverse()
+        strategies = [None] * L
+        for sg, m in zip(stages, repl):
+            for i in sg:
+                strategies[i] = Strategy(dp=m, tp=1)
+        boundary = max(l.boundary_bytes for l in self.layers)
+        total = pipeline_time(
+            [stage_cost(sg[0], sg[-1] + 1, m)
+             for sg, m in zip(stages, repl)],
+            self.num_microbatches, boundary, self.cluster)
+        return SearchResult(total, strategies, stages=stages,
+                            meta={"replication": repl,
+                                  "bottleneck": bottleneck,
+                                  "devices_used": n_best})
+
+
+class PipeOptSearching(BaseSearching):
+    """PipeOpt: jointly search the stage count and partition, picking the
+    best end-to-end pipeline estimate (pipeopt.py:9)."""
+
+    def __init__(self, layers, cluster, num_microbatches: int = 4,
+                 stage_options: Optional[Sequence[int]] = None):
+        super().__init__(layers, cluster)
+        self.num_microbatches = num_microbatches
+        self.stage_options = stage_options
+
+    def searching(self) -> SearchResult:
+        opts = self.stage_options or [
+            p for p in (1, 2, 4, 8, 16)
+            if p <= min(self.num_devices, len(self.layers))
+            and self.num_devices % p == 0]
+        best: Optional[SearchResult] = None
+        for p in opts:
+            r = GPipeSearching(self.layers, self.cluster, p,
+                               self.num_microbatches).searching()
+            r.meta = {"num_stages": p}
+            if best is None or r.cost < best.cost:
+                best = r
+        assert best is not None
+        return best

@@ -1,0 +1,196 @@
+"""Auto-parallel planner tests: native DP core vs Python fallback, the
+Galvatron-style search engine, and the v1-style searching strategies."""
+import numpy as np
+import pytest
+
+from hetu_tpu.csrc.build import load_dp_core
+from hetu_tpu.planner import (ChipSpec, ClusterSpec, FlexFlowSearching,
+                              GPipeSearching, LayerSpec, OptCNNSearching,
+                              PipeDreamSearching, PipeOptSearching,
+                              SearchEngine, Strategy,
+                              solve_layer_strategies,
+                              solve_pipeline_partition,
+                              transformer_layer_spec)
+from hetu_tpu.nn.parallel import config2ds
+
+
+def _cluster(chips=8, hbm=95e9):
+    return ClusterSpec(chip=ChipSpec(hbm_bytes=hbm), num_chips=chips)
+
+
+class TestNativeCore:
+    def test_native_library_builds(self):
+        lib = load_dp_core()
+        assert lib is not None, "g++ is available in this image; the " \
+            "native DP core must build"
+
+    def test_strategy_solver_native_matches_python(self):
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            L, S, M = 6, 4, 16
+            mem = rng.randint(0, 5, (L, S)).astype(np.int32)
+            intra = rng.rand(L, S)
+            inter = rng.rand(L, S, S) * 0.1
+            cn, rn = solve_layer_strategies(mem, intra, inter, M,
+                                            use_native=True)
+            cp, rp = solve_layer_strategies(mem, intra, inter, M,
+                                            use_native=False)
+            assert np.isclose(cn, cp), (cn, cp)
+            assert rn == rp
+
+    def test_strategy_solver_respects_memory(self):
+        # two strategies: fast-but-fat vs slow-but-lean
+        L = 4
+        mem = np.array([[4, 1]] * L, np.int32)
+        intra = np.array([[1.0, 3.0]] * L)
+        inter = np.zeros((L, 2, 2))
+        # generous budget -> all fast
+        c, r = solve_layer_strategies(mem, intra, inter, max_mem=17)
+        assert r == [0] * L and np.isclose(c, 4.0)
+        # tight budget -> forced lean
+        c, r = solve_layer_strategies(mem, intra, inter, max_mem=5)
+        assert r == [1] * L and np.isclose(c, 12.0)
+        # infeasible
+        c, r = solve_layer_strategies(mem, intra, inter, max_mem=2)
+        assert r is None and np.isinf(c)
+
+    def test_strategy_solver_transition_cost(self):
+        # strategy switch costs 10 -> stick to one strategy even if the
+        # per-layer optimum alternates
+        L = 4
+        mem = np.zeros((L, 2), np.int32)
+        intra = np.array([[1.0, 1.1], [1.1, 1.0]] * 2)
+        inter = np.zeros((L, 2, 2))
+        for i in range(1, L):
+            inter[i] = np.array([[0.0, 10.0], [10.0, 0.0]])
+        _, r = solve_layer_strategies(mem, intra, inter, max_mem=1)
+        assert len(set(r)) == 1  # no switching
+
+    def test_pipeline_partition_native_matches_python(self):
+        rng = np.random.RandomState(1)
+        for _ in range(5):
+            costs = rng.rand(12)
+            comm = rng.rand(12) * 0.1
+            bn, sn = solve_pipeline_partition(costs, 4, comm,
+                                              use_native=True)
+            bp, sp_ = solve_pipeline_partition(costs, 4, comm,
+                                               use_native=False)
+            assert np.isclose(bn, bp), (bn, bp)
+            assert sn == sp_
+
+    def test_pipeline_partition_balances(self):
+        costs = [1.0] * 8
+        bottleneck, stages = solve_pipeline_partition(costs, 4)
+        assert [len(s) for s in stages] == [2, 2, 2, 2]
+        assert np.isclose(bottleneck, 2.0)
+        # uneven: one heavy layer gets isolated
+        costs = [1.0, 1.0, 1.0, 10.0, 1.0, 1.0]
+        _, stages = solve_pipeline_partition(costs, 3)
+        heavy_stage = [s for s in stages if 3 in s][0]
+        assert heavy_stage == [3]
+
+    def test_pipeline_partition_covers_all_layers(self):
+        _, stages = solve_pipeline_partition([1.0] * 7, 3)
+        flat = [i for s in stages for i in s]
+        assert flat == list(range(7))
+
+
+def _gpt_layers(n=12, batch=8, seq=1024, hidden=1024):
+    return [transformer_layer_spec(batch, seq, hidden, 4 * hidden,
+                                   name=f"blocks{i}") for i in range(n)]
+
+
+class TestSearchEngine:
+    def test_finds_feasible_plan(self):
+        eng = SearchEngine(_cluster(), _gpt_layers(), global_batch=64,
+                           micro_batch=8)
+        plan = eng.search()
+        assert np.isfinite(plan.time) and plan.time > 0
+        assert len(plan.layer_strategies) == 12
+        assert sum(len(s) for s in plan.stages) == 12
+        for st in plan.layer_strategies:
+            assert st.dp * st.tp == 8 // plan.pp
+
+    def test_tight_memory_forces_memory_savers(self):
+        """On a tiny-HBM chip the plan must reach for recompute/zero/pp."""
+        small = _cluster(hbm=3e9)
+        eng = SearchEngine(small, _gpt_layers(hidden=2048), global_batch=64,
+                           micro_batch=8)
+        plan = eng.search()
+        assert any(st.recompute or st.zero > 0
+                   for st in plan.layer_strategies) or plan.pp > 1
+
+    def test_infeasible_raises(self):
+        nano = _cluster(hbm=1e6)  # 1 MB HBM: nothing fits
+        eng = SearchEngine(nano, _gpt_layers(), global_batch=64,
+                           micro_batch=8)
+        with pytest.raises(RuntimeError, match="no feasible plan"):
+            eng.search()
+
+    def test_ds_parallel_config_roundtrip(self):
+        eng = SearchEngine(_cluster(), _gpt_layers(n=8), global_batch=64,
+                           micro_batch=8)
+        plan = eng.search()
+        cfg = plan.to_ds_parallel_config()
+        assert len(cfg["layers"]) == 8
+        # every emitted layer entry parses through config2ds
+        for name, entry in cfg["layers"].items():
+            ds_union, dgs = config2ds(entry)
+            ds = ds_union.get(0)
+            assert ds.device_num == len(dgs[0])
+
+
+class TestV1Strategies:
+    def test_optcnn_prefers_tp_free_layers_consistent(self):
+        layers = _gpt_layers(n=6, hidden=512)
+        r = OptCNNSearching(layers, _cluster()).searching()
+        assert len(r.strategies) == 6
+        assert np.isfinite(r.cost)
+        # all-devices factorization respected
+        for st in r.strategies:
+            assert st.dp * st.tp == 8
+
+    def test_flexflow_beats_or_ties_worst_random(self):
+        layers = _gpt_layers(n=6, hidden=512)
+        ff = FlexFlowSearching(layers, _cluster(), round_budget=300, seed=3)
+        r = ff.searching()
+        # the MCMC result can't be worse than every candidate: compare
+        # against the single worst uniform assignment
+        worst = max(ff.simulate([st] * 6)
+                    for st in ff._device_factor_candidates())
+        assert r.cost <= worst + 1e-12
+
+    def test_flexflow_close_to_optcnn_optimum(self):
+        layers = _gpt_layers(n=6, hidden=512)
+        opt = OptCNNSearching(layers, _cluster()).searching()
+        ff = FlexFlowSearching(layers, _cluster(), round_budget=800,
+                               seed=0).searching()
+        assert ff.cost <= opt.cost * 1.5 + 1e-9
+
+    def test_gpipe_contiguous_stages(self):
+        layers = _gpt_layers(n=8, hidden=512)
+        r = GPipeSearching(layers, _cluster(), num_stages=4).searching()
+        assert r.stages is not None and len(r.stages) == 4
+        flat = [i for s in r.stages for i in s]
+        assert flat == list(range(8))
+
+    def test_pipedream_replicates_heavy_stages(self):
+        # one very heavy layer among light ones: PipeDream should give the
+        # heavy layer('s stage) more devices
+        layers = [transformer_layer_spec(8, 256, 256, 1024)
+                  for _ in range(5)]
+        layers.insert(2, transformer_layer_spec(8, 256, 1024, 8192))
+        r = PipeDreamSearching(layers, _cluster(chips=4)).searching()
+        repl = r.meta["replication"]
+        heavy_stage = [k for k, sg in enumerate(r.stages) if 2 in sg][0]
+        assert repl[heavy_stage] == max(repl)
+
+    def test_pipeopt_picks_best_stage_count(self):
+        layers = _gpt_layers(n=8, hidden=512)
+        r = PipeOptSearching(layers, _cluster(),
+                             stage_options=[1, 2, 4]).searching()
+        per_stage_costs = [GPipeSearching(layers, _cluster(), p).searching().cost
+                           for p in (1, 2, 4)]
+        assert r.meta["num_stages"] in (1, 2, 4)
+        assert np.isfinite(r.cost)
+        assert r.cost <= min(per_stage_costs) + 1e-12
