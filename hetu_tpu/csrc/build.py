@@ -27,8 +27,8 @@ def _compile(name: str, sources) -> Optional[str]:
     if os.path.exists(so_path) and all(
             os.path.getmtime(so_path) >= os.path.getmtime(s) for s in srcs):
         return so_path
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so_path,
-           *srcs]
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", so_path, *srcs]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (OSError, subprocess.SubprocessError):
@@ -50,6 +50,26 @@ def load_native(name: str, sources) -> Optional[ctypes.CDLL]:
                 lib = None
         _CACHE[name] = lib
         return lib
+
+
+def load_dataloader_core() -> Optional[ctypes.CDLL]:
+    lib = load_native("hetu_dataloader", ["dataloader.cc"])
+    if lib is not None and not getattr(lib, "_hetu_sigs_set", False):
+        lib.hetu_loader_create.restype = ctypes.c_void_p
+        lib.hetu_loader_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32]
+        lib.hetu_loader_num_batches.restype = ctypes.c_int64
+        lib.hetu_loader_num_batches.argtypes = [ctypes.c_void_p]
+        lib.hetu_loader_next.restype = ctypes.c_int32
+        lib.hetu_loader_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.hetu_loader_reset.restype = None
+        lib.hetu_loader_reset.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.hetu_loader_destroy.restype = None
+        lib.hetu_loader_destroy.argtypes = [ctypes.c_void_p]
+        lib._hetu_sigs_set = True
+    return lib
 
 
 def load_dp_core() -> Optional[ctypes.CDLL]:

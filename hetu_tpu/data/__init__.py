@@ -1,0 +1,18 @@
+"""Data subsystem: datasets, prefetching dataloader (native core), and
+variable-seq-len buckets.
+
+Covers the reference's C++ dataloader (``hetu/graph/data/dataloader.h``),
+Python data utils (``python/hetu/utils/data/``), GPT datasets
+(``examples/gpt/data_utils/``) and Hydraulis buckets
+(``examples/hydraulis/data_utils/bucket.py``).
+"""
+from .bucket import (Bucket, build_fake_batch_and_len,
+                     get_input_and_label_buckets, get_sorted_batch_and_len)
+from .dataloader import Dataloader
+from .dataset import Dataset, GPTJsonDataset, GPTSeqDataset, TensorDataset
+
+__all__ = [
+    "Bucket", "build_fake_batch_and_len", "get_input_and_label_buckets",
+    "get_sorted_batch_and_len", "Dataloader", "Dataset", "GPTJsonDataset",
+    "GPTSeqDataset", "TensorDataset",
+]

@@ -1,0 +1,191 @@
+"""Data subsystem tests: native prefetch loader vs python path, dp
+sharding, GPT datasets, and Hydraulis-style buckets."""
+import json
+
+import numpy as np
+import pytest
+
+from hetu_tpu.csrc.build import load_dataloader_core
+from hetu_tpu.data import (Bucket, Dataloader, GPTJsonDataset, GPTSeqDataset,
+                           TensorDataset, build_fake_batch_and_len,
+                           get_input_and_label_buckets,
+                           get_sorted_batch_and_len)
+
+
+def _rows(n=32, d=6):
+    return np.arange(n * d, dtype=np.int32).reshape(n, d)
+
+
+class TestDataloader:
+    def test_native_core_builds(self):
+        assert load_dataloader_core() is not None
+
+    def test_iterates_all_batches(self):
+        dl = Dataloader(_rows(), batch_size=8)
+        batches = list(dl)
+        assert len(batches) == 4 == len(dl)
+        got = np.concatenate(batches)
+        np.testing.assert_array_equal(np.sort(got[:, 0]), _rows()[:, 0])
+
+    def test_native_path_is_used_and_matches_python(self):
+        data = _rows(40)
+        nat = Dataloader(data, batch_size=8, use_native=True)
+        py = Dataloader(data, batch_size=8, use_native=False)
+        assert nat._lib is not None
+        a = np.concatenate(list(nat))
+        b = np.concatenate(list(py))
+        np.testing.assert_array_equal(a, b)  # no shuffle: same order
+
+    def test_shuffle_deterministic_per_seed_and_epoch(self):
+        data = _rows(64)
+        dl1 = Dataloader(data, batch_size=8, shuffle=True, seed=7)
+        dl2 = Dataloader(data, batch_size=8, shuffle=True, seed=7)
+        e1a, e2a = list(dl1), list(dl2)
+        for x, y in zip(e1a, e2a):
+            np.testing.assert_array_equal(x, y)
+        # second epoch reshuffles
+        e1b = list(dl1)
+        assert any((x != y).any() for x, y in zip(e1a, e1b))
+        # shuffled set == original set
+        got = np.concatenate(e1a)
+        np.testing.assert_array_equal(np.sort(got[:, 0]), data[:, 0])
+
+    def test_dp_sharding_disjoint_and_complete(self):
+        data = _rows(48)
+        shards = []
+        for r in range(4):
+            dl = Dataloader(data, batch_size=4).set_dp_rank(r, 4)
+            shards.append(np.concatenate(list(dl))[:, 0])
+        allv = np.concatenate(shards)
+        assert len(allv) == 48
+        np.testing.assert_array_equal(np.sort(allv), data[:, 0])
+        for i in range(4):
+            for j in range(i + 1, 4):
+                assert not set(shards[i]) & set(shards[j])
+
+    def test_drop_last_and_partial(self):
+        data = _rows(30)
+        assert len(list(Dataloader(data, batch_size=8))) == 3
+        dl = Dataloader(data, batch_size=8, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 4 and len(batches[-1]) == 6
+
+    def test_tuple_dataset_python_path(self):
+        xs = np.arange(20, dtype=np.float32).reshape(10, 2)
+        ys = np.arange(10, dtype=np.int32)
+        dl = Dataloader(TensorDataset(xs, ys), batch_size=5)
+        for bx, by in dl:
+            assert bx.shape == (5, 2) and by.shape == (5,)
+
+    def test_native_prefetch_many_epochs(self):
+        """Stress the background thread lifecycle."""
+        data = _rows(16)
+        dl = Dataloader(data, batch_size=4, shuffle=True, use_native=True)
+        for _ in range(5):
+            assert len(list(dl)) == 4
+
+
+class TestGPTDatasets:
+    def test_seq_dataset_windows(self):
+        toks = np.arange(100)
+        ds = GPTSeqDataset(toks, seq_len=16)
+        x, y = ds[0]
+        np.testing.assert_array_equal(x, np.arange(16))
+        np.testing.assert_array_equal(y, np.arange(1, 17))
+        x2, y2 = ds[1]
+        np.testing.assert_array_equal(x2, np.arange(16, 32))
+        mat = ds.as_matrix()
+        assert mat.shape == (len(ds), 32)
+
+    def test_seq_dataset_through_native_loader(self):
+        ds = GPTSeqDataset(np.arange(1000), seq_len=32)
+        dl = Dataloader(ds, batch_size=4, use_native=True)
+        for row in dl:
+            x, y = row[:, :32], row[:, 32:]
+            np.testing.assert_array_equal(x + 1, y)
+
+    def test_json_dataset(self, tmp_path):
+        p = tmp_path / "docs.jsonl"
+        with open(p, "w") as f:
+            for t in ["hello world", "foo bar baz", "x"]:
+                f.write(json.dumps({"content": t}) + "\n")
+        tok = lambda s: [ord(c) for c in s]  # noqa: E731
+        ds = GPTJsonDataset(str(p), "content", seq_len=8, tokenizer=tok,
+                            pad_id=0)
+        assert len(ds) == 3
+        assert ds[0].shape == (8,)
+        assert ds[2][0] == ord("x") and ds[2][1] == 0  # padded
+
+
+class TestBuckets:
+    def test_pad_data(self):
+        b = Bucket(pad_token=0, max_seqlen=16, alignment=8)
+        b.add_data(np.arange(1, 6), 5)
+        b.add_data(np.arange(1, 11), 10)
+        b.pad_data()
+        assert b.padded_batch.shape == (2, 16)
+        assert (b.padded_batch[0, 5:] == 0).all()
+        np.testing.assert_array_equal(b.padded_cu_seqlens_list[0], [0, 5])
+
+    def test_pack_data_greedy(self):
+        b = Bucket(pad_token=0, max_seqlen=32, alignment=8)
+        for n in (30, 8, 8, 8, 6):
+            b.add_data(np.full(n, 7), n)
+        b.pack_data()
+        # 30 alone (aligned 32); 8+8+8+6 -> aligned 8*4 = 32 fits one row
+        assert b.packed_batch_size == 2
+        assert b.packed_batch.shape == (2, 32)
+        total_valid = sum((row != 0).sum() for row in b.packed_batch)
+        assert total_valid == 30 + 8 + 8 + 8 + 6
+        # cu_seqlens aligned and monotone
+        for cu in b.packed_cu_seqlens_list:
+            assert (np.diff(cu) > 0).all()
+            assert (cu[1:-1] % 8 == 0).all()
+
+    def test_pack_with_option_matrix(self):
+        b = Bucket(pad_token=0, max_seqlen=32, alignment=8)
+        for n in (8, 8, 8):
+            b.add_data(np.full(n, 3), n)
+        mat = np.array([[1, 0, 1], [0, 1, 0]])
+        b.pack_data(mat)
+        assert b.packed_batch_size == 2
+        assert (b.packed_batch[0] != 0).sum() == 16
+        assert (b.packed_batch[1] != 0).sum() == 8
+
+    def test_sorted_batch(self):
+        batch, lens = build_fake_batch_and_len([9, 3, 6], pad_token=0)
+        sb, sl = get_sorted_batch_and_len(batch, 0)
+        np.testing.assert_array_equal(sl, [3, 6, 9])
+        assert (sb[0] != 0).sum() == 3
+
+    def test_input_label_buckets(self):
+        batch, _ = build_fake_batch_and_len([10, 8], pad_token=0)
+        ib, lb = get_input_and_label_buckets(batch, 0, [0, 1], 16,
+                                             alignment=4)
+        ib.pad_data()
+        lb.pad_data()
+        # labels are inputs shifted by one
+        np.testing.assert_array_equal(ib.padded_batch[0, 1:9],
+                                      lb.padded_batch[0, :8])
+        np.testing.assert_array_equal(ib.padded_cu_seqlens_list[0], [0, 9])
+
+    def test_too_long_sequence_rejected(self):
+        b = Bucket(pad_token=0, max_seqlen=8, alignment=8)
+        with pytest.raises(AssertionError, match="exceeds"):
+            b.add_data(np.arange(20), 20)
+
+    def test_overfull_option_matrix_rejected(self):
+        b = Bucket(pad_token=0, max_seqlen=16, alignment=8)
+        for n in (8, 8, 8):
+            b.add_data(np.full(n, 3), n)
+        with pytest.raises(ValueError, match="exceeds"):
+            b.pack_data(np.array([[1, 1, 1]]))
+
+    def test_pad_token_in_vocab_uses_prefix_length(self):
+        # a real 0 mid-sequence must not shrink the valid length
+        batch = np.array([[5, 0, 7, 3, 0, 0]])  # valid prefix = 4
+        sb, sl = get_sorted_batch_and_len(batch, pad_token=0)
+        np.testing.assert_array_equal(sl, [4])
+        ib, lb = get_input_and_label_buckets(batch, 0, [0], 8, alignment=4)
+        ib.pad_data()
+        np.testing.assert_array_equal(ib.padded_batch[0, :3], [5, 0, 7])
