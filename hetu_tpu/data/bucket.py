@@ -20,6 +20,30 @@ def _align_up(x: int, a: int) -> int:
     return (x + a - 1) // a * a
 
 
+def ffd_pack(seqlens: Sequence[int], max_seqlen: int, alignment: int
+             ) -> List[List[int]]:
+    """First-fit-decreasing packing of (aligned) sequence lengths into
+    rows of ``max_seqlen``; returns per-row index groups.  Shared by
+    :meth:`Bucket.pack_data` and the dispatcher's
+    :func:`hetu_tpu.planner.dispatch.batching_strategy`."""
+    order = sorted(range(len(seqlens)), key=lambda i: -seqlens[i])
+    groups: List[List[int]] = []
+    room: List[int] = []
+    for i in order:
+        n = _align_up(int(seqlens[i]), alignment)
+        assert n <= max_seqlen, \
+            f"sequence {i} (aligned {n}) exceeds max_seqlen {max_seqlen}"
+        for gi, g in enumerate(groups):
+            if room[gi] >= n:
+                g.append(i)
+                room[gi] -= n
+                break
+        else:
+            groups.append([i])
+            room.append(max_seqlen - n)
+    return groups
+
+
 class Bucket:
     """Collects variable-length sequences, then materializes either a
     padded batch (one row per sequence) or a packed batch (greedy
@@ -72,21 +96,8 @@ class Bucket:
                       for i in range(mat.shape[0])]
             groups = [g for g in groups if g]
         else:
-            order = sorted(range(len(self._seqs)),
-                           key=lambda i: -len(self._seqs[i]))
-            groups, room = [], []
-            for i in order:
-                n = _align_up(len(self._seqs[i]), self.alignment)
-                placed = False
-                for gi, g in enumerate(groups):
-                    if room[gi] >= n:
-                        g.append(i)
-                        room[gi] -= n
-                        placed = True
-                        break
-                if not placed:
-                    groups.append([i])
-                    room.append(self.max_seqlen - n)
+            groups = ffd_pack([len(s) for s in self._seqs],
+                              self.max_seqlen, self.alignment)
         # validate capacity before writing anything (matters for
         # caller-provided assignment matrices)
         for gi, g in enumerate(groups):

@@ -9,6 +9,10 @@ from .cost_model import (CHIPS, ChipSpec, ClusterSpec, LayerSpec, Strategy,
                          embedding_layer_spec, grad_sync_time, layer_memory,
                          layer_time, p2p_time, pipeline_time,
                          reduce_scatter_time, transformer_layer_spec)
+from .dispatch import (DispatchStrategy, batching_strategy, dynamic_dispatch,
+                       fit_cost_model, generate_strategy_pool,
+                       max_seqlen_for, quadratic_predict,
+                       solve_micro_batches)
 from .dp_solver import solve_layer_strategies, solve_pipeline_partition
 from .search import PlanResult, SearchEngine
 from .strategies import (BaseSearching, FlexFlowSearching, GPipeSearching,
@@ -21,6 +25,9 @@ __all__ = [
     "embedding_layer_spec", "layer_memory", "layer_time", "p2p_time",
     "pipeline_time", "reduce_scatter_time", "transformer_layer_spec",
     "solve_layer_strategies", "solve_pipeline_partition",
+    "DispatchStrategy", "batching_strategy", "dynamic_dispatch",
+    "fit_cost_model", "generate_strategy_pool", "max_seqlen_for",
+    "quadratic_predict", "solve_micro_batches",
     "PlanResult", "SearchEngine",
     "BaseSearching", "FlexFlowSearching", "GPipeSearching",
     "OptCNNSearching", "PipeDreamSearching", "PipeOptSearching",

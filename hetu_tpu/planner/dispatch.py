@@ -1,0 +1,293 @@
+"""Hydraulis-style variable-sequence-length dispatch.
+
+Capability counterpart of the reference's Hydraulis strategy package
+(``examples/hydraulis/strategy/``): quadratic attention cost model fit
+(``cost_model.py:12-20``), per-iteration ILP dispatch of sequences onto
+heterogeneous dp/cp groups (``dynamic_pulp.py:11`` — PuLP there, here
+``scipy.optimize.milp`` with a greedy LPT fallback), micro-batch
+splitting (``dynamic_pulp.py:97`` ``solve_v_micro_batches``), per-group
+packing (``dynamic_pulp.py:124`` ``batching_strategy``) and strategy-pool
+generation (``generate_strategy.py``).
+
+The flow per training iteration:
+  1. a global batch of sequences with heterogeneous lengths arrives;
+  2. :func:`dynamic_dispatch` assigns each sequence to one of the DP
+     groups (each running a different tp/pp/cp layout with its own
+     max-seqlen bound) minimizing the makespan estimate;
+  3. per group, :func:`solve_micro_batches` splits its sequences into
+     balanced micro-batches and :func:`batching_strategy` packs them into
+     fixed-shape rows (consumed by :class:`hetu_tpu.data.Bucket`).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .cost_model import ChipSpec, ClusterSpec
+from .dp_solver import solve_pipeline_partition
+
+
+# ---------------------------------------------------------------------------
+# quadratic cost model (attention makes per-seq time quadratic in length)
+# ---------------------------------------------------------------------------
+
+def quadratic_predict(s, a: float, b: float, c: float):
+    return a * np.square(np.asarray(s, np.float64)) + b * np.asarray(s) + c
+
+
+def fit_cost_model(seqlens: Sequence[int], times: Sequence[float]
+                   ) -> Tuple[float, float, float]:
+    """Least-squares fit t(s) = a s^2 + b s + c from profiled (seqlen,
+    time) points (reference cost_model.py quadratic fit)."""
+    s = np.asarray(seqlens, np.float64)
+    t = np.asarray(times, np.float64)
+    A = np.stack([s * s, s, np.ones_like(s)], axis=1)
+    coef, *_ = np.linalg.lstsq(A, t, rcond=None)
+    return float(coef[0]), float(coef[1]), float(coef[2])
+
+
+@dataclasses.dataclass
+class DispatchStrategy:
+    """One heterogeneous group's layout + fitted cost coefficients.
+
+    Coefficients (a, b, c) describe the layout at cp=1; ring-attention
+    context parallelism divides the per-rank work by cp."""
+    tp: int = 1
+    pp: int = 1
+    cp: int = 1
+    a: float = 0.0          # quadratic coeff (attention)
+    b: float = 1.0          # linear coeff
+    c: float = 0.0          # constant per-seq overhead
+    max_seqlen: int = 1 << 30
+
+    def seq_time(self, s) -> np.ndarray:
+        return quadratic_predict(s, self.a / self.cp, self.b / self.cp,
+                                 self.c)
+
+    def batch_time(self, seqlens: Sequence[int]) -> float:
+        """1F1B estimate: per-seq times + (pp-1) warmup/cooldown slots of
+        the longest sequence (reference static_strategy_time_cost)."""
+        if len(seqlens) == 0:
+            return 0.0
+        t = float(np.sum(self.seq_time(seqlens)))
+        return t + float(self.seq_time(max(seqlens))) * (self.pp - 1)
+
+
+# ---------------------------------------------------------------------------
+# dynamic dispatch: sequences -> groups
+# ---------------------------------------------------------------------------
+
+def dynamic_dispatch(strategies: Sequence[DispatchStrategy],
+                     batch_seqlens: np.ndarray,
+                     use_ilp: Optional[bool] = None,
+                     time_limit: float = 5.0) -> List[List[int]]:
+    """Assign every sequence to a strategy group minimizing the makespan.
+
+    Returns per-strategy lists of sequence indices.  Sequences may only go
+    to groups whose ``max_seqlen`` admits them (reference
+    dynamic_strategy's J bound).  Exact path: scipy MILP; fallback: LPT
+    greedy (longest sequence first onto the least-loaded eligible group).
+    """
+    seqlens = np.asarray(batch_seqlens).reshape(-1)
+    B, G = len(seqlens), len(strategies)
+    eligible = [[j for j, st in enumerate(strategies)
+                 if seqlens[i] <= st.max_seqlen] for i in range(B)]
+    for i, e in enumerate(eligible):
+        if not e:
+            raise ValueError(f"sequence {i} of length {seqlens[i]} exceeds "
+                             f"every strategy's max_seqlen")
+    if use_ilp is not False:
+        res = _dispatch_milp(strategies, seqlens, eligible, time_limit)
+        if res is not None:
+            return res
+        if use_ilp is True:
+            raise RuntimeError("MILP dispatch unavailable or infeasible")
+    return _dispatch_greedy(strategies, seqlens, eligible)
+
+
+def _dispatch_greedy(strategies, seqlens, eligible) -> List[List[int]]:
+    G = len(strategies)
+    loads = np.zeros(G)
+    out: List[List[int]] = [[] for _ in range(G)]
+    order = np.argsort(-seqlens)
+    for i in order:
+        costs = [loads[j] + float(strategies[j].seq_time(seqlens[i]))
+                 for j in eligible[i]]
+        j = eligible[i][int(np.argmin(costs))]
+        out[j].append(int(i))
+        loads[j] += float(strategies[j].seq_time(seqlens[i]))
+    for g in out:
+        g.sort()
+    return out
+
+
+def _dispatch_milp(strategies, seqlens, eligible, time_limit
+                   ) -> Optional[List[List[int]]]:
+    """min Z s.t. sum_j m_ij = 1, sum_i m_ij t_ij <= Z (per group)."""
+    try:
+        from scipy.optimize import LinearConstraint, milp
+        from scipy.sparse import lil_matrix
+    except ImportError:  # pragma: no cover - scipy is baked in
+        return None
+    B, G = len(seqlens), len(strategies)
+    nv = B * G + 1  # m_ij + Z
+    t = np.zeros((B, G))
+    for i in range(B):
+        for j in eligible[i]:
+            t[i, j] = float(strategies[j].seq_time(seqlens[i]))
+    cost = np.zeros(nv)
+    cost[-1] = 1.0  # minimize Z
+    A = lil_matrix((B + G, nv))
+    lb = np.zeros(B + G)
+    ub = np.zeros(B + G)
+    for i in range(B):  # assignment: sum_j m_ij == 1 over eligible j
+        for j in eligible[i]:
+            A[i, i * G + j] = 1.0
+        lb[i] = ub[i] = 1.0
+    for j in range(G):  # load: sum_i t_ij m_ij - Z <= 0
+        for i in range(B):
+            if t[i, j] > 0 or j in eligible[i]:
+                A[B + j, i * G + j] = t[i, j]
+        A[B + j, -1] = -1.0
+        lb[B + j] = -np.inf
+        ub[B + j] = 0.0
+    integrality = np.ones(nv)
+    integrality[-1] = 0
+    bounds_lb = np.zeros(nv)
+    bounds_ub = np.ones(nv)
+    bounds_ub[-1] = np.inf
+    # forbid ineligible assignments
+    for i in range(B):
+        for j in range(G):
+            if j not in eligible[i]:
+                bounds_ub[i * G + j] = 0.0
+    from scipy.optimize import Bounds
+    try:
+        res = milp(c=cost,
+                   constraints=LinearConstraint(A.tocsr(), lb, ub),
+                   integrality=integrality,
+                   bounds=Bounds(bounds_lb, bounds_ub),
+                   options={"time_limit": time_limit})
+    except Exception:
+        return None
+    if res is None or not res.success or res.x is None:
+        return None
+    m = np.round(res.x[:-1]).reshape(B, G)
+    out: List[List[int]] = [[] for _ in range(G)]
+    for i in range(B):
+        out[int(np.argmax(m[i]))].append(i)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-group micro-batching + packing
+# ---------------------------------------------------------------------------
+
+def solve_micro_batches(seqlens: Sequence[int], strategy: DispatchStrategy,
+                        num_micro_batches: int) -> List[List[int]]:
+    """Split a group's sequences into v balanced micro-batches (reference
+    solve_v_micro_batches): sort by length, contiguous bottleneck-DP
+    partition on the per-seq cost."""
+    if not seqlens:
+        return [[] for _ in range(num_micro_batches)]
+    idx = sorted(range(len(seqlens)), key=lambda i: seqlens[i])
+    costs = [float(strategy.seq_time(seqlens[i])) for i in idx]
+    v = min(num_micro_batches, len(idx))
+    _, parts = solve_pipeline_partition(costs, v)
+    out = [[idx[i] for i in part] for part in parts]
+    # fixed arity: always exactly num_micro_batches lists (1F1B schedules
+    # expect the same v across all dp groups)
+    out += [[] for _ in range(num_micro_batches - len(out))]
+    return out
+
+
+def batching_strategy(seqlens: Sequence[int], max_seqlen: int,
+                      alignment: int = 128) -> np.ndarray:
+    """Pack a group's sequences into rows of ``max_seqlen`` (first-fit
+    decreasing); returns the 0/1 batching-option matrix [rows, seqs]
+    consumed by :meth:`hetu_tpu.data.Bucket.pack_data` (reference
+    batching_strategy, dynamic_pulp.py:124)."""
+    from ..data.bucket import ffd_pack
+    rows = ffd_pack(seqlens, max_seqlen, alignment)
+    mat = np.zeros((len(rows), len(seqlens)), np.int8)
+    for ri, r in enumerate(rows):
+        for i in r:
+            mat[ri, i] = 1
+    return mat
+
+
+# ---------------------------------------------------------------------------
+# strategy pool generation
+# ---------------------------------------------------------------------------
+
+def max_seqlen_for(tp: int, pp: int, cluster: ClusterSpec,
+                   hidden: int, num_layers: int, cp: int = 1,
+                   bytes_per_token_act: Optional[float] = None,
+                   mem_fraction: float = 0.9,
+                   alignment: int = 128) -> int:
+    """Longest admissible sequence under a (tp, pp, cp) layout: activation
+    memory per token is linear in s (reference strategy_max_seqlen's
+    linear memory regression), params take the rest of HBM; ring-attention
+    CP shards the per-token activations across cp ranks.  The bound is
+    aligned DOWN so every admitted length survives aligned packing."""
+    chip = cluster.chip
+    budget = chip.hbm_bytes * mem_fraction
+    layers_here = max(1, num_layers // pp)
+    param_bytes = layers_here * (12 * hidden * hidden) * 2 / tp
+    opt_bytes = param_bytes * 7  # grads + adam states
+    act_per_token = bytes_per_token_act if bytes_per_token_act is not None \
+        else layers_here * 18 * hidden * 2 / tp
+    act_per_token /= cp
+    free = budget - param_bytes - opt_bytes
+    if free <= 0:
+        return 0
+    return int(free / act_per_token) // alignment * alignment
+
+
+def generate_strategy_pool(cluster: ClusterSpec, hidden: int,
+                           num_layers: int,
+                           layouts: Optional[Sequence[Sequence[int]]]
+                           = None,
+                           flops_coeff: Optional[Tuple[float, float, float]]
+                           = None) -> List[DispatchStrategy]:
+    """Candidate (tp, pp[, cp]) layouts with cost coefficients and
+    memory-bounded max seqlens (reference generate_strategy.py).
+
+    ``flops_coeff``, when given, is the (a, b, c) fit of a tp=1 profile;
+    it is rescaled by each layout's tp (cp scaling happens in
+    ``seq_time``)."""
+    n = cluster.total_chips
+    if layouts is None:
+        layouts = []
+        tp = 1
+        while tp <= min(8, n):
+            pp = 1
+            while tp * pp <= n:
+                layouts.append((tp, pp))
+                pp *= 2
+            tp *= 2
+    pool = []
+    for layout in layouts:
+        tp, pp = layout[0], layout[1]
+        cp = layout[2] if len(layout) > 2 else 1
+        ms = max_seqlen_for(tp, pp, cluster, hidden, num_layers, cp=cp)
+        if ms <= 0:
+            continue
+        if flops_coeff is not None:
+            a0, b0, c = flops_coeff
+            a, b = a0 / tp, b0 / tp
+        else:
+            # analytic: attention quadratic term + matmul linear term,
+            # scaled down by tp (sharded) and unchanged by pp (per-stage
+            # work overlaps in 1F1B steady state)
+            chip = cluster.chip
+            eff = chip.peak_flops * chip.mxu_efficiency * tp
+            a = 12.0 * hidden * num_layers / eff
+            b = 72.0 * hidden * hidden * num_layers / eff
+            c = 1e-4
+        pool.append(DispatchStrategy(tp=tp, pp=pp, cp=cp, a=a, b=b, c=c,
+                                     max_seqlen=ms))
+    return pool

@@ -1,0 +1,184 @@
+"""Hydraulis-style dispatcher tests: cost-model fit, MILP/greedy dispatch,
+micro-batch balancing, packing matrices, strategy-pool generation."""
+import numpy as np
+import pytest
+
+from hetu_tpu.data import Bucket
+from hetu_tpu.planner import (ChipSpec, ClusterSpec, DispatchStrategy,
+                              batching_strategy, dynamic_dispatch,
+                              fit_cost_model, generate_strategy_pool,
+                              max_seqlen_for, solve_micro_batches)
+
+
+class TestCostModel:
+    def test_fit_recovers_coefficients(self):
+        a, b, c = 2e-6, 3e-3, 0.5
+        s = np.arange(128, 4096, 128)
+        t = a * s**2 + b * s + c
+        fa, fb, fc = fit_cost_model(s, t)
+        assert np.isclose(fa, a, rtol=1e-6)
+        assert np.isclose(fb, b, rtol=1e-6)
+        assert np.isclose(fc, c, rtol=1e-4)
+
+    def test_fit_with_noise(self):
+        rng = np.random.RandomState(0)
+        s = np.arange(128, 4096, 64)
+        t = 1e-6 * s**2 + 1e-3 * s + 0.1 + rng.randn(len(s)) * 1e-3
+        fa, fb, fc = fit_cost_model(s, t)
+        assert np.isclose(fa, 1e-6, rtol=0.05)
+
+    def test_batch_time_includes_pipeline_slots(self):
+        st = DispatchStrategy(pp=4, a=0.0, b=1.0, c=0.0)
+        # 1F1B: sum + (pp-1)*longest
+        assert np.isclose(st.batch_time([10, 20]), 30 + 3 * 20)
+
+
+def _two_tier_pool():
+    """A big-memory slow group and a small-memory fast group."""
+    return [
+        DispatchStrategy(tp=8, pp=1, a=1e-6, b=1e-3, max_seqlen=8192),
+        DispatchStrategy(tp=2, pp=1, a=4e-6, b=4e-3, max_seqlen=2048),
+    ]
+
+
+class TestDynamicDispatch:
+    def test_long_sequences_respect_eligibility(self):
+        pool = _two_tier_pool()
+        lens = np.array([8000, 4000, 1000, 900, 800, 700])
+        for use_ilp in (False, None):
+            groups = dynamic_dispatch(pool, lens, use_ilp=use_ilp)
+            # sequences > 2048 must be in group 0
+            assert 0 in groups[0] and 1 in groups[0]
+            assert sum(len(g) for g in groups) == len(lens)
+
+    def test_balances_makespan(self):
+        pool = [DispatchStrategy(b=1.0, max_seqlen=100),
+                DispatchStrategy(b=1.0, max_seqlen=100)]
+        lens = np.array([10, 10, 10, 10, 10, 10])
+        groups = dynamic_dispatch(pool, lens, use_ilp=False)
+        assert len(groups[0]) == len(groups[1]) == 3
+
+    def test_milp_not_worse_than_greedy(self):
+        pool = _two_tier_pool()
+        rng = np.random.RandomState(1)
+        lens = rng.randint(100, 2000, 24)
+
+        def makespan(groups):
+            return max(pool[j].batch_time([lens[i] for i in g])
+                       for j, g in enumerate(groups))
+
+        greedy = dynamic_dispatch(pool, lens, use_ilp=False)
+        milp = dynamic_dispatch(pool, lens, use_ilp=True)
+        assert makespan(milp) <= makespan(greedy) * 1.01
+
+    def test_impossible_sequence_raises(self):
+        pool = [DispatchStrategy(max_seqlen=100)]
+        with pytest.raises(ValueError, match="exceeds"):
+            dynamic_dispatch(pool, np.array([500]))
+
+
+class TestMicroBatching:
+    def test_balanced_split(self):
+        st = DispatchStrategy(b=1.0)
+        lens = [100, 100, 100, 100, 50, 50, 50, 50]
+        mbs = solve_micro_batches(lens, st, 4)
+        assert len(mbs) == 4
+        got = sorted(i for mb in mbs for i in mb)
+        assert got == list(range(8))
+        loads = [sum(lens[i] for i in mb) for mb in mbs]
+        assert max(loads) <= 200  # perfectly balanceable
+
+    def test_empty_group(self):
+        st = DispatchStrategy()
+        assert solve_micro_batches([], st, 4) == [[], [], [], []]
+
+
+class TestBatchingMatrix:
+    def test_matrix_feeds_bucket(self):
+        lens = [100, 100, 60, 50, 200]
+        mat = batching_strategy(lens, max_seqlen=256, alignment=64)
+        assert mat.shape[1] == 5
+        np.testing.assert_array_equal(mat.sum(axis=0), np.ones(5))
+        # aligned row loads within capacity
+        aligned = [(l + 63) // 64 * 64 for l in lens]
+        for r in range(mat.shape[0]):
+            assert sum(aligned[i] for i in range(5) if mat[r, i]) <= 256
+        # feed into Bucket.pack_data
+        b = Bucket(pad_token=0, max_seqlen=256, alignment=64)
+        for n in lens:
+            b.add_data(np.full(n, 9), n)
+        b.pack_data(mat)
+        assert b.packed_batch_size == mat.shape[0]
+
+
+class TestStrategyPool:
+    def test_pool_generation(self):
+        cluster = ClusterSpec(chip=ChipSpec(), num_chips=8)
+        pool = generate_strategy_pool(cluster, hidden=4096, num_layers=32)
+        assert pool, "pool must not be empty"
+        for st in pool:
+            assert st.max_seqlen > 0
+            assert st.tp * st.pp <= 8
+
+    def test_more_parallelism_longer_sequences(self):
+        cluster = ClusterSpec(chip=ChipSpec(), num_chips=8)
+        m1 = max_seqlen_for(1, 1, cluster, hidden=8192, num_layers=48)
+        m8 = max_seqlen_for(8, 1, cluster, hidden=8192, num_layers=48)
+        assert m8 > m1
+        mpp = max_seqlen_for(1, 8, cluster, hidden=8192, num_layers=48)
+        assert mpp > m1
+        m_base = max_seqlen_for(1, 1, cluster, hidden=2048, num_layers=24)
+        m_cp = max_seqlen_for(1, 1, cluster, hidden=2048, num_layers=24,
+                              cp=4)
+        assert m_base > 0
+        assert m_cp > m_base  # CP shards activations -> longer sequences
+
+    def test_max_seqlen_bound_survives_aligned_packing(self):
+        """Any admitted length must pack into rows of max_seqlen."""
+        cluster = ClusterSpec(chip=ChipSpec(), num_chips=8)
+        ms = max_seqlen_for(2, 1, cluster, hidden=4096, num_layers=32)
+        assert ms % 128 == 0
+        mat = batching_strategy([ms], max_seqlen=ms, alignment=128)
+        assert mat.shape == (1, 1)
+
+    def test_profiled_coeff_rescaled_per_layout(self):
+        cluster = ClusterSpec(chip=ChipSpec(), num_chips=8)
+        pool = generate_strategy_pool(cluster, hidden=2048, num_layers=16,
+                                      layouts=[(1, 1), (8, 1)],
+                                      flops_coeff=(1e-6, 1e-3, 0.0))
+        t1 = float(pool[0].seq_time(1024))
+        t8 = float(pool[1].seq_time(1024))
+        assert np.isclose(t1 / t8, 8.0)
+
+    def test_cp_divides_seq_time(self):
+        a = DispatchStrategy(a=1e-6, b=1e-3, cp=1)
+        b = DispatchStrategy(a=1e-6, b=1e-3, cp=4)
+        assert np.isclose(float(a.seq_time(2048)) / float(b.seq_time(2048)),
+                          4.0)
+
+    def test_micro_batch_arity_fixed(self):
+        st = DispatchStrategy(b=1.0)
+        out = solve_micro_batches([100, 100], st, 4)
+        assert len(out) == 4
+        assert sorted(i for mb in out for i in mb) == [0, 1]
+
+    def test_end_to_end_dispatch_flow(self):
+        """pool -> dispatch -> micro-batch -> pack (the per-iteration
+        Hydraulis flow)."""
+        cluster = ClusterSpec(chip=ChipSpec(), num_chips=16)
+        pool = generate_strategy_pool(cluster, hidden=2048, num_layers=16)
+        rng = np.random.RandomState(2)
+        lens = rng.randint(128, 4096, 32)
+        lens = np.minimum(lens, max(s.max_seqlen for s in pool))
+        groups = dynamic_dispatch(pool, lens, use_ilp=False)
+        assert sum(len(g) for g in groups) == 32
+        for st, g in zip(pool, groups):
+            if not g:
+                continue
+            mbs = solve_micro_batches([lens[i] for i in g], st, 2)
+            for mb in mbs:
+                if mb:
+                    glens = [lens[g[i]] for i in mb]
+                    mat = batching_strategy(glens, max_seqlen=max(
+                        (int(l) + 127) // 128 * 128 for l in glens))
+                    assert mat.sum() == len(glens)
