@@ -72,6 +72,24 @@ def load_dataloader_core() -> Optional[ctypes.CDLL]:
     return lib
 
 
+def load_embed_cache_core() -> Optional[ctypes.CDLL]:
+    lib = load_native("hetu_embed_cache", ["embed_cache.cc"])
+    if lib is not None and not getattr(lib, "_hetu_sigs_set", False):
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.hetu_cache_create.restype = ctypes.c_void_p
+        lib.hetu_cache_create.argtypes = [ctypes.c_int32, ctypes.c_int64]
+        lib.hetu_cache_destroy.restype = None
+        lib.hetu_cache_destroy.argtypes = [ctypes.c_void_p]
+        lib.hetu_cache_size.restype = ctypes.c_int64
+        lib.hetu_cache_size.argtypes = [ctypes.c_void_p]
+        lib.hetu_cache_lookup.restype = ctypes.c_int64
+        lib.hetu_cache_lookup.argtypes = [
+            ctypes.c_void_p, i64p, ctypes.c_int64, i64p, u8p, i64p, i64p]
+        lib._hetu_sigs_set = True
+    return lib
+
+
 def load_dp_core() -> Optional[ctypes.CDLL]:
     lib = load_native("hetu_dp_core", ["dp_core.cc"])
     if lib is not None and not getattr(lib, "_hetu_sigs_set", False):

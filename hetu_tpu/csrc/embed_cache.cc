@@ -1,0 +1,142 @@
+// Native embedding-cache policy core (HET-style).
+//
+// Counterpart of the reference's hetu_cache
+// (hetu/v1/src/hetu_cache/include/{cache.h,lru_cache.h,lfu_cache.h,
+// lfuopt_cache.h} — the VLDB'22 HET cache-enabled embedding system).
+// The policy bookkeeping (key -> slot map, recency/frequency eviction)
+// runs on the host in C++; the actual embedding rows live in a fixed
+// [limit, dim] device array indexed by the slots this core hands out, so
+// the TPU side is a static-shape gather/scatter.
+//
+// Eviction rule: victim = min (priority, tiebreak) where
+//   LRU    — priority 0,        tiebreak last-access time
+//   LFU    — priority frequency, tiebreak first-insertion time
+//   LFUOpt — priority frequency, tiebreak last-access time
+//            (frequency + recency, approximating lfuopt_cache.h's
+//            offline-optimal refinement)
+//
+// C ABI, loaded via ctypes (hetu_tpu/csrc/build.py).
+
+#include <cstdint>
+#include <set>
+#include <tuple>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Policy : int32_t { kLRU = 0, kLFU = 1, kLFUOpt = 2 };
+
+struct Entry {
+  int64_t slot;
+  int64_t freq;
+  int64_t tie;
+  int64_t batch;  // last lookup batch that touched this key (pinning)
+};
+
+using Rank = std::tuple<int64_t, int64_t, int64_t>;  // (prio, tie, key)
+
+struct Cache {
+  Policy policy;
+  int64_t limit;
+  int64_t clock = 0;
+  int64_t batch_id = 0;
+  std::unordered_map<int64_t, Entry> map;  // key -> entry
+  std::set<Rank> ranks;                    // eviction order (begin = victim)
+  std::vector<int64_t> free_slots;
+
+  explicit Cache(Policy p, int64_t lim) : policy(p), limit(lim) {
+    free_slots.reserve(lim);
+    for (int64_t s = lim - 1; s >= 0; --s) free_slots.push_back(s);
+  }
+
+  int64_t prio(const Entry& e) const {
+    return policy == kLRU ? 0 : e.freq;
+  }
+
+  void touch(int64_t key, Entry& e) {
+    ranks.erase({prio(e), e.tie, key});
+    e.freq += 1;
+    if (policy != kLFU) e.tie = ++clock;  // LFU keeps insertion time
+    e.batch = batch_id;
+    ranks.insert({prio(e), e.tie, key});
+  }
+
+  void insert(int64_t key, int64_t slot) {
+    Entry e{slot, 1, ++clock, batch_id};
+    map.emplace(key, e);
+    ranks.insert({prio(e), e.tie, key});
+  }
+
+  // Returns (victim key, victim slot), skipping keys pinned by the
+  // current batch (their returned slots must stay valid); (-1, -1) if
+  // everything is pinned.
+  std::pair<int64_t, int64_t> evict() {
+    for (auto it = ranks.begin(); it != ranks.end(); ++it) {
+      const int64_t key = std::get<2>(*it);
+      Entry& e = map[key];
+      if (e.batch == batch_id) continue;  // pinned
+      const int64_t slot = e.slot;
+      ranks.erase(it);
+      map.erase(key);
+      free_slots.push_back(slot);
+      return {key, slot};
+    }
+    return {-1, -1};
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hetu_cache_create(int32_t policy, int64_t limit) {
+  return new Cache(static_cast<Policy>(policy), limit);
+}
+
+void hetu_cache_destroy(void* h) { delete static_cast<Cache*>(h); }
+
+int64_t hetu_cache_size(void* h) {
+  return static_cast<int64_t>(static_cast<Cache*>(h)->map.size());
+}
+
+// Process a batch of keys.  For each key, return its cache slot
+// (allocating/evicting on miss) and whether it missed.  Keys of the
+// current batch are pinned: they are never evicted within the call, so
+// every returned slot stays valid.  Evicted (key, slot) pairs are
+// reported so the host can write those rows back to the master table
+// before they are overwritten.  Returns the number of evictions
+// (evicted_* arrays must hold >= n entries), or -1 if the batch has more
+// unique keys than the cache limit.
+int64_t hetu_cache_lookup(void* h, const int64_t* keys, int64_t n,
+                          int64_t* slots, uint8_t* is_miss,
+                          int64_t* evicted_keys, int64_t* evicted_slots) {
+  auto* c = static_cast<Cache*>(h);
+  c->batch_id += 1;
+  int64_t num_evicted = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t key = keys[i];
+    auto it = c->map.find(key);
+    if (it != c->map.end()) {
+      slots[i] = it->second.slot;
+      is_miss[i] = 0;
+      c->touch(key, it->second);
+      continue;
+    }
+    if (c->free_slots.empty()) {
+      const auto [vk, vs] = c->evict();
+      if (vk < 0) return -1;  // batch exceeds cache capacity
+      evicted_keys[num_evicted] = vk;
+      evicted_slots[num_evicted] = vs;
+      ++num_evicted;
+    }
+    const int64_t slot = c->free_slots.back();
+    c->free_slots.pop_back();
+    c->insert(key, slot);
+    slots[i] = slot;
+    is_miss[i] = 1;
+  }
+  return num_evicted;
+}
+
+}  // extern "C"

@@ -1,0 +1,11 @@
+"""Embedding subsystem: HET-style cached embeddings + host parameter
+server (PS analog) for CTR-scale tables.
+
+Covers the reference's v1 PS/embedding stack: ps-lite
+(``hetu/v1/ps-lite/``), HET cache (``hetu/v1/src/hetu_cache/``).
+"""
+from .cache import CachePolicy
+from .cached import CachedEmbedding
+from .host import HostParameterServer
+
+__all__ = ["CachePolicy", "CachedEmbedding", "HostParameterServer"]

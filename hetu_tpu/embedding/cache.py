@@ -1,0 +1,136 @@
+"""Embedding cache policies (host-side bookkeeping).
+
+Python face of the native core (``hetu_tpu/csrc/embed_cache.cc``),
+counterpart of the reference's HET caches
+(``hetu/v1/src/hetu_cache/include/{lru_cache.h,lfu_cache.h,
+lfuopt_cache.h}``).  A pure-Python fallback implements identical
+semantics when no compiler is available.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import List, Tuple
+
+import numpy as np
+
+from ..csrc.build import load_embed_cache_core
+
+POLICIES = {"lru": 0, "lfu": 1, "lfuopt": 2}
+
+
+class CachePolicy:
+    """key -> slot map of bounded size with LRU/LFU/LFUOpt eviction.
+
+    ``lookup(keys)`` returns (slots, is_miss, evicted_keys, evicted_slots):
+    evicted rows must be written back to the master table by the caller
+    before their slots are overwritten.
+    """
+
+    def __init__(self, limit: int, policy: str = "lru",
+                 use_native: bool = True):
+        assert policy in POLICIES, f"unknown policy {policy!r}"
+        self.limit = int(limit)
+        self.policy = policy
+        self._lib = load_embed_cache_core() if use_native else None
+        if self._lib is not None:
+            self._handle = self._lib.hetu_cache_create(
+                POLICIES[policy], self.limit)
+        else:
+            self._py = _PyCache(self.limit, policy)
+
+    def __len__(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.hetu_cache_size(self._handle))
+        return len(self._py.map)
+
+    def lookup(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
+                                                np.ndarray, np.ndarray]:
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        n = len(keys)
+        if self._lib is not None:
+            slots = np.empty(n, np.int64)
+            miss = np.empty(n, np.uint8)
+            ek = np.empty(n, np.int64)
+            es = np.empty(n, np.int64)
+            ne = self._lib.hetu_cache_lookup(
+                self._handle,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+                slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                miss.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                ek.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                es.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            if ne < 0:
+                raise ValueError(
+                    f"batch has more unique keys than the cache limit "
+                    f"({self.limit})")
+            return slots, miss.astype(bool), ek[:ne].copy(), es[:ne].copy()
+        return self._py.lookup(keys)
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None and \
+                getattr(self, "_handle", None) is not None:
+            self._lib.hetu_cache_destroy(self._handle)
+            self._handle = None
+
+
+class _PyCache:
+    """Fallback with semantics identical to the native core: victim =
+    min (priority, tiebreak); LRU -> (0, last access), LFU -> (freq,
+    insertion time), LFUOpt -> (freq, last access)."""
+
+    def __init__(self, limit: int, policy: str):
+        self.limit = limit
+        self.policy = policy
+        self.map = {}                   # key -> slot
+        self.freq = {}                  # key -> freq
+        self.tie = {}                   # key -> tiebreak clock
+        self.batch = {}                 # key -> last batch id (pinning)
+        self.clock = 0
+        self.batch_id = 0
+        self.free = list(range(limit - 1, -1, -1))
+
+    def _touch(self, key):
+        self.freq[key] += 1
+        if self.policy != "lfu":        # LFU keeps insertion time
+            self.clock += 1
+            self.tie[key] = self.clock
+        self.batch[key] = self.batch_id
+
+    def _victim(self):
+        cands = [k for k in self.map if self.batch[k] != self.batch_id]
+        if not cands:
+            raise ValueError(f"batch has more unique keys than the cache "
+                             f"limit ({self.limit})")
+        if self.policy == "lru":
+            return min(cands, key=lambda k: self.tie[k])
+        return min(cands, key=lambda k: (self.freq[k], self.tie[k]))
+
+    def lookup(self, keys):
+        self.batch_id += 1
+        n = len(keys)
+        slots = np.empty(n, np.int64)
+        miss = np.zeros(n, bool)
+        ek, es = [], []
+        for i, key in enumerate(keys):
+            key = int(key)
+            if key in self.map:
+                slots[i] = self.map[key]
+                self._touch(key)
+                continue
+            if not self.free:
+                v = self._victim()
+                ek.append(v)
+                es.append(self.map[v])
+                self.free.append(self.map.pop(v))
+                self.freq.pop(v)
+                self.tie.pop(v)
+                self.batch.pop(v)
+            slot = self.free.pop()
+            self.map[key] = slot
+            self.freq[key] = 1
+            self.clock += 1
+            self.tie[key] = self.clock
+            self.batch[key] = self.batch_id
+            slots[i] = slot
+            miss[i] = True
+        return slots, miss, np.asarray(ek, np.int64), np.asarray(es, np.int64)

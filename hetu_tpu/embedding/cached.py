@@ -1,0 +1,94 @@
+"""Cache-enabled embedding training (HET analog).
+
+Counterpart of the reference's HET system (VLDB'22;
+``hetu/v1/src/hetu_cache/include/{cache.h,embedding.h,hetu_client.h}``):
+the full table lives in host RAM (master), a bounded device cache of hot
+rows lives in HBM as a regular trainable variable ``[cache_size, dim]``,
+and a host-side policy (:class:`hetu_tpu.embedding.cache.CachePolicy`,
+native C++ core) maps keys to cache slots.
+
+Per step: ``prepare_batch(ids)`` resolves ids -> slots, writes evicted
+rows back to the master and stages missed rows into the device cache;
+the graph then runs a STATIC-shape gather on the cache variable and the
+optimizer dense-updates it on device (TPU-friendly: no dynamic shapes,
+no host round-trip inside the compiled step).  ``flush()`` writes every
+resident row back.  Unlike HET's bounded-staleness push/pull (pull_bound/
+push_bound, cache.h:25-26), synchronization here is exact at eviction
+and flush.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..graph.ctor import NormalInitializer, parameter, placeholder
+from ..graph.graph import Graph, get_default_graph
+from .. import ops
+from ..nn.module import Module
+from .cache import CachePolicy
+
+
+class CachedEmbedding(Module):
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 cache_size: int, policy: str = "lfu",
+                 scale: float = 0.01, seed: int = 0,
+                 name: str = "cached_embed"):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.cache_size = cache_size
+        rng = np.random.RandomState(seed)
+        self.master = (rng.randn(num_embeddings, embedding_dim)
+                       * scale).astype(np.float32)
+        self._policy = CachePolicy(cache_size, policy)
+        self._resident: Dict[int, int] = {}    # key -> slot
+        self.cache_table = parameter(
+            NormalInitializer(0.0, scale), (cache_size, embedding_dim),
+            name=f"{name}.cache")
+        self._graph: Graph = self.cache_table.graph or get_default_graph()
+
+    # -- host-side step preparation ---------------------------------------
+
+    def prepare_batch(self, ids: np.ndarray) -> np.ndarray:
+        """Resolve ids -> device-cache slots, syncing rows as needed.
+        Returns slots with the same shape as ids (feed them to the slot
+        placeholder)."""
+        ids_arr = np.asarray(ids, np.int64)
+        uniq, inv = np.unique(ids_arr.reshape(-1), return_inverse=True)
+        slots_u, miss, ev_keys, ev_slots = self._policy.lookup(uniq)
+        g = self._graph
+        if len(ev_keys) or miss.any():
+            cache = np.asarray(g.get_tensor_value(self.cache_table))
+            if len(ev_keys):
+                self.master[ev_keys] = cache[ev_slots]
+                for k in ev_keys:
+                    self._resident.pop(int(k), None)
+            if miss.any():
+                cache = cache.copy()
+                cache[slots_u[miss]] = self.master[uniq[miss]]
+                g.reset_variable(self.cache_table, cache)
+        for k, s in zip(uniq, slots_u):
+            self._resident[int(k)] = int(s)
+        return slots_u[inv].reshape(ids_arr.shape).astype(np.int32)
+
+    def flush(self) -> None:
+        """Write all resident rows back to the master table."""
+        if not self._resident:
+            return
+        cache = np.asarray(self._graph.get_tensor_value(self.cache_table))
+        keys = np.fromiter(self._resident.keys(), np.int64)
+        slots = np.fromiter(self._resident.values(), np.int64)
+        self.master[keys] = cache[slots]
+
+    # -- graph-side -------------------------------------------------------
+
+    def forward(self, slots):
+        """slots: int tensor of cache-slot ids -> [..., dim] embeddings
+        (a static-shape gather on the cache variable)."""
+        return ops.embedding_lookup(self.cache_table, slots)
+
+    @property
+    def hit_info(self):
+        return {"resident": len(self._resident),
+                "cache_size": self.cache_size}

@@ -1,0 +1,81 @@
+"""Host-RAM parameter server for embedding tables (PS analog).
+
+Capability counterpart of the reference's ps-lite parameter server
+(``hetu/v1/ps-lite/src/{worker.cc,PSFunc.cc,PSFhandle_embedding.cc}`` —
+push/pull with server-side sparse optimizers) re-expressed for TPU: the
+master tables live in host RAM (numpy), only the rows a batch touches
+move to the device.  ``push`` applies the server-side sparse update
+(SGD / AdaGrad / Adam, as the reference's embedding PS handlers do).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+class HostParameterServer:
+    """Named host-side embedding tables with sparse push/pull."""
+
+    def __init__(self, optimizer: str = "sgd", lr: float = 0.05,
+                 betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-8):
+        assert optimizer in ("sgd", "adagrad", "adam")
+        self.optimizer = optimizer
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.tables: Dict[str, np.ndarray] = {}
+        self._state: Dict[str, Dict[str, np.ndarray]] = {}
+        self._step: Dict[str, int] = {}
+
+    def register(self, name: str, num_embeddings: int, dim: int,
+                 init: Optional[np.ndarray] = None, scale: float = 0.01,
+                 seed: int = 0) -> None:
+        if init is not None:
+            table = np.asarray(init, np.float32).copy()
+            assert table.shape == (num_embeddings, dim)
+        else:
+            rng = np.random.RandomState(seed)
+            table = (rng.randn(num_embeddings, dim) * scale).astype(
+                np.float32)
+        self.tables[name] = table
+        st: Dict[str, np.ndarray] = {}
+        if self.optimizer == "adagrad":
+            st["accum"] = np.zeros_like(table)
+        elif self.optimizer == "adam":
+            st["m"] = np.zeros_like(table)
+            st["v"] = np.zeros_like(table)
+        self._state[name] = st
+        self._step[name] = 0
+
+    def pull(self, name: str, keys: np.ndarray) -> np.ndarray:
+        """Fetch rows for (possibly repeated) keys."""
+        return self.tables[name][np.asarray(keys, np.int64)]
+
+    def push(self, name: str, keys: np.ndarray, grads: np.ndarray) -> None:
+        """Apply sparse gradients: repeated keys are summed first (the
+        reference's server-side aggregation), then one optimizer step runs
+        on the touched rows only."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(keys), -1)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        g = np.zeros((len(uniq), grads.shape[1]), np.float32)
+        np.add.at(g, inv, grads)
+        table = self.tables[name]
+        st = self._state[name]
+        if self.optimizer == "sgd":
+            table[uniq] -= self.lr * g
+        elif self.optimizer == "adagrad":
+            st["accum"][uniq] += g * g
+            table[uniq] -= self.lr * g / (np.sqrt(st["accum"][uniq])
+                                          + self.eps)
+        else:  # adam (per-table step count; sparse variant)
+            self._step[name] += 1
+            t = self._step[name]
+            b1, b2 = self.betas
+            st["m"][uniq] = b1 * st["m"][uniq] + (1 - b1) * g
+            st["v"][uniq] = b2 * st["v"][uniq] + (1 - b2) * g * g
+            mhat = st["m"][uniq] / (1 - b1 ** t)
+            vhat = st["v"][uniq] / (1 - b2 ** t)
+            table[uniq] -= self.lr * mhat / (np.sqrt(vhat) + self.eps)

@@ -1,0 +1,152 @@
+"""CTR model family: WDL, DeepFM, DCN.
+
+Capability counterparts of the reference's CTR examples
+(``hetu/v1/examples/ctr/models/{wdl_criteo.py,wdl_adult.py,
+deepfm_criteo.py,dcn_criteo.py}`` — Criteo-style recommenders trained
+with PS/hybrid embedding backends).  Sparse features go through a
+pluggable embedding module (dense :class:`hetu_tpu.nn.Embedding`, the
+HET-style :class:`hetu_tpu.embedding.CachedEmbedding`, or host-PS pulled
+rows); dense features feed the MLP towers directly.
+
+All towers are plain matmul stacks — XLA fuses them onto the MXU.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .. import ops
+from ..nn import Embedding, Linear, Module, ModuleList, Sequential, ReLU
+
+
+class MLP(Module):
+    def __init__(self, dims: Sequence[int], activate_last: bool = False,
+                 name: str = "mlp"):
+        super().__init__()
+        layers = []
+        for i in range(len(dims) - 1):
+            layers.append(Linear(dims[i], dims[i + 1]))
+            if i < len(dims) - 2 or activate_last:
+                layers.append(ReLU())
+        self.net = Sequential(*layers)
+
+    def forward(self, x):
+        return self.net(x)
+
+
+class _CTRBase(Module):
+    """Shared wiring: sparse field embeddings + dense features."""
+
+    def __init__(self, num_sparse_fields: int, vocab_size: int,
+                 embedding_dim: int, num_dense: int,
+                 embedding: Optional[Module] = None):
+        super().__init__()
+        self.num_sparse_fields = num_sparse_fields
+        self.embedding_dim = embedding_dim
+        self.num_dense = num_dense
+        # one shared table over all fields (ids are globally offset), the
+        # reference's Criteo layout
+        self.embedding = embedding if embedding is not None else \
+            Embedding(vocab_size, embedding_dim)
+
+    def embed(self, sparse_ids):
+        """[B, F] ids -> [B, F, D] embeddings."""
+        return self.embedding(sparse_ids)
+
+
+class WDL(_CTRBase):
+    """Wide & Deep (reference wdl_criteo.py): linear 'wide' part over
+    sparse embeddings + dense, MLP 'deep' part."""
+
+    def __init__(self, num_sparse_fields: int, vocab_size: int,
+                 embedding_dim: int = 16, num_dense: int = 13,
+                 hidden: Sequence[int] = (256, 256, 256),
+                 embedding: Optional[Module] = None):
+        super().__init__(num_sparse_fields, vocab_size, embedding_dim,
+                         num_dense, embedding)
+        flat = num_sparse_fields * embedding_dim
+        self.wide = Linear(flat + num_dense, 1)
+        self.deep = MLP([flat + num_dense, *hidden, 1])
+
+    def forward(self, sparse_ids, dense):
+        e = self.embed(sparse_ids)
+        flat = ops.reshape(e, (e.shape[0], -1))
+        x = ops.concat([flat, dense], axis=1)
+        return self.wide(x) + self.deep(x)
+
+
+class DeepFM(_CTRBase):
+    """DeepFM (reference deepfm_criteo.py): first-order linear term +
+    second-order FM interactions + deep MLP."""
+
+    def __init__(self, num_sparse_fields: int, vocab_size: int,
+                 embedding_dim: int = 16, num_dense: int = 13,
+                 hidden: Sequence[int] = (256, 256),
+                 embedding: Optional[Module] = None):
+        super().__init__(num_sparse_fields, vocab_size, embedding_dim,
+                         num_dense, embedding)
+        self.linear_embedding = Embedding(vocab_size, 1)
+        flat = num_sparse_fields * embedding_dim
+        self.deep = MLP([flat + num_dense, *hidden, 1])
+        self.dense_linear = Linear(num_dense, 1)
+
+    def forward(self, sparse_ids, dense):
+        e = self.embed(sparse_ids)                       # [B, F, D]
+        # first order
+        first = ops.reduce_sum(
+            ops.reshape(self.linear_embedding(sparse_ids),
+                        (e.shape[0], -1)), axis=1, keepdims=True)
+        first = first + self.dense_linear(dense)
+        # second order FM: 0.5 * ((sum e)^2 - sum e^2)
+        s = ops.reduce_sum(e, axis=1)                    # [B, D]
+        fm = 0.5 * ops.reduce_sum(s * s - ops.reduce_sum(e * e, axis=1),
+                                  axis=1, keepdims=True)
+        # deep
+        flat = ops.reshape(e, (e.shape[0], -1))
+        deep = self.deep(ops.concat([flat, dense], axis=1))
+        return first + fm + deep
+
+
+class CrossLayer(Module):
+    """One DCN cross layer: x_{l+1} = x0 * (w^T x_l) + b + x_l."""
+
+    def __init__(self, dim: int):
+        super().__init__()
+        self.w = Linear(dim, 1, bias=False)
+        self.b = Linear(dim, dim, bias=True)  # bias carrier; weight unused
+
+    def forward(self, x0, xl):
+        return x0 * self.w(xl) + (self.b.bias + xl)
+
+
+class DCN(_CTRBase):
+    """Deep & Cross Network (reference dcn_criteo.py): explicit
+    feature-cross tower + deep tower, concatenated into the head."""
+
+    def __init__(self, num_sparse_fields: int, vocab_size: int,
+                 embedding_dim: int = 16, num_dense: int = 13,
+                 num_cross: int = 3, hidden: Sequence[int] = (256, 256),
+                 embedding: Optional[Module] = None):
+        super().__init__(num_sparse_fields, vocab_size, embedding_dim,
+                         num_dense, embedding)
+        dim = num_sparse_fields * embedding_dim + num_dense
+        self.crosses = ModuleList([CrossLayer(dim) for _ in range(num_cross)])
+        self.deep = MLP([dim, *hidden], activate_last=True)
+        self.head = Linear(dim + hidden[-1], 1)
+
+    def forward(self, sparse_ids, dense):
+        e = self.embed(sparse_ids)
+        x0 = ops.concat([ops.reshape(e, (e.shape[0], -1)), dense], axis=1)
+        xl = x0
+        for cross in self.crosses:
+            xl = cross(x0, xl)
+        deep = self.deep(x0)
+        return self.head(ops.concat([xl, deep], axis=1))
+
+
+def ctr_loss(logits, labels):
+    """Binary cross entropy with logits (the reference trains all CTR
+    models with BCE, examples/ctr/run_hetu.py)."""
+    return ops.binary_cross_entropy(ops.reshape(logits, (-1,)), labels,
+                                    with_logits=True)

@@ -21,12 +21,11 @@ The flow per training iteration:
 from __future__ import annotations
 
 import dataclasses
-import math
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .cost_model import ChipSpec, ClusterSpec
+from .cost_model import ClusterSpec
 from .dp_solver import solve_pipeline_partition
 
 
@@ -64,16 +63,25 @@ class DispatchStrategy:
     max_seqlen: int = 1 << 30
 
     def seq_time(self, s) -> np.ndarray:
+        """FULL-model time for one sequence (cp shards the ring-attention
+        work; pp gets its credit in :meth:`batch_time`)."""
         return quadratic_predict(s, self.a / self.cp, self.b / self.cp,
                                  self.c)
 
+    def steady_time(self, s) -> np.ndarray:
+        """Steady-state 1F1B contribution of one sequence: with pp stages
+        in flight, each new micro-batch occupies the pipeline for ~t/pp."""
+        return self.seq_time(s) / self.pp
+
     def batch_time(self, seqlens: Sequence[int]) -> float:
-        """1F1B estimate: per-seq times + (pp-1) warmup/cooldown slots of
-        the longest sequence (reference static_strategy_time_cost)."""
+        """1F1B estimate: steady-state contributions + warmup/cooldown of
+        (pp-1) stage-slots of the longest sequence (reference
+        static_strategy_time_cost)."""
         if len(seqlens) == 0:
             return 0.0
-        t = float(np.sum(self.seq_time(seqlens)))
-        return t + float(self.seq_time(max(seqlens))) * (self.pp - 1)
+        t = float(np.sum(self.steady_time(seqlens)))
+        return t + float(self.seq_time(max(seqlens))) \
+            * (self.pp - 1) / self.pp
 
 
 # ---------------------------------------------------------------------------
@@ -109,16 +117,29 @@ def dynamic_dispatch(strategies: Sequence[DispatchStrategy],
 
 
 def _dispatch_greedy(strategies, seqlens, eligible) -> List[List[int]]:
+    """LPT onto the group whose batch_time grows least (objective
+    identical to batch_time: steady-state + pipeline warmup)."""
     G = len(strategies)
-    loads = np.zeros(G)
+    steady = np.zeros(G)
+    max_t = np.zeros(G)
     out: List[List[int]] = [[] for _ in range(G)]
+
+    def group_time(j, extra_steady=0.0, extra_t=0.0):
+        st = strategies[j]
+        mt = max(max_t[j], extra_t)
+        return steady[j] + extra_steady + mt * (st.pp - 1) / st.pp
+
     order = np.argsort(-seqlens)
     for i in order:
-        costs = [loads[j] + float(strategies[j].seq_time(seqlens[i]))
-                 for j in eligible[i]]
+        costs = []
+        for j in eligible[i]:
+            t = float(strategies[j].seq_time(seqlens[i]))
+            costs.append(group_time(j, t / strategies[j].pp, t))
         j = eligible[i][int(np.argmin(costs))]
+        t = float(strategies[j].seq_time(seqlens[i]))
         out[j].append(int(i))
-        loads[j] += float(strategies[j].seq_time(seqlens[i]))
+        steady[j] += t / strategies[j].pp
+        max_t[j] = max(max_t[j], t)
     for g in out:
         g.sort()
     return out
@@ -126,45 +147,69 @@ def _dispatch_greedy(strategies, seqlens, eligible) -> List[List[int]]:
 
 def _dispatch_milp(strategies, seqlens, eligible, time_limit
                    ) -> Optional[List[List[int]]]:
-    """min Z s.t. sum_j m_ij = 1, sum_i m_ij t_ij <= Z (per group)."""
+    """Exact makespan minimization over the batch_time objective
+    (mirrors the reference's PuLP formulation with its Y_j max-seqlen
+    auxiliaries, dynamic_pulp.py:50-60):
+
+        min Z
+        s.t. sum_j m_ij = 1                                    (assign)
+             Y_j >= t_ij m_ij                                  (group max)
+             sum_i (t_ij/pp_j) m_ij + ((pp_j-1)/pp_j) Y_j <= Z (load)
+    """
     try:
-        from scipy.optimize import LinearConstraint, milp
+        from scipy.optimize import Bounds, LinearConstraint, milp
         from scipy.sparse import lil_matrix
     except ImportError:  # pragma: no cover - scipy is baked in
         return None
     B, G = len(seqlens), len(strategies)
-    nv = B * G + 1  # m_ij + Z
+    # variables: m_ij (B*G binary), Y_j (G continuous), Z
+    nv = B * G + G + 1
+    iY = B * G
+    iZ = B * G + G
     t = np.zeros((B, G))
     for i in range(B):
         for j in eligible[i]:
             t[i, j] = float(strategies[j].seq_time(seqlens[i]))
     cost = np.zeros(nv)
-    cost[-1] = 1.0  # minimize Z
-    A = lil_matrix((B + G, nv))
-    lb = np.zeros(B + G)
-    ub = np.zeros(B + G)
+    cost[iZ] = 1.0  # minimize Z
+    nc = B + B * G + G
+    A = lil_matrix((nc, nv))
+    lb = np.zeros(nc)
+    ub = np.zeros(nc)
+    row = 0
     for i in range(B):  # assignment: sum_j m_ij == 1 over eligible j
         for j in eligible[i]:
-            A[i, i * G + j] = 1.0
-        lb[i] = ub[i] = 1.0
-    for j in range(G):  # load: sum_i t_ij m_ij - Z <= 0
+            A[row, i * G + j] = 1.0
+        lb[row] = ub[row] = 1.0
+        row += 1
+    for i in range(B):  # group max: t_ij m_ij - Y_j <= 0
+        for j in range(G):
+            if t[i, j] > 0:
+                A[row, i * G + j] = t[i, j]
+                A[row, iY + j] = -1.0
+                lb[row] = -np.inf
+                ub[row] = 0.0
+            row += 1
+    for j in range(G):  # load: sum_i (t_ij/pp) m_ij + ((pp-1)/pp) Y_j <= Z
+        pp = strategies[j].pp
         for i in range(B):
-            if t[i, j] > 0 or j in eligible[i]:
-                A[B + j, i * G + j] = t[i, j]
-        A[B + j, -1] = -1.0
-        lb[B + j] = -np.inf
-        ub[B + j] = 0.0
-    integrality = np.ones(nv)
-    integrality[-1] = 0
+            if t[i, j] > 0:
+                A[row, i * G + j] = t[i, j] / pp
+        A[row, iY + j] = (pp - 1) / pp
+        A[row, iZ] = -1.0
+        lb[row] = -np.inf
+        ub[row] = 0.0
+        row += 1
+    integrality = np.zeros(nv)
+    integrality[:B * G] = 1
     bounds_lb = np.zeros(nv)
-    bounds_ub = np.ones(nv)
-    bounds_ub[-1] = np.inf
+    bounds_ub = np.full(nv, np.inf)
+    bounds_ub[:B * G] = 1.0
     # forbid ineligible assignments
     for i in range(B):
         for j in range(G):
             if j not in eligible[i]:
                 bounds_ub[i * G + j] = 0.0
-    from scipy.optimize import Bounds
     try:
         res = milp(c=cost,
                    constraints=LinearConstraint(A.tocsr(), lb, ub),
@@ -175,7 +220,7 @@ def _dispatch_milp(strategies, seqlens, eligible, time_limit
         return None
     if res is None or not res.success or res.x is None:
         return None
-    m = np.round(res.x[:-1]).reshape(B, G)
+    m = np.round(res.x[:B * G]).reshape(B, G)
     out: List[List[int]] = [[] for _ in range(G)]
     for i in range(B):
         out[int(np.argmax(m[i]))].append(i)

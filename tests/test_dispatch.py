@@ -29,8 +29,17 @@ class TestCostModel:
 
     def test_batch_time_includes_pipeline_slots(self):
         st = DispatchStrategy(pp=4, a=0.0, b=1.0, c=0.0)
-        # 1F1B: sum + (pp-1)*longest
-        assert np.isclose(st.batch_time([10, 20]), 30 + 3 * 20)
+        # 1F1B: steady-state sum/pp + (pp-1)/pp * longest
+        assert np.isclose(st.batch_time([10, 20]), 30 / 4 + 3 * 20 / 4)
+
+    def test_pp_gets_throughput_credit(self):
+        """Equal-hardware pp=8 and tp=8 groups must have comparable
+        estimated throughput (1F1B steady state), not a ~pp gap."""
+        tp8 = DispatchStrategy(tp=8, pp=1, a=1e-6 / 8, b=1e-3 / 8)
+        pp8 = DispatchStrategy(tp=1, pp=8, a=1e-6, b=1e-3)
+        lens = [1024] * 64
+        ratio = pp8.batch_time(lens) / tp8.batch_time(lens)
+        assert ratio < 1.5, ratio  # near parity, not ~8x
 
 
 def _two_tier_pool():

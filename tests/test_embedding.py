@@ -1,0 +1,253 @@
+"""Embedding subsystem tests: cache policies (native vs python), the
+HET-style cached embedding, the host PS, and CTR models."""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import nn, ops, optim
+from hetu_tpu.embedding import CachedEmbedding, CachePolicy, \
+    HostParameterServer
+from hetu_tpu.embedding.cache import _PyCache
+from hetu_tpu.models.ctr import DCN, DeepFM, WDL, ctr_loss
+
+
+class TestCachePolicy:
+    def test_native_builds(self):
+        from hetu_tpu.csrc.build import load_embed_cache_core
+        assert load_embed_cache_core() is not None
+
+    @pytest.mark.parametrize("policy", ["lru", "lfu", "lfuopt"])
+    def test_basic_hit_miss(self, policy):
+        c = CachePolicy(4, policy)
+        slots, miss, ek, es = c.lookup(np.array([1, 2, 3]))
+        assert miss.all() and len(ek) == 0
+        assert len(set(slots.tolist())) == 3
+        s2, m2, _, _ = c.lookup(np.array([1, 2, 3]))
+        assert not m2.any()
+        np.testing.assert_array_equal(slots, s2)
+
+    def test_lru_evicts_least_recent(self):
+        c = CachePolicy(2, "lru")
+        c.lookup(np.array([1]))
+        c.lookup(np.array([2]))
+        c.lookup(np.array([1]))          # 1 is now most recent
+        _, _, ek, _ = c.lookup(np.array([3]))
+        assert ek.tolist() == [2]
+
+    def test_lfu_evicts_least_frequent(self):
+        c = CachePolicy(2, "lfu")
+        for _ in range(3):
+            c.lookup(np.array([1]))      # freq(1) = 3
+        c.lookup(np.array([2]))          # freq(2) = 1
+        _, _, ek, _ = c.lookup(np.array([3]))
+        assert ek.tolist() == [2]
+
+    def test_repeated_keys_in_one_batch(self):
+        c = CachePolicy(4, "lru")
+        slots, miss, _, _ = c.lookup(np.array([7, 7, 7, 8]))
+        assert slots[0] == slots[1] == slots[2] != slots[3]
+        assert miss.tolist() == [True, False, False, True]
+
+    def test_eviction_returns_slot_for_reuse(self):
+        c = CachePolicy(2, "lru")
+        s1, _, _, _ = c.lookup(np.array([1, 2]))
+        _, _, ek, es = c.lookup(np.array([3]))
+        assert len(ek) == 1
+        assert es[0] in s1  # reused one of the two slots
+        assert len(c) == 2
+
+    @pytest.mark.parametrize("policy", ["lru", "lfu", "lfuopt"])
+    def test_batch_keys_pinned_against_eviction(self, policy):
+        """Keys of the current batch must never be evicted within the
+        same lookup, so every returned slot stays valid."""
+        c = CachePolicy(2, policy)
+        c.lookup(np.array([1, 2]))
+        slots, _, ek, _ = c.lookup(np.array([3, 4]))
+        assert sorted(ek.tolist()) == [1, 2]      # not 3!
+        assert len(set(slots.tolist())) == 2
+        # resident bookkeeping stays consistent under heavy churn
+        resident = {}
+        rng = np.random.RandomState(0)
+        for _ in range(50):
+            keys = np.unique(rng.randint(0, 40, 2))
+            s, _, ek, _ = c.lookup(keys)
+            for k in ek:
+                resident.pop(int(k), None)
+            for k, sl in zip(keys, s):
+                resident[int(k)] = int(sl)
+            assert len(resident) <= 2
+
+    @pytest.mark.parametrize("policy", ["lru", "lfu", "lfuopt"])
+    def test_oversized_batch_raises(self, policy):
+        c = CachePolicy(2, policy)
+        with pytest.raises(ValueError, match="cache limit"):
+            c.lookup(np.array([1, 2, 3]))
+        cp = CachePolicy(2, policy, use_native=False)
+        with pytest.raises(ValueError, match="cache limit"):
+            cp.lookup(np.array([1, 2, 3]))
+
+    @pytest.mark.parametrize("policy", ["lru", "lfu", "lfuopt"])
+    def test_native_matches_python(self, policy):
+        rng = np.random.RandomState(0)
+        nat = CachePolicy(8, policy, use_native=True)
+        py = CachePolicy(8, policy, use_native=False)
+        assert nat._lib is not None
+        for _ in range(30):
+            keys = rng.randint(0, 20, rng.randint(1, 6))
+            sn, mn, ekn, _ = nat.lookup(keys)
+            sp, mp, ekp, _ = py.lookup(keys)
+            np.testing.assert_array_equal(mn, mp)
+            np.testing.assert_array_equal(np.sort(ekn), np.sort(ekp))
+
+
+class TestCachedEmbedding:
+    def test_matches_full_embedding_training(self):
+        """Cached embedding (cache smaller than vocab) must train to the
+        same result as a plain embedding given identical data order."""
+        N, D, B = 32, 8, 8
+        rng = np.random.RandomState(0)
+        batches = [rng.randint(0, N, B) for _ in range(12)]
+
+        def run(cached):
+            from hetu_tpu.graph import ctor
+            ctor._seed_counter[0] = 99
+            master = CachedEmbedding(N, D, cache_size=16, seed=1) \
+                .master.copy()
+            with ht.graph("define_and_run", create_new=True) as g:
+                ids_ph = ht.placeholder("int32", (B,), name="ids")
+                if cached:
+                    emb = CachedEmbedding(N, D, cache_size=16, policy="lru",
+                                          seed=1)
+                    out = emb(ids_ph)
+                else:
+                    emb = None
+                    w = ctor.parameter(ctor.ProvidedInitializer(master),
+                                       (N, D), name="full")
+                    out = ops.embedding_lookup(w, ids_ph)
+                loss = ops.reduce_mean(out * out)
+                train_op = optim.SGDOptimizer(lr=0.5).minimize(loss)
+                losses = []
+                for b in batches:
+                    feed = emb.prepare_batch(b) if cached else \
+                        b.astype(np.int32)
+                    l, _ = g.run(loss, [loss, train_op], {ids_ph: feed})
+                    losses.append(float(np.asarray(l)))
+                if cached:
+                    emb.flush()
+                    table = emb.master.copy()
+                else:
+                    table = np.asarray(g.get_tensor_value(w))
+            return losses, table
+
+        lc, tc = run(True)
+        lf, tf = run(False)
+        np.testing.assert_allclose(lc, lf, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(tc, tf, rtol=1e-4, atol=1e-5)
+
+    def test_eviction_preserves_learned_rows(self):
+        """Rows evicted from the cache must carry their updates back to
+        the master (no silent loss of training)."""
+        N, D = 8, 4
+        with ht.graph("define_and_run", create_new=True) as g:
+            emb = CachedEmbedding(N, D, cache_size=2, policy="lru", seed=2)
+            ids_ph = ht.placeholder("int32", (2,), name="slots")
+            out = emb(ids_ph)
+            loss = ops.reduce_mean(out)
+            train_op = optim.SGDOptimizer(lr=1.0).minimize(loss)
+            before = emb.master[0].copy()
+            g.run(loss, [train_op], {ids_ph: emb.prepare_batch(
+                np.array([0, 1]))})
+            # touch two other keys twice -> evicts 0 and 1
+            g.run(loss, [train_op], {ids_ph: emb.prepare_batch(
+                np.array([2, 3]))})
+            g.run(loss, [train_op], {ids_ph: emb.prepare_batch(
+                np.array([4, 5]))})
+            assert not np.allclose(emb.master[0], before)  # write-back
+
+
+class TestHostPS:
+    def test_pull_push_roundtrip(self):
+        ps = HostParameterServer(optimizer="sgd", lr=1.0)
+        ps.register("emb", 10, 4, seed=0)
+        rows = ps.pull("emb", [1, 3])
+        ps.push("emb", [1, 3], np.ones((2, 4)))
+        rows2 = ps.pull("emb", [1, 3])
+        np.testing.assert_allclose(rows - 1.0, rows2)
+
+    def test_duplicate_keys_summed(self):
+        ps = HostParameterServer(optimizer="sgd", lr=1.0)
+        ps.register("emb", 4, 2, seed=0)
+        r0 = ps.pull("emb", [2])[0].copy()
+        ps.push("emb", [2, 2, 2], np.ones((3, 2)))
+        np.testing.assert_allclose(ps.pull("emb", [2])[0], r0 - 3.0)
+
+    @pytest.mark.parametrize("opt", ["adagrad", "adam"])
+    def test_sparse_optimizers_converge(self, opt):
+        ps = HostParameterServer(optimizer=opt, lr=0.1)
+        ps.register("emb", 6, 3, seed=1)
+        target = np.zeros(3)
+        for _ in range(200):
+            row = ps.pull("emb", [2])[0]
+            ps.push("emb", [2], (row - target)[None, :])
+        assert np.abs(ps.pull("emb", [2])[0]).max() < 1e-2
+
+    def test_untouched_rows_unchanged(self):
+        ps = HostParameterServer()
+        ps.register("emb", 5, 2, seed=0)
+        before = ps.tables["emb"].copy()
+        ps.push("emb", [0], np.ones((1, 2)))
+        np.testing.assert_array_equal(ps.tables["emb"][1:], before[1:])
+
+
+class TestCTRModels:
+    def _data(self, B=16, F=5, vocab=50, nd=4, seed=0):
+        rng = np.random.RandomState(seed)
+        ids = rng.randint(0, vocab, (B, F)).astype(np.int32)
+        dense = rng.randn(B, nd).astype(np.float32)
+        # learnable rule: label depends on a dense feature
+        labels = (dense[:, 0] > 0).astype(np.float32)
+        return ids, dense, labels
+
+    @pytest.mark.parametrize("cls", [WDL, DeepFM, DCN])
+    def test_trains(self, cls):
+        from hetu_tpu.graph import ctor
+        ctor._seed_counter[0] = 7
+        ids, dense, labels = self._data()
+        with ht.graph("define_and_run", create_new=True) as g:
+            sp = ht.placeholder("int32", ids.shape, name="sp")
+            dn = ht.placeholder("float32", dense.shape, name="dn")
+            lb = ht.placeholder("float32", labels.shape, name="lb")
+            model = cls(num_sparse_fields=5, vocab_size=50,
+                        embedding_dim=8, num_dense=4, hidden=(32, 32))
+            loss = ctr_loss(model(sp, dn), lb)
+            train_op = optim.AdamOptimizer(lr=1e-2).minimize(loss)
+            losses = []
+            for _ in range(30):
+                l, _ = g.run(loss, [loss, train_op],
+                             {sp: ids, dn: dense, lb: labels})
+                losses.append(float(np.asarray(l)))
+        assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+    def test_wdl_with_cached_embedding(self):
+        """CTR model over the HET-style cached embedding backend."""
+        from hetu_tpu.graph import ctor
+        ctor._seed_counter[0] = 11
+        ids, dense, labels = self._data(vocab=40)
+        with ht.graph("define_and_run", create_new=True) as g:
+            emb = CachedEmbedding(40 * 1, 8, cache_size=64, policy="lfu")
+            sp = ht.placeholder("int32", ids.shape, name="sp")
+            dn = ht.placeholder("float32", dense.shape, name="dn")
+            lb = ht.placeholder("float32", labels.shape, name="lb")
+            model = WDL(num_sparse_fields=5, vocab_size=40,
+                        embedding_dim=8, num_dense=4, hidden=(32,),
+                        embedding=emb)
+            loss = ctr_loss(model(sp, dn), lb)
+            train_op = optim.AdamOptimizer(lr=1e-2).minimize(loss)
+            losses = []
+            for _ in range(20):
+                slots = emb.prepare_batch(ids)
+                l, _ = g.run(loss, [loss, train_op],
+                             {sp: slots, dn: dense, lb: labels})
+                losses.append(float(np.asarray(l)))
+            emb.flush()
+        assert losses[-1] < losses[0]
