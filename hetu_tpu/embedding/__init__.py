@@ -6,6 +6,19 @@ Covers the reference's v1 PS/embedding stack: ps-lite
 """
 from .cache import CachePolicy
 from .cached import CachedEmbedding
+from .compression import (AutoDimEmbedding, CompositionalEmbedding,
+                          DeepLightEmbedding, DHEEmbedding, DPQEmbedding,
+                          HashEmbedding, LowRankEmbedding, MGQEEmbedding,
+                          MixedDimensionEmbedding, OptEmbedEmbedding,
+                          PEPEmbedding, QuantizedEmbedding, ROBEEmbedding,
+                          TensorTrainEmbedding)
 from .host import HostParameterServer
 
-__all__ = ["CachePolicy", "CachedEmbedding", "HostParameterServer"]
+__all__ = [
+    "CachePolicy", "CachedEmbedding", "HostParameterServer",
+    "AutoDimEmbedding", "CompositionalEmbedding", "DeepLightEmbedding",
+    "DHEEmbedding", "DPQEmbedding", "HashEmbedding", "LowRankEmbedding",
+    "MGQEEmbedding", "MixedDimensionEmbedding", "OptEmbedEmbedding",
+    "PEPEmbedding", "QuantizedEmbedding", "ROBEEmbedding",
+    "TensorTrainEmbedding",
+]

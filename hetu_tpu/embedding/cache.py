@@ -47,6 +47,14 @@ class CachePolicy:
                                                 np.ndarray, np.ndarray]:
         keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
         n = len(keys)
+        # validate BEFORE mutating: a batch with more unique keys than
+        # slots would otherwise partially evict/insert and corrupt the
+        # caller's resident bookkeeping
+        n_unique = len(np.unique(keys))
+        if n_unique > self.limit:
+            raise ValueError(
+                f"batch has more unique keys ({n_unique}) than the cache "
+                f"limit ({self.limit})")
         if self._lib is not None:
             slots = np.empty(n, np.int64)
             miss = np.empty(n, np.uint8)

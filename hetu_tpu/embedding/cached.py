@@ -47,6 +47,26 @@ class CachedEmbedding(Module):
             NormalInitializer(0.0, scale), (cache_size, embedding_dim),
             name=f"{name}.cache")
         self._graph: Graph = self.cache_table.graph or get_default_graph()
+        self._optimizer = None
+
+    def attach_optimizer(self, optimizer) -> None:
+        """Register the optimizer training ``cache_table`` so slot-keyed
+        optimizer state (Adam m/v, momentum) is zeroed when a new key is
+        staged into a slot — otherwise the newcomer inherits the evicted
+        key's accumulated state."""
+        self._optimizer = optimizer
+
+    def _zero_slot_opt_state(self, slots: np.ndarray) -> None:
+        if self._optimizer is None or not len(slots):
+            return
+        tid = self.cache_table.id
+        for state in self._optimizer._state.values():
+            if isinstance(state, dict) and tid in state:
+                arr = np.asarray(state[tid])
+                if arr.ndim >= 1 and arr.shape[0] == self.cache_size:
+                    arr = arr.copy()
+                    arr[slots] = 0
+                    state[tid] = arr
 
     # -- host-side step preparation ---------------------------------------
 
@@ -68,6 +88,7 @@ class CachedEmbedding(Module):
                 cache = cache.copy()
                 cache[slots_u[miss]] = self.master[uniq[miss]]
                 g.reset_variable(self.cache_table, cache)
+                self._zero_slot_opt_state(slots_u[miss])
         for k, s in zip(uniq, slots_u):
             self._resident[int(k)] = int(s)
         return slots_u[inv].reshape(ids_arr.shape).astype(np.int32)

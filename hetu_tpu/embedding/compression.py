@@ -1,0 +1,530 @@
+"""Embedding memory-compression methods.
+
+Capability counterpart of the reference's EmbeddingMemoryCompression tool
+(``tools/EmbeddingMemoryCompression/methods/layers/`` — the VLDB'24
+benchmark of ~19 compression methods).  Every class is a drop-in
+``Module``: ``ids -> [..., dim]`` embeddings, so CTR models
+(:mod:`hetu_tpu.models.ctr`) accept any of them via their ``embedding=``
+argument.  Methods are grouped by family:
+
+hashing     — :class:`HashEmbedding` (hash.py), :class:`CompositionalEmbedding`
+              (compo.py, quotient-remainder), :class:`ROBEEmbedding` (robe.py),
+              :class:`DHEEmbedding` (dhe.py)
+quantization— :class:`DPQEmbedding` (dpq.py), :class:`MGQEEmbedding` (mgqe.py),
+              :class:`QuantizedEmbedding` (quantize.py/alpt.py, int8 + learned
+              scale via straight-through)
+factorization— :class:`TensorTrainEmbedding` (tensortrain.py),
+              :class:`LowRankEmbedding` (autosrh-style)
+pruning     — :class:`DeepLightEmbedding` (deeplight.py, magnitude mask),
+              :class:`PEPEmbedding` (pep.py, learned-threshold soft pruning),
+              :class:`OptEmbedEmbedding` (optembed.py, learnable dim mask)
+mixed-dim   — :class:`MixedDimensionEmbedding` (mde.py/adapt.py, frequency-
+              tiered dims + projection), :class:`AutoDimEmbedding`
+              (autodim.py, soft dim selection)
+
+All ops are dense gathers/matmuls (MXU-friendly); masks use
+straight-through estimators instead of dynamic sparsity so shapes stay
+static under jit.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ops
+from ..graph.ctor import (ConstantInitializer, NormalInitializer,
+                          parameter)
+from ..nn.module import Module
+
+__all__ = [
+    "HashEmbedding", "CompositionalEmbedding", "ROBEEmbedding",
+    "DHEEmbedding", "DPQEmbedding", "MGQEEmbedding", "QuantizedEmbedding",
+    "TensorTrainEmbedding", "LowRankEmbedding", "DeepLightEmbedding",
+    "PEPEmbedding", "OptEmbedEmbedding", "MixedDimensionEmbedding",
+    "AutoDimEmbedding",
+]
+
+_P1 = 2654435761  # Knuth multiplicative hashing constants
+_P2 = 40503
+
+
+def _hash(ids, salt: int, mod: int):
+    h = (ids.astype(jnp.uint32) * np.uint32(_P1)
+         + np.uint32(salt * _P2 + 1))
+    return (h % np.uint32(mod)).astype(jnp.int32)
+
+
+class _CompressedEmbedding(Module):
+    """Shared bits: target (num_embeddings, dim) + memory accounting."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+
+    def compression_ratio(self) -> float:
+        """full-table params / this method's params."""
+        full = self.num_embeddings * self.embedding_dim
+        mine = 0
+        for _, p in self.named_parameters():
+            mine += int(np.prod(p.shape))
+        return full / max(1, mine)
+
+
+class HashEmbedding(_CompressedEmbedding):
+    """Hash trick: one shared table of ``table_size`` rows (hash.py)."""
+
+    def __init__(self, num_embeddings, embedding_dim, table_size: int,
+                 scale: float = 0.01, name: str = "hash_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        self.table_size = table_size
+        self.table = parameter(NormalInitializer(0.0, scale),
+                               (table_size, embedding_dim),
+                               name=f"{name}.table")
+
+    def forward(self, ids):
+        mod = self.table_size
+        slot = ops.functional._op("hash_ids",
+                                  lambda i: _hash(i, 0, mod), [ids])
+        return ops.embedding_lookup(self.table, slot)
+
+
+class CompositionalEmbedding(_CompressedEmbedding):
+    """Quotient-remainder compositional embedding (compo.py): two small
+    tables combined elementwise (mul or sum)."""
+
+    def __init__(self, num_embeddings, embedding_dim, num_buckets: int,
+                 combine: str = "mul", scale: float = 0.01,
+                 name: str = "compo_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        assert combine in ("mul", "sum")
+        self.combine = combine
+        self.num_buckets = num_buckets
+        q_rows = (num_embeddings + num_buckets - 1) // num_buckets
+        self.q_table = parameter(NormalInitializer(0.0, scale),
+                                 (q_rows, embedding_dim),
+                                 name=f"{name}.q")
+        self.r_table = parameter(NormalInitializer(0.0, scale),
+                                 (num_buckets, embedding_dim),
+                                 name=f"{name}.r")
+
+    def forward(self, ids):
+        nb = self.num_buckets
+        q = ops.functional._op("quotient", lambda i: i // nb, [ids])
+        r = ops.functional._op("remainder", lambda i: i % nb, [ids])
+        eq = ops.embedding_lookup(self.q_table, q)
+        er = ops.embedding_lookup(self.r_table, r)
+        return eq * er if self.combine == "mul" else eq + er
+
+
+class ROBEEmbedding(_CompressedEmbedding):
+    """ROBE-Z (robe.py): rows are chunks read from one shared flat
+    parameter array at hashed offsets."""
+
+    def __init__(self, num_embeddings, embedding_dim, robe_size: int,
+                 block_size: int = 8, scale: float = 0.01,
+                 name: str = "robe_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        assert embedding_dim % block_size == 0
+        self.block_size = block_size
+        self.num_blocks = embedding_dim // block_size
+        self.robe_size = robe_size
+        self.flat = parameter(NormalInitializer(0.0, scale), (robe_size,),
+                              name=f"{name}.flat")
+        self._arange = np.arange(block_size)
+
+    def forward(self, ids):
+        B, Z, nb = self.block_size, self.robe_size, self.num_blocks
+        off = self._arange
+
+        def _impl(flat, i):
+            # per-(id, block) hashed start offset into the flat array
+            blocks = jnp.arange(nb, dtype=jnp.int32)
+            starts = _hash(i[..., None] * nb + blocks, 1, Z - B)  # [..., nb]
+            idx = starts[..., None] + off                        # [..., nb, B]
+            return flat[idx].reshape(*i.shape, nb * B)
+
+        return ops.functional._op("robe_lookup", _impl, [self.flat, ids])
+
+
+class DHEEmbedding(_CompressedEmbedding):
+    """Deep hash embedding (dhe.py): k hash codes -> MLP decoder."""
+
+    def __init__(self, num_embeddings, embedding_dim, num_hashes: int = 16,
+                 hidden: int = 64, num_layers: int = 2,
+                 name: str = "dhe_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        self.num_hashes = num_hashes
+        dims = [num_hashes] + [hidden] * (num_layers - 1) + [embedding_dim]
+        self.ws = []
+        self.bs = []
+        for li in range(len(dims) - 1):
+            w = parameter(NormalInitializer(0.0, 1.0 / math.sqrt(dims[li])),
+                          (dims[li], dims[li + 1]), name=f"{name}.w{li}")
+            b = parameter(ConstantInitializer(0.0), (dims[li + 1],),
+                          name=f"{name}.b{li}")
+            self.register_parameter(f"w{li}", w)
+            self.register_parameter(f"b{li}", b)
+            self.ws.append(w)
+            self.bs.append(b)
+
+    def forward(self, ids):
+        k = self.num_hashes
+
+        def _codes(i):
+            salts = jnp.arange(k, dtype=jnp.int32)
+            h = _hash(i[..., None] * k + salts, 7, 1 << 20)
+            return (h.astype(jnp.float32) / (1 << 19)) - 1.0  # [-1, 1)
+
+        x = ops.functional._op("dhe_codes", _codes, [ids])
+        for li, (w, b) in enumerate(zip(self.ws, self.bs)):
+            x = ops.matmul(x, w) + b
+            if li < len(self.ws) - 1:
+                x = ops.gelu(x)
+        return x
+
+
+class DPQEmbedding(_CompressedEmbedding):
+    """Differentiable product quantization (dpq.py): per-subspace
+    codebooks, hard assignment with a straight-through estimator."""
+
+    def __init__(self, num_embeddings, embedding_dim, num_codebooks: int = 4,
+                 codebook_size: int = 64, scale: float = 0.05,
+                 name: str = "dpq_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        assert embedding_dim % num_codebooks == 0
+        self.num_codebooks = num_codebooks
+        self.codebook_size = codebook_size
+        sub = embedding_dim // num_codebooks
+        # query table: what gets compared against codewords
+        self.query = parameter(NormalInitializer(0.0, scale),
+                               (num_embeddings, num_codebooks, sub),
+                               name=f"{name}.query")
+        self.codebooks = parameter(NormalInitializer(0.0, scale),
+                                   (num_codebooks, codebook_size, sub),
+                                   name=f"{name}.codebooks")
+
+    def _mask_distances(self, d, ids):
+        """Hook: restrict codeword choices per id (overridden by MGQE)."""
+        return d
+
+    def forward(self, ids):
+        mask = self._mask_distances
+
+        def _impl(query, books, i):
+            q = query[i]                                  # [..., C, sub]
+            # distances to codewords: [..., C, K]
+            d = jnp.einsum("...cs,cks->...ck", q, books)
+            d = mask(d, i)
+            idx = jnp.argmax(d, axis=-1)                  # [..., C]
+            # gather codewords: [..., C, sub]
+            cw = jnp.einsum("...ck,cks->...cs",
+                            jax.nn.one_hot(idx, books.shape[1]), books)
+            # straight-through: forward hard codeword, backward soft query
+            out = q + jax.lax.stop_gradient(cw - q)
+            return out.reshape(*i.shape, -1)
+
+        return ops.functional._op(f"{type(self).__name__}_lookup", _impl,
+                                  [self.query, self.codebooks, ids])
+
+    def compression_ratio(self) -> float:
+        # deployed size = codes (C * log2(K) bits) + codebooks; the query
+        # table exists only at training time (dpq.py's inference path)
+        full = self.num_embeddings * self.embedding_dim * 32
+        codes = self.num_embeddings * self.num_codebooks \
+            * math.log2(self.codebook_size)
+        books = int(np.prod(self.codebooks.shape)) * 32
+        return full / (codes + books)
+
+
+class MGQEEmbedding(DPQEmbedding):
+    """Multi-granular quantized embedding (mgqe.py): frequent ids use
+    more codewords than rare ids (per-id codebook-size cap)."""
+
+    def __init__(self, num_embeddings, embedding_dim, num_codebooks: int = 4,
+                 codebook_size: int = 64, hot_fraction: float = 0.1,
+                 cold_codebook_size: int = 16, name: str = "mgqe_emb",
+                 **kw):
+        super().__init__(num_embeddings, embedding_dim,
+                         num_codebooks=num_codebooks,
+                         codebook_size=codebook_size, name=name, **kw)
+        # ids < hot_boundary are "hot" (assumed frequency-sorted vocab,
+        # the reference's setting on Criteo)
+        self.hot_boundary = max(1, int(num_embeddings * hot_fraction))
+        self.cold_codebook_size = cold_codebook_size
+
+    def _mask_distances(self, d, ids):
+        # cold ids may only use the first `cold_codebook_size` codewords
+        K = d.shape[-1]
+        cold = (ids >= self.hot_boundary)[..., None, None]
+        mask = jnp.arange(K) >= self.cold_codebook_size
+        return jnp.where(cold & mask, -jnp.inf, d)
+
+
+class QuantizedEmbedding(_CompressedEmbedding):
+    """Uniform quantization with a learned per-row scale and
+    straight-through rounding (quantize.py; ALPT's learned step size,
+    alpt.py)."""
+
+    def __init__(self, num_embeddings, embedding_dim, bits: int = 8,
+                 scale: float = 0.01, name: str = "quant_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        self.bits = bits
+        self.table = parameter(NormalInitializer(0.0, scale),
+                               (num_embeddings, embedding_dim),
+                               name=f"{name}.table")
+        self.step = parameter(ConstantInitializer(scale / 8),
+                              (num_embeddings, 1), name=f"{name}.step")
+
+    def forward(self, ids):
+        qmax = 2 ** (self.bits - 1) - 1
+
+        def _impl(table, step, i):
+            w = table[i]
+            s = jnp.abs(step[i]) + 1e-8
+            q = jnp.clip(jnp.round(w / s), -qmax - 1, qmax)
+            deq = q * s
+            return w + jax.lax.stop_gradient(deq - w)  # STE
+
+        return ops.functional._op("quant_lookup", _impl,
+                                  [self.table, self.step, ids])
+
+    def compression_ratio(self) -> float:
+        full = self.num_embeddings * self.embedding_dim * 32
+        mine = self.num_embeddings * (self.embedding_dim * self.bits + 32)
+        return full / mine
+
+
+class TensorTrainEmbedding(_CompressedEmbedding):
+    """TT-Rec (tensortrain.py): the table as a 3-core tensor-train."""
+
+    def __init__(self, num_embeddings, embedding_dim, ranks: int = 16,
+                 scale: float = 0.3, name: str = "tt_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        # factor shapes: N ~ n1*n2*n3, D = d1*d2*d3
+        self.n = _factor3(num_embeddings)
+        self.d = _factor3(embedding_dim)
+        self.ranks = (1, ranks, ranks, 1)
+        r = self.ranks
+        self.cores = []
+        for k in range(3):
+            core = parameter(
+                NormalInitializer(0.0, scale),
+                (self.n[k], r[k] * self.d[k] * r[k + 1]),
+                name=f"{name}.core{k}")
+            self.register_parameter(f"core{k}", core)
+            self.cores.append(core)
+
+    def forward(self, ids):
+        n1, n2, n3 = self.n
+        d1, d2, d3 = self.d
+        r = self.ranks
+
+        def _impl(c0, c1, c2, i):
+            i1 = i // (n2 * n3)
+            i2 = (i // n3) % n2
+            i3 = i % n3
+            g0 = c0[i1].reshape(*i.shape, r[0] * d1, r[1])
+            g1 = c1[i2].reshape(*i.shape, r[1], d2 * r[2])
+            g2 = c2[i3].reshape(*i.shape, r[2], d3 * r[3])
+            x = jnp.einsum("...ar,...rb->...ab", g0, g1)  # [d1, d2*r2]
+            x = x.reshape(*i.shape, d1 * d2, r[2])
+            x = jnp.einsum("...ar,...rb->...ab", x, g2)   # [d1*d2, d3]
+            return x.reshape(*i.shape, d1 * d2 * d3)
+
+        return ops.functional._op("tt_lookup", _impl,
+                                  [*self.cores, ids])
+
+
+class LowRankEmbedding(_CompressedEmbedding):
+    """Low-rank factorization E = U V (autosrh-style base)."""
+
+    def __init__(self, num_embeddings, embedding_dim, rank: int,
+                 scale: float = 0.05, name: str = "lowrank_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        self.u = parameter(NormalInitializer(0.0, scale),
+                           (num_embeddings, rank), name=f"{name}.u")
+        self.v = parameter(NormalInitializer(0.0, scale),
+                           (rank, embedding_dim), name=f"{name}.v")
+
+    def forward(self, ids):
+        return ops.matmul(ops.embedding_lookup(self.u, ids), self.v)
+
+
+class DeepLightEmbedding(_CompressedEmbedding):
+    """DeepLight (deeplight.py): magnitude pruning with a target sparsity
+    ramp; the mask is applied with a straight-through estimator."""
+
+    def __init__(self, num_embeddings, embedding_dim,
+                 target_sparsity: float = 0.9, scale: float = 0.01,
+                 name: str = "deeplight_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        self.target_sparsity = target_sparsity
+        self.table = parameter(NormalInitializer(0.0, scale),
+                               (num_embeddings, embedding_dim),
+                               name=f"{name}.table")
+        # sparsity lives in a (non-trainable) graph variable so ramping
+        # it mid-training takes effect inside the compiled step (a plain
+        # Python attribute would be snapshotted at trace time)
+        self.sparsity = parameter(ConstantInitializer(0.0), (),
+                                  name=f"{name}.sparsity", trainable=False)
+
+    def set_sparsity(self, s: float) -> None:
+        """Ramp callback (the reference anneals sparsity during
+        training)."""
+        g = self.sparsity.graph
+        g.reset_variable(self.sparsity,
+                         np.float32(min(s, self.target_sparsity)))
+
+    def forward(self, ids):
+        def _impl(table, s, i):
+            w = table[i]
+            thresh = jnp.quantile(jnp.abs(w), jnp.clip(s, 0.0, 1.0))
+            pruned = jnp.where(jnp.abs(w) >= thresh, w, 0.0)
+            ste = w + jax.lax.stop_gradient(pruned - w)
+            return jnp.where(s > 0.0, ste, w)
+
+        return ops.functional._op("deeplight_lookup", _impl,
+                                  [self.table, self.sparsity, ids])
+
+
+class PEPEmbedding(_CompressedEmbedding):
+    """PEP (pep.py): learnable soft-threshold pruning
+    w' = sign(w) * relu(|w| - sigmoid(g))."""
+
+    def __init__(self, num_embeddings, embedding_dim, scale: float = 0.01,
+                 init_threshold: float = -8.0, name: str = "pep_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        self.table = parameter(NormalInitializer(0.0, scale),
+                               (num_embeddings, embedding_dim),
+                               name=f"{name}.table")
+        self.gate = parameter(ConstantInitializer(init_threshold),
+                              (num_embeddings, 1), name=f"{name}.gate")
+
+    def forward(self, ids):
+        def _impl(table, gate, i):
+            w = table[i]
+            g = jax.nn.sigmoid(gate[i])
+            return jnp.sign(w) * jax.nn.relu(jnp.abs(w) - g)
+
+        return ops.functional._op("pep_lookup", _impl,
+                                  [self.table, self.gate, ids])
+
+
+class OptEmbedEmbedding(_CompressedEmbedding):
+    """OptEmbed (optembed.py): learnable per-dimension mask via a
+    temperature sigmoid gate with straight-through binarization."""
+
+    def __init__(self, num_embeddings, embedding_dim, scale: float = 0.01,
+                 temperature: float = 2.0, name: str = "optembed_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        self.temperature = temperature
+        self.table = parameter(NormalInitializer(0.0, scale),
+                               (num_embeddings, embedding_dim),
+                               name=f"{name}.table")
+        self.dim_logits = parameter(ConstantInitializer(1.0),
+                                    (embedding_dim,),
+                                    name=f"{name}.dim_logits")
+
+    def forward(self, ids):
+        tau = self.temperature
+
+        def _impl(table, logits, i):
+            w = table[i]
+            soft = jax.nn.sigmoid(logits / tau)
+            hard = (soft > 0.5).astype(w.dtype)
+            mask = soft + jax.lax.stop_gradient(hard - soft)
+            return w * mask
+
+        return ops.functional._op("optembed_lookup", _impl,
+                                  [self.table, self.dim_logits, ids])
+
+
+class MixedDimensionEmbedding(_CompressedEmbedding):
+    """Mixed dimensions by frequency tier (mde.py / adapt.py): hot ids
+    get full-dim rows, cold ids get a narrow table + projection."""
+
+    def __init__(self, num_embeddings, embedding_dim,
+                 hot_fraction: float = 0.1, cold_dim: Optional[int] = None,
+                 scale: float = 0.01, name: str = "mde_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        self.hot_rows = max(1, int(num_embeddings * hot_fraction))
+        self.cold_dim = cold_dim or max(1, embedding_dim // 8)
+        self.hot = parameter(NormalInitializer(0.0, scale),
+                             (self.hot_rows, embedding_dim),
+                             name=f"{name}.hot")
+        self.cold = parameter(NormalInitializer(0.0, scale),
+                              (num_embeddings - self.hot_rows,
+                               self.cold_dim), name=f"{name}.cold")
+        self.proj = parameter(NormalInitializer(0.0, scale),
+                              (self.cold_dim, embedding_dim),
+                              name=f"{name}.proj")
+
+    def forward(self, ids):
+        hb = self.hot_rows
+
+        def _impl(hot, cold, proj, i):
+            is_hot = i < hb
+            eh = hot[jnp.clip(i, 0, hot.shape[0] - 1)]
+            ec = cold[jnp.clip(i - hb, 0, cold.shape[0] - 1)] @ proj
+            return jnp.where(is_hot[..., None], eh, ec)
+
+        return ops.functional._op("mde_lookup", _impl,
+                                  [self.hot, self.cold, self.proj, ids])
+
+
+class AutoDimEmbedding(_CompressedEmbedding):
+    """AutoDim (autodim.py): softmax selection over candidate dims, each
+    candidate a narrow table + projection; differentiable architecture
+    params pick the dimension."""
+
+    def __init__(self, num_embeddings, embedding_dim,
+                 candidate_dims: Sequence[int] = (2, 8, 32),
+                 scale: float = 0.01, name: str = "autodim_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        self.candidate_dims = tuple(candidate_dims)
+        self.tables = []
+        self.projs = []
+        for k, d in enumerate(self.candidate_dims):
+            t = parameter(NormalInitializer(0.0, scale),
+                          (num_embeddings, d), name=f"{name}.t{k}")
+            p = parameter(NormalInitializer(0.0, scale),
+                          (d, embedding_dim), name=f"{name}.p{k}")
+            self.register_parameter(f"t{k}", t)
+            self.register_parameter(f"p{k}", p)
+            self.tables.append(t)
+            self.projs.append(p)
+        self.alpha = parameter(ConstantInitializer(0.0),
+                               (len(self.candidate_dims),),
+                               name=f"{name}.alpha")
+
+    def forward(self, ids):
+        outs = [ops.matmul(ops.embedding_lookup(t, ids), p)
+                for t, p in zip(self.tables, self.projs)]
+        w = ops.softmax(self.alpha, axis=-1)
+        acc = None
+        for k, o in enumerate(outs):
+            term = o * ops.getitem(w, k)
+            acc = term if acc is None else acc + term
+        return acc
+
+    def selected_dim(self, graph) -> int:
+        a = np.asarray(graph.get_tensor_value(self.alpha))
+        return self.candidate_dims[int(np.argmax(a))]
+
+
+def _factor3(n: int) -> Sequence[int]:
+    """n1 <= n2 <= n3 with n1*n2*n3 >= n, as balanced as possible."""
+    c = int(round(n ** (1 / 3)))
+    for a in range(c, 0, -1):
+        if n % a == 0:
+            rest = n // a
+            b = int(round(rest ** 0.5))
+            for bb in range(b, 0, -1):
+                if rest % bb == 0:
+                    return sorted((a, bb, rest // bb))
+    return (1, 1, n)

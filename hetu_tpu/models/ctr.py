@@ -86,7 +86,11 @@ class DeepFM(_CTRBase):
                  embedding: Optional[Module] = None):
         super().__init__(num_sparse_fields, vocab_size, embedding_dim,
                          num_dense, embedding)
-        self.linear_embedding = Embedding(vocab_size, 1)
+        # first-order term is a projection of the SAME embedding output
+        # (not a second id-indexed table) so pluggable backends that remap
+        # ids — e.g. CachedEmbedding slots — stay consistent
+        self.first_order = Linear(num_sparse_fields * embedding_dim, 1,
+                                  bias=False)
         flat = num_sparse_fields * embedding_dim
         self.deep = MLP([flat + num_dense, *hidden, 1])
         self.dense_linear = Linear(num_dense, 1)
@@ -94,9 +98,7 @@ class DeepFM(_CTRBase):
     def forward(self, sparse_ids, dense):
         e = self.embed(sparse_ids)                       # [B, F, D]
         # first order
-        first = ops.reduce_sum(
-            ops.reshape(self.linear_embedding(sparse_ids),
-                        (e.shape[0], -1)), axis=1, keepdims=True)
+        first = self.first_order(ops.reshape(e, (e.shape[0], -1)))
         first = first + self.dense_linear(dense)
         # second order FM: 0.5 * ((sum e)^2 - sum e^2)
         s = ops.reduce_sum(e, axis=1)                    # [B, D]
@@ -113,11 +115,12 @@ class CrossLayer(Module):
 
     def __init__(self, dim: int):
         super().__init__()
+        from ..graph.ctor import ConstantInitializer, parameter
         self.w = Linear(dim, 1, bias=False)
-        self.b = Linear(dim, dim, bias=True)  # bias carrier; weight unused
+        self.b = parameter(ConstantInitializer(0.0), (dim,), name="cross.b")
 
     def forward(self, x0, xl):
-        return x0 * self.w(xl) + (self.b.bias + xl)
+        return x0 * self.w(xl) + (self.b + xl)
 
 
 class DCN(_CTRBase):

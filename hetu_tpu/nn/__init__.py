@@ -10,6 +10,8 @@ from .parallel import (ColumnParallelLinear, RowParallelLinear,
                        config2ds, sharded)
 from .moe import (MoELayer, Experts, TopKGate, KTop1Gate, HashGate, SAMGate,
                   BalanceGate, make_moe_layer)
+from .lora import (LoRAColumnParallelLinear, LoRARowParallelLinear,
+                   LoRAEmbedding, mark_only_lora_trainable, merge_lora)
 # Reference-compatible aliases (parallel_multi_ds.py exports)
 HtMultiColumnParallelLinear = ColumnParallelLinear
 HtMultiRowParallelLinear = RowParallelLinear
@@ -33,4 +35,6 @@ __all__ = [
     "HtMultiParallelLayerNorm", "HtMultiParallelRMSNorm",
     "MoELayer", "Experts", "TopKGate", "KTop1Gate", "HashGate", "SAMGate",
     "BalanceGate", "make_moe_layer",
+    "LoRAColumnParallelLinear", "LoRARowParallelLinear", "LoRAEmbedding",
+    "mark_only_lora_trainable", "merge_lora",
 ]

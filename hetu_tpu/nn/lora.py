@@ -1,0 +1,165 @@
+"""LoRA: low-rank adaptation of (parallel) linear and embedding layers.
+
+Counterpart of the reference's LoRA-parallel modules
+(``python/hetu/nn/modules/parallel_lora.py``:
+LoRAColumnParallelLinear:180, LoRARowParallelLinear:251,
+LoRAParallelEmbedding:104, LoRAModel:339 with mark-only-lora-trainable).
+
+Sharding follows the base layer: for a column-parallel base (W split on
+out), B is split on out and A replicated; for a row-parallel base (W
+split on in), A is split on in and B replicated — so the adapter matmuls
+ride the same mesh axes with no extra collectives.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from jax.sharding import PartitionSpec as P
+
+from .. import ops
+from ..graph.ctor import (ConstantInitializer, HeUniformInitializer,
+                          NormalInitializer, parallel_parameter)
+from .module import Module
+from .parallel import ColumnParallelLinear, RowParallelLinear, sharded
+
+
+class LoRALayerMixin:
+    """Adds lora_A/lora_B around a frozen base weight."""
+
+    def init_lora(self, in_features: int, out_features: int, rank: int,
+                  alpha: float, a_pspec, b_pspec, dtype, name: str):
+        self.rank = rank
+        self.scaling = alpha / rank
+        self.merged = False
+        # reference init: A ~ kaiming-uniform, B = 0 (adapter starts as
+        # identity)
+        self.lora_A = parallel_parameter(
+            HeUniformInitializer(), (rank, in_features), pspec=a_pspec,
+            dtype=dtype, name=f"{name}.lora_A")
+        self.lora_B = parallel_parameter(
+            ConstantInitializer(0.0), (out_features, rank), pspec=b_pspec,
+            dtype=dtype, name=f"{name}.lora_B")
+
+    def lora_delta(self, x):
+        """x @ A^T @ B^T * scaling."""
+        h = ops.linear(x, self.lora_A, None, trans_b=True)
+        return ops.linear(h, self.lora_B, None, trans_b=True) * self.scaling
+
+
+class LoRAColumnParallelLinear(ColumnParallelLinear, LoRALayerMixin):
+    """Column-parallel linear + LoRA (parallel_lora.py:180): B is split
+    on the out dim like the base weight, A is replicated."""
+
+    def __init__(self, in_features: int, out_features: int, rank: int = 8,
+                 alpha: float = 16.0, bias: bool = True,
+                 gather_output: bool = False, dp_axis: str = "dp",
+                 tp_axis: str = "tp", dtype=None, name: str = "lora_colp",
+                 **kw):
+        super().__init__(in_features, out_features, bias=bias,
+                         gather_output=gather_output, dp_axis=dp_axis,
+                         tp_axis=tp_axis, dtype=dtype, name=name, **kw)
+        self.weight.trainable = False
+        if self.bias is not None:
+            self.bias.trainable = False
+        self.init_lora(in_features, out_features, rank, alpha,
+                       a_pspec=P(), b_pspec=P(tp_axis, None), dtype=dtype,
+                       name=name)
+
+    def forward(self, x):
+        out = super().forward(x)
+        if not self.merged:
+            out = out + self.lora_delta(x)
+        return out
+
+
+class LoRARowParallelLinear(RowParallelLinear, LoRALayerMixin):
+    """Row-parallel linear + LoRA (parallel_lora.py:251): A is split on
+    the in dim like the base weight, B is replicated."""
+
+    def __init__(self, in_features: int, out_features: int, rank: int = 8,
+                 alpha: float = 16.0, bias: bool = True, sp: bool = False,
+                 dp_axis: str = "dp", tp_axis: str = "tp", dtype=None,
+                 name: str = "lora_rowp", **kw):
+        super().__init__(in_features, out_features, bias=bias, sp=sp,
+                         dp_axis=dp_axis, tp_axis=tp_axis, dtype=dtype,
+                         name=name, **kw)
+        self.weight.trainable = False
+        if self.bias is not None:
+            self.bias.trainable = False
+        self.init_lora(in_features, out_features, rank, alpha,
+                       a_pspec=P(None, tp_axis), b_pspec=P(), dtype=dtype,
+                       name=name)
+
+    def forward(self, x):
+        out = super().forward(x)
+        if not self.merged:
+            out = out + self.lora_delta(x)
+        return out
+
+
+class LoRAEmbedding(Module):
+    """Embedding + low-rank delta (parallel_lora.py:104): frozen base
+    table, delta = one_hot(ids) @ A^T @ B^T expressed as two lookups."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 rank: int = 8, alpha: float = 16.0, dtype=None,
+                 name: str = "lora_embed"):
+        super().__init__()
+        self.num_embeddings, self.embedding_dim = num_embeddings, \
+            embedding_dim
+        self.scaling = alpha / rank
+        self.merged = False
+        self.weight = parallel_parameter(
+            NormalInitializer(0.0, 0.02), (num_embeddings, embedding_dim),
+            dtype=dtype, name=f"{name}.weight")
+        self.weight.trainable = False
+        # reference init for embeddings: A = 0, B ~ normal (delta starts 0)
+        self.lora_A = parallel_parameter(
+            ConstantInitializer(0.0), (num_embeddings, rank), dtype=dtype,
+            name=f"{name}.lora_A")
+        self.lora_B = parallel_parameter(
+            NormalInitializer(0.0, 0.02), (rank, embedding_dim),
+            dtype=dtype, name=f"{name}.lora_B")
+
+    def forward(self, ids):
+        out = ops.embedding_lookup(self.weight, ids)
+        if not self.merged:
+            a = ops.embedding_lookup(self.lora_A, ids)
+            out = out + ops.matmul(a, self.lora_B) * self.scaling
+        return out
+
+
+def mark_only_lora_trainable(model: Module, bias: str = "none") -> None:
+    """Freeze everything except lora_A/lora_B (LoRAModel's freeze
+    behavior, parallel_lora.py:339).  ``bias``: 'none' | 'all'."""
+    for name, p in model.named_parameters():
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in ("lora_A", "lora_B"):
+            p.trainable = True
+        elif leaf == "bias" and bias == "all":
+            p.trainable = True
+        else:
+            p.trainable = False
+
+
+def merge_lora(model: Module, graph=None) -> None:
+    """Fold every adapter into its base weight (W += B A * scaling) and
+    mark it merged, so inference runs at base-model cost."""
+    import numpy as np
+    for mod in model.modules():
+        if isinstance(mod, (LoRAColumnParallelLinear,
+                            LoRARowParallelLinear)) and not mod.merged:
+            g = graph or mod.weight.graph
+            W = np.asarray(g.get_tensor_value(mod.weight))
+            A = np.asarray(g.get_tensor_value(mod.lora_A))
+            B = np.asarray(g.get_tensor_value(mod.lora_B))
+            g.reset_variable(mod.weight, W + (B @ A) * mod.scaling)
+            mod.merged = True
+        elif isinstance(mod, LoRAEmbedding) and not mod.merged:
+            g = graph or mod.weight.graph
+            W = np.asarray(g.get_tensor_value(mod.weight))
+            A = np.asarray(g.get_tensor_value(mod.lora_A))
+            B = np.asarray(g.get_tensor_value(mod.lora_B))
+            g.reset_variable(mod.weight, W + (A @ B) * mod.scaling)
+            mod.merged = True

@@ -144,6 +144,29 @@ class TestCachedEmbedding:
         np.testing.assert_allclose(lc, lf, rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(tc, tf, rtol=1e-4, atol=1e-5)
 
+    def test_staged_slot_gets_fresh_optimizer_state(self):
+        """With attach_optimizer, a newly staged key must not inherit
+        the evicted key's Adam m/v (slot-keyed state is zeroed)."""
+        N, D = 8, 4
+        with ht.graph("define_and_run", create_new=True) as g:
+            emb = CachedEmbedding(N, D, cache_size=2, policy="lru", seed=3)
+            opt = optim.AdamOptimizer(lr=0.1)
+            emb.attach_optimizer(opt)
+            ids_ph = ht.placeholder("int32", (2,), name="slots")
+            loss = ops.reduce_mean(emb(ids_ph))
+            train_op = opt.minimize(loss)
+            # build momentum on keys 0,1
+            for _ in range(3):
+                g.run(loss, [train_op],
+                      {ids_ph: emb.prepare_batch(np.array([0, 1]))})
+            m = {k: np.asarray(v) for k, v in opt._state["m"].items()}
+            tid = emb.cache_table.id
+            assert np.abs(m[tid]).max() > 0
+            # stage keys 2,3 -> evicts 0,1; their slots' m/v must be zero
+            slots = emb.prepare_batch(np.array([2, 3]))
+            m_after = np.asarray(opt._state["m"][tid])
+            assert np.abs(m_after[slots]).max() == 0
+
     def test_eviction_preserves_learned_rows(self):
         """Rows evicted from the cache must carry their updates back to
         the master (no silent loss of training)."""
