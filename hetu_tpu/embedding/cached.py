@@ -59,14 +59,7 @@ class CachedEmbedding(Module):
     def _zero_slot_opt_state(self, slots: np.ndarray) -> None:
         if self._optimizer is None or not len(slots):
             return
-        tid = self.cache_table.id
-        for state in self._optimizer._state.values():
-            if isinstance(state, dict) and tid in state:
-                arr = np.asarray(state[tid])
-                if arr.ndim >= 1 and arr.shape[0] == self.cache_size:
-                    arr = arr.copy()
-                    arr[slots] = 0
-                    state[tid] = arr
+        self._optimizer.reset_state_rows(self.cache_table, slots)
 
     # -- host-side step preparation ---------------------------------------
 

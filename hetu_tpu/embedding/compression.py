@@ -220,12 +220,17 @@ class DPQEmbedding(_CompressedEmbedding):
             # distances to codewords: [..., C, K]
             d = jnp.einsum("...cs,cks->...ck", q, books)
             d = mask(d, i)
+            # soft-to-hard straight-through (the DPQ paper's tempered
+            # softmax): forward = hard codeword, backward flows through
+            # the soft mixture so BOTH the query table and the codebooks
+            # receive gradient (the deployed artifact is the codebooks)
+            soft = jax.nn.softmax(d, axis=-1)             # [..., C, K]
+            cw_soft = jnp.einsum("...ck,cks->...cs", soft, books)
             idx = jnp.argmax(d, axis=-1)                  # [..., C]
-            # gather codewords: [..., C, sub]
-            cw = jnp.einsum("...ck,cks->...cs",
-                            jax.nn.one_hot(idx, books.shape[1]), books)
-            # straight-through: forward hard codeword, backward soft query
-            out = q + jax.lax.stop_gradient(cw - q)
+            cw_hard = jnp.einsum("...ck,cks->...cs",
+                                 jax.nn.one_hot(idx, books.shape[1]),
+                                 books)
+            out = cw_soft + jax.lax.stop_gradient(cw_hard - cw_soft)
             return out.reshape(*i.shape, -1)
 
         return ops.functional._op(f"{type(self).__name__}_lookup", _impl,
@@ -286,9 +291,13 @@ class QuantizedEmbedding(_CompressedEmbedding):
         def _impl(table, step, i):
             w = table[i]
             s = jnp.abs(step[i]) + 1e-8
-            q = jnp.clip(jnp.round(w / s), -qmax - 1, qmax)
-            deq = q * s
-            return w + jax.lax.stop_gradient(deq - w)  # STE
+            wn = w / s
+            q = jnp.clip(jnp.round(wn), -qmax - 1, qmax)
+            # LSQ-style STE: round passes gradient through to w, and the
+            # dequant multiply keeps s differentiable so the learned step
+            # actually trains (ALPT)
+            q_ste = wn + jax.lax.stop_gradient(q - wn)
+            return q_ste * s
 
         return ops.functional._op("quant_lookup", _impl,
                                   [self.table, self.step, ids])

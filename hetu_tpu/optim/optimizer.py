@@ -102,6 +102,25 @@ class Optimizer:
     def _store_state(self, state: Dict[str, Any]) -> None:
         self._state = dict(state)
 
+    def reset_state_rows(self, param: Tensor, rows) -> None:
+        """Zero the leading-dim rows of every per-param state array for
+        ``param`` (momentum, Adam m/v).  Used by cache-backed embeddings
+        when a slot's occupant changes (hetu_tpu/embedding/cached.py);
+        subclasses with non-standard state layouts must override."""
+        import numpy as np
+        rows = np.asarray(rows)
+        if rows.size == 0:
+            return
+        tid = param.id
+        nrows = param.shape[0] if param.shape else 0
+        for state in self._state.values():
+            if isinstance(state, dict) and tid in state:
+                arr = np.asarray(state[tid])
+                if arr.ndim >= 1 and arr.shape[0] == nrows:
+                    arr = arr.copy()
+                    arr[rows] = 0
+                    state[tid] = arr
+
     def _init_state(self, var_state, xs) -> Dict[str, Any]:
         return {}
 

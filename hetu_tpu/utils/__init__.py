@@ -1,1 +1,9 @@
 from . import checkpoint  # noqa: F401
+from .logging_utils import TIK, TOK, Timer, get_logger, set_log_level
+from .profiler import (MemoryProfiler, OpProfiler, StepProfiler,
+                       device_memory_stats)
+
+__all__ = [
+    "checkpoint", "TIK", "TOK", "Timer", "get_logger", "set_log_level",
+    "MemoryProfiler", "OpProfiler", "StepProfiler", "device_memory_stats",
+]

@@ -1,0 +1,199 @@
+"""Graph/memory profiler subsystem.
+
+Counterpart of the reference's profiling stack (SURVEY.md §5a-c):
+- op-level ``TimeCost`` per micro-batch + impl-level op profiler
+  (``hetu/graph/profiler.h:40``, ``hetu/impl/profiler/profiler.h:16-25``)
+  -> :class:`OpProfiler` (eager replay timing each op) and
+  :class:`StepProfiler` (whole-step wall times with warmup discard);
+- subgraph fwd/bwd/update aggregation (``SubGraphProfiling``,
+  ``graph.h:445``) -> :meth:`OpProfiler.by_group`;
+- memory info (``CUDAProfiler::GetCurrMemoryInfo``, ``MicroBatchMemoryInfo``
+  ``graph/profiler.h:20-47``) -> :func:`device_memory_stats` +
+  :class:`MemoryProfiler` with the env-file protocol
+  (``HETU_TPU_MEMORY_PROFILE`` / ``HETU_TPU_MEMORY_LOG_FILE``, mirroring
+  the reference's ``HETU_MEMORY_PROFILE`` envs,
+  ``executable_graph.cc:1738-1761``).
+
+On TPU the per-op path uses eager replay (each op dispatched and
+synchronized individually) — inside a jitted step XLA fuses ops, so
+per-op attribution is only meaningful un-fused, exactly like the
+reference's impl-level profiler which times raw kernel launches.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+ENV_MEMORY_PROFILE = "HETU_TPU_MEMORY_PROFILE"
+ENV_MEMORY_LOG_FILE = "HETU_TPU_MEMORY_LOG_FILE"
+
+
+def device_memory_stats(device=None) -> Dict[str, int]:
+    """Per-device memory counters (bytes).  On TPU backends this reads
+    the allocator's live/peak stats (the analogue of the reference's
+    mempool reserved/peak/allocated); platforms without stats (CPU sim)
+    return zeros."""
+    import jax
+    d = device or jax.devices()[0]
+    stats = None
+    try:
+        stats = d.memory_stats()
+    except Exception:
+        stats = None
+    if not stats:
+        return {"bytes_in_use": 0, "peak_bytes_in_use": 0,
+                "bytes_limit": 0}
+    return {"bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+            "bytes_limit": int(stats.get("bytes_limit", 0))}
+
+
+class OpProfiler:
+    """Eager-replay op profiler: walks the graph topologically, running
+    and synchronizing each op to attribute wall time per op / op type /
+    name group (the reference's per-op TimeCost + SubGraph profile)."""
+
+    def __init__(self, graph):
+        self.graph = graph
+        self.records: List[Dict[str, Any]] = []
+
+    def profile(self, targets: Sequence, feed_dict: Dict,
+                warmup: int = 1, iters: int = 3) -> List[Dict[str, Any]]:
+        import jax
+        g = self.graph
+        targets = list(targets)
+        env: Dict[int, Any] = {}
+        for t, v in feed_dict.items():
+            env[t.id] = np.asarray(v)
+        topo = g._topo_from(targets)
+        records = []
+        for node in topo:
+            if node.op_type == "placeholder":
+                continue
+            if node.op_type == "constant":
+                env[node.outputs[0].id] = node.attrs["value"]
+                continue
+            if node.op_type == "variable":
+                for out in node.outputs:
+                    env[out.id] = g._materialize_var(out)
+                continue
+            if node.impl is None:
+                continue  # structural nodes (update/gradients handled by run)
+            in_vals = [env[inp.id] for inp in node.inputs if inp.id in env]
+            if len(in_vals) != len(node.inputs):
+                continue
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("_")}
+
+            def run_once():
+                out = node.impl(*in_vals, **attrs)
+                jax.block_until_ready(out)
+                return out
+
+            out = run_once()
+            for _ in range(warmup):
+                run_once()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                run_once()
+            dt = (time.perf_counter() - t0) / iters
+            for t, o in zip(node.outputs, jax.tree_util.tree_leaves(out)):
+                env[t.id] = o
+            records.append({
+                "name": node.name or node.op_type,
+                "op_type": node.op_type,
+                "time": dt,
+                "out_shapes": [tuple(t.shape) for t in node.outputs],
+            })
+        self.records = records
+        return records
+
+    # -- aggregations (reference SubGraph::profile) ------------------------
+
+    def by_type(self) -> Dict[str, float]:
+        agg: Dict[str, float] = defaultdict(float)
+        for r in self.records:
+            agg[r["op_type"]] += r["time"]
+        return dict(sorted(agg.items(), key=lambda kv: -kv[1]))
+
+    def by_group(self, depth: int = 1) -> Dict[str, float]:
+        """Aggregate by name prefix (module path), e.g. 'blocks0' for
+        'blocks0.attn.qkv'."""
+        agg: Dict[str, float] = defaultdict(float)
+        for r in self.records:
+            parts = r["name"].split(".")
+            agg[".".join(parts[:depth])] += r["time"]
+        return dict(sorted(agg.items(), key=lambda kv: -kv[1]))
+
+    def total(self) -> float:
+        return sum(r["time"] for r in self.records)
+
+    def summary(self, top: int = 10) -> str:
+        lines = [f"{'op':<28}{'type':<22}{'ms':>8}"]
+        for r in sorted(self.records, key=lambda r: -r["time"])[:top]:
+            lines.append(f"{r['name'][:27]:<28}{r['op_type'][:21]:<22}"
+                         f"{r['time'] * 1e3:>8.3f}")
+        lines.append(f"total {self.total() * 1e3:.3f} ms over "
+                     f"{len(self.records)} ops")
+        return "\n".join(lines)
+
+
+class StepProfiler:
+    """Whole-step timing: wraps ``graph.run`` calls, discarding compile/
+    warmup steps, reporting mean/p50/p90 (the e2e analogue of the
+    reference's TIK/TOK + per-micro-batch TimeCost)."""
+
+    def __init__(self, warmup: int = 2):
+        self.warmup = warmup
+        self.times: List[float] = []
+        self._count = 0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        self._count += 1
+        if self._count > self.warmup:
+            self.times.append(dt)
+
+    def stats(self) -> Dict[str, float]:
+        if not self.times:
+            return {"mean": 0.0, "p50": 0.0, "p90": 0.0, "steps": 0}
+        a = np.asarray(self.times)
+        return {"mean": float(a.mean()), "p50": float(np.percentile(a, 50)),
+                "p90": float(np.percentile(a, 90)), "steps": len(a)}
+
+
+class MemoryProfiler:
+    """Per-step memory snapshots appended to a JSONL log when enabled via
+    env (reference: ``HETU_MEMORY_PROFILE=MICRO_BATCH`` +
+    ``HETU_MEMORY_LOG_FILE``)."""
+
+    def __init__(self, log_file: Optional[str] = None,
+                 enabled: Optional[bool] = None):
+        env_mode = os.environ.get(ENV_MEMORY_PROFILE, "")
+        self.enabled = enabled if enabled is not None else bool(env_mode)
+        self.log_file = log_file or os.environ.get(ENV_MEMORY_LOG_FILE)
+        self.snapshots: List[Dict[str, Any]] = []
+
+    def snapshot(self, tag: str, micro_batch_id: int = -1) -> Dict:
+        if not self.enabled:
+            return {}
+        rec = {"tag": tag, "micro_batch_id": micro_batch_id,
+               "ts": time.time(), **device_memory_stats()}
+        self.snapshots.append(rec)
+        if self.log_file:
+            with open(self.log_file, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return rec
+
+    def peak(self) -> int:
+        return max((s["peak_bytes_in_use"] for s in self.snapshots),
+                   default=0)

@@ -61,9 +61,7 @@ class TestCompressionMethods:
             ph = ht.placeholder("int32", ids.shape, name="ids")
             out = emb(ph)
             assert tuple(out.shape) == (4, 3, D), cls.__name__
-            loss = ops.reduce_mean((out - 1.0) ** 2) \
-                if cls is not DeepLightEmbedding else \
-                ops.reduce_mean((out - 1.0) * (out - 1.0))
+            loss = ops.reduce_mean((out - 1.0) ** 2)
             train_op = optim.AdamOptimizer(lr=1e-2).minimize(loss)
             l0 = None
             for _ in range(5):
@@ -104,6 +102,35 @@ class TestCompressionMethods:
                          {ph: np.arange(8, dtype=np.int32)})
         frac_zero = float((np.asarray(o) == 0).mean())
         assert frac_zero >= 0.6  # ~75% pruned
+
+    def test_dpq_codebooks_receive_gradient(self):
+        """The deployed artifact (codebooks) must train, not just the
+        training-time query table."""
+        with ht.graph("define_and_run", create_new=True) as g:
+            emb = _make(DPQEmbedding)
+            ph = ht.placeholder("int32", (8,), name="ids")
+            loss = ops.reduce_mean((emb(ph) - 1.0) ** 2)
+            train_op = optim.AdamOptimizer(lr=1e-2).minimize(loss)
+            b0 = np.asarray(g.get_tensor_value(emb.codebooks)).copy()
+            for _ in range(5):
+                g.run(loss, [train_op],
+                      {ph: np.arange(8, dtype=np.int32)})
+            b1 = np.asarray(g.get_tensor_value(emb.codebooks))
+        assert np.abs(b1 - b0).max() > 0
+
+    def test_quantized_step_size_trains(self):
+        """ALPT: the learned quantization step must receive gradient."""
+        with ht.graph("define_and_run", create_new=True) as g:
+            emb = _make(QuantizedEmbedding)
+            ph = ht.placeholder("int32", (8,), name="ids")
+            loss = ops.reduce_mean((emb(ph) - 1.0) ** 2)
+            train_op = optim.AdamOptimizer(lr=1e-2).minimize(loss)
+            s0 = np.asarray(g.get_tensor_value(emb.step)).copy()
+            for _ in range(5):
+                g.run(loss, [train_op],
+                      {ph: np.arange(8, dtype=np.int32)})
+            s1 = np.asarray(g.get_tensor_value(emb.step))
+        assert np.abs(s1[:8] - s0[:8]).max() > 0
 
     def test_deeplight_ramp_applies_mid_training(self):
         """set_sparsity AFTER the step is compiled must still take
