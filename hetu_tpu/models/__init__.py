@@ -1,6 +1,16 @@
+from .bert import (BertConfig, BertForPreTraining,
+                   BertForSequenceClassification, BertModel)
+from .cnn import BasicBlock, ResNet, SimpleCNN, resnet18, resnet34
+from .ctr import DCN, DeepFM, WDL, ctr_loss
 from .gpt import (GPTConfig, GPTModel, GPTLMHeadModel, llama_config,
                   LLamaLMHeadModel, LLamaModel)
 from .gpt_pipeline import GPTPipelineModel, block_fn
+from .rnn import GRU, LSTM, RNN, RNNLanguageModel
 
 __all__ = ["GPTConfig", "GPTModel", "GPTLMHeadModel", "llama_config",
-           "LLamaLMHeadModel", "LLamaModel", "GPTPipelineModel", "block_fn"]
+           "LLamaLMHeadModel", "LLamaModel", "GPTPipelineModel", "block_fn",
+           "BertConfig", "BertModel", "BertForPreTraining",
+           "BertForSequenceClassification",
+           "SimpleCNN", "ResNet", "BasicBlock", "resnet18", "resnet34",
+           "WDL", "DeepFM", "DCN", "ctr_loss",
+           "RNN", "GRU", "LSTM", "RNNLanguageModel"]

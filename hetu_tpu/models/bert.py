@@ -1,0 +1,194 @@
+"""BERT model family on parallel layers.
+
+Counterpart of the reference's BERT workload (``tests/hetu_bert.py`` —
+the v2 op-test model — and ``v1/examples/nlp``): bidirectional
+transformer encoder with token/position/segment embeddings, MLM + NSP
+pre-training heads, and a sequence-classification head.  Uses the same
+column/row-parallel layers and sharding annotations as the GPT family.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from jax.sharding import PartitionSpec as P
+
+from .. import ops
+from ..graph.ctor import NormalInitializer, parallel_parameter
+from ..nn import (ColumnParallelLinear, Module, ModuleList,
+                  ParallelLayerNorm, RowParallelLinear,
+                  VocabParallelEmbedding, vocab_parallel_cross_entropy)
+from ..nn.parallel import sharded
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None   # None -> 4h
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.0
+    init_std: float = 0.02
+    dtype: str = "float32"
+    dp_axis: str = "dp"
+    tp_axis: str = "tp"
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+class BertSelfAttention(Module):
+    """Bidirectional multi-head attention, TP head-split."""
+
+    def __init__(self, cfg: BertConfig, idx: int):
+        super().__init__()
+        self.cfg = cfg
+        self.qkv = ColumnParallelLinear(
+            cfg.hidden_size, 3 * cfg.hidden_size, dp_axis=cfg.dp_axis,
+            tp_axis=cfg.tp_axis,
+            init=NormalInitializer(0.0, cfg.init_std),
+            name=f"bert.blocks{idx}.attn.qkv")
+        self.dense = RowParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, dp_axis=cfg.dp_axis,
+            tp_axis=cfg.tp_axis,
+            init=NormalInitializer(0.0, cfg.init_std),
+            name=f"bert.blocks{idx}.attn.dense")
+
+    def forward(self, x):
+        cfg = self.cfg
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv(x)                           # [b, s, 3h] (tp-split)
+        qkv = ops.reshape(qkv, (b, s, 3, cfg.num_heads, cfg.head_dim))
+        qkv = sharded(qkv, P(cfg.dp_axis, None, None, cfg.tp_axis, None))
+        q = ops.getitem(qkv, (slice(None), slice(None), 0))
+        k = ops.getitem(qkv, (slice(None), slice(None), 1))
+        v = ops.getitem(qkv, (slice(None), slice(None), 2))
+        out = ops.attention(q, k, v, causal=False)  # [b, s, nh, hd]
+        out = ops.reshape(out, (b, s, cfg.hidden_size))
+        out = sharded(out, P(cfg.dp_axis, None, cfg.tp_axis))
+        return self.dense(out)
+
+
+class BertLayer(Module):
+    """Post-norm encoder block (original BERT ordering)."""
+
+    def __init__(self, cfg: BertConfig, idx: int):
+        super().__init__()
+        self.attn = BertSelfAttention(cfg, idx)
+        self.ln1 = ParallelLayerNorm(cfg.hidden_size, dp_axis=cfg.dp_axis,
+                                     tp_axis=cfg.tp_axis,
+                                     name=f"bert.blocks{idx}.ln1")
+        self.fc1 = ColumnParallelLinear(
+            cfg.hidden_size, cfg.ffn_size, dp_axis=cfg.dp_axis,
+            tp_axis=cfg.tp_axis,
+            init=NormalInitializer(0.0, cfg.init_std),
+            name=f"bert.blocks{idx}.mlp.fc1")
+        self.fc2 = RowParallelLinear(
+            cfg.ffn_size, cfg.hidden_size, dp_axis=cfg.dp_axis,
+            tp_axis=cfg.tp_axis,
+            init=NormalInitializer(0.0, cfg.init_std),
+            name=f"bert.blocks{idx}.mlp.fc2")
+        self.ln2 = ParallelLayerNorm(cfg.hidden_size, dp_axis=cfg.dp_axis,
+                                     tp_axis=cfg.tp_axis,
+                                     name=f"bert.blocks{idx}.ln2")
+
+    def forward(self, x):
+        x = self.ln1(x + self.attn(x))
+        x = self.ln2(x + self.fc2(ops.gelu(self.fc1(x))))
+        return x
+
+
+class BertModel(Module):
+    """Embeddings + encoder stack + pooler."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, dp_axis=cfg.dp_axis,
+            tp_axis=cfg.tp_axis,
+            init=NormalInitializer(0.0, cfg.init_std), name="bert.wte")
+        self.wpe = parallel_parameter(
+            NormalInitializer(0.0, cfg.init_std),
+            (cfg.max_seq_len, cfg.hidden_size), pspec=P(),
+            name="bert.wpe")
+        self.wse = parallel_parameter(
+            NormalInitializer(0.0, cfg.init_std),
+            (cfg.type_vocab_size, cfg.hidden_size), pspec=P(),
+            name="bert.wse")
+        self.ln = ParallelLayerNorm(cfg.hidden_size, dp_axis=cfg.dp_axis,
+                                    tp_axis=cfg.tp_axis, name="bert.ln")
+        self.blocks = ModuleList([BertLayer(cfg, i)
+                                  for i in range(cfg.num_layers)])
+        self.pooler = ColumnParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, gather_output=True,
+            dp_axis=cfg.dp_axis, tp_axis=cfg.tp_axis,
+            init=NormalInitializer(0.0, cfg.init_std), name="bert.pooler")
+
+    def forward(self, input_ids, token_type_ids=None):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        x = self.wte(input_ids)
+        pos = ops.slice(self.wpe, (0, 0), (s, cfg.hidden_size))
+        x = x + pos
+        if token_type_ids is not None:
+            x = x + ops.embedding_lookup(self.wse, token_type_ids)
+        x = self.ln(x)
+        for blk in self.blocks:
+            x = blk(x)
+        cls = ops.getitem(x, (slice(None), 0))     # [b, h]
+        pooled = ops.tanh(self.pooler(cls))
+        return x, pooled
+
+
+class BertForPreTraining(Module):
+    """MLM + NSP heads (the hetu_bert.py pre-training setup)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = BertModel(cfg)
+        self.nsp_head = ColumnParallelLinear(
+            cfg.hidden_size, 2, gather_output=True, dp_axis=cfg.dp_axis,
+            tp_axis=cfg.tp_axis, name="bert.nsp")
+
+    def forward(self, input_ids, token_type_ids=None, mlm_labels=None,
+                nsp_labels=None):
+        cfg = self.cfg
+        hidden, pooled = self.bert(input_ids, token_type_ids)
+        # tied MLM head: hidden @ wte^T (vocab-parallel)
+        logits = ops.linear(hidden, self.bert.wte.weight, trans_b=True)
+        if mlm_labels is None:
+            return logits
+        mlm_loss = vocab_parallel_cross_entropy(
+            logits, mlm_labels, dp_axis=cfg.dp_axis, tp_axis=cfg.tp_axis,
+            ignore_index=-100)
+        loss = mlm_loss
+        if nsp_labels is not None:
+            nsp_logits = self.nsp_head(pooled)
+            loss = loss + ops.softmax_cross_entropy(nsp_logits, nsp_labels)
+        return loss
+
+
+class BertForSequenceClassification(Module):
+    def __init__(self, cfg: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.classifier = ColumnParallelLinear(
+            cfg.hidden_size, num_classes, gather_output=True,
+            dp_axis=cfg.dp_axis, tp_axis=cfg.tp_axis, name="bert.cls")
+
+    def forward(self, input_ids, labels=None, token_type_ids=None):
+        _, pooled = self.bert(input_ids, token_type_ids)
+        logits = self.classifier(pooled)
+        if labels is None:
+            return logits
+        return ops.softmax_cross_entropy(logits, labels)

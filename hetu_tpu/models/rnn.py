@@ -1,0 +1,178 @@
+"""Recurrent models: LSTM/GRU/vanilla RNN + a language-model wrapper.
+
+Counterpart of the reference's RNN workloads (``tests/test_rnn.py``,
+``v1`` sequence layers).  Recurrence is expressed with ``lax.scan`` —
+the XLA-idiomatic loop (static trip count, no Python-level unrolling),
+with all gate matmuls fused into one [h, 4h]/[h, 3h] projection per step
+so the MXU sees large GEMMs.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import ops
+from ..graph.ctor import (ConstantInitializer, XavierUniformInitializer,
+                          parameter)
+from ..nn import Embedding, Linear, Module
+
+
+class _RecurrentBase(Module):
+    """Shared scaffolding: fused input/hidden projections + lax.scan."""
+
+    GATES = 1
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 name: str = "rnn"):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        g = self.GATES
+        self.w_ih = parameter(XavierUniformInitializer(),
+                              (input_size, g * hidden_size),
+                              name=f"{name}.w_ih")
+        self.w_hh = parameter(XavierUniformInitializer(),
+                              (hidden_size, g * hidden_size),
+                              name=f"{name}.w_hh")
+        self.bias = parameter(ConstantInitializer(0.0), (g * hidden_size,),
+                              name=f"{name}.bias")
+
+    def _cell(self, carry, gates):
+        raise NotImplementedError
+
+    def _init_carry(self, batch, dtype):
+        raise NotImplementedError
+
+    def forward(self, x, initial_state=None):
+        """x: [batch, seq, input] -> (outputs [batch, seq, hidden],
+        final hidden state).  ``initial_state``: [batch, hidden] hidden
+        (RNN/GRU) or (h, c) tuple (LSTM); zeros when omitted."""
+        H = self.hidden_size
+        cell = self._cell
+        init = self._init_carry
+        init_inputs = []
+        if initial_state is not None:
+            init_inputs = list(initial_state) \
+                if isinstance(initial_state, (tuple, list)) \
+                else [initial_state]
+
+        def _impl(x, w_ih, w_hh, b, *carry_in):
+            # precompute all input projections in one big matmul
+            xg = jnp.einsum("bsi,ig->bsg", x, w_ih) + b   # [b, s, g*H]
+
+            def step(carry, xg_t):
+                h = carry[0] if isinstance(carry, tuple) else carry
+                gates = xg_t + h @ w_hh
+                new_carry = cell(carry, gates)
+                h_out = new_carry[0] if isinstance(new_carry, tuple) \
+                    else new_carry
+                return new_carry, h_out
+
+            if carry_in:
+                carry0 = carry_in[0] if len(carry_in) == 1 \
+                    else tuple(carry_in)
+            else:
+                carry0 = init(x.shape[0], x.dtype)
+            carry, ys = lax.scan(step, carry0,
+                                 jnp.swapaxes(xg, 0, 1))   # scan over seq
+            h_final = carry[0] if isinstance(carry, tuple) else carry
+            return jnp.swapaxes(ys, 0, 1), h_final
+
+        return ops.functional._op(f"{type(self).__name__}_scan", _impl,
+                                  [x, self.w_ih, self.w_hh, self.bias,
+                                   *init_inputs],
+                                  num_outputs=2)
+
+
+class RNN(_RecurrentBase):
+    """Vanilla tanh RNN."""
+
+    GATES = 1
+
+    def _cell(self, h, gates):
+        return jnp.tanh(gates)
+
+    def _init_carry(self, batch, dtype):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+
+class GRU(_RecurrentBase):
+    """GRU needs the hidden projection per-gate (reset gates the
+    candidate's hidden term), so it overrides the scan instead of
+    _cell."""
+
+    GATES = 3
+
+    def forward(self, x, initial_state=None):
+        H = self.hidden_size
+        init_inputs = [initial_state] if initial_state is not None else []
+
+        def _impl(x, w_ih, w_hh, b, *carry_in):
+            xg = jnp.einsum("bsi,ig->bsg", x, w_ih) + b
+
+            def step(h, xg_t):
+                hg = h @ w_hh                       # [b, 3H]
+                r = jax.nn.sigmoid(xg_t[:, :H] + hg[:, :H])
+                z = jax.nn.sigmoid(xg_t[:, H:2 * H] + hg[:, H:2 * H])
+                n = jnp.tanh(xg_t[:, 2 * H:] + r * hg[:, 2 * H:])
+                h_new = (1 - z) * n + z * h
+                return h_new, h_new
+
+            h0 = carry_in[0] if carry_in \
+                else jnp.zeros((x.shape[0], H), x.dtype)
+            carry, ys = lax.scan(step, h0, jnp.swapaxes(xg, 0, 1))
+            return jnp.swapaxes(ys, 0, 1), carry
+
+        return ops.functional._op("gru_scan", _impl,
+                                  [x, self.w_ih, self.w_hh, self.bias,
+                                   *init_inputs],
+                                  num_outputs=2)
+
+
+class LSTM(_RecurrentBase):
+    GATES = 4
+
+    def _cell(self, carry, gates):
+        h, c = carry
+        H = self.hidden_size
+        i = jax.nn.sigmoid(gates[:, :H])
+        f = jax.nn.sigmoid(gates[:, H:2 * H] + 1.0)  # forget bias 1
+        g = jnp.tanh(gates[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(gates[:, 3 * H:])
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new)
+
+    def _init_carry(self, batch, dtype):
+        z = jnp.zeros((batch, self.hidden_size), dtype)
+        return (z, z)
+
+
+class RNNLanguageModel(Module):
+    """Embedding -> recurrent stack -> tied-ish LM head (the reference's
+    test_rnn.py language-model shape)."""
+
+    def __init__(self, vocab_size: int, hidden_size: int,
+                 cell: str = "lstm", num_layers: int = 1,
+                 name: str = "rnnlm"):
+        super().__init__()
+        cells = {"rnn": RNN, "gru": GRU, "lstm": LSTM}
+        self.embed = Embedding(vocab_size, hidden_size)
+        self.layers = []
+        for li in range(num_layers):
+            layer = cells[cell](hidden_size, hidden_size,
+                                name=f"{name}.l{li}")
+            self.add_module(f"l{li}", layer)
+            self.layers.append(layer)
+        self.head = Linear(hidden_size, vocab_size)
+
+    def forward(self, input_ids, labels=None):
+        x = self.embed(input_ids)
+        for layer in self.layers:
+            x, _ = layer(x)
+        logits = self.head(x)
+        if labels is None:
+            return logits
+        return ops.softmax_cross_entropy(logits, labels)

@@ -1,0 +1,157 @@
+"""ds_parallel_config generators — the JSON parallel-layout IR.
+
+Counterpart of the reference's config generators
+(``examples/gpt/ds_parallel_config/generate_gpt_3d_config.py`` and
+``generate_gpt_hetero_3d_config.py``): given (dp, tp, pp[, hetero
+layout]) over an ordered chip list, emit the per-module JSON spec
+(``split``/``dup``/``device_group_union``/``type``/``zero``) parsed by
+:func:`hetu_tpu.nn.parallel.config2ds`.  Entries always use the union
+form (one group per pipeline stage), which covers both the homogeneous
+``device_group`` and heterogeneous ``device_group_union`` schemas of the
+reference.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+
+def _entry(split: Dict[str, List[int]], dup: List[int],
+           groups: List[List[int]], kind: str = "variable",
+           zero: bool = False) -> Dict:
+    e = {"split": split, "dup": dup, "device_group_union": groups,
+         "type": kind}
+    if kind == "variable":
+        e["zero"] = zero
+    return e
+
+
+def generate_gpt_3d_config(num_layers: int, dp: int, tp: int, pp: int,
+                           num_devices: Optional[int] = None,
+                           zero: bool = True,
+                           devices: Optional[Sequence[int]] = None) -> Dict:
+    """Homogeneous 3-D (dp x tp x pp) layout for a GPT stack.
+
+    Layers are split evenly into pp stages; each stage occupies dp*tp
+    chips (dp-major, tp-minor — the reference's device ordering).
+    """
+    n = num_devices or dp * tp * pp
+    assert dp * tp * pp == n, f"dp*tp*pp != num_devices ({dp}*{tp}*{pp} != {n})"
+    devices = list(devices) if devices is not None else list(range(n))
+    per_stage = dp * tp
+    stage_groups = [devices[s * per_stage:(s + 1) * per_stage]
+                    for s in range(pp)]
+    layers_per_stage = (num_layers + pp - 1) // pp
+
+    cfg: Dict = {
+        "zero": zero,
+        "devices": devices,
+        "input": _entry({"0": [dp]}, [tp], [stage_groups[0]],
+                        kind="placeholder"),
+        "gpt": {
+            "wte": _entry({"0": [tp]}, [dp], [stage_groups[0]], zero=zero),
+            "wpe": _entry({}, [per_stage], [stage_groups[0]], zero=zero),
+            "blocks": {},
+            "layernorm_final": _entry({}, [per_stage], [stage_groups[-1]],
+                                      zero=zero),
+        },
+        "lm_head": _entry({"1": [tp]}, [dp], [stage_groups[-1]], zero=zero),
+        "label": _entry({"0": [dp]}, [tp], [stage_groups[-1]],
+                        kind="placeholder"),
+    }
+    blocks = cfg["gpt"]["blocks"]
+    for s in range(pp):
+        lo = s * layers_per_stage
+        hi = min(num_layers - 1, (s + 1) * layers_per_stage - 1)
+        if lo > hi:
+            continue
+        g = [stage_groups[s]]
+        blocks[f"blocks{lo}-{hi}"] = {
+            "range": [lo, hi],
+            "layernorm1": _entry({}, [per_stage], g, zero=zero),
+            "attn": {
+                "qkv": _entry({"1": [tp]}, [dp], g, zero=zero),
+                "dense": _entry({"0": [tp]}, [dp], g, zero=zero),
+            },
+            "layernorm2": _entry({}, [per_stage], g, zero=zero),
+            "mlp": {
+                "dense_h_to_4h": _entry({"1": [tp]}, [dp], g, zero=zero),
+                "dense_4h_to_h": _entry({"0": [tp]}, [dp], g, zero=zero),
+            },
+        }
+    return cfg
+
+
+def generate_gpt_hetero_3d_config(num_layers: int,
+                                  stage_layouts: Sequence[Dict],
+                                  zero: bool = True) -> Dict:
+    """Heterogeneous layout (Malleus): per-pipeline-stage dicts
+    ``{"dp": int, "tp": int, "devices": [ids], "layers": [lo, hi]}`` with
+    possibly unequal shapes per stage (reference
+    generate_gpt_hetero_3d_config.py; hetero_stages in
+    examples/gpt/train_hetu.py:256-335)."""
+    devices: List[int] = []
+    for st in stage_layouts:
+        assert st["dp"] * st["tp"] == len(st["devices"]), \
+            f"stage {st}: dp*tp != len(devices)"
+        devices.extend(st["devices"])
+    first, last = stage_layouts[0], stage_layouts[-1]
+
+    def single(st, key_split, kind="variable"):
+        g = [list(st["devices"])]
+        if key_split == "col":
+            split, dup = {"1": [st["tp"]]}, [st["dp"]]
+        elif key_split == "row":
+            split, dup = {"0": [st["tp"]]}, [st["dp"]]
+        elif key_split == "vocab":
+            split, dup = {"0": [st["tp"]]}, [st["dp"]]
+        else:
+            split, dup = {}, [len(st["devices"])]
+        return _entry(split, dup, g, kind=kind,
+                      zero=zero if kind == "variable" else False)
+
+    cfg: Dict = {
+        "zero": zero,
+        "hetero": True,
+        "devices": devices,
+        "input": single(first, None, kind="placeholder"),
+        "gpt": {
+            "wte": single(first, "vocab"),
+            "wpe": single(first, None),
+            "blocks": {},
+            "layernorm_final": single(last, None),
+        },
+        "lm_head": single(last, "col"),
+        "label": single(last, None, kind="placeholder"),
+    }
+    blocks = cfg["gpt"]["blocks"]
+    for st in stage_layouts:
+        lo, hi = st["layers"]
+        blocks[f"blocks{lo}-{hi}"] = {
+            "range": [lo, hi],
+            "layernorm1": single(st, None),
+            "attn": {"qkv": single(st, "col"),
+                     "dense": single(st, "row")},
+            "layernorm2": single(st, None),
+            "mlp": {"dense_h_to_4h": single(st, "col"),
+                    "dense_4h_to_h": single(st, "row")},
+        }
+    return cfg
+
+
+def save_ds_config(cfg: Dict, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(cfg, f, indent=2)
+
+
+def iter_block_entries(cfg: Dict):
+    """Yield (block_range, sub_name, entry) for every leaf block entry."""
+    for bname, block in cfg["gpt"]["blocks"].items():
+        for key, val in block.items():
+            if key == "range":
+                continue
+            if "type" in val:
+                yield block["range"], key, val
+            else:
+                for sub, leaf in val.items():
+                    yield block["range"], f"{key}.{sub}", leaf

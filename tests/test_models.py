@@ -1,0 +1,217 @@
+"""Model-family tests: BERT, CNN/ResNet, RNN/LSTM/GRU + ds-config
+generators (the reference's tests/hetu_bert.py, test_cifar10.py,
+test_rnn.py coverage)."""
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import hetu_tpu as ht
+from hetu_tpu import ops, optim
+from hetu_tpu.models import (GRU, LSTM, RNN, BertConfig, BertForPreTraining,
+                             BertForSequenceClassification, ResNet,
+                             RNNLanguageModel, SimpleCNN, resnet18)
+from hetu_tpu.nn.parallel import config2ds
+from hetu_tpu.utils.ds_config import (generate_gpt_3d_config,
+                                      generate_gpt_hetero_3d_config,
+                                      iter_block_entries)
+
+
+def _fix_seed(v=9):
+    from hetu_tpu.graph import ctor
+    ctor._seed_counter[0] = v
+
+
+def _bert_cfg(**kw):
+    kw.setdefault("vocab_size", 64)
+    kw.setdefault("hidden_size", 32)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("max_seq_len", 16)
+    return BertConfig(**kw)
+
+
+class TestBert:
+    def test_pretraining_loss_decreases(self):
+        _fix_seed()
+        rng = np.random.RandomState(0)
+        B, S = 4, 16
+        ids = rng.randint(0, 64, (B, S)).astype(np.int32)
+        seg = (np.arange(S)[None, :] >= S // 2).astype(np.int32) \
+            * np.ones((B, 1), np.int32)
+        mlm = ids.copy()
+        mlm[:, ::3] = -100  # ignore unmasked positions
+        nsp = rng.randint(0, 2, (B,)).astype(np.int32)
+        with ht.graph("define_and_run", create_new=True) as g:
+            model = BertForPreTraining(_bert_cfg())
+            i = ht.placeholder("int32", (B, S), name="ids")
+            t = ht.placeholder("int32", (B, S), name="seg")
+            ml = ht.placeholder("int32", (B, S), name="mlm")
+            ns = ht.placeholder("int32", (B,), name="nsp")
+            loss = model(i, token_type_ids=t, mlm_labels=ml, nsp_labels=ns)
+            train_op = optim.AdamOptimizer(lr=1e-3).minimize(loss)
+            losses = []
+            for _ in range(8):
+                l, _ = g.run(loss, [loss, train_op],
+                             {i: ids, t: seg, ml: mlm, ns: nsp})
+                losses.append(float(np.asarray(l)))
+        assert losses[-1] < losses[0]
+
+    def test_sequence_classification(self):
+        _fix_seed()
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, 64, (8, 16)).astype(np.int32)
+        labels = rng.randint(0, 2, (8,)).astype(np.int32)
+        with ht.graph("define_and_run", create_new=True) as g:
+            model = BertForSequenceClassification(_bert_cfg(), 2)
+            i = ht.placeholder("int32", ids.shape, name="ids")
+            lb = ht.placeholder("int32", labels.shape, name="lb")
+            loss = model(i, labels=lb)
+            train_op = optim.AdamOptimizer(lr=1e-3).minimize(loss)
+            losses = [float(np.asarray(g.run(loss, [loss, train_op],
+                                             {i: ids, lb: labels})[0]))
+                      for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+    def test_bert_tp_matches_single_device(self, devices8):
+        _fix_seed(77)
+        rng = np.random.RandomState(2)
+        ids = rng.randint(0, 64, (4, 16)).astype(np.int32)
+        labels = rng.randint(0, 2, (4,)).astype(np.int32)
+
+        def run(mesh_shape, devs=None):
+            _fix_seed(77)
+            mesh = ht.create_mesh(mesh_shape, devs) if mesh_shape else None
+            with ht.graph("define_and_run", create_new=True,
+                          mesh=mesh) as g:
+                model = BertForSequenceClassification(_bert_cfg(), 2)
+                i = ht.parallel_placeholder(
+                    "int32", ids.shape,
+                    pspec=P("dp", None) if mesh else None, name="ids")
+                lb = ht.parallel_placeholder(
+                    "int32", labels.shape,
+                    pspec=P("dp") if mesh else None, name="lb")
+                loss = model(i, labels=lb)
+                train_op = optim.AdamOptimizer(lr=1e-3).minimize(loss)
+                return [float(np.asarray(
+                    g.run(loss, [loss, train_op], {i: ids, lb: labels})[0]))
+                    for _ in range(3)]
+
+        l1 = run(None)
+        l2 = run({"dp": 2, "tp": 2}, devices8[:4])
+        np.testing.assert_allclose(l1, l2, rtol=2e-3, atol=1e-4)
+
+
+class TestCNN:
+    def test_simple_cnn_trains(self):
+        _fix_seed()
+        rng = np.random.RandomState(0)
+        X = rng.randn(8, 3, 32, 32).astype(np.float32)
+        y = rng.randint(0, 10, (8,)).astype(np.int32)
+        with ht.graph("define_and_run", create_new=True) as g:
+            model = SimpleCNN()
+            xi = ht.placeholder("float32", X.shape, name="x")
+            yi = ht.placeholder("int32", y.shape, name="y")
+            loss = model(xi, yi)
+            train_op = optim.AdamOptimizer(lr=1e-3).minimize(loss)
+            losses = [float(np.asarray(g.run(loss, [loss, train_op],
+                                             {xi: X, yi: y})[0]))
+                      for _ in range(6)]
+        assert losses[-1] < losses[0]
+
+    def test_resnet_forward_and_train(self):
+        _fix_seed()
+        rng = np.random.RandomState(1)
+        X = rng.randn(4, 3, 32, 32).astype(np.float32)
+        y = rng.randint(0, 10, (4,)).astype(np.int32)
+        with ht.graph("define_and_run", create_new=True) as g:
+            model = ResNet(10, stages=(1, 1), widths=(8, 16))
+            xi = ht.placeholder("float32", X.shape, name="x")
+            yi = ht.placeholder("int32", y.shape, name="y")
+            logits = model(xi)
+            assert tuple(logits.shape) == (4, 10)
+            loss = model(xi, yi)
+            train_op = optim.AdamOptimizer(lr=1e-3).minimize(loss)
+            losses = [float(np.asarray(g.run(loss, [loss, train_op],
+                                             {xi: X, yi: y})[0]))
+                      for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+    def test_resnet18_structure(self):
+        with ht.graph("define_and_run", create_new=True):
+            m = resnet18()
+            assert len(m.blocks) == 8  # (2+2+2+2)
+
+
+class TestRNN:
+    @pytest.mark.parametrize("cell", ["rnn", "gru", "lstm"])
+    def test_lm_trains(self, cell):
+        _fix_seed()
+        # learnable pattern: next token = current + 1 (mod V)
+        V, B, S = 16, 4, 12
+        ids = np.stack([np.arange(s, s + S) % V for s in range(B)]) \
+            .astype(np.int32)
+        labels = (ids + 1) % V
+        with ht.graph("define_and_run", create_new=True) as g:
+            model = RNNLanguageModel(V, 32, cell=cell)
+            i = ht.placeholder("int32", ids.shape, name="ids")
+            lb = ht.placeholder("int32", labels.shape, name="lb")
+            loss = model(i, lb)
+            train_op = optim.AdamOptimizer(lr=1e-2).minimize(loss)
+            losses = [float(np.asarray(g.run(loss, [loss, train_op],
+                                             {i: ids, lb: labels})[0]))
+                      for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.6, (cell, losses[::10])
+
+    def test_lstm_state_shapes(self):
+        with ht.graph("define_and_run", create_new=True) as g:
+            lstm = LSTM(8, 16)
+            x = ht.placeholder("float32", (2, 5, 8), name="x")
+            ys, carry = lstm(x)
+            assert tuple(ys.shape) == (2, 5, 16)
+            X = np.random.RandomState(0).randn(2, 5, 8).astype(np.float32)
+            (out,) = g.run(ys, [ys], {x: X})
+        assert np.asarray(out).shape == (2, 5, 16)
+
+
+class TestDSConfigGenerator:
+    def test_3d_config_parses_via_config2ds(self):
+        cfg = generate_gpt_3d_config(num_layers=8, dp=2, tp=2, pp=2)
+        assert len(cfg["devices"]) == 8
+        n_entries = 0
+        for rng_, name, entry in iter_block_entries(cfg):
+            ds_union, dgs = config2ds(entry)
+            assert ds_union.get(0).device_num == len(dgs[0]) == 4
+            n_entries += 1
+        assert n_entries == 2 * 6  # 2 stages x 6 leaf entries
+        # stage ranges cover all layers disjointly
+        ranges = [b["range"] for b in cfg["gpt"]["blocks"].values()]
+        covered = sorted(x for lo, hi in ranges for x in range(lo, hi + 1))
+        assert covered == list(range(8))
+
+    def test_3d_config_shapes(self):
+        cfg = generate_gpt_3d_config(num_layers=4, dp=4, tp=2, pp=1,
+                                     zero=True)
+        qkv = next(e for r, n, e in iter_block_entries(cfg)
+                   if n == "attn.qkv")
+        assert qkv["split"] == {"1": [2]}
+        assert qkv["dup"] == [4]
+        assert qkv["zero"] is True
+
+    def test_invalid_product_raises(self):
+        with pytest.raises(AssertionError):
+            generate_gpt_3d_config(num_layers=4, dp=2, tp=2, pp=2,
+                                   num_devices=4)
+
+    def test_hetero_config(self):
+        stages = [
+            {"dp": 2, "tp": 2, "devices": [0, 1, 2, 3], "layers": [0, 3]},
+            {"dp": 1, "tp": 2, "devices": [4, 5], "layers": [4, 7]},
+        ]
+        cfg = generate_gpt_hetero_3d_config(8, stages)
+        assert cfg["hetero"] and len(cfg["devices"]) == 6
+        b0 = cfg["gpt"]["blocks"]["blocks0-3"]
+        b1 = cfg["gpt"]["blocks"]["blocks4-7"]
+        assert b0["attn"]["qkv"]["dup"] == [2]
+        assert b1["attn"]["qkv"]["dup"] == [1]
+        for _, _, entry in iter_block_entries(cfg):
+            config2ds(entry)  # parses
