@@ -2,6 +2,8 @@ from .bert import (BertConfig, BertForPreTraining,
                    BertForSequenceClassification, BertModel)
 from .cnn import BasicBlock, ResNet, SimpleCNN, resnet18, resnet34
 from .ctr import DCN, DeepFM, WDL, ctr_loss
+from .gnn import GCN, DistGCN15D, GCNLayer, SparseGCNLayer, \
+    normalize_adjacency
 from .gpt import (GPTConfig, GPTModel, GPTLMHeadModel, llama_config,
                   LLamaLMHeadModel, LLamaModel)
 from .gpt_pipeline import GPTPipelineModel, block_fn
@@ -13,4 +15,6 @@ __all__ = ["GPTConfig", "GPTModel", "GPTLMHeadModel", "llama_config",
            "BertForSequenceClassification",
            "SimpleCNN", "ResNet", "BasicBlock", "resnet18", "resnet34",
            "WDL", "DeepFM", "DCN", "ctr_loss",
-           "RNN", "GRU", "LSTM", "RNNLanguageModel"]
+           "RNN", "GRU", "LSTM", "RNNLanguageModel",
+           "GCN", "DistGCN15D", "GCNLayer", "SparseGCNLayer",
+           "normalize_adjacency"]

@@ -48,6 +48,14 @@ class GPTConfig:
     dp_axis: str = "dp"
     tp_axis: str = "tp"
     cp_axis: Optional[str] = None   # context parallel (ring attention) axis
+    # MoE (v1 MoELayer capability): >0 replaces the dense MLP with a
+    # mixture of experts every `moe_every` blocks
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_every: int = 1
+    moe_aux_coef: float = 0.01
+    ep_axis: Optional[str] = None   # expert-parallel mesh axis
 
     def __post_init__(self):
         assert self.hidden_size % self.num_heads == 0, \
@@ -195,13 +203,39 @@ class ParallelMLP(Module):
         return out
 
 
+class MoEMLP(Module):
+    """MoE feed-forward block (reference v1 MoELayer in a transformer,
+    v1/examples/moe): token dispatch + stacked experts; the aux balance
+    loss is accumulated on the module for the LM head to pick up."""
+
+    def __init__(self, config: GPTConfig, layer_idx: int = 0):
+        super().__init__()
+        from ..nn.moe import make_moe_layer
+        c = config
+        self.moe = make_moe_layer(
+            c.hidden_size, c.ffn_size, num_experts=c.num_experts,
+            gate_type="topk", k=c.moe_top_k,
+            capacity_factor=c.moe_capacity_factor,
+            activation="gelu" if c.activation == "gelu" else "silu",
+            ep_axis=c.ep_axis, dtype=c.dtype, name=f"h{layer_idx}.moe")
+        self.last_aux = None
+
+    def forward(self, x):
+        out, aux = self.moe(x)
+        self.last_aux = aux
+        return out
+
+
 class GPTBlock(Module):
     def __init__(self, config: GPTConfig, layer_idx: int):
         super().__init__()
         self.ln_1 = _norm(config, f"h{layer_idx}.ln_1")
         self.attn = ParallelAttentionBlock(config, layer_idx)
         self.ln_2 = _norm(config, f"h{layer_idx}.ln_2")
-        self.mlp = ParallelMLP(config, layer_idx)
+        use_moe = config.num_experts > 0 and \
+            layer_idx % max(1, config.moe_every) == 0
+        self.mlp = MoEMLP(config, layer_idx) if use_moe \
+            else ParallelMLP(config, layer_idx)
 
     def forward(self, x, seq_len: int):
         x = x + self.attn(self.ln_1(x), seq_len)
@@ -282,6 +316,11 @@ class GPTLMHeadModel(Module):
         loss = vocab_parallel_cross_entropy(
             logits, labels, dp_axis=c.dp_axis, tp_axis=c.tp_axis,
             seq_axis=c.cp_axis, ignore_index=-100)
+        if c.num_experts > 0 and c.moe_aux_coef:
+            for block in self.transformer.h:
+                if isinstance(block.mlp, MoEMLP) and \
+                        block.mlp.last_aux is not None:
+                    loss = loss + c.moe_aux_coef * block.mlp.last_aux
         return loss
 
 

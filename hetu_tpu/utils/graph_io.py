@@ -1,0 +1,185 @@
+"""Graph import/export.
+
+Counterpart of the reference's ONNX interop (``hetu/v1/python/hetu/onnx/``
+import/export).  Two formats:
+
+- **JSON structure export** (always available): ops, tensors, shapes,
+  attrs — enough for visualization, diffing, and re-importing the graph
+  *structure* (impl lambdas are re-bound by op_type through the op
+  registry).
+- **ONNX export** (gated on the ``onnx`` package, which is not baked into
+  every image): maps the common op subset to ONNX nodes.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# op_type -> ONNX operator name for the exportable subset
+_ONNX_OPS = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "neg": "Neg", "abs": "Abs", "exp": "Exp", "log": "Log",
+    "sqrt": "Sqrt", "tanh": "Tanh", "sigmoid": "Sigmoid",
+    "relu": "Relu", "gelu": "Gelu", "softmax": "Softmax",
+    "matmul": "MatMul", "linear": "Gemm", "reshape": "Reshape",
+    "transpose": "Transpose", "concat": "Concat", "slice": "Slice",
+    "reduce_sum": "ReduceSum", "reduce_mean": "ReduceMean",
+    "reduce_max": "ReduceMax", "embedding_lookup": "Gather",
+    "layer_norm": "LayerNormalization", "conv2d": "Conv",
+    "max_pool": "MaxPool", "avg_pool": "AveragePool",
+    "batch_norm": "BatchNormalization", "cast": "Cast",
+    "where": "Where", "pow": "Pow", "one_hot": "OneHot",
+}
+
+
+def _is_function(v: Any) -> bool:
+    """True only for real function objects (impl lambdas, init_fns) — NOT
+    for callable classes like jnp.float32, which are legitimate attr
+    values (cast dtypes)."""
+    import functools
+    import types
+    return isinstance(v, (types.FunctionType, types.MethodType,
+                          types.BuiltinFunctionType, functools.partial))
+
+
+def _jsonable(v: Any):
+    if isinstance(v, (int, float, str, bool, type(None))):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, np.ndarray):
+        return {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+    return repr(v)
+
+
+def export_graph_json(graph, targets=None, path: Optional[str] = None
+                      ) -> Dict:
+    """Serialize the graph structure (ops/tensors/shapes/attrs)."""
+    nodes = graph._topo_from(list(targets)) if targets is not None \
+        else list(graph.ops)
+    out: Dict = {"format": "hetu_tpu.graph.v1", "ops": []}
+    for node in nodes:
+        out["ops"].append({
+            "id": node.id,
+            "op_type": node.op_type,
+            "name": node.name,
+            "inputs": [t.id for t in node.inputs],
+            "outputs": [
+                {"id": t.id, "name": t.name,
+                 "shape": [int(d) for d in t.concrete_shape()],
+                 "dtype": str(t.dtype)}
+                for t in node.outputs],
+            "attrs": {k: _jsonable(v) for k, v in node.attrs.items()
+                      if not k.startswith("_") and not _is_function(v)},
+            "onnx_op": _ONNX_OPS.get(node.op_type),
+        })
+    if path:
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
+def graph_summary(graph, targets=None) -> str:
+    """Human-readable op listing (netron-lite)."""
+    spec = export_graph_json(graph, targets)
+    lines = []
+    for op in spec["ops"]:
+        outs = ", ".join(f"{o['name']}:{o['shape']}" for o in op["outputs"])
+        ins = ", ".join(str(i) for i in op["inputs"])
+        lines.append(f"[{op['id']:>4}] {op['op_type']:<22} ({ins}) -> {outs}")
+    return "\n".join(lines)
+
+
+def _onnx_attrs(op_type: str, attrs: Dict) -> Dict:
+    """Map our op attrs to the ONNX node's required attributes."""
+    out: Dict = {}
+    if op_type in ("concat", "stack", "softmax", "log_softmax"):
+        out["axis"] = int(attrs.get("axis", -1))
+    elif op_type == "transpose" and attrs.get("perm") is not None:
+        out["perm"] = [int(p) for p in attrs["perm"]]
+    elif op_type in ("reduce_sum", "reduce_mean", "reduce_max"):
+        ax = attrs.get("axis")
+        if ax is not None:
+            out["axes"] = [int(a) for a in np.atleast_1d(ax)]
+        out["keepdims"] = int(bool(attrs.get("keepdims", False)))
+    return out
+
+
+def export_onnx(graph, targets, path: str):
+    """Export the subset of the graph mappable to ONNX: placeholders
+    become graph inputs, materialized variables become initializers,
+    targets become graph outputs.  Requires the ``onnx`` package (not
+    bundled in all images — install separately)."""
+    try:
+        import onnx
+        from onnx import helper, numpy_helper
+    except ImportError as e:
+        raise ImportError(
+            "ONNX export needs the `onnx` package; it is not installed in "
+            "this environment. Use export_graph_json() for the native "
+            "JSON graph format instead.") from e
+
+    _NP2ONNX = {"float32": onnx.TensorProto.FLOAT,
+                "float16": onnx.TensorProto.FLOAT16,
+                "bfloat16": onnx.TensorProto.BFLOAT16,
+                "int32": onnx.TensorProto.INT32,
+                "int64": onnx.TensorProto.INT64,
+                "bool": onnx.TensorProto.BOOL}
+
+    def vi(t):
+        dt = _NP2ONNX.get(str(np.dtype(t.dtype.to_jnp()))
+                          if hasattr(t.dtype, "to_jnp") else str(t.dtype),
+                          onnx.TensorProto.FLOAT)
+        return helper.make_tensor_value_info(
+            f"t{t.id}", dt, [int(d) for d in t.concrete_shape()])
+
+    nodes = graph._topo_from(list(targets))
+    onnx_nodes, inputs, initializers = [], [], []
+    unmapped = []
+    for node in nodes:
+        if node.op_type == "placeholder":
+            inputs.append(vi(node.outputs[0]))
+            continue
+        if node.op_type == "variable":
+            t = node.outputs[0]
+            arr = np.asarray(graph._materialize_var(t))
+            initializers.append(
+                numpy_helper.from_array(arr, name=f"t{t.id}"))
+            continue
+        if node.op_type == "constant":
+            arr = np.asarray(node.attrs["value"])
+            initializers.append(
+                numpy_helper.from_array(arr,
+                                        name=f"t{node.outputs[0].id}"))
+            continue
+        op_name = _ONNX_OPS.get(node.op_type)
+        if op_name is None:
+            unmapped.append(node.op_type)
+            continue
+        extra_inputs = []
+        if node.op_type == "reshape":
+            # ONNX Reshape takes the target shape as a tensor input
+            shp = np.asarray([int(d) for d in
+                              node.outputs[0].concrete_shape()], np.int64)
+            sname = f"t{node.outputs[0].id}_shape"
+            initializers.append(numpy_helper.from_array(shp, name=sname))
+            extra_inputs = [sname]
+        onnx_nodes.append(helper.make_node(
+            op_name,
+            inputs=[f"t{t.id}" for t in node.inputs] + extra_inputs,
+            outputs=[f"t{t.id}" for t in node.outputs],
+            name=node.name or f"op{node.id}",
+            **_onnx_attrs(node.op_type, node.attrs)))
+    if unmapped:
+        raise ValueError(f"ops without ONNX mapping: {sorted(set(unmapped))}")
+    outputs = [vi(t) for t in targets]
+    g = helper.make_graph(onnx_nodes, "hetu_tpu", inputs, outputs,
+                          initializer=initializers)
+    model = helper.make_model(g)
+    onnx.checker.check_model(model)
+    onnx.save(model, path)
+    return model

@@ -162,6 +162,44 @@ class TestMoELayer:
         assert np.abs(after - before).max() > 0
 
 
+class TestMoEGPT:
+    """MoE wired into the GPT family (v1 MoE-transformer capability)."""
+
+    def test_moe_gpt_trains_and_matches_ep(self, devices8):
+        import hetu_tpu as ht
+        from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+        rng = np.random.RandomState(0)
+        X = rng.randint(0, 64, (8, 16)).astype(np.int32)
+        L = np.roll(X, -1, 1)
+
+        def run(mesh_shape, ep_axis, devs=None):
+            _fix_seed()
+            mesh = ht.create_mesh(mesh_shape, devs) if mesh_shape else None
+            cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=4, max_seq_len=16, num_experts=4,
+                            moe_top_k=2, dtype="float32", sp=False,
+                            ep_axis=ep_axis)
+            with ht.graph("define_and_run", create_new=True,
+                          mesh=mesh) as g:
+                ids = ht.parallel_placeholder(
+                    "int32", X.shape, pspec=P("dp", None) if mesh else None,
+                    name="ids")
+                labels = ht.parallel_placeholder(
+                    "int32", X.shape, pspec=P("dp", None) if mesh else None,
+                    name="labels")
+                model = GPTLMHeadModel(cfg)
+                loss = model(ids, labels)
+                train_op = optim.AdamOptimizer(lr=1e-3).minimize(loss)
+                return [float(np.asarray(
+                    g.run(loss, [loss, train_op],
+                          {ids: X, labels: L})[0])) for _ in range(3)]
+
+        l1 = run(None, None)
+        assert l1[-1] < l1[0]
+        l2 = run({"dp": 2, "ep": 4}, "ep", devices8)
+        np.testing.assert_allclose(l1, l2, rtol=2e-3, atol=1e-4)
+
+
 class TestExpertParallel:
     """Single-device MoE == EP-sharded MoE (same init), mirroring the
     reference's loss-equivalence testing style."""
