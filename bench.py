@@ -46,7 +46,7 @@ def main():
                         num_heads=12, max_seq_len=1024, sp=False,
                         dtype="bfloat16", position="learned",
                         activation="gelu", norm="layernorm")
-        batch, seq, steps, warmup = 8, 1024, 10, 3
+        batch, seq, steps, warmup = 32, 1024, 10, 3
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
                         num_heads=8, max_seq_len=256, sp=False,

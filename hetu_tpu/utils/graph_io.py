@@ -17,20 +17,24 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-# op_type -> ONNX operator name for the exportable subset
+# op_type -> ONNX operator name for the subset we translate EXACTLY
+# (elementwise ops are attr-free; matmul/linear get their trans flags
+# lowered to Transpose nodes; reduce_* use opset-13 axes-as-input; gelu
+# maps its `approximate` flag).  Ops with unhandled required attributes
+# (conv/pool/slice/one_hot/batch_norm/...) are deliberately NOT listed —
+# exporting them raises "ops without ONNX mapping" instead of silently
+# emitting a model that computes something else.
 _ONNX_OPS = {
     "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
     "neg": "Neg", "abs": "Abs", "exp": "Exp", "log": "Log",
     "sqrt": "Sqrt", "tanh": "Tanh", "sigmoid": "Sigmoid",
     "relu": "Relu", "gelu": "Gelu", "softmax": "Softmax",
-    "matmul": "MatMul", "linear": "Gemm", "reshape": "Reshape",
-    "transpose": "Transpose", "concat": "Concat", "slice": "Slice",
+    "log_softmax": "LogSoftmax",
+    "matmul": "MatMul", "linear": "MatMul", "reshape": "Reshape",
+    "transpose": "Transpose", "concat": "Concat",
     "reduce_sum": "ReduceSum", "reduce_mean": "ReduceMean",
     "reduce_max": "ReduceMax", "embedding_lookup": "Gather",
-    "layer_norm": "LayerNormalization", "conv2d": "Conv",
-    "max_pool": "MaxPool", "avg_pool": "AveragePool",
-    "batch_norm": "BatchNormalization", "cast": "Cast",
-    "where": "Where", "pow": "Pow", "one_hot": "OneHot",
+    "where": "Where", "pow": "Pow",
 }
 
 
@@ -53,6 +57,9 @@ def _jsonable(v: Any):
         return {str(k): _jsonable(x) for k, x in v.items()}
     if isinstance(v, np.ndarray):
         return {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+    if hasattr(v, "__array__") and hasattr(v, "dtype"):  # jax.Array etc.
+        a = np.asarray(v)
+        return {"__ndarray__": a.tolist(), "dtype": str(a.dtype)}
     return repr(v)
 
 
@@ -95,17 +102,18 @@ def graph_summary(graph, targets=None) -> str:
 
 
 def _onnx_attrs(op_type: str, attrs: Dict) -> Dict:
-    """Map our op attrs to the ONNX node's required attributes."""
+    """Map our op attrs to the ONNX node's required attributes (the
+    opset-13+ reduce ``axes`` input is handled separately)."""
     out: Dict = {}
     if op_type in ("concat", "stack", "softmax", "log_softmax"):
         out["axis"] = int(attrs.get("axis", -1))
     elif op_type == "transpose" and attrs.get("perm") is not None:
         out["perm"] = [int(p) for p in attrs["perm"]]
     elif op_type in ("reduce_sum", "reduce_mean", "reduce_max"):
-        ax = attrs.get("axis")
-        if ax is not None:
-            out["axes"] = [int(a) for a in np.atleast_1d(ax)]
         out["keepdims"] = int(bool(attrs.get("keepdims", False)))
+    elif op_type == "gelu":
+        out["approximate"] = "tanh" if attrs.get("approximate", True) \
+            else "none"
     return out
 
 
@@ -160,19 +168,60 @@ def export_onnx(graph, targets, path: str):
         if op_name is None:
             unmapped.append(node.op_type)
             continue
+        in_names = [f"t{t.id}" for t in node.inputs]
+        out_name = f"t{node.outputs[0].id}"
+        nname = node.name or f"op{node.id}"
+
+        def transposed(name, tag, rank):
+            tname = f"{name}_{tag}_T"
+            perm = list(range(rank))
+            perm[-1], perm[-2] = perm[-2], perm[-1]
+            onnx_nodes.append(helper.make_node(
+                "Transpose", [name], [tname], perm=perm,
+                name=f"{nname}.{tag}_T"))
+            return tname
+
+        if node.op_type in ("matmul", "linear"):
+            # lower trans flags to explicit (last-two-dims) Transpose
+            # nodes; `linear` additionally adds the bias
+            a, b = in_names[0], in_names[1]
+            if node.attrs.get("trans_a"):
+                a = transposed(a, "a", len(node.inputs[0].shape))
+            if node.attrs.get("trans_b", node.op_type == "linear"):
+                b = transposed(b, "b", len(node.inputs[1].shape))
+            if node.op_type == "linear":
+                mm = f"{out_name}_mm"
+                onnx_nodes.append(helper.make_node(
+                    "MatMul", [a, b], [mm], name=f"{nname}.mm"))
+                onnx_nodes.append(helper.make_node(
+                    "Add", [mm, in_names[2]], [out_name],
+                    name=f"{nname}.bias"))
+            else:
+                onnx_nodes.append(helper.make_node(
+                    "MatMul", [a, b], [out_name], name=nname))
+            continue
         extra_inputs = []
         if node.op_type == "reshape":
             # ONNX Reshape takes the target shape as a tensor input
             shp = np.asarray([int(d) for d in
                               node.outputs[0].concrete_shape()], np.int64)
-            sname = f"t{node.outputs[0].id}_shape"
+            sname = f"{out_name}_shape"
             initializers.append(numpy_helper.from_array(shp, name=sname))
             extra_inputs = [sname]
+        elif node.op_type in ("reduce_sum", "reduce_mean", "reduce_max"):
+            # opset 13+: axes is an input, not an attribute
+            ax = node.attrs.get("axis")
+            if ax is not None:
+                axes = np.asarray(np.atleast_1d(ax), np.int64)
+                aname = f"{out_name}_axes"
+                initializers.append(
+                    numpy_helper.from_array(axes, name=aname))
+                extra_inputs = [aname]
         onnx_nodes.append(helper.make_node(
             op_name,
-            inputs=[f"t{t.id}" for t in node.inputs] + extra_inputs,
+            inputs=in_names + extra_inputs,
             outputs=[f"t{t.id}" for t in node.outputs],
-            name=node.name or f"op{node.id}",
+            name=nname,
             **_onnx_attrs(node.op_type, node.attrs)))
     if unmapped:
         raise ValueError(f"ops without ONNX mapping: {sorted(set(unmapped))}")
