@@ -14,7 +14,7 @@ from . import parallel
 from .parallel import (DistributedStates, DistributedStatesUnion,
                        DistributedStatesHierarchy, create_mesh)
 from .graph import (Tensor, SymbolicDim, Graph, EagerGraph,
-                    DefineAndRunGraph, RunLevel, graph, run_level,
+                    DefineAndRunGraph, DefineByRunGraph, RunLevel, graph, run_level,
                     get_default_graph, placeholder, parameter, variable,
                     parallel_placeholder, parallel_parameter)
 from .graph.amp import autocast, GradScaler

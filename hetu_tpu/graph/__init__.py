@@ -1,5 +1,5 @@
 from .tensor import Tensor, SymbolicDim
-from .graph import (Graph, EagerGraph, DefineAndRunGraph, OpNode, RunLevel,
+from .graph import (Graph, EagerGraph, DefineAndRunGraph, DefineByRunGraph, OpNode, RunLevel,
                     graph, run_level, get_default_graph)
 from .ctor import (placeholder, parameter, variable, parallel_placeholder,
                    parallel_parameter, Initializer, ConstantInitializer,
@@ -9,7 +9,7 @@ from .ctor import (placeholder, parameter, variable, parallel_placeholder,
                    HeNormalInitializer, ProvidedInitializer)
 
 __all__ = [
-    "Tensor", "SymbolicDim", "Graph", "EagerGraph", "DefineAndRunGraph",
+    "Tensor", "SymbolicDim", "Graph", "EagerGraph", "DefineAndRunGraph", "DefineByRunGraph",
     "OpNode", "RunLevel", "graph", "run_level", "get_default_graph",
     "placeholder", "parameter", "variable", "parallel_placeholder",
     "parallel_parameter", "Initializer", "ConstantInitializer",

@@ -370,6 +370,44 @@ class EagerGraph(Graph):
         return self._lift_constant(self._fresh_rng_key())
 
 
+class DefineByRunGraph(Graph):
+    """Lazy trace variant (reference ``define_by_run_graph.h:9``): ops
+    record symbolically like DefineAndRun, but values materialize on
+    demand via :meth:`get_or_compute` (the reference's ``GetOrCompute``)
+    with per-tensor caching — new ops invalidate nothing already
+    computed, matching torch-like deferred execution without re-running
+    the whole graph per fetch."""
+
+    def __init__(self, name: str = "define_by_run"):
+        super().__init__(name)
+        self._computed: Dict[int, Any] = {}
+
+    def get_or_compute(self, t: Tensor):
+        if t.id in self._computed:
+            return self._computed[t.id]
+        env: Dict[int, Any] = dict(self._computed)
+        for vt_id, vt in self._var_tensors.items():
+            env.setdefault(vt_id, self._materialize_var(vt))
+        (val,) = self._eval_targets([t], env)
+        self._computed[t.id] = val
+        return val
+
+    def feed(self, t: Tensor, value) -> None:
+        """Bind a placeholder's value for subsequent get_or_compute."""
+        self._computed[t.id] = jnp.asarray(value)
+
+    def invalidate(self) -> None:
+        """Drop cached activations (keep variables)."""
+        self._computed.clear()
+
+    def get_tensor_value(self, t: Tensor):
+        if t.id in self._computed:
+            return self._computed[t.id]
+        if t.id in self._var_tensors:
+            return super().get_tensor_value(t)
+        return self.get_or_compute(t)
+
+
 class DefineAndRunGraph(Graph):
     """Symbolic graph with an executable-plan pool."""
 
@@ -710,8 +748,12 @@ class graph:
         else:
             cache_key = f"{prefix}_{kind}"
             if create_new or cache_key not in _default_graphs:
-                g = (DefineAndRunGraph(cache_key) if kind == "define_and_run"
-                     else EagerGraph(cache_key))
+                if kind == "define_and_run":
+                    g = DefineAndRunGraph(cache_key)
+                elif kind == "define_by_run":
+                    g = DefineByRunGraph(cache_key)
+                else:
+                    g = EagerGraph(cache_key)
                 if create_new:
                     self.g = g
                 else:

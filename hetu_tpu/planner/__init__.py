@@ -12,7 +12,7 @@ from .cost_model import (CHIPS, ChipSpec, ClusterSpec, LayerSpec, Strategy,
 from .dispatch import (DispatchStrategy, batching_strategy, dynamic_dispatch,
                        fit_cost_model, generate_strategy_pool,
                        max_seqlen_for, quadratic_predict,
-                       solve_micro_batches)
+                       solve_micro_batches, static_dispatch)
 from .dp_solver import solve_layer_strategies, solve_pipeline_partition
 from .search import PlanResult, SearchEngine
 from .strategies import (BaseSearching, FlexFlowSearching, GPipeSearching,
@@ -27,7 +27,7 @@ __all__ = [
     "solve_layer_strategies", "solve_pipeline_partition",
     "DispatchStrategy", "batching_strategy", "dynamic_dispatch",
     "fit_cost_model", "generate_strategy_pool", "max_seqlen_for",
-    "quadratic_predict", "solve_micro_batches",
+    "quadratic_predict", "solve_micro_batches", "static_dispatch",
     "PlanResult", "SearchEngine",
     "BaseSearching", "FlexFlowSearching", "GPipeSearching",
     "OptCNNSearching", "PipeDreamSearching", "PipeOptSearching",

@@ -227,6 +227,64 @@ def _dispatch_milp(strategies, seqlens, eligible, time_limit
     return out
 
 
+def static_dispatch(strategies: Sequence[DispatchStrategy],
+                    length_counts: Sequence[Tuple[int, int]]
+                    ) -> List[Tuple[int, int]]:
+    """Offline (static) dispatch (reference ``strategy/static.py``):
+    given the dataset's seqlen histogram ``[(length, count), ...]``,
+    assign contiguous length RANGES to strategies once, instead of
+    re-solving per iteration.
+
+    Strategies are ordered by ``max_seqlen`` ascending; a bottleneck DP
+    picks the range boundaries minimizing the max per-strategy load.
+    Returns per-strategy (lo, hi] length bounds (lo == hi for unused
+    strategies).
+    """
+    order = sorted(range(len(strategies)),
+                   key=lambda j: strategies[j].max_seqlen)
+    G = len(order)
+    buckets = sorted(length_counts)
+    L = len(buckets)
+    if buckets and buckets[-1][0] > strategies[order[-1]].max_seqlen:
+        raise ValueError(
+            f"longest sequence {buckets[-1][0]} exceeds every strategy's "
+            f"max_seqlen")
+    INF = float("inf")
+
+    def load(j, a, b):  # strategy j handles buckets [a, b)
+        st = strategies[order[j]]
+        if b > a and buckets[b - 1][0] > st.max_seqlen:
+            return INF
+        return sum(float(st.steady_time(s)) * c for s, c in buckets[a:b])
+
+    # f[a][j]: min bottleneck covering buckets [a:] with strategies j..G-1
+    f = np.full((L + 1, G + 1), INF)
+    cut = np.full((L + 1, G + 1), -1, np.int64)
+    f[L, :] = 0.0
+    for j in range(G - 1, -1, -1):
+        for a in range(L, -1, -1):
+            for b in range(a, L + 1):
+                c = max(load(j, a, b), f[b, j + 1])
+                if c < f[a, j]:
+                    f[a, j] = c
+                    cut[a, j] = b
+    if not np.isfinite(f[0, 0]):
+        raise ValueError("no feasible static assignment")
+    ranges = []
+    a = 0
+    for j in range(G):
+        b = int(cut[a, j]) if np.isfinite(f[a, j]) and cut[a, j] >= 0 else a
+        lo = buckets[a - 1][0] if a > 0 else 0
+        hi = buckets[b - 1][0] if b > a else lo
+        ranges.append((lo, hi))
+        a = b
+    # un-sort back to the caller's strategy order
+    out: List[Tuple[int, int]] = [None] * G  # type: ignore
+    for pos, j in enumerate(order):
+        out[j] = ranges[pos]
+    return out
+
+
 # ---------------------------------------------------------------------------
 # per-group micro-batching + packing
 # ---------------------------------------------------------------------------

@@ -191,3 +191,37 @@ class TestStrategyPool:
                     mat = batching_strategy(glens, max_seqlen=max(
                         (int(l) + 127) // 128 * 128 for l in glens))
                     assert mat.sum() == len(glens)
+
+
+class TestStaticDispatch:
+    def test_ranges_cover_and_respect_limits(self):
+        from hetu_tpu.planner import static_dispatch
+        pool = [DispatchStrategy(tp=8, b=1e-3, max_seqlen=8192),
+                DispatchStrategy(tp=2, b=4e-3, max_seqlen=2048)]
+        hist = [(256, 100), (1024, 50), (4096, 10), (8192, 2)]
+        ranges = static_dispatch(pool, hist)
+        assert len(ranges) == 2
+        # long sequences must land in the big-memory strategy's range
+        lo0, hi0 = ranges[0]
+        assert hi0 >= 8192
+        # every histogram length falls in exactly one range
+        for s, _ in hist:
+            hits = [j for j, (lo, hi) in enumerate(ranges) if lo < s <= hi]
+            assert len(hits) == 1, (s, ranges)
+
+    def test_static_balances_load(self):
+        from hetu_tpu.planner import static_dispatch
+        pool = [DispatchStrategy(b=1.0, max_seqlen=10000),
+                DispatchStrategy(b=1.0, max_seqlen=10000)]
+        hist = [(100, 10), (200, 10), (300, 10), (400, 10)]
+        ranges = static_dispatch(pool, hist)
+        loads = []
+        for lo, hi in ranges:
+            loads.append(sum(s * c for s, c in hist if lo < s <= hi))
+        assert max(loads) < sum(s * c for s, c in hist)  # actually split
+
+    def test_impossible_length_raises(self):
+        from hetu_tpu.planner import static_dispatch
+        pool = [DispatchStrategy(max_seqlen=100)]
+        with pytest.raises(ValueError, match="exceeds"):
+            static_dispatch(pool, [(500, 1)])

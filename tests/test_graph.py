@@ -264,3 +264,41 @@ class TestReviewRegressions:
             g.run(loss, [loss, op],
                   {x: np.ones((8, 4), np.float32), s: np.float32(2.0)},
                   num_micro_batches=4)
+
+
+class TestDefineByRunGraph:
+    """Lazy-trace graph type (reference DefineByRunGraph,
+    define_by_run_graph.h:9): ops record symbolically, values
+    materialize on demand with caching."""
+
+    def test_get_or_compute_lazy_and_cached(self):
+        import hetu_tpu as ht
+        from hetu_tpu import ops
+        from hetu_tpu.graph.ctor import ConstantInitializer, parameter
+        with ht.graph("define_by_run", create_new=True) as g:
+            w = parameter(ConstantInitializer(2.0), (3,), name="w")
+            y = w * 3.0
+            z = y + 1.0
+            # nothing computed yet
+            assert y.id not in g._computed
+            val = g.get_or_compute(z)
+            np.testing.assert_allclose(np.asarray(val), [7.0, 7.0, 7.0])
+            # intermediate cached too; new ops don't recompute it
+            zz = z * 2.0
+            np.testing.assert_allclose(np.asarray(g.get_or_compute(zz)),
+                                       [14.0] * 3)
+            assert z.id in g._computed
+
+    def test_feed_and_invalidate(self):
+        import hetu_tpu as ht
+        from hetu_tpu import ops
+        with ht.graph("define_by_run", create_new=True) as g:
+            x = ht.placeholder("float32", (2,), name="x")
+            y = x * 10.0
+            g.feed(x, np.array([1.0, 2.0], np.float32))
+            np.testing.assert_allclose(np.asarray(g.get_or_compute(y)),
+                                       [10.0, 20.0])
+            g.invalidate()
+            g.feed(x, np.array([3.0, 4.0], np.float32))
+            np.testing.assert_allclose(np.asarray(g.get_or_compute(y)),
+                                       [30.0, 40.0])
