@@ -92,6 +92,17 @@ class Bucket:
         """
         if batching_option_matrix is not None:
             mat = np.asarray(batching_option_matrix)
+            if mat.shape[1] != len(self._seqs):
+                raise ValueError(
+                    f"batching_option_matrix has {mat.shape[1]} columns "
+                    f"for {len(self._seqs)} sequences")
+            cover = mat.sum(axis=0)
+            bad = np.nonzero(cover != 1)[0]
+            if bad.size:
+                raise ValueError(
+                    f"batching_option_matrix must assign each sequence to "
+                    f"exactly one row; sequences {bad.tolist()[:8]} are "
+                    f"covered {cover[bad].tolist()[:8]} times")
             groups = [[j for j in range(mat.shape[1]) if mat[i, j]]
                       for i in range(mat.shape[0])]
             groups = [g for g in groups if g]

@@ -255,13 +255,18 @@ class Graph:
         return order
 
     def _eval_targets(self, targets: Sequence[Tensor],
-                      env: Dict[int, Any]) -> List[Any]:
+                      env: Dict[int, Any],
+                      out_env: Optional[Dict[int, Any]] = None) -> List[Any]:
         """Evaluate target tensors given env (tensor.id -> concrete value).
 
         Pure w.r.t. env: used both eagerly and under jit tracing.
+        ``out_env``, when given, receives every value computed along the
+        way (keyed by tensor id) so callers can cache intermediates.
         """
         base_env = dict(env)  # leaf values only (placeholders/variables)
-        env = dict(env)
+        env = dict(env) if out_env is None else out_env
+        if out_env is not None:
+            out_env.update(base_env)
         for node in self._topo_from(targets):
             if all(t.id in env for t in node.outputs):
                 continue
@@ -388,8 +393,12 @@ class DefineByRunGraph(Graph):
         env: Dict[int, Any] = dict(self._computed)
         for vt_id, vt in self._var_tensors.items():
             env.setdefault(vt_id, self._materialize_var(vt))
-        (val,) = self._eval_targets([t], env)
-        self._computed[t.id] = val
+        # cache every intermediate computed for this fetch (reference
+        # GetOrCompute caches per-tensor): separate fetches then reuse
+        # one consistent set of values instead of re-running upstream.
+        full_env: Dict[int, Any] = {}
+        (val,) = self._eval_targets([t], env, out_env=full_env)
+        self._computed.update(full_env)
         return val
 
     def feed(self, t: Tensor, value) -> None:

@@ -212,11 +212,18 @@ class MoEMLP(Module):
         super().__init__()
         from ..nn.moe import make_moe_layer
         c = config
+        # experts use the config activation directly; swiglu (gated, 2x
+        # fc1 width) has no stacked-expert form here, so it maps to its
+        # silu nonlinearity
+        moe_act = "silu" if c.activation == "swiglu" else c.activation
+        if moe_act not in ("relu", "gelu", "silu"):
+            raise ValueError(
+                f"MoE experts do not support activation {c.activation!r}")
         self.moe = make_moe_layer(
             c.hidden_size, c.ffn_size, num_experts=c.num_experts,
             gate_type="topk", k=c.moe_top_k,
             capacity_factor=c.moe_capacity_factor,
-            activation="gelu" if c.activation == "gelu" else "silu",
+            activation=moe_act,
             ep_axis=c.ep_axis, dtype=c.dtype, name=f"h{layer_idx}.moe")
         self.last_aux = None
 

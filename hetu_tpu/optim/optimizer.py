@@ -113,13 +113,17 @@ class Optimizer:
             return
         tid = param.id
         nrows = param.shape[0] if param.shape else 0
+        rows_dev = jnp.asarray(rows)
         for state in self._state.values():
             if isinstance(state, dict) and tid in state:
-                arr = np.asarray(state[tid])
-                if arr.ndim >= 1 and arr.shape[0] == nrows:
-                    arr = arr.copy()
-                    arr[rows] = 0
-                    state[tid] = arr
+                arr = state[tid]
+                if hasattr(arr, "ndim") and arr.ndim >= 1 \
+                        and arr.shape[0] == nrows:
+                    # device-side masked update: preserves the array's
+                    # sharding/placement (a numpy round-trip would gather
+                    # and fail on non-fully-addressable arrays)
+                    arr = jnp.asarray(arr)
+                    state[tid] = arr.at[rows_dev].set(0)
 
     def _init_state(self, var_state, xs) -> Dict[str, Any]:
         return {}

@@ -53,18 +53,32 @@ class PlanResult:
         per_stage = len(chips) // self.pp
         out: Dict = {"pp": self.pp, "num_layers": {}, "layers": {}}
         for si, stage in enumerate(self.stages):
-            group = chips[si * per_stage:(si + 1) * per_stage]
+            group = [chips[si * per_stage:(si + 1) * per_stage]]
             for li in stage:
                 st = self.layer_strategies[li]
                 name = (layer_names[li] if layer_names is not None
                         else f"blocks{li}")
+
+                def _w(split):
+                    # matches generate_gpt_3d_config's schema: column-
+                    # parallel weights split dim 1, row-parallel dim 0,
+                    # norms duplicated over the whole stage group
+                    return {
+                        "type": "variable",
+                        "split": split,
+                        "dup": ([st.dp] if split else [st.dp * st.tp]),
+                        "device_group_union": group,
+                        "zero": st.zero > 0,
+                        "recompute": st.recompute,
+                    }
+
                 out["layers"][name] = {
-                    "type": "variable",
-                    "split": {"0": [st.tp]},
-                    "dup": [st.dp],
-                    "device_group_union": [group],
-                    "zero": st.zero > 0,
-                    "recompute": st.recompute,
+                    "layernorm1": _w({}),
+                    "attn": {"qkv": _w({"1": [st.tp]}),
+                             "dense": _w({"0": [st.tp]})},
+                    "layernorm2": _w({}),
+                    "mlp": {"dense_h_to_4h": _w({"1": [st.tp]}),
+                            "dense_4h_to_h": _w({"0": [st.tp]})},
                 }
         return out
 

@@ -133,11 +133,27 @@ class TestSearchEngine:
         plan = eng.search()
         cfg = plan.to_ds_parallel_config()
         assert len(cfg["layers"]) == 8
-        # every emitted layer entry parses through config2ds
+
+        def _leaf_entries(d):
+            if "type" in d:
+                yield d
+                return
+            for v in d.values():
+                if isinstance(v, dict):
+                    yield from _leaf_entries(v)
+
+        # every emitted per-weight entry parses through config2ds, with
+        # the generator schema's shard dims (col-parallel dim 1,
+        # row-parallel dim 0)
         for name, entry in cfg["layers"].items():
-            ds_union, dgs = config2ds(entry)
-            ds = ds_union.get(0)
-            assert ds.device_num == len(dgs[0])
+            leaves = list(_leaf_entries(entry))
+            assert len(leaves) == 6  # ln1, qkv, dense, ln2, fc1, fc2
+            for leaf in leaves:
+                ds_union, dgs = config2ds(leaf)
+                ds = ds_union.get(0)
+                assert ds.device_num == len(dgs[0])
+            assert entry["attn"]["qkv"]["split"].keys() <= {"1"}
+            assert entry["attn"]["dense"]["split"].keys() <= {"0"}
 
 
 class TestV1Strategies:
