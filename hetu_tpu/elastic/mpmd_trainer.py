@@ -1,0 +1,155 @@
+"""Elastic trainer over the MPMD hetero pipeline (Malleus end-to-end).
+
+Closes the reference loop of SURVEY.md §3.5 with *hetero execution*: the
+:class:`~hetu_tpu.elastic.strategy.StrategyModel` solves unequal
+per-stage layer ranges and per-pipeline micro-batch counts from
+straggler ratios, and — unlike a rectangular SPMD projection — the MPMD
+runtime actually executes them: each stage is its own program on its own
+submesh, so a slow device really does get fewer layers and a slow
+pipeline fewer micro-batches (reference ``DeducePipeline``,
+``define_and_run_graph.cc:139``, and the per-dp micro-batch counts of
+``examples/gpt/train_hetu.py:256-335``).
+
+On a layout change the trainer gathers params + Adam moments keyed by
+canonical parameter name, rebuilds the stage programs for the new
+layout, and reloads state (the SwitchExecGraph migration, here via
+``device_put`` resharding).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..models.gpt import GPTConfig
+from ..models.gpt_mpmd import MPMDGPT
+from ..parallel.pipeline_mpmd import MPMDAdam
+from .strategy import Strategy, StrategyModel
+
+
+def strategy_meshes(strat: Strategy, devices: Sequence[Any]
+                    ) -> List[List[Mesh]]:
+    """Build per-(pipeline, stage) submeshes from a solved Strategy's
+    device permutation (stage-major, pipeline, tp-minor ordering — see
+    StrategyModel._solve_one)."""
+    tp, pp, dp = strat.tp, strat.pp, strat.dp
+    out: List[List[Mesh]] = []
+    for p in range(dp):
+        stages = []
+        for s in range(pp):
+            ids = strat.device_order[(s * dp + p) * tp:
+                                     (s * dp + p + 1) * tp]
+            devs = np.array([devices[i] for i in ids]).reshape(1, tp)
+            stages.append(Mesh(devs, ("dp", "tp")))
+        out.append(stages)
+    return out
+
+
+class ElasticMPMDTrainer:
+    """Profile → re-solve → rebuild+migrate loop over MPMDGPT."""
+
+    def __init__(self, cfg: GPTConfig, solver: StrategyModel,
+                 data_provider: Callable[[int], Tuple[np.ndarray,
+                                                      np.ndarray]],
+                 devices: Optional[Sequence[Any]] = None,
+                 lr: float = 1e-3,
+                 schedule: str = "1f1b",
+                 switch_threshold: float = 0.05,
+                 seed: int = 0):
+        self.cfg = cfg
+        self.solver = solver
+        self.data_provider = data_provider
+        self.devices = list(devices) if devices is not None \
+            else jax.devices()[:solver.n]
+        assert len(self.devices) == solver.n
+        self.lr = lr
+        self.schedule = schedule
+        self.switch_threshold = switch_threshold
+        self.seed = seed
+        self.step_idx = 0
+        self.history: List[Dict[str, Any]] = []
+        strat = solver.make_plans([1.0] * solver.n, top_k=1)[0]
+        self.current_strategy: Strategy = strat
+        self.model: MPMDGPT = None  # set by _build
+        self.opt: MPMDAdam = None
+        self._build(strat, state=None, opt_state=None)
+
+    # -- layout (re)build ----------------------------------------------------
+
+    def _build(self, strat: Strategy,
+               state: Optional[Dict[str, Any]],
+               opt_state: Optional[Tuple[Dict, Dict, int]]) -> None:
+        meshes = strategy_meshes(strat, self.devices)
+        self.model = MPMDGPT(self.cfg, stage_layers=strat.stage_layers,
+                             meshes=meshes, schedule=self.schedule,
+                             seed=self.seed)
+        self.opt = MPMDAdam(self.model.runtime, lr=self.lr)
+        if state is not None:
+            self.model.load_state(state)
+        if opt_state is not None:
+            m_state, v_state, t = opt_state
+            self.model.load_state(m_state, extra=self.opt.m)
+            self.model.load_state(v_state, extra=self.opt.v)
+            self.opt.t = t
+        self.current_strategy = strat
+
+    def _gather_all(self):
+        state = self.model.gather_state()
+        m = self.model.gather_state(extra=self.opt.m)
+        v = self.model.gather_state(extra=self.opt.v)
+        return state, (m, v, self.opt.t)
+
+    # -- training ------------------------------------------------------------
+
+    def train_steps(self, steps: int) -> List[float]:
+        losses = []
+        strat = self.current_strategy
+        for _ in range(steps):
+            ids, labels = self.data_provider(self.step_idx)
+            data = self.model.split_micro_batches(ids, labels,
+                                                  strat.micro_batches)
+            loss, grads, _ = self.model.train_step(
+                data, rng=jax.random.PRNGKey(self.step_idx))
+            self.opt.apply(grads)
+            losses.append(float(loss))
+            self.step_idx += 1
+        return losses
+
+    # -- retune --------------------------------------------------------------
+
+    def retune(self, ratios: Sequence[float]) -> bool:
+        """Re-solve for straggler ratios; rebuild + migrate when the new
+        plan is sufficiently better.  Returns True on a switch."""
+        plans = self.solver.make_plans(ratios, top_k=1)
+        if not plans:
+            return False
+        best = plans[0]
+        cur = self.solver.estimate(self.current_strategy, ratios)
+        if best.est_step_time >= cur * (1 - self.switch_threshold):
+            return False
+        t0 = time.perf_counter()
+        state, opt_state = self._gather_all()
+        self._build(best, state=state, opt_state=opt_state)
+        self.history.append({
+            "step": self.step_idx,
+            "strategy": best.describe(),
+            "switch_seconds": time.perf_counter() - t0,
+        })
+        return True
+
+    def run(self, total_steps: int, retune_every: int = 0,
+            ratio_provider: Optional[Callable[[int], Sequence[float]]]
+            = None) -> List[float]:
+        losses: List[float] = []
+        while len(losses) < total_steps:
+            chunk = min(retune_every or total_steps,
+                        total_steps - len(losses))
+            losses += self.train_steps(chunk)
+            if retune_every and len(losses) < total_steps:
+                ratios = ratio_provider(self.step_idx) if ratio_provider \
+                    else [1.0] * self.solver.n
+                self.retune(ratios)
+        return losses

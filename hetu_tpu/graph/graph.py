@@ -396,9 +396,13 @@ class DefineByRunGraph(Graph):
         # cache every intermediate computed for this fetch (reference
         # GetOrCompute caches per-tensor): separate fetches then reuse
         # one consistent set of values instead of re-running upstream.
+        # Variable VALUES stay out of the cache — reset_variable /
+        # optimizer updates must be visible to later fetches.
         full_env: Dict[int, Any] = {}
         (val,) = self._eval_targets([t], env, out_env=full_env)
-        self._computed.update(full_env)
+        self._computed.update(
+            {k: v for k, v in full_env.items()
+             if k not in self._var_tensors})
         return val
 
     def feed(self, t: Tensor, value) -> None:

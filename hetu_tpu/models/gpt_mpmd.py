@@ -1,0 +1,432 @@
+"""Heterogeneous MPMD-pipelined GPT — real GPT-2 and LLaMA blocks.
+
+The model-side counterpart of
+:mod:`hetu_tpu.parallel.pipeline_mpmd`: builds per-stage pure forward
+functions + parameter pytrees for *unequal* per-stage layer ranges
+(Malleus ``Strategy.stage_layers``) and per-pipeline device submeshes.
+
+Unlike the SPMD stacked-stage path (``models/gpt_pipeline.py``, which
+requires homogeneous blocks), stages here are independent programs, so
+the full GPT-2 architecture is supported: gelu+bias, LayerNorm with
+bias, learned positions, GQA, dropout — plus the LLaMA variant
+(swiglu/rmsnorm/rotary).  Embedding lives on stage 0 and the LM head +
+loss on the last stage; with ``tie_embeddings`` the two stages carry the
+same logical ``wte`` whose grads are summed by key (the reference's
+shared-weight p2p handling, ``executable_graph.cc:2312-2453``).
+
+Parameters are keyed per *global layer index* ("layer7") so the elastic
+engine can re-partition stages and migrate state between layouts.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.pipeline_mpmd import MPMDPipelineRuntime, Stage
+from .gpt import GPTConfig
+
+# ---------------------------------------------------------------------------
+# pure block functions (GPT-2 and LLaMA variants)
+
+
+def _rotary_tables(seq_len: int, d: int):
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2, dtype=np.float32) / d))
+    ang = np.outer(np.arange(seq_len, dtype=np.float32), inv)
+    emb = np.concatenate([ang, ang], axis=-1)
+    return (jnp.asarray(np.cos(emb)[None, :, None, :]),
+            jnp.asarray(np.sin(emb)[None, :, None, :]))
+
+
+def _apply_rotary(x, cos, sin):
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    return x * cos.astype(x.dtype) + rot * sin.astype(x.dtype)
+
+
+def _norm_apply(cfg: GPTConfig, p: Dict[str, Any], x):
+    xf = x.astype(jnp.float32)
+    if cfg.norm == "rmsnorm":
+        out = xf * lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + 1e-6)
+        return (out * p["g"].astype(jnp.float32)).astype(x.dtype)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, -1, keepdims=True)
+    out = (xf - mu) * lax.rsqrt(var + 1e-5)
+    return (out * p["g"].astype(jnp.float32)
+            + p["b"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _dropout(x, rate: float, key):
+    if not rate or key is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def _wsc(v, mesh: Optional[Mesh], spec: P):
+    if mesh is None:
+        return v
+    return lax.with_sharding_constraint(v, NamedSharding(mesh, spec))
+
+
+def block_apply(cfg: GPTConfig, p: Dict[str, Any], x, key=None,
+                mesh: Optional[Mesh] = None):
+    """One transformer block, pure.  x: [b, s, h].
+
+    Honors cfg.norm / cfg.activation / cfg.position / GQA
+    (cfg.num_kv_heads) / cfg.dropout (needs ``key``) / biases (gelu
+    mode), i.e. actual GPT-2 as well as LLaMA blocks.
+    """
+    c = cfg
+    b, s, hdim = x.shape
+    nh, kvh, hd = c.num_heads, c.kv_heads, c.head_dim
+    bias = c.activation == "gelu"
+    k1 = k2 = k3 = None
+    if key is not None and c.dropout:
+        k1, k2, k3 = jax.random.split(key, 3)
+
+    h = _norm_apply(c, p["ln1"], x)
+    qkv = jnp.einsum("bsh,oh->bso", h, p["qkv"])
+    if bias:
+        qkv = qkv + p["qkv_b"]
+    qkv = _wsc(qkv, mesh, P("dp", None, "tp"))
+    q_size, kv_size = nh * hd, kvh * hd
+    q = qkv[..., :q_size].reshape(b, s, nh, hd)
+    k = qkv[..., q_size:q_size + kv_size].reshape(b, s, kvh, hd)
+    v = qkv[..., q_size + kv_size:].reshape(b, s, kvh, hd)
+    if c.position == "rotary":
+        cos, sin = _rotary_tables(s, hd)
+        q = _apply_rotary(q, cos, sin)
+        k = _apply_rotary(k, cos, sin)
+    if kvh != nh:  # GQA: broadcast kv heads over query groups
+        rep = nh // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    q = _wsc(q, mesh, P("dp", None, "tp", None))
+    k = _wsc(k, mesh, P("dp", None, "tp", None))
+    v = _wsc(v, mesh, P("dp", None, "tp", None))
+    # attention (causal), fp32 softmax
+    scale = 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+    probs = _dropout(probs, c.dropout, k1)
+    attn = jnp.einsum("bnqk,bknd->bqnd", probs, v).reshape(b, s, nh * hd)
+    attn = _wsc(attn, mesh, P("dp", None, "tp"))
+    out = jnp.einsum("bso,ho->bsh", attn, p["attn_out"])
+    if bias:
+        out = out + p["attn_out_b"]
+    out = _dropout(out, c.dropout, k2)
+    x = x + _wsc(out, mesh, P("dp", None, None))
+
+    h = _norm_apply(c, p["ln2"], x)
+    up = jnp.einsum("bsh,oh->bso", h, p["mlp_up"])
+    if bias:
+        up = up + p["mlp_up_b"]
+    up = _wsc(up, mesh, P("dp", None, "tp"))
+    if c.activation == "swiglu":
+        u1, u2 = jnp.split(up, 2, axis=-1)
+        act = jax.nn.silu(u1) * u2
+    elif c.activation == "gelu":
+        act = jax.nn.gelu(up, approximate=True)
+    elif c.activation == "relu":
+        act = jax.nn.relu(up)
+    else:
+        act = jax.nn.silu(up)
+    down = jnp.einsum("bso,ho->bsh", act, p["mlp_down"])
+    if bias:
+        down = down + p["mlp_down_b"]
+    down = _dropout(down, c.dropout, k3)
+    return x + _wsc(down, mesh, P("dp", None, None))
+
+
+def init_block_params(cfg: GPTConfig, rng: np.random.RandomState
+                      ) -> Dict[str, Any]:
+    c = cfg
+    h, f = c.hidden_size, c.ffn_size
+    nh, kvh, hd = c.num_heads, c.kv_heads, c.head_dim
+    bias = c.activation == "gelu"
+    mult = 2 if c.activation == "swiglu" else 1
+    depth_std = c.init_std / math.sqrt(2 * c.num_layers)
+    qkv_out = (nh + 2 * kvh) * hd
+
+    def w(shape, std):
+        return rng.normal(0.0, std, shape).astype(np.float32)
+
+    p: Dict[str, Any] = {
+        "ln1": {"g": np.ones(h, np.float32)},
+        "qkv": w((qkv_out, h), c.init_std),
+        "attn_out": w((h, nh * hd), depth_std),
+        "ln2": {"g": np.ones(h, np.float32)},
+        "mlp_up": w((mult * f, h), c.init_std),
+        "mlp_down": w((h, f), depth_std),
+    }
+    if c.norm == "layernorm":
+        p["ln1"]["b"] = np.zeros(h, np.float32)
+        p["ln2"]["b"] = np.zeros(h, np.float32)
+    if bias:
+        p["qkv_b"] = np.zeros(qkv_out, np.float32)
+        p["attn_out_b"] = np.zeros(h, np.float32)
+        p["mlp_up_b"] = np.zeros(mult * f, np.float32)
+        p["mlp_down_b"] = np.zeros(h, np.float32)
+    return p
+
+
+BLOCK_SPECS = {
+    "qkv": P("tp", None), "attn_out": P(None, "tp"),
+    "mlp_up": P("tp", None), "mlp_down": P(None, "tp"),
+    "qkv_b": P("tp"), "attn_out_b": P(), "mlp_up_b": P("tp"),
+    "mlp_down_b": P(),
+    "ln1": P(), "ln2": P(),
+}
+
+
+# ---------------------------------------------------------------------------
+# stage builders
+
+
+def _embed_apply(cfg: GPTConfig, p, ids, key):
+    x = jnp.take(p["wte"], ids, axis=0)
+    if cfg.position == "learned":
+        x = x + p["wpe"][: ids.shape[1]][None]
+    return _dropout(x, cfg.dropout, key)
+
+
+def _head_loss_apply(cfg: GPTConfig, p, x, labels, mesh):
+    x = _norm_apply(cfg, p["ln_f"], x)
+    logits = jnp.einsum("bsh,vh->bsv", x, p["wte_head"])
+    logits = _wsc(logits, mesh, P("dp", None, "tp"))
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    valid = (labels >= 0).astype(jnp.float32)
+    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+def _place_entry(v, mesh: Mesh, spec: P):
+    if isinstance(v, dict):
+        # norm params: small vectors, replicated
+        return {k: jax.device_put(np.asarray(vv), NamedSharding(mesh, P()))
+                for k, vv in v.items()}
+    return jax.device_put(np.asarray(v), NamedSharding(mesh, spec))
+
+
+def _place_stage(params: Dict[str, Any], mesh: Optional[Mesh],
+                 specs: Dict[str, P]) -> Dict[str, Any]:
+    """Put a stage's params on its submesh: block entries use
+    BLOCK_SPECS per weight, others the given spec (default replicated)."""
+    if mesh is None:
+        return jax.tree_util.tree_map(jnp.asarray, params)
+    out: Dict[str, Any] = {}
+    for name, sub in params.items():
+        if name.startswith("layer"):
+            out[name] = {k: _place_entry(v, mesh, BLOCK_SPECS.get(k, P()))
+                         for k, v in sub.items()}
+        else:
+            out[name] = _place_entry(sub, mesh, specs.get(name, P()))
+    return out
+
+
+class MPMDGPT:
+    """GPT over the MPMD pipeline runtime with hetero stage layouts.
+
+    ``stage_layers[p]`` — layers per stage for pipeline ``p`` (sums to
+    cfg.num_layers); ``meshes[p][s]`` — submesh per stage (axes
+    ("dp","tp"); None = default device).  Parameter entries are keyed
+    "layerN" / "wte" / "wpe" / "ln_f" / "head" so grads reduce correctly
+    across pipelines and (for the tied wte) across first/last stages.
+    """
+
+    def __init__(self, cfg: GPTConfig,
+                 stage_layers: Sequence[Sequence[int]],
+                 meshes: Optional[Sequence[Sequence[Optional[Mesh]]]] = None,
+                 schedule: str = "1f1b",
+                 seed: int = 0):
+        self.cfg = cfg
+        self.stage_layers = [list(sl) for sl in stage_layers]
+        P_n = len(self.stage_layers)
+        S = len(self.stage_layers[0])
+        assert all(len(sl) == S for sl in self.stage_layers)
+        assert all(sum(sl) == cfg.num_layers for sl in self.stage_layers)
+        assert all(all(n >= 1 for n in sl) for sl in self.stage_layers)
+        if meshes is None:
+            meshes = [[None] * S for _ in range(P_n)]
+        self.meshes = meshes
+
+        # one canonical init (shared across pipelines: DP replicas)
+        rng = np.random.RandomState(seed)
+        layer_params = [init_block_params(cfg, rng)
+                        for _ in range(cfg.num_layers)]
+        wte = rng.normal(0.0, cfg.init_std,
+                         (cfg.vocab_size, cfg.hidden_size)).astype(np.float32)
+        wpe = rng.normal(0.0, cfg.init_std,
+                         (cfg.max_seq_len, cfg.hidden_size)).astype(np.float32)
+        head = wte if cfg.tie_embeddings else \
+            rng.normal(0.0, cfg.init_std,
+                       (cfg.vocab_size, cfg.hidden_size)).astype(np.float32)
+        ln_f = {"g": np.ones(cfg.hidden_size, np.float32)}
+        if cfg.norm == "layernorm":
+            ln_f["b"] = np.zeros(cfg.hidden_size, np.float32)
+
+        pipes: List[List[Stage]] = []
+        self.layer_keys: List[List[Dict[str, Any]]] = []
+        for p in range(P_n):
+            stages: List[Stage] = []
+            keys_per_stage: List[Dict[str, Any]] = []
+            lo = 0
+            for s, n in enumerate(self.stage_layers[p]):
+                mesh = self.meshes[p][s]
+                lrange = list(range(lo, lo + n))
+                lo += n
+                params: Dict[str, Any] = {}
+                keys: Dict[str, Any] = {}
+                specs: Dict[str, P] = {}
+                for li in lrange:
+                    params[f"layer{li}"] = layer_params[li]
+                    keys[f"layer{li}"] = f"layer{li}"
+                if s == 0:
+                    params["wte"] = wte
+                    keys["wte"] = "wte"
+                    specs["wte"] = P("tp", None)
+                    if cfg.position == "learned":
+                        params["wpe"] = wpe
+                        keys["wpe"] = "wpe"
+                last = s == S - 1
+                if last:
+                    params["ln_f"] = ln_f
+                    keys["ln_f"] = "ln_f"
+                    params["wte_head"] = head
+                    keys["wte_head"] = "wte" if cfg.tie_embeddings \
+                        else "head"
+                    specs["wte_head"] = P("tp", None)
+                placed = _place_stage(params, mesh, specs)
+                fwd = self._make_stage_fwd(lrange, first=(s == 0),
+                                           last=last, mesh=mesh)
+                stages.append(Stage(
+                    fwd, placed, mesh=mesh,
+                    act_spec=P("dp", None, None) if s else P("dp", None),
+                    is_last=last))
+                keys_per_stage.append(keys)
+            pipes.append(stages)
+            self.layer_keys.append(keys_per_stage)
+        self.runtime = MPMDPipelineRuntime(pipes, schedule=schedule)
+
+    def _make_stage_fwd(self, lrange: List[int], first: bool, last: bool,
+                        mesh: Optional[Mesh]):
+        cfg = self.cfg
+
+        if last:
+            def fwd(params, x, labels, rng):
+                if first:  # S == 1
+                    x = _embed_apply(cfg, params, x,
+                                     jax.random.fold_in(rng, 997)
+                                     if cfg.dropout else None)
+                for i, li in enumerate(lrange):
+                    key = jax.random.fold_in(rng, li) if cfg.dropout \
+                        else None
+                    x = block_apply(cfg, params[f"layer{li}"], x, key, mesh)
+                return _head_loss_apply(cfg, params, x, labels, mesh)
+            return fwd
+
+        def fwd(params, x, rng):
+            if first:
+                x = _embed_apply(cfg, params, x,
+                                 jax.random.fold_in(rng, 997)
+                                 if cfg.dropout else None)
+            for li in lrange:
+                key = jax.random.fold_in(rng, li) if cfg.dropout else None
+                x = block_apply(cfg, params[f"layer{li}"], x, key, mesh)
+            return x
+        return fwd
+
+    # -- training ------------------------------------------------------------
+
+    def split_micro_batches(self, ids: np.ndarray, labels: np.ndarray,
+                            micro_batches: Sequence[int]
+                            ) -> List[List[Tuple[Any, Any]]]:
+        """Apportion the global batch into per-pipeline micro-batch lists
+        (Malleus unequal counts); every micro-batch has equal size."""
+        M_total = sum(micro_batches)
+        assert ids.shape[0] % M_total == 0, \
+            f"batch {ids.shape[0]} not divisible by {M_total} micro-batches"
+        mb = ids.shape[0] // M_total
+        data: List[List[Tuple[Any, Any]]] = []
+        off = 0
+        for p, m_p in enumerate(micro_batches):
+            lst = []
+            mesh = self.meshes[p][0]
+            for _ in range(m_p):
+                x = jnp.asarray(ids[off:off + mb])
+                y = jnp.asarray(labels[off:off + mb])
+                if mesh is not None:
+                    sh = NamedSharding(mesh, P("dp", None))
+                    x = jax.device_put(x, sh)
+                ly_mesh = self.meshes[p][-1]
+                if ly_mesh is not None:
+                    y = jax.device_put(y, NamedSharding(ly_mesh,
+                                                        P("dp", None)))
+                lst.append((x, y))
+                off += mb
+            data.append(lst)
+        return data
+
+    def train_step(self, data, rng=None):
+        from ..parallel.pipeline_mpmd import reduce_layer_grads
+        loss, grads, stats = self.runtime.train_step(data, rng=rng)
+        # sums across pipelines per layer key AND across first/last stage
+        # for the tied wte (same "wte" key on both entries)
+        grads = reduce_layer_grads(self.runtime, grads, self.layer_keys)
+        return loss, grads, stats
+
+    # -- state migration (elastic re-layout) ---------------------------------
+
+    def _entry_spec(self, name: str) -> P:
+        if name in ("wte", "wte_head"):
+            return P("tp", None)
+        return P()
+
+    def gather_state(self, extra: Optional[List[List[Any]]] = None
+                     ) -> Dict[str, Any]:
+        """Host snapshot keyed by canonical parameter key (pipe 0 copy;
+        all copies are kept identical).  ``extra`` optionally gathers a
+        parallel structure (e.g. optimizer moments) with the same keys."""
+        src = extra if extra is not None else \
+            [[st.params for st in pipe] for pipe in self.runtime.pipes]
+        out: Dict[str, Any] = {}
+        for s, keys in enumerate(self.layer_keys[0]):
+            for name, key in keys.items():
+                if key is not None and key not in out:
+                    out[key] = jax.device_get(src[0][s][name])
+        return out
+
+    def load_state(self, state: Dict[str, Any],
+                   extra: Optional[List[List[Any]]] = None) -> None:
+        """Place a :meth:`gather_state` snapshot onto every pipe/stage
+        copy (the hot-switch migration: reference SwitchExecGraph's
+        param resharding, switch_exec_graph.h:459)."""
+        dst = extra if extra is not None else \
+            [[st.params for st in pipe] for pipe in self.runtime.pipes]
+        for p, pipe in enumerate(self.runtime.pipes):
+            for s, stage in enumerate(pipe):
+                keys = self.layer_keys[p][s]
+                for name, key in keys.items():
+                    if key is None or key not in state:
+                        continue
+                    val = state[key]
+                    if stage.mesh is None:
+                        placed = jax.tree_util.tree_map(jnp.asarray, val)
+                    elif name.startswith("layer"):
+                        placed = {k: _place_entry(v, stage.mesh,
+                                                  BLOCK_SPECS.get(k, P()))
+                                  for k, v in val.items()}
+                    else:
+                        placed = _place_entry(val, stage.mesh,
+                                              self._entry_spec(name))
+                    dst[p][s][name] = placed

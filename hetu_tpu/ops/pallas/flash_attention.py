@@ -43,19 +43,27 @@ SUBLANES = 8     # second-to-last tile width (f32/int32)
 
 
 def _padded_segs(segment_ids, b, h, sq, sk):
-    """Broadcast [b, s] segment ids into TPU-tileable layouts:
-    q side [bh, sq, LANES], kv side [bh, SUBLANES, sk] (stock-kernel trick)."""
+    """Broadcast segment ids into TPU-tileable layouts: q side
+    [bh, sq, LANES], kv side [bh, SUBLANES, sk] (stock-kernel trick).
+
+    ``segment_ids`` is either a [b, sq] array (shared q/kv — requires
+    sq == sk) or a tuple ``(q_ids [b, sq], kv_ids [b, sk])`` — the ring
+    attention case where the visiting KV block carries its own ids.
+    """
     if segment_ids is None:
         q_segs = jnp.zeros((b * h, sq, LANES), jnp.int32)
         kv_segs = jnp.zeros((b * h, SUBLANES, sk), jnp.int32)
         return q_segs, kv_segs
-    flat_q = jnp.repeat(segment_ids[:, None, :], h, axis=1).reshape(b * h, sq)
-    q_segs = jnp.broadcast_to(flat_q[:, :, None], (b * h, sq, LANES))
-    if sq == sk:
-        flat_kv = flat_q
+    if isinstance(segment_ids, (tuple, list)):
+        q_ids, kv_ids = segment_ids
     else:
-        raise NotImplementedError(
-            "segment_ids with sq != sk needs a separate kv_segment_ids")
+        if sq != sk:
+            raise NotImplementedError(
+                "segment_ids with sq != sk needs a (q_ids, kv_ids) tuple")
+        q_ids = kv_ids = segment_ids
+    flat_q = jnp.repeat(q_ids[:, None, :], h, axis=1).reshape(b * h, sq)
+    q_segs = jnp.broadcast_to(flat_q[:, :, None], (b * h, sq, LANES))
+    flat_kv = jnp.repeat(kv_ids[:, None, :], h, axis=1).reshape(b * h, sk)
     kv_segs = jnp.broadcast_to(flat_kv[:, None, :], (b * h, SUBLANES, sk))
     return q_segs, kv_segs
 
@@ -77,8 +85,8 @@ def _block_sizes(s: int, d: int, dtype) -> Tuple[int, int]:
 def _fwd_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,  # inputs
                 o_ref, lse_ref,                              # outputs
                 acc_ref, m_ref, l_ref,                       # scratch
-                *, scale: float, causal: bool, bq: int, bk: int,
-                num_kv: int, use_segs: bool):
+                *, scale: float, causal: bool, offset: int, bq: int,
+                bk: int, num_kv: int, use_segs: bool):
     kv_idx = pl.program_id(2)
     q_idx = pl.program_id(1)
 
@@ -88,10 +96,12 @@ def _fwd_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,  # inputs
         m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # block-level causal skip: kv block strictly after q block -> no work
+    # block-level causal skip: kv block strictly after q block -> no
+    # work (offset shifts the diagonal right: rows are offset global
+    # positions ahead of cols — the SYM tail-half case)
     run = True
     if causal:
-        run = kv_idx * bk <= q_idx * bq + bq - 1
+        run = kv_idx * bk <= q_idx * bq + bq - 1 + offset
 
     @pl.when(run)
     def _compute():
@@ -103,7 +113,7 @@ def _fwd_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,  # inputs
         if causal:
             rows = q_idx * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = kv_idx * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(cols <= rows, s, DEFAULT_MASK_VALUE)
+            s = jnp.where(cols <= rows + offset, s, DEFAULT_MASK_VALUE)
         if use_segs:
             qs = q_seg_ref[0, :, 0]        # [bq] (lane-padded layout)
             ks = kv_seg_ref[0, 0, :]       # [bk] (sublane-padded layout)
@@ -130,7 +140,7 @@ def _fwd_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,  # inputs
         lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, scale, causal, segment_ids):
+def _flash_fwd(q, k, v, scale, causal, segment_ids, causal_offset=0):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
@@ -144,8 +154,8 @@ def _flash_fwd(q, k, v, scale, causal, segment_ids):
     q_segs, kv_segs = _padded_segs(segment_ids, b, h, sq, sk)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
-        num_kv=num_kv, use_segs=use_segs)
+        _fwd_kernel, scale=scale, causal=causal, offset=causal_offset,
+        bq=bq, bk=bk, num_kv=num_kv, use_segs=use_segs)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -183,7 +193,7 @@ def _flash_fwd(q, k, v, scale, causal, segment_ids):
 
 def _bwd_dq_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
                    lse_ref, delta_ref, dq_ref, dq_acc,
-                   *, scale, causal, bq, bk, num_kv, use_segs):
+                   *, scale, causal, offset, bq, bk, num_kv, use_segs):
     kv_idx = pl.program_id(2)
     q_idx = pl.program_id(1)
 
@@ -193,7 +203,7 @@ def _bwd_dq_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
 
     run = True
     if causal:
-        run = kv_idx * bk <= q_idx * bq + bq - 1
+        run = kv_idx * bk <= q_idx * bq + bq - 1 + offset
 
     @pl.when(run)
     def _compute():
@@ -206,7 +216,7 @@ def _bwd_dq_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
         if causal:
             rows = q_idx * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = kv_idx * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(cols <= rows, s, DEFAULT_MASK_VALUE)
+            s = jnp.where(cols <= rows + offset, s, DEFAULT_MASK_VALUE)
         if use_segs:
             seg_ok = q_seg_ref[0, :, 0][:, None] == kv_seg_ref[0, 0, :][None, :]
             s = jnp.where(seg_ok, s, DEFAULT_MASK_VALUE)
@@ -228,7 +238,7 @@ def _bwd_dq_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
 
 def _bwd_dkv_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
                     lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, causal, bq, bk, num_q, use_segs):
+                    *, scale, causal, offset, bq, bk, num_q, use_segs):
     q_idx = pl.program_id(2)
     kv_idx = pl.program_id(1)
 
@@ -240,7 +250,7 @@ def _bwd_dkv_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
     run = True
     if causal:
         # q block strictly before kv block -> fully masked
-        run = q_idx * bq + bq - 1 >= kv_idx * bk
+        run = q_idx * bq + bq - 1 + offset >= kv_idx * bk
 
     @pl.when(run)
     def _compute():
@@ -253,7 +263,7 @@ def _bwd_dkv_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
         if causal:
             rows = q_idx * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = kv_idx * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(cols <= rows, s, DEFAULT_MASK_VALUE)
+            s = jnp.where(cols <= rows + offset, s, DEFAULT_MASK_VALUE)
         if use_segs:
             seg_ok = q_seg_ref[0, :, 0][:, None] == kv_seg_ref[0, 0, :][None, :]
             s = jnp.where(seg_ok, s, DEFAULT_MASK_VALUE)
@@ -277,7 +287,7 @@ def _bwd_dkv_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(scale, causal, segment_ids, res, g):
+def _flash_bwd(scale, causal, segment_ids, res, g, causal_offset=0):
     q, k, v, out, lse = res
     do = g[0] if isinstance(g, (tuple, list)) else g
     b, sq, h, d = q.shape
@@ -301,8 +311,8 @@ def _flash_bwd(scale, causal, segment_ids, res, g):
     q_segs, kv_segs = _padded_segs(segment_ids, b, h, sq, sk)
 
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
-        num_kv=num_kv, use_segs=use_segs)
+        _bwd_dq_kernel, scale=scale, causal=causal, offset=causal_offset,
+        bq=bq, bk=bk, num_kv=num_kv, use_segs=use_segs)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b * h, num_q, num_kv),
@@ -323,8 +333,8 @@ def _flash_bwd(scale, causal, segment_ids, res, g):
     )(q_segs, kv_segs, qr, kr, vr, dor, lser, delta)
 
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
-        num_q=num_q, use_segs=use_segs)
+        _bwd_dkv_kernel, scale=scale, causal=causal, offset=causal_offset,
+        bq=bq, bk=bk, num_q=num_q, use_segs=use_segs)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(b * h, num_kv, num_q),

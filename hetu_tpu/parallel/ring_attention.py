@@ -10,10 +10,26 @@ visiting KV, merging partial results with online log-sum-exp correction
 per-round kernels the way the reference overlaps its comm/attn CUDA
 streams via events.
 
-Per-pair mask classes mirror ``AttnMask`` CAUSAL/FULL/EMPTY
-(``ParallelAttention.h:25``) for the NORMAL (contiguous) split pattern;
-the backward ring piggybacks dKV accumulators around the ring exactly one
-full cycle so they land home (reference grad piggyback, ``.cc:781``).
+Split patterns (reference ``SplitPattern`` NORMAL/SYM,
+``ParallelAttention.h:19``, env ``HETU_PARALLEL_ATTN_SPLIT_PATTERN``):
+
+- ``normal`` — contiguous split.  Under a causal mask the per-pair
+  classes are CAUSAL/FULL/EMPTY and the *last* rank does ~cp× the work
+  of rank 0 (the imbalance SYM exists to kill).
+- ``sym`` — symmetric (head+tail) split: the global sequence is cut into
+  ``2·cp`` chunks and rank i holds chunks ``(i, 2cp-1-i)``.  Per-pair
+  masks then fall into the reference's five classes
+  (``AttnMask`` CAUSAL/ROW/COL/EMPTY/FULL, ``.cc:140-200``): the pair
+  with itself is the composite causal (head-causal / tail-sees-head /
+  tail-causal), earlier ranks' KV is visible only in its head half
+  (COL), later ranks only to the tail Q half (ROW) — every (rank, round)
+  does exactly ``s_local²/2`` score work, i.e. perfectly balanced.
+
+Variable per-rank sequence lengths (reference ``_seq_len_list``) and
+packed/varlen sequences ride the same mechanism: local segment ids
+(global doc ids, ``-1`` = padding) travel the ring *with* their KV block
+and mask score entries whose q/kv ids differ — supported in the NORMAL
+pattern, where contiguity keeps global causal order per segment.
 
 Usage: inside ``shard_map`` with the sequence dim sharded over
 ``axis_name``; or via :func:`ring_attention_sharded` which wraps the
@@ -27,10 +43,15 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
-from ..ops.pallas.flash_attention import (_flash_bwd, _flash_fwd,
-                                          flash_attention_with_lse)
+from ..ops.pallas.flash_attention import _flash_bwd, _flash_fwd
+
+# pair-mask classes (reference AttnMask, ParallelAttention.h:25);
+# at runtime they are compressed into per-pattern 0..2 branch indices
+# (see _mask_kind) so only reachable branches compile
+CAUSAL, FULL, EMPTY, CAUSAL_SYM, COL, ROW = range(6)
 
 
 def _merge(acc, o_r, lse_r):
@@ -52,80 +73,168 @@ def _merge(acc, o_r, lse_r):
     return m_new, denom_new, out_new
 
 
-def _pair_fwd(q, k, v, scale, mask_kind):
-    """(out, lse) of one (q-rank, kv-rank) pair; mask_kind 0=causal 1=full
-    2=empty."""
+def _pair_fwd(q, k, v, scale, mask_kind, segs, pattern, causal):
+    """(out, lse) of one (q-rank, kv-rank) pair.
+
+    ``mask_kind`` is a 0..2 class index whose meaning depends on the
+    static ``pattern`` (normal: CAUSAL/FULL/EMPTY; sym:
+    CAUSAL_SYM/COL/ROW) so only the three reachable branches compile;
+    ``segs`` is None or a ``(q_ids [b,s], kv_ids [b,s])`` tuple (NORMAL
+    pattern only).
+    """
     b, s, h, d = q.shape
+    sh = s // 2
 
     def causal_fn(_):
-        o, lse = _flash_fwd(q, k, v, scale, True, None)
+        o, lse = _flash_fwd(q, k, v, scale, True, segs)
         return o.astype(jnp.float32), lse  # branch dtypes must match empty_fn
 
     def full_fn(_):
-        o, lse = _flash_fwd(q, k, v, scale, False, None)
+        o, lse = _flash_fwd(q, k, v, scale, False, segs)
         return o.astype(jnp.float32), lse
 
     def empty_fn(_):
         return (jnp.zeros((b, s, h, d), jnp.float32),
                 jnp.full((b, h, s), -jnp.inf, jnp.float32))
 
-    return lax.switch(mask_kind, [causal_fn, full_fn, empty_fn], None)
+    def causal_sym_fn(_):
+        # [[causal, empty], [full, causal]] on (head, tail) halves:
+        # qh vs kh causal; qt vs full kv causal shifted by sh
+        o1, l1 = _flash_fwd(q[:, :sh], k[:, :sh], v[:, :sh], scale, True,
+                            None)
+        o2, l2 = _flash_fwd(q[:, sh:], k, v, scale, True, None,
+                            causal_offset=sh)
+        return (jnp.concatenate([o1, o2], axis=1).astype(jnp.float32),
+                jnp.concatenate([l1, l2], axis=2))
+
+    def col_fn(_):
+        # all q rows see only the kv head half (earlier chunk)
+        o, lse = _flash_fwd(q, k[:, :sh], v[:, :sh], scale, False, None)
+        return o.astype(jnp.float32), lse
+
+    def row_fn(_):
+        # only the q tail half sees this (later) rank's kv
+        o2, l2 = _flash_fwd(q[:, sh:], k, v, scale, False, None)
+        o = jnp.concatenate(
+            [jnp.zeros((b, sh, h, d), jnp.float32), o2.astype(jnp.float32)],
+            axis=1)
+        lse = jnp.concatenate(
+            [jnp.full((b, h, sh), -jnp.inf, jnp.float32), l2], axis=2)
+        return o, lse
+
+    if not causal:
+        return full_fn(None)
+    branches = [causal_sym_fn, col_fn, row_fn] if pattern == "sym" \
+        else [causal_fn, full_fn, empty_fn]
+    return lax.switch(mask_kind, branches, None)
 
 
-def _pair_bwd(q, k, v, do, out, lse, scale, mask_kind):
-    """dq, dk, dv of one pair given global lse; empty pairs short-circuit."""
+def _pair_bwd(q, k, v, do, out, lse, scale, mask_kind, segs, pattern,
+              causal):
+    """dq, dk, dv of one pair given global lse; empty pairs short-circuit.
+    Branch selection mirrors :func:`_pair_fwd`."""
+    b, s, h, d = q.shape
+    sh = s // 2
+
     def causal_fn(_):
-        return _flash_bwd(scale, True, None, (q, k, v, out, lse), do)
+        return _flash_bwd(scale, True, segs, (q, k, v, out, lse), do)
 
     def full_fn(_):
-        return _flash_bwd(scale, False, None, (q, k, v, out, lse), do)
+        return _flash_bwd(scale, False, segs, (q, k, v, out, lse), do)
 
     def empty_fn(_):
         return (jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v))
 
-    return lax.switch(mask_kind, [causal_fn, full_fn, empty_fn], None)
+    def causal_sym_fn(_):
+        dq1, dk1, dv1 = _flash_bwd(
+            scale, True, None,
+            (q[:, :sh], k[:, :sh], v[:, :sh], out[:, :sh], lse[:, :, :sh]),
+            do[:, :sh])
+        dq2, dk2, dv2 = _flash_bwd(
+            scale, True, None,
+            (q[:, sh:], k, v, out[:, sh:], lse[:, :, sh:]),
+            do[:, sh:], causal_offset=sh)
+        dq = jnp.concatenate([dq1, dq2], axis=1)
+        pad = jnp.zeros((b, sh, h, d), dk1.dtype)
+        dk = jnp.concatenate([dk1, pad], axis=1) + dk2
+        dv = jnp.concatenate([dv1, pad], axis=1) + dv2
+        return dq, dk, dv
 
+    def col_fn(_):
+        dq, dkh, dvh = _flash_bwd(
+            scale, False, None,
+            (q, k[:, :sh], v[:, :sh], out, lse), do)
+        pad = jnp.zeros((b, s - sh, h, d), dkh.dtype)
+        return (dq, jnp.concatenate([dkh, pad], axis=1),
+                jnp.concatenate([dvh, pad], axis=1))
 
-def _mask_kind(my_rank, kv_rank, causal: bool):
-    """NORMAL split pattern: earlier ranks' KV fully visible, own rank
-    causal, later ranks empty (ParallelAttention.h:25 CAUSAL/FULL/EMPTY)."""
+    def row_fn(_):
+        dq2, dk, dv = _flash_bwd(
+            scale, False, None,
+            (q[:, sh:], k, v, out[:, sh:], lse[:, :, sh:]), do[:, sh:])
+        dq = jnp.concatenate(
+            [jnp.zeros((b, sh, h, d), dq2.dtype), dq2], axis=1)
+        return dq, dk, dv
+
     if not causal:
-        return jnp.int32(1)
+        return full_fn(None)
+    branches = [causal_sym_fn, col_fn, row_fn] if pattern == "sym" \
+        else [causal_fn, full_fn, empty_fn]
+    return lax.switch(mask_kind, branches, None)
+
+
+def _mask_kind(my_rank, kv_rank, causal: bool, pattern: str):
+    """Classify the (q-rank, kv-rank) pair into a 0..2 branch index
+    (reference GenerateAttnInfo, ParallelAttention.cc:140-200): under
+    "normal" 0/1/2 = CAUSAL/FULL/EMPTY, under "sym" = CAUSAL_SYM/COL/ROW
+    — in both patterns self-pair / earlier-rank / later-rank."""
+    if not causal:
+        return jnp.int32(0)  # unused: _pair_* short-circuit to full
     return jnp.where(kv_rank == my_rank, 0,
                      jnp.where(kv_rank < my_rank, 1, 2)).astype(jnp.int32)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_attn(q, k, v, axis_name, scale, causal):
-    out, _ = _ring_fwd_impl(q, k, v, axis_name, scale, causal)
+def _ring_segs(q_ids, kv_ids, use_segs):
+    return (q_ids, kv_ids) if use_segs else None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ring_attn(q, k, v, seg_ids, axis_name, scale, causal, pattern,
+               use_segs):
+    out, _ = _ring_fwd_impl(q, k, v, seg_ids, axis_name, scale, causal,
+                            pattern, use_segs)
     return out
 
 
-def _ring_fwd_impl(q, k, v, axis_name, scale, causal):
+def _ring_fwd_impl(q, k, v, seg_ids, axis_name, scale, causal, pattern,
+                   use_segs):
     cp = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s, h, d = q.shape
     perm = [(i, (i + 1) % cp) for i in range(cp)]
+    # kv-side ids: padding (-1) maps to -2 so q-pad never matches kv-pad
+    kv_ids0 = jnp.where(seg_ids < 0, -2, seg_ids)
 
     def body(r, carry):
-        (k_cur, v_cur), acc = carry
+        (k_cur, v_cur, kvseg_cur), acc = carry
         kv_rank = (my - r) % cp
-        kind = _mask_kind(my, kv_rank, causal)
-        o_r, lse_r = _pair_fwd(q, k_cur, v_cur, scale, kind)
+        kind = _mask_kind(my, kv_rank, causal, pattern)
+        o_r, lse_r = _pair_fwd(q, k_cur, v_cur, scale, kind,
+                               _ring_segs(seg_ids, kvseg_cur, use_segs),
+                               pattern, causal)
         acc = _merge(acc, o_r, lse_r)
-        # rotate KV to the next rank (skippable on last round, but keeping
-        # it makes the loop uniform; XLA overlaps it with the next round)
+        # rotate KV (and its segment ids) to the next rank (reference
+        # BatchedISendIRecv ring); XLA overlaps with the next round
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt), acc
+        s_nxt = lax.ppermute(kvseg_cur, axis_name, perm)
+        return (k_nxt, v_nxt, s_nxt), acc
 
     init_acc = (jnp.full((b, h, s), -jnp.inf, jnp.float32),   # m
                 jnp.zeros((b, h, s), jnp.float32),            # denom
                 jnp.zeros((b, s, h, d), jnp.float32))         # out (bqhd)
-    # note: out accum uses [b, s, h, d] but m/denom use [b, h, s]; transpose
-    # lse-space corrections into out-space on the fly inside _merge
-    (_, _), (m, denom, out_acc) = lax.fori_loop(
-        0, cp, body, ((k, v), init_acc))
+    (_, _, _), (m, denom, out_acc) = lax.fori_loop(
+        0, cp, body, ((k, v, kv_ids0), init_acc))
     safe = jnp.where(denom == 0.0, 1.0, denom)
     # denom is [b, h, s]; out_acc is [b, s, h, d]
     out = out_acc / safe.transpose(0, 2, 1)[..., None]
@@ -133,23 +242,27 @@ def _ring_fwd_impl(q, k, v, axis_name, scale, causal):
     return out.astype(q.dtype), lse
 
 
-def _ring_fwd_rule(q, k, v, axis_name, scale, causal):
-    out, lse = _ring_fwd_impl(q, k, v, axis_name, scale, causal)
-    return out, (q, k, v, out, lse)
+def _ring_fwd_rule(q, k, v, seg_ids, axis_name, scale, causal, pattern,
+                   use_segs):
+    out, lse = _ring_fwd_impl(q, k, v, seg_ids, axis_name, scale, causal,
+                              pattern, use_segs)
+    return out, (q, k, v, seg_ids, out, lse)
 
 
-def _ring_bwd_rule(axis_name, scale, causal, res, do):
-    q, k, v, out, lse = res
+def _ring_bwd_rule(axis_name, scale, causal, pattern, use_segs, res, do):
+    q, k, v, seg_ids, out, lse = res
     cp = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
+    kv_ids0 = jnp.where(seg_ids < 0, -2, seg_ids)
 
     def body(r, carry):
-        (k_cur, v_cur), (dk_cur, dv_cur), dq_acc = carry
+        (k_cur, v_cur, kvseg_cur), (dk_cur, dv_cur), dq_acc = carry
         kv_rank = (my - r) % cp
-        kind = _mask_kind(my, kv_rank, causal)
-        dq_c, dk_c, dv_c = _pair_bwd(q, k_cur, v_cur, do, out, lse,
-                                     scale, kind)
+        kind = _mask_kind(my, kv_rank, causal, pattern)
+        dq_c, dk_c, dv_c = _pair_bwd(
+            q, k_cur, v_cur, do, out, lse, scale, kind,
+            _ring_segs(seg_ids, kvseg_cur, use_segs), pattern, causal)
         dq_acc = dq_acc + dq_c.astype(jnp.float32)
         dk_cur = dk_cur + dk_c.astype(jnp.float32)
         dv_cur = dv_cur + dv_c.astype(jnp.float32)
@@ -157,51 +270,176 @@ def _ring_bwd_rule(axis_name, scale, causal, res, do):
         # after cp shifts they arrive back at the owning rank
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        s_nxt = lax.ppermute(kvseg_cur, axis_name, perm)
         dk_nxt = lax.ppermute(dk_cur, axis_name, perm)
         dv_nxt = lax.ppermute(dv_cur, axis_name, perm)
-        return (k_nxt, v_nxt), (dk_nxt, dv_nxt), dq_acc
+        return (k_nxt, v_nxt, s_nxt), (dk_nxt, dv_nxt), dq_acc
 
-    init = ((k, v), (jnp.zeros(k.shape, jnp.float32),
-                     jnp.zeros(v.shape, jnp.float32)),
+    init = ((k, v, kv_ids0), (jnp.zeros(k.shape, jnp.float32),
+                              jnp.zeros(v.shape, jnp.float32)),
             jnp.zeros(q.shape, jnp.float32))
     (_, (dk, dv), dq) = lax.fori_loop(0, cp, body, init)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            np.zeros(seg_ids.shape, jax.dtypes.float0))
 
 
 _ring_attn.defvjp(_ring_fwd_rule, _ring_bwd_rule)
 
 
+# ---------------------------------------------------------------------------
+# SYM layout helpers
+
+
+def sym_indices(s_global: int, cp: int) -> np.ndarray:
+    """Permutation putting the global sequence into SYM ring layout:
+    2·cp chunks, rank i's shard = [chunk i, chunk 2cp-1-i]."""
+    assert s_global % (2 * cp) == 0, \
+        f"seq {s_global} not divisible by 2*cp={2 * cp}"
+    ch = s_global // (2 * cp)
+    idx = []
+    for i in range(cp):
+        idx.extend(range(i * ch, (i + 1) * ch))
+        idx.extend(range((2 * cp - 1 - i) * ch, (2 * cp - i) * ch))
+    return np.asarray(idx, dtype=np.int64)
+
+
+def sym_inverse_indices(s_global: int, cp: int) -> np.ndarray:
+    fwd = sym_indices(s_global, cp)
+    inv = np.empty_like(fwd)
+    inv[fwd] = np.arange(s_global)
+    return inv
+
+
+def sym_shard(x, cp: int, axis: int = 1):
+    """Reorder a GLOBAL array so contiguous cp-sharding yields the SYM
+    layout (apply before feeding a seq-sharded pjit/shard_map)."""
+    return jnp.take(x, jnp.asarray(sym_indices(x.shape[axis], cp)),
+                    axis=axis)
+
+
+def sym_unshard(x, cp: int, axis: int = 1):
+    return jnp.take(x, jnp.asarray(sym_inverse_indices(x.shape[axis], cp)),
+                    axis=axis)
+
+
+def pair_score_area(cp: int, pattern: str, causal: bool = True
+                    ) -> np.ndarray:
+    """Relative attention-score work per (rank, round), in units of
+    (s_local)² — the balance diagnostic the tests assert on.  Under
+    NORMAL+causal the last rank does ~cp× rank 0's work; under SYM every
+    entry is 0.5."""
+    area = np.zeros((cp, cp))
+    for i in range(cp):
+        for r in range(cp):
+            j = (i - r) % cp
+            if not causal:
+                area[i, r] = 1.0
+            elif pattern == "sym":
+                area[i, r] = 0.5  # CAUSAL_SYM, COL and ROW all cover half
+            else:
+                area[i, r] = 0.5 if j == i else (1.0 if j < i else 0.0)
+    return area
+
+
+# ---------------------------------------------------------------------------
+# public API
+
+
 def ring_attention(q, k, v, axis_name: str = "cp", causal: bool = True,
-                   softmax_scale: Optional[float] = None) -> jax.Array:
+                   softmax_scale: Optional[float] = None,
+                   split_pattern: str = "normal",
+                   segment_ids: Optional[jax.Array] = None,
+                   seq_len: Optional[jax.Array] = None) -> jax.Array:
     """Ring attention on sequence-sharded [b, s_local, h, d] inputs.
 
     Must be called inside shard_map/pjit with ``axis_name`` in scope.
+
+    ``split_pattern``: "normal" (contiguous) or "sym" (symmetric causal
+    load balancing; shard with :func:`sym_shard`).
+    ``segment_ids``: local [b, s_local] global doc ids for packed
+    sequences; ``-1`` marks padding (NORMAL pattern only).
+    ``seq_len``: this rank's valid length (scalar; positions >= seq_len
+    are padding) — the reference's per-rank ``_seq_len_list``.  May be
+    combined with ``segment_ids``.
     """
     scale = softmax_scale if softmax_scale is not None \
         else 1.0 / math.sqrt(q.shape[-1])
-    return _ring_attn(q, k, v, axis_name, scale, causal)
+    b, s = q.shape[0], q.shape[1]
+    use_segs = segment_ids is not None or seq_len is not None
+    if use_segs and split_pattern == "sym":
+        raise NotImplementedError(
+            "varlen/packed ring attention requires the NORMAL split "
+            "pattern (SYM chunking would break global segment order)")
+    if split_pattern == "sym" and s % 2 != 0:
+        raise ValueError(f"sym split needs an even local seq, got {s}")
+    if segment_ids is None:
+        seg_ids = jnp.zeros((b, s), jnp.int32)
+    else:
+        seg_ids = segment_ids.astype(jnp.int32)
+    if seq_len is not None:
+        pos = jnp.arange(s, dtype=jnp.int32)[None, :]
+        seg_ids = jnp.where(pos < seq_len, seg_ids, -1)
+    return _ring_attn(q, k, v, seg_ids, axis_name, scale, causal,
+                      split_pattern, use_segs)
 
 
 def ring_attention_sharded(q, k, v, mesh, axis_name: str = "cp",
                            causal: bool = True,
                            softmax_scale: Optional[float] = None,
                            batch_axis: Optional[str] = "dp",
-                           head_axis: Optional[str] = "tp") -> jax.Array:
+                           head_axis: Optional[str] = "tp",
+                           split_pattern: str = "normal",
+                           segment_ids: Optional[jax.Array] = None,
+                           seq_lens: Optional[jax.Array] = None
+                           ) -> jax.Array:
     """Convenience wrapper: shard_map ring attention over a mesh for global
     [b, s, h, d] arrays (seq sharded over ``axis_name``; batch over
     ``batch_axis``; heads over ``head_axis`` — the reference's TP head
-    split + CP combination)."""
+    split + CP combination).
+
+    With ``split_pattern="sym"`` the caller's GLOBAL arrays are reordered
+    into the SYM layout on the way in and back on the way out.
+    ``seq_lens``: [cp] per-rank valid lengths (``_seq_len_list``).
+    ``segment_ids``: global [b, s] packed doc ids (-1 pad).
+    """
     from jax.sharding import PartitionSpec as P
     from .comm import shard_map
+
+    cp = mesh.shape[axis_name]
 
     def axis_or_none(name):
         return name if (name and name in mesh.axis_names) else None
 
     spec = P(axis_or_none(batch_axis), axis_name, axis_or_none(head_axis),
              None)
+    if split_pattern == "sym":
+        q, k, v = (sym_shard(x, cp, axis=1) for x in (q, k, v))
+
+    if segment_ids is not None or seq_lens is not None:
+        b, s = q.shape[0], q.shape[1]
+        segs = jnp.zeros((b, s), jnp.int32) if segment_ids is None \
+            else segment_ids.astype(jnp.int32)
+        if seq_lens is not None:
+            s_local = s // cp
+            pos = jnp.arange(s, dtype=jnp.int32)[None, :]
+            local_pos = pos % s_local
+            rank = pos // s_local
+            lens = jnp.asarray(seq_lens, jnp.int32)[rank]
+            segs = jnp.where(local_pos < lens, segs, -1)
+
+        fn = shard_map(
+            lambda q, k, v, sg: ring_attention(
+                q, k, v, axis_name, causal, softmax_scale, split_pattern,
+                segment_ids=sg),
+            mesh, (spec, spec, spec, P(axis_or_none(batch_axis),
+                                       axis_name)), spec)
+        return fn(q, k, v, segs)
 
     fn = shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis_name, causal,
-                                       softmax_scale),
+                                       softmax_scale, split_pattern),
         mesh, (spec, spec, spec), spec)
-    return fn(q, k, v)
+    out = fn(q, k, v)
+    if split_pattern == "sym":
+        out = sym_unshard(out, cp, axis=1)
+    return out

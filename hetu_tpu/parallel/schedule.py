@@ -1,0 +1,114 @@
+"""Pipeline schedules — GPipe and PipeDream-Flush (1F1B).
+
+TPU-native counterpart of the reference's schedule generators
+(``hetu/graph/executable_graph.cc:1343`` ``GenerateGpipeSchedule`` and
+``:1376`` ``GeneratePipedreamFlushSchedule``): emit, per pipeline stage,
+the ordered list of forward/backward micro-batch tasks the executor runs.
+The MPMD runtime (:mod:`hetu_tpu.parallel.pipeline_mpmd`) consumes these
+task lists; unlike the reference's per-rank CUDA task loop, here a single
+controller enqueues tasks onto per-stage device submeshes and XLA's async
+dispatch provides the overlap.
+
+The property that makes 1F1B 1F1B: the number of *in-flight* micro-batches
+(forward done, backward not yet) at stage ``s`` never exceeds ``S - s``
+(pipeline depth bound), while GPipe's grows to ``M``.  ``max_in_flight``
+computes that bound for any schedule so tests (and the runtime's memory
+accounting) can assert it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Literal, Sequence
+
+TaskKind = Literal["F", "B"]
+
+
+@dataclass(frozen=True)
+class Task:
+    kind: str           # "F" | "B"
+    micro_batch: int
+
+    def __repr__(self) -> str:  # compact: F0, B3
+        return f"{self.kind}{self.micro_batch}"
+
+
+def generate_gpipe_schedule(num_stages: int, num_micro_batches: int,
+                            inference: bool = False) -> List[List[Task]]:
+    """All forwards, then all backwards (fill/drain).
+
+    Reference ``GenerateGpipeSchedule`` (executable_graph.cc:1343).
+    """
+    out: List[List[Task]] = []
+    for _ in range(num_stages):
+        tasks = [Task("F", m) for m in range(num_micro_batches)]
+        if not inference:
+            tasks += [Task("B", m) for m in range(num_micro_batches)]
+        out.append(tasks)
+    return out
+
+
+def generate_pipedream_flush_schedule(num_stages: int,
+                                      num_micro_batches: int,
+                                      inference: bool = False
+                                      ) -> List[List[Task]]:
+    """1F1B (PipeDream-Flush): warmup forwards, steady-state alternating
+    one-forward-one-backward, cooldown backwards, synchronous flush at the
+    end of the step.
+
+    Reference ``GeneratePipedreamFlushSchedule``
+    (executable_graph.cc:1376).  Stage ``s`` (0-indexed) runs
+    ``min(M, S-1-s)`` warmup forwards, so at most ``S - s`` micro-batches
+    are ever in flight.
+    """
+    S, M = num_stages, num_micro_batches
+    if inference:
+        return generate_gpipe_schedule(S, M, inference=True)
+    out: List[List[Task]] = []
+    for s in range(S):
+        warmup = min(M, S - 1 - s)
+        tasks: List[Task] = [Task("F", m) for m in range(warmup)]
+        f, b = warmup, 0
+        # steady state: 1F1B
+        while f < M:
+            tasks.append(Task("F", f))
+            f += 1
+            tasks.append(Task("B", b))
+            b += 1
+        # cooldown: drain remaining backwards
+        while b < M:
+            tasks.append(Task("B", b))
+            b += 1
+        out.append(tasks)
+    return out
+
+
+def max_in_flight(stage_tasks: Sequence[Task]) -> int:
+    """Peak number of micro-batches with forward done but backward not —
+    the stage's activation-stash high-water mark."""
+    live = 0
+    peak = 0
+    for t in stage_tasks:
+        if t.kind == "F":
+            live += 1
+            peak = max(peak, live)
+        else:
+            live -= 1
+    return peak
+
+
+def validate_schedule(schedule: Sequence[Sequence[Task]],
+                      num_micro_batches: int) -> None:
+    """Sanity checks: every stage runs F and B exactly once per
+    micro-batch; per-stage B(m) comes after F(m)."""
+    for s, tasks in enumerate(schedule):
+        seen_f = [False] * num_micro_batches
+        seen_b = [False] * num_micro_batches
+        for t in tasks:
+            if t.kind == "F":
+                assert not seen_f[t.micro_batch], (s, t)
+                seen_f[t.micro_batch] = True
+            else:
+                assert seen_f[t.micro_batch], (s, t)
+                assert not seen_b[t.micro_batch], (s, t)
+                seen_b[t.micro_batch] = True
+        assert all(seen_f) and all(seen_b), f"stage {s} incomplete"

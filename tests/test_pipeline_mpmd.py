@@ -1,0 +1,230 @@
+"""MPMD hetero pipeline: 1F1B schedule, unequal stages, tied embeddings.
+
+Covers VERDICT round-1 items 2/3/7(部分)/8: PipeDream-Flush bounded
+in-flight, hetero stage_layers actually executing, per-pipeline
+micro-batch counts, shared-embedding grad handling.
+"""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from hetu_tpu.models.gpt import GPTConfig, llama_config
+from hetu_tpu.models.gpt_mpmd import MPMDGPT
+from hetu_tpu.parallel.pipeline_mpmd import MPMDAdam
+from hetu_tpu.parallel.schedule import (generate_gpipe_schedule,
+                                        generate_pipedream_flush_schedule,
+                                        max_in_flight, validate_schedule)
+
+
+def _cfg(**kw):
+    kw.setdefault("vocab_size", 96)
+    kw.setdefault("hidden_size", 48)
+    kw.setdefault("num_layers", 8)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("max_seq_len", 16)
+    kw.setdefault("dtype", "float32")
+    return llama_config(**kw)
+
+
+def _data(cfg, batch, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, (batch, cfg.max_seq_len)
+                      ).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1)
+    return ids, labels
+
+
+class TestSchedules:
+    def test_1f1b_in_flight_bounded_by_depth(self):
+        for S, M in [(2, 4), (4, 8), (4, 32), (8, 8)]:
+            sched = generate_pipedream_flush_schedule(S, M)
+            validate_schedule(sched, M)
+            for s, tasks in enumerate(sched):
+                assert max_in_flight(tasks) == min(M, S - s), (S, M, s)
+
+    def test_gpipe_in_flight_is_m(self):
+        sched = generate_gpipe_schedule(4, 8)
+        validate_schedule(sched, 8)
+        assert all(max_in_flight(t) == 8 for t in sched)
+
+
+class TestHeteroPipelineEquivalence:
+    def test_pp4_hetero_stage_layers_matches_pp1(self, devices8):
+        """pp4 with stage_layers [1,1,3,3] on 4x2-device submeshes matches
+        the same model on one device (VERDICT item 2 Done criterion)."""
+        cfg = _cfg()
+        ids, labels = _data(cfg, batch=8)
+
+        ref = MPMDGPT(cfg, stage_layers=[[8]], seed=3)
+        meshes = [[Mesh(np.array(devices8[2 * s:2 * s + 2]).reshape(1, 2),
+                        ("dp", "tp")) for s in range(4)]]
+        het = MPMDGPT(cfg, stage_layers=[[1, 1, 3, 3]], meshes=meshes,
+                      seed=3)
+
+        opt_r = MPMDAdam(ref.runtime, lr=1e-2)
+        opt_h = MPMDAdam(het.runtime, lr=1e-2)
+        losses_r, losses_h = [], []
+        for step in range(4):
+            d_r = ref.split_micro_batches(ids, labels, [4])
+            d_h = het.split_micro_batches(ids, labels, [4])
+            lr_, gr, _ = ref.train_step(d_r)
+            lh_, gh, _ = het.train_step(d_h)
+            losses_r.append(float(lr_))
+            losses_h.append(float(lh_))
+            opt_r.apply(gr)
+            opt_h.apply(gh)
+        np.testing.assert_allclose(losses_r, losses_h, rtol=2e-4)
+        assert losses_r[-1] < losses_r[0]
+
+    def test_1f1b_stash_below_gpipe_at_m8(self, devices8):
+        """Memory assertion: 1F1B in-flight activation peak < GPipe's
+        (VERDICT item 2 Done criterion)."""
+        cfg = _cfg(num_layers=4)
+        ids, labels = _data(cfg, batch=8)
+        meshes = [[Mesh(np.array(devices8[2 * s:2 * s + 2]).reshape(1, 2),
+                        ("dp", "tp")) for s in range(4)]]
+        res = {}
+        for sched in ("1f1b", "gpipe"):
+            model = MPMDGPT(cfg, stage_layers=[[1, 1, 1, 1]], meshes=meshes,
+                            schedule=sched, seed=0)
+            data = model.split_micro_batches(ids, labels, [8])
+            loss, _, stats = model.train_step(data)
+            res[sched] = (loss, stats)
+        # same math regardless of schedule
+        np.testing.assert_allclose(res["1f1b"][0], res["gpipe"][0],
+                                   rtol=1e-5)
+        # stage 0 stash: 1F1B holds at most S, GPipe holds M
+        s1 = res["1f1b"][1]
+        sg = res["gpipe"][1]
+        assert max(s1.stash_peak) <= 4
+        assert max(sg.stash_peak) == 8
+        assert max(s1.stash_peak_bytes) < max(sg.stash_peak_bytes)
+
+    def test_hetero_dp_unequal_micro_batches(self, devices8):
+        """Two pipelines with micro-batch counts [3, 1] (Malleus
+        apportionment) match the single-pipeline run on the same global
+        batch."""
+        cfg = _cfg(num_layers=4)
+        ids, labels = _data(cfg, batch=8)
+
+        ref = MPMDGPT(cfg, stage_layers=[[4]], seed=1)
+        d = ref.split_micro_batches(ids, labels, [4])
+        _, gr, _ = ref.train_step(d)
+
+        meshes = [
+            [Mesh(np.array(devices8[0:2]).reshape(1, 2), ("dp", "tp")),
+             Mesh(np.array(devices8[2:4]).reshape(1, 2), ("dp", "tp"))],
+            [Mesh(np.array(devices8[4:6]).reshape(1, 2), ("dp", "tp")),
+             Mesh(np.array(devices8[6:8]).reshape(1, 2), ("dp", "tp"))],
+        ]
+        het = MPMDGPT(cfg, stage_layers=[[2, 2], [1, 3]], meshes=meshes,
+                      seed=1)
+        dh = het.split_micro_batches(ids, labels, [3, 1])
+        _, gh, _ = het.train_step(dh)
+
+        # wte grad (stage 0) must match the reference run
+        g_ref = np.asarray(gr[0][0]["wte"])
+        g_het = np.asarray(jax.device_get(gh[0][0]["wte"]))
+        np.testing.assert_allclose(g_ref, g_het, rtol=5e-4, atol=1e-6)
+        # layer grads live at different (pipe, stage) per layout but agree
+        g_ref3 = np.asarray(gr[0][0]["layer3"]["qkv"])
+        g_het3 = np.asarray(jax.device_get(gh[1][1]["layer3"]["qkv"]))
+        np.testing.assert_allclose(g_ref3, g_het3, rtol=5e-4, atol=1e-6)
+
+
+class TestGPT2ArchAndTying:
+    def test_gpt2_architecture_trains(self, devices8):
+        """Real GPT-2: gelu+bias, layernorm, learned positions, GQA,
+        dropout — pipelined (VERDICT item 8)."""
+        cfg = GPTConfig(vocab_size=96, hidden_size=48, num_layers=4,
+                        num_heads=4, num_kv_heads=2, max_seq_len=16,
+                        activation="gelu", norm="layernorm",
+                        position="learned", dropout=0.1, dtype="float32")
+        ids, labels = _data(cfg, batch=4)
+        meshes = [[Mesh(np.array(devices8[4 * s:4 * s + 4]).reshape(2, 2),
+                        ("dp", "tp")) for s in range(2)]]
+        model = MPMDGPT(cfg, stage_layers=[[2, 2]], meshes=meshes, seed=0)
+        opt = MPMDAdam(model.runtime, lr=1e-2)
+        losses = []
+        for step in range(6):
+            data = model.split_micro_batches(ids, labels, [2])
+            loss, grads, _ = model.train_step(
+                data, rng=jax.random.PRNGKey(step))
+            opt.apply(grads)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_tied_embeddings_match_single_stage(self):
+        """Tied wte across first/last stage: grads summed across stages
+        (reference shared-weight p2p, executable_graph.cc:2312) — pp2
+        must equal pp1 exactly."""
+        cfg = _cfg(num_layers=2, tie_embeddings=True)
+        ids, labels = _data(cfg, batch=4)
+
+        one = MPMDGPT(cfg, stage_layers=[[2]], seed=5)
+        two = MPMDGPT(cfg, stage_layers=[[1, 1]], seed=5)
+        d1 = one.split_micro_batches(ids, labels, [2])
+        d2 = two.split_micro_batches(ids, labels, [2])
+        l1, g1, _ = one.train_step(d1)
+        l2, g2, _ = two.train_step(d2)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        # single stage: wte and wte_head entries carry the summed grad
+        np.testing.assert_allclose(np.asarray(g1[0][0]["wte"]),
+                                   np.asarray(g2[0][0]["wte"]),
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(g2[0][0]["wte"]),
+                                   np.asarray(g2[0][1]["wte_head"]),
+                                   rtol=1e-6)
+
+    def test_tied_training_keeps_copies_identical(self):
+        cfg = _cfg(num_layers=2, tie_embeddings=True)
+        ids, labels = _data(cfg, batch=4)
+        model = MPMDGPT(cfg, stage_layers=[[1, 1]], seed=2)
+        opt = MPMDAdam(model.runtime, lr=1e-2)
+        for step in range(3):
+            data = model.split_micro_batches(ids, labels, [2])
+            _, grads, _ = model.train_step(data)
+            opt.apply(grads)
+        wte = np.asarray(model.runtime.pipes[0][0].params["wte"])
+        head = np.asarray(model.runtime.pipes[0][1].params["wte_head"])
+        np.testing.assert_allclose(wte, head, rtol=1e-6)
+
+
+class TestElasticMPMD:
+    def test_elastic_trainer_hetero_switch_preserves_training(self,
+                                                              devices8):
+        """Malleus end-to-end: straggler ratios re-solve to an unequal
+        stage layout, the trainer migrates params+optimizer state, and
+        the loss trajectory matches an unswitched run (same math)."""
+        from hetu_tpu.elastic.mpmd_trainer import ElasticMPMDTrainer
+        from hetu_tpu.elastic.strategy import StrategyModel
+
+        cfg = _cfg(num_layers=8)
+        ids, labels = _data(cfg, batch=4)
+
+        def provider(step):
+            return ids, labels
+
+        def make(solver_kw=None):
+            solver = StrategyModel(8, cfg.num_layers, num_micro_batches=2,
+                                   tp_candidates=[2], pp_candidates=[4])
+            return ElasticMPMDTrainer(cfg, solver, provider,
+                                      devices=devices8, lr=1e-2, seed=7)
+
+        base = make()
+        l_base = base.train_steps(6)
+
+        tr = make()
+        l_pre = tr.train_steps(3)
+        # device 0 becomes a 3x straggler: the re-solved plan must give
+        # its stage fewer layers
+        ratios = [3.0] + [1.0] * 7
+        switched = tr.retune(ratios)
+        assert switched, "expected a hetero re-layout"
+        sl = tr.current_strategy.stage_layers[0]
+        assert sl != [2, 2, 2, 2], sl
+        assert sum(sl) == 8 and min(sl) >= 1
+        l_post = tr.train_steps(3)
+        np.testing.assert_allclose(l_pre + l_post, l_base, rtol=2e-4)
+        assert tr.history and tr.history[0]["switch_seconds"] > 0

@@ -118,3 +118,142 @@ class TestRingRegressions:
             x = ht.placeholder("float32", (2, 8, 2, 4), name="q")
             with _pytest.raises(ValueError, match="parallel_attention"):
                 _ops.parallel_attention(x, x, x)
+
+
+class TestSymSplitPattern:
+    """SYM causal load balancing (reference SplitPattern::SYM,
+    ParallelAttention.h:19, .cc:140-200)."""
+
+    def test_sym_fwd_matches_dense(self, devices8):
+        from hetu_tpu.parallel.ring_attention import pair_score_area
+        mesh = ht.create_mesh({"cp": 4}, devices8[:4])
+        q, k, v = _mk()
+        out = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                     batch_axis=None, head_axis=None,
+                                     split_pattern="sym")
+        ref = sdpa_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_sym_bwd_matches_dense(self, devices8):
+        mesh = ht.create_mesh({"cp": 4}, devices8[:4])
+        q, k, v = _mk(s=128)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_attention_sharded(
+                q, k, v, mesh, causal=True, batch_axis=None,
+                head_axis=None, split_pattern="sym") ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(sdpa_reference(q, k, v, causal=True) ** 2)
+
+        g1 = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-3,
+                                       err_msg=f"d{name}")
+
+    def test_sym_balances_per_round_work(self):
+        """Per-(rank, round) score area: NORMAL causal is cp x imbalanced,
+        SYM is exactly uniform (the point of the pattern)."""
+        from hetu_tpu.parallel.ring_attention import pair_score_area
+        for cp in (2, 4, 8):
+            normal = pair_score_area(cp, "normal").sum(axis=1)
+            sym = pair_score_area(cp, "sym").sum(axis=1)
+            assert normal.max() / normal.min() >= 2 * cp - 1
+            np.testing.assert_allclose(sym, sym[0])
+            # same total work overall
+            np.testing.assert_allclose(normal.sum(), sym.sum())
+
+    def test_sym_roundtrip_indices(self):
+        from hetu_tpu.parallel.ring_attention import sym_shard, sym_unshard
+        x = jnp.arange(2 * 32 * 3).reshape(2, 32, 3).astype(jnp.float32)
+        y = sym_unshard(sym_shard(x, 4), 4)
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestVarlenRing:
+    """Per-rank variable seq lens (_seq_len_list) + packed segments in
+    the ring (reference ParallelAttention.cc:1061 varlen path)."""
+
+    def test_unequal_per_rank_lengths_match_oracle(self, devices8):
+        cp, s_local = 4, 64
+        mesh = ht.create_mesh({"cp": cp}, devices8[:4])
+        q, k, v = _mk(s=cp * s_local)
+        lens = np.array([64, 32, 48, 16], np.int32)  # valid per rank
+
+        out = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                     batch_axis=None, head_axis=None,
+                                     seq_lens=lens)
+        # oracle: same padding expressed as segment ids (-1 -> unique neg)
+        pos = np.arange(cp * s_local)
+        valid = (pos % s_local) < lens[pos // s_local]
+        segs = np.where(valid, 0, -1 - pos).astype(np.int32)  # pads unique
+        segs = np.broadcast_to(segs, (q.shape[0], cp * s_local))
+        ref = sdpa_reference(q, k, v, causal=True,
+                             segment_ids=jnp.asarray(segs))
+        ov = np.asarray(out)[:, valid]
+        rv = np.asarray(ref)[:, valid]
+        np.testing.assert_allclose(ov, rv, rtol=1e-4, atol=1e-4)
+
+    def test_unequal_lengths_bwd(self, devices8):
+        cp, s_local = 4, 32
+        mesh = ht.create_mesh({"cp": cp}, devices8[:4])
+        q, k, v = _mk(s=cp * s_local)
+        lens = np.array([32, 16, 24, 8], np.int32)
+        pos = np.arange(cp * s_local)
+        valid = (pos % s_local) < lens[pos // s_local]
+        segs = np.where(valid, 0, -1 - pos).astype(np.int32)
+        segs = np.broadcast_to(segs, (q.shape[0], cp * s_local))
+        vm = jnp.asarray(valid[None, :, None, None], jnp.float32)
+
+        def loss_ring(q, k, v):
+            o = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                       batch_axis=None, head_axis=None,
+                                       seq_lens=lens)
+            return jnp.sum((o * vm) ** 2)
+
+        def loss_ref(q, k, v):
+            o = sdpa_reference(q, k, v, causal=True,
+                               segment_ids=jnp.asarray(segs))
+            return jnp.sum((o * vm) ** 2)
+
+        g1 = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g1, g2):
+            av = np.asarray(a)[:, valid]
+            bv = np.asarray(b)[:, valid]
+            np.testing.assert_allclose(av, bv, rtol=1e-3, atol=1e-3,
+                                       err_msg=f"d{name}")
+
+    def test_packed_segments_cross_rank(self, devices8):
+        """Docs packed across rank boundaries: same doc attends causally
+        across ranks, different docs never attend."""
+        cp, s_local = 4, 32
+        s = cp * s_local
+        mesh = ht.create_mesh({"cp": cp}, devices8[:4])
+        q, k, v = _mk(s=s)
+        # three docs: [0, 100) / [100, 180) / [180, 256) — boundaries NOT
+        # on rank boundaries
+        doc = np.zeros(s, np.int32)
+        doc[100:180] = 1
+        doc[180:] = 2
+        segs = np.broadcast_to(doc, (q.shape[0], s)).copy()
+
+        out = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                     batch_axis=None, head_axis=None,
+                                     segment_ids=jnp.asarray(segs))
+        ref = sdpa_reference(q, k, v, causal=True,
+                             segment_ids=jnp.asarray(segs))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_sym_plus_varlen_raises(self, devices8):
+        mesh = ht.create_mesh({"cp": 4}, devices8[:4])
+        q, k, v = _mk(s=128)
+        with pytest.raises(NotImplementedError):
+            ring_attention_sharded(q, k, v, mesh, causal=True,
+                                   batch_axis=None, head_axis=None,
+                                   split_pattern="sym",
+                                   seq_lens=np.array([32, 32, 32, 16]))
