@@ -31,6 +31,7 @@ from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple,
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -427,6 +428,66 @@ class DefineAndRunGraph(Graph):
     def __init__(self, name: str = "define_and_run"):
         super().__init__(name)
         self._plan_pool: Dict[Tuple, Any] = {}
+        self._shape_buckets: Optional[List[int]] = None
+        self._bucket_pad_values: Dict[int, Any] = {}
+
+    # -- shape-plan bucketing ------------------------------------------------
+
+    def set_shape_buckets(self, buckets, pad_values=None) -> None:
+        """Bucket symbolic feed dims so varying shapes reuse compiled
+        plans (reference DeduceShapePlan + shape-plan pool,
+        define_and_run_graph.cc:273; SURVEY hard part #4).
+
+        ``buckets``: sorted list of allowed sizes, or an int alignment
+        (round symbolic dims up to a multiple — the data/bucket.py
+        alignment convention).  Feeds are padded up to the bucket along
+        every :class:`SymbolicDim` axis; ``pad_values`` maps placeholder
+        Tensors to their pad fill (default 0 — use the loss ignore_index
+        for label feeds so padded positions drop out of the loss).
+        """
+        if isinstance(buckets, int):
+            self._shape_buckets = buckets
+        else:
+            self._shape_buckets = sorted(int(b) for b in buckets)
+            if not self._shape_buckets:
+                raise ValueError("shape bucket list must be non-empty")
+        self._bucket_pad_values = {
+            (t.id if isinstance(t, Tensor) else t): v
+            for t, v in (pad_values or {}).items()}
+
+    def _bucket_dim(self, size: int) -> int:
+        b = self._shape_buckets
+        if isinstance(b, int):
+            return ((size + b - 1) // b) * b
+        for cand in b:
+            if cand >= size:
+                return cand
+        raise ValueError(
+            f"feed dim {size} exceeds the largest shape bucket {b[-1]}")
+
+    def _bucket_feeds(self, feed_dict: Dict[Tensor, Any]
+                      ) -> Dict[Tensor, Any]:
+        """Pad feeds up to bucket boundaries along symbolic dims."""
+        out = {}
+        for t, v in feed_dict.items():
+            arr = np.asarray(v) if not isinstance(v, jax.Array) else v
+            pads = []
+            changed = False
+            for i, dim in enumerate(t.shape):
+                if isinstance(dim, SymbolicDim) and i < arr.ndim:
+                    tgt = self._bucket_dim(arr.shape[i])
+                    pads.append((0, tgt - arr.shape[i]))
+                    changed = changed or tgt != arr.shape[i]
+                else:
+                    pads.append((0, 0))
+            if changed:
+                # np.pad keeps the feed host-side: _plan_key reads feed
+                # dtypes/shapes and must not force a device sync; run()
+                # device_puts the padded array once afterwards
+                fill = self._bucket_pad_values.get(t.id, 0)
+                arr = np.pad(np.asarray(arr), pads, constant_values=fill)
+            out[t] = arr
+        return out
 
     # -- plan construction ---------------------------------------------------
 
@@ -459,28 +520,30 @@ class DefineAndRunGraph(Graph):
                 getattr(self, "_offload", False))
 
     def _split_micro_batches(self, feeds: Dict[int, Any], n: int):
-        """Split feed arrays along dim 0 into n micro-batches
-        (reference NDArray::split at executable_graph.cc:1828).
-        Scalars (0-d feeds) are replicated; the rng key feed is folded with
-        the micro-batch index so stochastic ops differ per micro-batch."""
+        """Stack feed arrays into [n, batch/n, ...] micro-batch form
+        (reference NDArray::split at executable_graph.cc:1828) — the
+        leading dim is consumed by the executor's ``lax.scan`` so the
+        fwd+bwd graph is traced ONCE regardless of n (the reference loops
+        micro-batches at runtime, executable_graph.cc:1424; a trace-time
+        Python loop would duplicate the whole XLA program n times).
+        Scalars (0-d feeds) are replicated; the rng key feed is folded
+        with the micro-batch index so stochastic ops differ per
+        micro-batch."""
         rng_id = self._rng_tensor.id if self._rng_tensor is not None else None
         if n == 1:
-            return [feeds]
-        out = []
-        for i in range(n):
-            mb = {}
-            for tid, v in feeds.items():
-                if tid == rng_id:
-                    mb[tid] = jax.random.fold_in(v, i)
-                    continue
-                if np.ndim(v) == 0:
-                    mb[tid] = v
-                    continue
-                b = v.shape[0]
-                assert b % n == 0, f"batch {b} not divisible by {n} micro-batches"
-                chunk = b // n
-                mb[tid] = v[i * chunk:(i + 1) * chunk]
-            out.append(mb)
+            return feeds
+        out = {}
+        for tid, v in feeds.items():
+            if tid == rng_id:
+                out[tid] = jnp.stack(
+                    [jax.random.fold_in(v, i) for i in range(n)])
+                continue
+            if np.ndim(v) == 0:
+                out[tid] = jnp.broadcast_to(jnp.asarray(v), (n,))
+                continue
+            b = v.shape[0]
+            assert b % n == 0, f"batch {b} not divisible by {n} micro-batches"
+            out[tid] = v.reshape(n, b // n, *v.shape[1:])
         return out
 
     def _build_executable(self, fetches: List[Tensor],
@@ -554,32 +617,53 @@ class DefineAndRunGraph(Graph):
                 fetch_vals = graph._eval_targets(fetches, env)
                 return fetch_vals, None
 
+            # micro-batch loop as a runtime lax.scan over the stacked
+            # [M, ...] feeds (reference ComputeFunc loop,
+            # executable_graph.cc:1424): one traced fwd+bwd body for any
+            # M, instead of unrolling M copies of the program.
+            # Scalar fetches average over micro-batches; non-scalar
+            # fetches return the last micro-batch's value.
+            M = num_micro_batches
+
+            def _merge_fetches(carry_fv, fv):
+                return [c + f if f.ndim == 0 else f
+                        for c, f in zip(carry_fv, fv)]
+
+            def _zeros_of(sds):
+                return jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), sds)
+
             if update_node is None:
-                all_fetches = [fwd_bwd(mb) for mb in feeds_mb]
-                fetch_vals = [vals for vals, _ in all_fetches]
-                # return last micro-batch fetches (stacked would change shape)
-                out = [jnp.mean(jnp.stack([fv[i] for fv in fetch_vals]), axis=0)
-                       if fetch_vals[0][i].ndim == 0
-                       else fetch_vals[-1][i]
-                       for i in range(len(fetches))]
+                if M == 1:
+                    fetch_vals, _ = fwd_bwd(feeds_mb)
+                    return fetch_vals, var_state, opt_state, grad_accum
+
+                def body(carry_fv, mb):
+                    fv, _ = fwd_bwd(mb)
+                    return _merge_fetches(carry_fv, fv), None
+
+                first = jax.tree_util.tree_map(lambda v: v[0], feeds_mb)
+                fv_sds, _ = jax.eval_shape(fwd_bwd, first)
+                fetch_vals, _ = lax.scan(body, _zeros_of(fv_sds), feeds_mb)
+                out = [v / M if v.ndim == 0 else v for v in fetch_vals]
                 return out, var_state, opt_state, grad_accum
 
-            # micro-batch loop with grad accumulation
-            # (reference ComputeFunc loop, executable_graph.cc:1424)
-            acc_grads = None
-            fetch_vals = None
-            for mb in feeds_mb:
-                fv, grads = fwd_bwd(mb)
-                if acc_grads is None:
-                    acc_grads = grads
-                    fetch_vals = fv
-                else:
-                    acc_grads = {k: acc_grads[k] + g for k, g in grads.items()}
-                    fetch_vals = [a + b if b.ndim == 0 else b
-                                  for a, b in zip(fetch_vals, fv)]
-            n = len(feeds_mb)
-            acc_grads = {k: g / n for k, g in acc_grads.items()}
-            fetch_vals = [v / n if v.ndim == 0 else v for v in fetch_vals]
+            # grad accumulation across micro-batches
+            if M == 1:
+                fetch_vals, acc_grads = fwd_bwd(feeds_mb)
+            else:
+                def body(carry, mb):
+                    carry_fv, carry_g = carry
+                    fv, g = fwd_bwd(mb)
+                    new_g = {k: carry_g[k] + g[k] for k in g}
+                    return (_merge_fetches(carry_fv, fv), new_g), None
+
+                first = jax.tree_util.tree_map(lambda v: v[0], feeds_mb)
+                fv_sds, g_sds = jax.eval_shape(fwd_bwd, first)
+                (fetch_vals, acc_grads), _ = lax.scan(
+                    body, (_zeros_of(fv_sds), _zeros_of(g_sds)), feeds_mb)
+            acc_grads = {k: g / M for k, g in acc_grads.items()}
+            fetch_vals = [v / M if v.ndim == 0 else v for v in fetch_vals]
 
             # fold in persistent accumulation (RunLevel.GRAD across runs)
             if grad_accum:
@@ -660,6 +744,8 @@ class DefineAndRunGraph(Graph):
         if run_level == RunLevel.TOPO:
             return self._topo_from([f for f in fetches if isinstance(f, Tensor)])
 
+        if self._shape_buckets is not None:
+            feed_dict = self._bucket_feeds(feed_dict)
         self._bind_symbolic_dims(feed_dict)
 
         # find update node among fetches (optimizer.minimize output);
