@@ -1,0 +1,78 @@
+"""Ablation timing for bench perf work. Usage: python scratch/abl.py VARIANT
+Variants: base, noflash, noloss, noattn, b64, fp32master
+"""
+import sys, time, os
+import numpy as np
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "base"
+
+import jax
+import jax.numpy as jnp
+import hetu_tpu as ht
+from hetu_tpu import optim, ops
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+
+batch, seq, steps, warmup = 32, 1024, 8, 2
+if VARIANT == "b64":
+    batch = 64
+if VARIANT == "b16":
+    batch = 16
+
+cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                num_heads=12, max_seq_len=1024, sp=False,
+                dtype="bfloat16", position="learned",
+                activation="gelu", norm="layernorm")
+
+if VARIANT == "noflash":
+    import importlib
+    A = importlib.import_module("hetu_tpu.ops.attention")
+    _orig = A.sdpa
+    def sdpa_noflash(q, k, v, **kw):
+        kw["use_flash"] = False
+        return _orig(q, k, v, **kw)
+    A.sdpa = sdpa_noflash
+
+if VARIANT == "noattn":
+    import hetu_tpu.models.gpt as G
+    class NoAttn:
+        def __init__(self, *a, **k): pass
+    # replace attention output with identity: monkeypatch block fwd
+    _orig_fwd = G.ParallelAttentionBlock.forward
+    def fwd(self, x, seq_len):
+        return self.out(self.qkv(x)[..., :768])
+    G.ParallelAttentionBlock.forward = fwd
+
+with ht.graph("define_and_run", create_new=True) as g:
+    ids = ht.placeholder("int32", (batch, seq), name="input_ids")
+    labels = ht.placeholder("int32", (batch, seq), name="labels")
+    model = GPTLMHeadModel(cfg)
+    if VARIANT == "noloss":
+        h = model.transformer(ids, seq_len=seq)
+        loss = ops.reduce_mean(h * h)
+    else:
+        loss = model(ids, labels, seq_len=seq)
+    train_op = optim.AdamOptimizer(lr=1e-4, weight_decay=0.01).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    IDS = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    L = np.roll(IDS, -1, axis=1)
+
+    def _sync():
+        arrs = list(g._var_data.values())
+        for arr in (arrs[0], arrs[-1]):
+            np.asarray(arr.ravel()[0])
+
+    t_c0 = time.perf_counter()
+    for _ in range(warmup):
+        g.run(loss, [loss, train_op], {ids: IDS, labels: L})
+        _sync()
+    t_c1 = time.perf_counter()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        g.run(loss, [loss, train_op], {ids: IDS, labels: L})
+    _sync()
+    dt = (time.perf_counter() - t0) / steps
+
+tok = batch * seq / dt
+print(f"VARIANT={VARIANT} step={dt*1e3:.1f}ms tok/s={tok:,.0f} "
+      f"(warmup+compile {t_c1-t_c0:.1f}s)")

@@ -1,0 +1,201 @@
+"""Experimental flash attention kernel variants for perf tuning.
+
+Variants controlled by flags:
+- no seg operands when unused (always here)
+- diag: specialize diagonal vs fully-visible blocks (skip mask compute)
+- bq/bk block sizes
+"""
+import functools, math, sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+LANES = 128
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, bq, bk, num_kv, diag_spec):
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = True
+    if causal:
+        run = kv_idx * bk <= q_idx * bq + bq - 1
+
+    def _body(mask_needed):
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if mask_needed:
+            rows = q_idx * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = kv_idx * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows, s, DEFAULT_MASK_VALUE)
+        m_prev = m_ref[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_cur[:, None])
+        alpha = jnp.exp(m_prev - m_cur)
+        l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_cur[:, None], m_ref.shape)
+
+    if causal and diag_spec:
+        # diagonal (partially masked) blocks need the iota mask; fully
+        # visible blocks below the diagonal skip it
+        is_diag = (kv_idx * bk + bk - 1) > (q_idx * bq)
+
+        @pl.when(run & is_diag)
+        def _c1():
+            _body(True)
+
+        @pl.when(run & jnp.logical_not(is_diag))
+        def _c2():
+            _body(False)
+    else:
+        @pl.when(run)
+        def _c():
+            _body(causal)
+
+    @pl.when(kv_idx == num_kv - 1)
+    def _fin():
+        l = l_ref[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
+        m = m_ref[:, 0]
+        lse = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(safe_l))
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
+
+
+def flash_fwd(q, k, v, scale, causal, bq=512, bk=512, diag_spec=True,
+              dimsem=False):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    bq = min(bq, sq); bk = min(bk, sk)
+    num_q, num_kv = sq // bq, sk // bk
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, num_kv=num_kv,
+                               diag_spec=diag_spec)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+        if dimsem else None,
+    )(qr, kr, vr)
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out, lse
+
+
+if __name__ == "__main__":
+    B, S, NH, D = 32, 1024, 12, 64
+    REP = 20
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, S, NH, D), jnp.bfloat16)
+    k = jax.random.normal(key, (B, S, NH, D), jnp.bfloat16)
+    v = jax.random.normal(key, (B, S, NH, D), jnp.bfloat16)
+
+    def _sync(r):
+        for x in jax.tree.leaves(r):
+            np.asarray(x.ravel()[0])
+
+    def timeit_rep(body, carry, n=3, warm=1):
+        @jax.jit
+        def run(c):
+            def step(c, _):
+                return body(c), None
+            c, _ = lax.scan(step, c, None, length=REP)
+            return c
+        for _ in range(warm):
+            r = run(carry)
+        _sync(r)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = run(carry)
+        _sync(r)
+        return (time.perf_counter() - t0) / (n * REP)
+
+    scale = 1.0 / math.sqrt(D)
+    fl = 2 * 2 * B * NH * S * S * D / 2
+
+    # correctness check vs dense
+    def dense(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        qi = lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        ki = lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        s = jnp.where(ki <= qi, s, -jnp.inf)
+        p = jax.nn.softmax(s, -1).astype(v.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    qs, ks, vs = q[:2, :, :2], k[:2, :, :2], v[:2, :, :2]
+    o1, _ = jax.jit(lambda q, k, v: flash_fwd(q, k, v, scale, True))(qs, ks, vs)
+    o2 = jax.jit(dense)(qs, ks, vs)
+    err = float(jnp.max(jnp.abs(o1.astype(jnp.float32) - o2.astype(jnp.float32))))
+    print(f"max err vs dense: {err:.4f}")
+
+    for bq, bk, ds, sem in ((1024, 1024, True, False),
+                            (1024, 1024, True, True),
+                            (512, 1024, True, True),
+                            (512, 512, True, True),
+                            (512, 512, False, True)):
+        try:
+            t = timeit_rep(
+                lambda c, bq=bq, bk=bk, ds=ds, sem=sem: flash_fwd(
+                    c, k, v, scale, True, bq, bk, ds, sem)[0], q)
+            print(f"fwd bq={bq} bk={bk} diag={ds} sem={sem}: {t*1e3:.2f}ms "
+                  f"({fl/t/1e12:.1f} Tf/s)")
+        except Exception as e:
+            print(f"fwd bq={bq} bk={bk} diag={ds} sem={sem}: FAIL {type(e).__name__}: {e}")
+
+    # splash attention reference
+    try:
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_kernel as sk, splash_attention_mask as sm)
+        mask = sm.MultiHeadMask(
+            [sm.CausalMask((S, S)) for _ in range(NH)])
+        kernel = sk.make_splash_mha_single_device(mask=mask)
+        qh = q.transpose(0, 2, 1, 3) * scale
+        kh = k.transpose(0, 2, 1, 3)
+        vh = v.transpose(0, 2, 1, 3)
+        vm = jax.vmap(kernel)
+        t = timeit_rep(lambda c: vm(c, kh, vh).astype(jnp.bfloat16), qh)
+        print(f"splash fwd: {t*1e3:.2f}ms ({fl/t/1e12:.1f} Tf/s)")
+        def sg(c):
+            g = jax.grad(lambda q: vm(q, kh, vh).astype(jnp.float32).sum())(c)
+            return g.astype(jnp.bfloat16)
+        t = timeit_rep(sg, qh)
+        print(f"splash fwd+bwd: {t*1e3:.2f}ms")
+    except Exception as e:
+        import traceback; traceback.print_exc()

@@ -1,0 +1,82 @@
+"""Micro-timings: flash kernel, matmuls, CE, on the real chip."""
+import sys, time, math, functools
+import numpy as np
+import jax, jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+B, S, NH, D, H, V = 32, 1024, 12, 64, 768, 50304
+
+def _sync(r):
+    leaves = jax.tree.leaves(r)
+    for x in leaves:
+        np.asarray(x.ravel()[0])
+
+def timeit(f, *args, n=10, warm=2):
+    for _ in range(warm):
+        r = f(*args)
+    _sync(r)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = f(*args)
+    _sync(r)
+    return (time.perf_counter() - t0) / n
+
+k = jax.random.PRNGKey(0)
+q = jax.random.normal(k, (B, S, NH, D), jnp.bfloat16)
+kk = jax.random.normal(k, (B, S, NH, D), jnp.bfloat16)
+v = jax.random.normal(k, (B, S, NH, D), jnp.bfloat16)
+
+from hetu_tpu.ops.pallas.flash_attention import flash_attention
+
+fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+t = timeit(fwd, q, kk, v)
+fl = 2 * 2 * B * NH * S * S * D / 2 * 1.0  # qk+pv, causal half
+print(f"flash fwd: {t*1e3:.2f}ms ({fl/t/1e12:.1f} Tf/s eff)")
+
+def fb(q, k, v):
+    return jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True)
+                    .astype(jnp.float32).sum(), argnums=(0, 1, 2))(q, k, v)
+fbj = jax.jit(fb)
+t = timeit(fbj, q, kk, v)
+print(f"flash fwd+bwd(grad only): {t*1e3:.2f}ms (flops~{3.5*fl/t/1e12:.1f} Tf/s eff)")
+
+# matmul floor: the per-layer matmuls fwd
+a = jax.random.normal(k, (B * S, H), jnp.bfloat16)
+w1 = jax.random.normal(k, (H, 3 * H), jnp.bfloat16)
+w2 = jax.random.normal(k, (H, H), jnp.bfloat16)
+w3 = jax.random.normal(k, (H, 4 * H), jnp.bfloat16)
+w4 = jax.random.normal(k, (4 * H, H), jnp.bfloat16)
+mm = jax.jit(lambda a: ((a @ w1)[:, :H] @ w2) + (jax.nn.gelu(a @ w3) @ w4))
+t = timeit(mm, a)
+fl = 2 * B * S * H * (3 * H + H + 4 * H + 4 * H)
+print(f"layer-matmuls fwd: {t*1e3:.2f}ms ({fl/t/1e12:.1f} Tf/s eff)")
+
+# lm head + CE variants
+x = jax.random.normal(k, (B * S, H), jnp.bfloat16)
+wv = jax.random.normal(k, (H, V), jnp.bfloat16)
+lbl = jnp.asarray(np.random.RandomState(0).randint(0, V, (B * S,)), jnp.int32)
+
+def ce_plain(x, wv):
+    lg = (x @ wv).astype(jnp.float32)
+    lp = jax.nn.log_softmax(lg, -1)
+    return -jnp.mean(jnp.take_along_axis(lp, lbl[:, None], 1))
+g1 = jax.jit(jax.grad(ce_plain, argnums=(0, 1)))
+t = timeit(g1, x, wv)
+fl = 3 * 2 * B * S * H * V
+print(f"CE plain fwd+bwd: {t*1e3:.2f}ms ({fl/t/1e12:.1f} Tf/s eff)")
+
+def ce_chunk(x, wv):
+    CH = 16
+    xc = x.reshape(CH, (B * S) // CH, H)
+    lc = lbl.reshape(CH, (B * S) // CH)
+    def body(c, op):
+        xx, ll = op
+        lg = (xx @ wv).astype(jnp.float32)
+        lse = jax.nn.logsumexp(lg, -1)
+        picked = jnp.take_along_axis(lg, ll[:, None], 1)[:, 0]
+        return c + jnp.sum(lse - picked), None
+    tot, _ = jax.lax.scan(body, 0.0, (xc, lc))
+    return tot / (B * S)
+g2 = jax.jit(jax.grad(ce_chunk, argnums=(0, 1)))
+t = timeit(g2, x, wv)
+print(f"CE chunk16 fwd+bwd: {t*1e3:.2f}ms ({fl/t/1e12:.1f} Tf/s eff)")

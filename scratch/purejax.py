@@ -1,0 +1,142 @@
+"""Pure-JAX GPT-2 floor: same math as bench config, raw jax.jit + optax-free
+adam, no graph engine. Variants: base, flash, fusedce, flash_fusedce, remat
+"""
+import sys, time, functools, math
+import numpy as np
+import jax, jax.numpy as jnp
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "base"
+
+V, H, L, NH, S, B = 50304, 768, 12, 12, 1024, 32
+D = H // NH
+key = jax.random.PRNGKey(0)
+
+def init():
+    ks = jax.random.split(key, 100)
+    p = {}
+    p["wte"] = jax.random.normal(ks[0], (V, H), jnp.float32) * 0.02
+    p["wpe"] = jax.random.normal(ks[1], (S, H), jnp.float32) * 0.02
+    p["lm_head"] = jax.random.normal(ks[2], (H, V), jnp.float32) * 0.02
+    p["lnf_g"] = jnp.ones((H,)); p["lnf_b"] = jnp.zeros((H,))
+    blocks = []
+    for i in range(L):
+        k = jax.random.split(ks[3 + i], 8)
+        blocks.append(dict(
+            qkv_w=jax.random.normal(k[0], (H, 3 * H), jnp.float32) * 0.02,
+            qkv_b=jnp.zeros((3 * H,)),
+            out_w=jax.random.normal(k[1], (H, H), jnp.float32) * 0.01,
+            out_b=jnp.zeros((H,)),
+            up_w=jax.random.normal(k[2], (H, 4 * H), jnp.float32) * 0.02,
+            up_b=jnp.zeros((4 * H,)),
+            dn_w=jax.random.normal(k[3], (4 * H, H), jnp.float32) * 0.01,
+            dn_b=jnp.zeros((H,)),
+            ln1_g=jnp.ones((H,)), ln1_b=jnp.zeros((H,)),
+            ln2_g=jnp.ones((H,)), ln2_b=jnp.zeros((H,)),
+        ))
+    p["blocks"] = blocks
+    return jax.tree.map(lambda x: x.astype(jnp.bfloat16), p)
+
+def ln(x, g, b):
+    m = jnp.mean(x.astype(jnp.float32), -1, keepdims=True)
+    v = jnp.var(x.astype(jnp.float32), -1, keepdims=True)
+    return ((x - m) * jax.lax.rsqrt(v + 1e-5) * g + b).astype(x.dtype)
+
+def attn_xla(q, k, v):
+    # [B,S,NH,D]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (1.0 / math.sqrt(D))
+    qi = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+    s = jnp.where(ki <= qi, s.astype(jnp.float32), -jnp.inf)
+    p = jax.nn.softmax(s, -1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+use_flash = VARIANT in ("flash", "flash_fusedce", "flash_remat")
+if use_flash:
+    sys.path.insert(0, "/root/repo")
+    from hetu_tpu.ops.pallas.flash_attention import flash_attention
+
+def block_fwd(x, bp):
+    h = ln(x, bp["ln1_g"], bp["ln1_b"])
+    qkv = h @ bp["qkv_w"] + bp["qkv_b"]
+    q, k, v = jnp.split(qkv, 3, -1)
+    q = q.reshape(B, S, NH, D); k = k.reshape(B, S, NH, D)
+    v = v.reshape(B, S, NH, D)
+    if use_flash:
+        a = flash_attention(q, k, v, causal=True)
+    else:
+        a = attn_xla(q, k, v)
+    a = a.reshape(B, S, H)
+    x = x + a @ bp["out_w"] + bp["out_b"]
+    h = ln(x, bp["ln2_g"], bp["ln2_b"])
+    h = jax.nn.gelu(h @ bp["up_w"] + bp["up_b"])
+    x = x + h @ bp["dn_w"] + bp["dn_b"]
+    return x
+
+fused_ce = VARIANT in ("fusedce", "flash_fusedce")
+
+def loss_fn(p, ids, labels):
+    x = p["wte"][ids] + p["wpe"][None, :S]
+    for bp in p["blocks"]:
+        x = block_fwd(x, bp)
+    x = ln(x, p["lnf_g"], p["lnf_b"])
+    if fused_ce:
+        # chunked CE: never materialize full [B*S, V] logits at once
+        xf = x.reshape(B * S, H)
+        lf = labels.reshape(B * S)
+        CH = 8
+        xc = xf.reshape(CH, (B * S) // CH, H)
+        lc = lf.reshape(CH, (B * S) // CH)
+        def body(c, op):
+            xx, ll = op
+            lg = (xx @ p["lm_head"]).astype(jnp.float32)
+            lse = jax.nn.logsumexp(lg, -1)
+            picked = jnp.take_along_axis(lg, ll[:, None], 1)[:, 0]
+            return c + jnp.sum(lse - picked), None
+        tot, _ = jax.lax.scan(body, 0.0, (xc, lc))
+        return tot / (B * S)
+    lg = (x @ p["lm_head"]).astype(jnp.float32)
+    lp = jax.nn.log_softmax(lg, -1)
+    picked = jnp.take_along_axis(lp, labels[..., None], -1)[..., 0]
+    return -jnp.mean(picked)
+
+def adam_update(p, g, m, v, step):
+    b1, b2, eps, lr = 0.9, 0.999, 1e-8, 1e-4
+    m = jax.tree.map(lambda m_, g_: b1 * m_ + (1 - b1) * g_.astype(jnp.float32), m, g)
+    v = jax.tree.map(lambda v_, g_: b2 * v_ + (1 - b2) * jnp.square(g_.astype(jnp.float32)), v, g)
+    def upd(p_, m_, v_):
+        mh = m_ / (1 - b1 ** step); vh = v_ / (1 - b2 ** step)
+        return (p_.astype(jnp.float32) - lr * mh / (jnp.sqrt(vh) + eps)).astype(p_.dtype)
+    return jax.tree.map(upd, p, m, v), m, v
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def train_step(p, m, v, step, ids, labels):
+    lval, g = jax.value_and_grad(loss_fn)(p, ids, labels)
+    p, m, v = adam_update(p, g, m, v, step)
+    return p, m, v, lval
+
+p = init()
+m = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), p)
+v = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), p)
+rng = np.random.RandomState(0)
+IDS = jnp.asarray(rng.randint(0, V, (B, S)), jnp.int32)
+LBL = jnp.roll(IDS, -1, 1)
+
+t0 = time.perf_counter()
+for i in range(2):
+    p, m, v, lval = train_step(p, m, v, jnp.float32(i + 1), IDS, LBL)
+np.asarray(lval)
+t1 = time.perf_counter()
+steps = 8
+t0 = time.perf_counter()
+for i in range(steps):
+    p, m, v, lval = train_step(p, m, v, jnp.float32(i + 3), IDS, LBL)
+np.asarray(lval)
+dt = (time.perf_counter() - t0) / steps
+tok = B * S / dt
+# honest flops: matmul params (no embeddings) + attention
+n_mat = H * 3 * H + H * H + H * 4 * H * 2
+n_mat = n_mat * L + H * V
+att = 12 * S * H * L // 2  # causal halves the realized flops
+fl = (6 * n_mat + att) * tok
+print(f"VARIANT={VARIANT} step={dt*1e3:.1f}ms tok/s={tok:,.0f} "
+      f"honestMFU={fl/197e12:.3f} (compile {t1-t0:.0f}s)")
