@@ -83,13 +83,21 @@ def main():
 
     n_params = sum(
         int(np.prod(t.concrete_shape())) for t in g._var_tensors.values())
+    # Honest matmul-FLOP accounting: embedding tables are gathers, not
+    # matmuls — exclude wte/wpe from the 6N term.  (lm_head is untied here
+    # and IS a matmul, so it stays in n_matmul.)  Attention scores/values
+    # add 12*L*S*H per token for full attention; causal halves it to
+    # 6*L*S*H (fwd=2*S*H per layer causal, bwd=2x fwd).
+    n_matmul = sum(
+        int(np.prod(t.concrete_shape())) for t in g._var_tensors.values()
+        if not (t.name and ("wte" in t.name or "wpe" in t.name)))
     tokens_per_step = batch * seq
     tokens_per_sec = tokens_per_step / dt
     n_chips = 1  # bench runs single-chip
     tps_per_chip = tokens_per_sec / n_chips
-    # 6*N flops/token (fwd+bwd)
-    flops_per_sec = 6.0 * n_params * tokens_per_sec
-    mfu = flops_per_sec / peak_flops_per_chip()
+    attn_flops_per_token = 6.0 * cfg.num_layers * seq * cfg.hidden_size
+    flops_per_token = 6.0 * n_matmul + attn_flops_per_token
+    mfu = flops_per_token * tokens_per_sec / peak_flops_per_chip()
     result = {
         "metric": "gpt2_tokens_per_sec_per_chip",
         "value": round(tps_per_chip, 1),
@@ -98,7 +106,10 @@ def main():
         "extra": {
             "step_time_s": round(dt, 4),
             "mfu": round(mfu, 4),
+            "mfu_formula": "(6*n_matmul + 6*L*S*H_causal_attn)*tok/s "
+                           "/ peak; embedding gathers excluded",
             "params": n_params,
+            "params_matmul": n_matmul,
             "platform": jax.devices()[0].platform,
             "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
             "batch": batch, "seq": seq,

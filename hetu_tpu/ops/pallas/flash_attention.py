@@ -10,10 +10,20 @@ Returns (out, lse); the log-sum-exp output is what ring attention's online
 correction needs (reference ``AttnCommRing::ExecCorr``,
 ``ops/ParallelAttention.h:361``) and what the backward recompute uses.
 
+Backward is a single fused kernel (dq, dk, dv in one grid pass): grid
+(bh, q, kv) with kv innermost; dq accumulates in a per-q-block VMEM
+scratch, dk/dv accumulate in full-sequence VMEM scratch written out once
+per bh, and delta = rowsum(do*o) is computed in-kernel at kv==0 — so the
+score matrix is materialized once per (q, kv) block pair instead of twice
+(the split dq / dkv formulation).  Sequences whose dk/dv scratch would
+exceed the VMEM budget fall back to the split two-kernel path.
+
 Layout: [batch, seq, heads, head_dim] (reference convention).  Internally
 [b*h, s, d].  Causal masking is block-skipped (fully-masked kv blocks are
 not computed).  ``segment_ids`` gives packed/varlen semantics (the
-cu_seqlens path of the reference, ``ops/Attention.h:286``).
+cu_seqlens path of the reference, ``ops/Attention.h:286``).  Narrow
+(8-lane) layouts are used for the lse / delta / q-segment operands — not
+full 128-lane broadcasts.
 
 On CPU the kernel runs in interpret mode so the whole path is testable on
 the simulated mesh (SURVEY.md §4 takeaway).
@@ -41,17 +51,21 @@ def _interpret() -> bool:
 LANES = 128      # last-dim tile width
 SUBLANES = 8     # second-to-last tile width (f32/int32)
 
+# dk/dv full-sequence fp32 scratch budget for the fused backward; above
+# this the split two-kernel path is used (e.g. d=64 -> sk <= 8192).
+_FUSED_DKV_VMEM_BYTES = 4 * 1024 * 1024
+
 
 def _padded_segs(segment_ids, b, h, sq, sk):
     """Broadcast segment ids into TPU-tileable layouts: q side
-    [bh, sq, LANES], kv side [bh, SUBLANES, sk] (stock-kernel trick).
+    [bh, sq, SUBLANES] (narrow lanes), kv side [bh, SUBLANES, sk].
 
     ``segment_ids`` is either a [b, sq] array (shared q/kv — requires
     sq == sk) or a tuple ``(q_ids [b, sq], kv_ids [b, sk])`` — the ring
     attention case where the visiting KV block carries its own ids.
     """
     if segment_ids is None:
-        q_segs = jnp.zeros((b * h, sq, LANES), jnp.int32)
+        q_segs = jnp.zeros((b * h, sq, SUBLANES), jnp.int32)
         kv_segs = jnp.zeros((b * h, SUBLANES, sk), jnp.int32)
         return q_segs, kv_segs
     if isinstance(segment_ids, (tuple, list)):
@@ -62,7 +76,7 @@ def _padded_segs(segment_ids, b, h, sq, sk):
                 "segment_ids with sq != sk needs a (q_ids, kv_ids) tuple")
         q_ids = kv_ids = segment_ids
     flat_q = jnp.repeat(q_ids[:, None, :], h, axis=1).reshape(b * h, sq)
-    q_segs = jnp.broadcast_to(flat_q[:, :, None], (b * h, sq, LANES))
+    q_segs = jnp.broadcast_to(flat_q[:, :, None], (b * h, sq, SUBLANES))
     flat_kv = jnp.repeat(kv_ids[:, None, :], h, axis=1).reshape(b * h, sk)
     kv_segs = jnp.broadcast_to(flat_kv[:, None, :], (b * h, SUBLANES, sk))
     return q_segs, kv_segs
@@ -115,7 +129,7 @@ def _fwd_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,  # inputs
             cols = kv_idx * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows + offset, s, DEFAULT_MASK_VALUE)
         if use_segs:
-            qs = q_seg_ref[0, :, 0]        # [bq] (lane-padded layout)
+            qs = q_seg_ref[0, :, 0]        # [bq] (narrow-lane layout)
             ks = kv_seg_ref[0, 0, :]       # [bk] (sublane-padded layout)
             seg_ok = qs[:, None] == ks[None, :]
             s = jnp.where(seg_ok, s, DEFAULT_MASK_VALUE)
@@ -161,7 +175,7 @@ def _flash_fwd(q, k, v, scale, causal, segment_ids, causal_offset=0):
         kernel,
         grid=(b * h, num_q, num_kv),
         in_specs=[
-            pl.BlockSpec((1, bq, LANES), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, SUBLANES), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, SUBLANES, bk), lambda bh, i, j: (bh, 0, j)),
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
@@ -169,11 +183,11 @@ def _flash_fwd(q, k, v, scale, causal, segment_ids, causal_offset=0):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bq, LANES), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, SUBLANES), lambda bh, i, j: (bh, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, SUBLANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -188,7 +202,140 @@ def _flash_fwd(q, k, v, scale, causal, segment_ids, causal_offset=0):
 
 
 # ---------------------------------------------------------------------------
-# backward
+# backward — fused single kernel (dq + dk + dv)
+# ---------------------------------------------------------------------------
+
+def _bwd_fused_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
+                      o_ref, lse_ref,
+                      dq_ref, dk_ref, dv_ref,
+                      dq_acc, dk_acc, dv_acc, delta_scr,
+                      *, scale, causal, offset, bq, bk, num_q, num_kv,
+                      use_segs):
+    q_idx = pl.program_id(1)
+    kv_idx = pl.program_id(2)
+
+    @pl.when(jnp.logical_and(q_idx == 0, kv_idx == 0))
+    def _init_kv():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(kv_idx == 0)
+    def _init_q():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        delta = jnp.sum(do * o, axis=1)          # rowsum(do*o), in-kernel
+        delta_scr[:] = jnp.broadcast_to(delta[:, None], delta_scr.shape)
+
+    # fully-masked (q, kv) block pairs contribute to none of dq/dk/dv
+    run = True
+    if causal:
+        run = kv_idx * bk <= q_idx * bq + bq - 1 + offset
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_idx * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = kv_idx * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows + offset, s, DEFAULT_MASK_VALUE)
+        if use_segs:
+            seg_ok = (q_seg_ref[0, :, 0][:, None]
+                      == kv_seg_ref[0, 0, :][None, :])
+            s = jnp.where(seg_ok, s, DEFAULT_MASK_VALUE)
+        lse = lse_ref[0, :, 0]
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
+        dv_acc[pl.dslice(kv_idx * bk, bk), :] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = delta_scr[:, 0]
+        ds = p * (dp - delta[:, None]) * scale
+        dsl = ds.astype(q.dtype)
+        dq_acc[:] += jax.lax.dot_general(
+            dsl, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[pl.dslice(kv_idx * bk, bk), :] += jax.lax.dot_general(
+            dsl, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kv_idx == num_kv - 1)
+    def _fin_q():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+    @pl.when(jnp.logical_and(q_idx == num_q - 1, kv_idx == num_kv - 1))
+    def _fin_kv():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_fused(scale, causal, segment_ids, res, do, causal_offset):
+    q, k, v, out, lse = res
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    dor = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    outr = out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    lser = jnp.broadcast_to(lse.reshape(b * h, sq)[:, :, None],
+                            (b * h, sq, SUBLANES))
+    bq, _ = _block_sizes(sq, d, q.dtype)
+    _, bk = _block_sizes(sk, d, q.dtype)
+    num_q, num_kv = sq // bq, sk // bk
+
+    use_segs = segment_ids is not None
+    q_segs, kv_segs = _padded_segs(segment_ids, b, h, sq, sk)
+
+    kernel = functools.partial(
+        _bwd_fused_kernel, scale=scale, causal=causal, offset=causal_offset,
+        bq=bq, bk=bk, num_q=num_q, num_kv=num_kv, use_segs=use_segs)
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(b * h, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, bq, SUBLANES), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, SUBLANES, bk), lambda bh, i, j: (bh, 0, j)),
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, SUBLANES), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i, j: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i, j: (bh, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((sk, d), jnp.float32),
+            pltpu.VMEM((sk, d), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q_segs, kv_segs, qr, kr, vr, dor, outr, lser)
+    dq = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    dk = dk.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    dv = dv.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# backward — split two-kernel fallback (long sequences)
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
@@ -287,9 +434,8 @@ def _bwd_dkv_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(scale, causal, segment_ids, res, g, causal_offset=0):
+def _flash_bwd_split(scale, causal, segment_ids, res, do, causal_offset):
     q, k, v, out, lse = res
-    do = g[0] if isinstance(g, (tuple, list)) else g
     b, sq, h, d = q.shape
     sk = k.shape[1]
     qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
@@ -298,11 +444,11 @@ def _flash_bwd(scale, causal, segment_ids, res, g, causal_offset=0):
     dor = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     outr = out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     lser = lse.reshape(b * h, sq)
-    # delta = rowsum(do * o)  [bh, sq] -> lane-padded [bh, sq, LANES]
+    # delta = rowsum(do * o)  [bh, sq] -> narrow-lane [bh, sq, SUBLANES]
     delta = jnp.sum(dor.astype(jnp.float32) * outr.astype(jnp.float32),
                     axis=-1)
-    delta = jnp.broadcast_to(delta[:, :, None], (b * h, sq, LANES))
-    lser = jnp.broadcast_to(lser[:, :, None], (b * h, sq, LANES))
+    delta = jnp.broadcast_to(delta[:, :, None], (b * h, sq, SUBLANES))
+    lser = jnp.broadcast_to(lser[:, :, None], (b * h, sq, SUBLANES))
     bq, _ = _block_sizes(sq, d, q.dtype)
     _, bk = _block_sizes(sk, d, q.dtype)
     num_q, num_kv = sq // bq, sk // bk
@@ -317,14 +463,14 @@ def _flash_bwd(scale, causal, segment_ids, res, g, causal_offset=0):
         dq_kernel,
         grid=(b * h, num_q, num_kv),
         in_specs=[
-            pl.BlockSpec((1, bq, LANES), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, SUBLANES), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, SUBLANES, bk), lambda bh, i, j: (bh, 0, j)),
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bq, LANES), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bq, LANES), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, SUBLANES), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, SUBLANES), lambda bh, i, j: (bh, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
@@ -339,14 +485,14 @@ def _flash_bwd(scale, causal, segment_ids, res, g, causal_offset=0):
         dkv_kernel,
         grid=(b * h, num_kv, num_q),
         in_specs=[
-            pl.BlockSpec((1, bq, LANES), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq, SUBLANES), lambda bh, j, i: (bh, i, 0)),
             pl.BlockSpec((1, SUBLANES, bk), lambda bh, j, i: (bh, 0, j)),
             pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
             pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, bq, LANES), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, bq, LANES), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq, SUBLANES), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq, SUBLANES), lambda bh, j, i: (bh, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
@@ -367,6 +513,18 @@ def _flash_bwd(scale, causal, segment_ids, res, g, causal_offset=0):
     dk = dk.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
     dv = dv.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
     return dq, dk, dv
+
+
+def _flash_bwd(scale, causal, segment_ids, res, g, causal_offset=0):
+    do = g[0] if isinstance(g, (tuple, list)) else g
+    q, k, v, out, lse = res
+    sk, d = k.shape[1], k.shape[3]
+    # fused needs two full-sk fp32 scratch planes in VMEM
+    if 2 * sk * d * 4 <= _FUSED_DKV_VMEM_BYTES:
+        return _flash_bwd_fused(scale, causal, segment_ids,
+                                (q, k, v, out, lse), do, causal_offset)
+    return _flash_bwd_split(scale, causal, segment_ids,
+                            (q, k, v, out, lse), do, causal_offset)
 
 
 # ---------------------------------------------------------------------------

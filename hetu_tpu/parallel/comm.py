@@ -108,12 +108,33 @@ def axis_size(axis: str) -> int:
     return lax.axis_size(axis)
 
 
-def barrier() -> None:
+def barrier(coordinator=None, name: str = "default",
+            world_size: Optional[int] = None) -> None:
     """Host-level barrier (reference gRPC Barrier, heturpc.proto:44).
 
-    Within a single jit program XLA collectives are self-synchronizing; this
-    is only for host-side coordination between programs.
+    Within a single jit program XLA collectives are self-synchronizing;
+    this is only for host-side coordination between programs.
+
+    Single-host: a tiny device all-reduce (drains in-flight programs on
+    all local devices).  Multi-host: pass the process's
+    ``rpc.CoordinatorClient`` as ``coordinator`` — the barrier then goes
+    through its cross-host rendezvous (``CoordinatorClient.barrier``),
+    the way the reference routes Barrier through heturpc.  When a client
+    has been registered via :func:`set_coordinator` it is used
+    automatically.
     """
+    coord = coordinator if coordinator is not None else _COORDINATOR[0]
+    if coord is not None:
+        # an unresolvable world size would make the server release the
+        # barrier immediately (n=0) — a silent no-op; fail loudly instead
+        ws = world_size if world_size is not None \
+            else getattr(coord, "world_size", None)
+        if not ws:
+            raise ValueError(
+                "coordinator barrier needs a world_size (pass it here or "
+                "start the CoordinatorServer with world_size=N)")
+        coord.barrier(name=name, world_size=ws)
+        return
     # Tiny all-reduce over all devices, blocking until complete.
     n = jax.device_count()
     if n > 1:
@@ -122,25 +143,153 @@ def barrier() -> None:
             jax.pmap(lambda v: lax.psum(v, "i"), axis_name="i")(x))
 
 
+_COORDINATOR: list = [None]
+
+
+def set_coordinator(client) -> None:
+    """Register the process's CoordinatorClient so :func:`barrier` (and
+    other host-level sync points) route through the cross-host
+    coordinator instead of the local-device fallback."""
+    _COORDINATOR[0] = client
+
+
 # -- split collectives (hetero ZeRO, ops/Communication.h:655-845) -----------
 #
 # The reference defines SplitAllGather/SplitAllReduce/SplitReduceScatter that
 # run a collective independently over *sub-groups* of unequal sizes (needed
 # when hetero pipelines give parameter shards different replication factors).
-# On TPU, unequal sub-groups of one logical axis are expressed by reshaping
-# the mesh axis into (outer, inner) axes; the inner axis is the sub-group.
-# These wrappers document the mapping and implement the equal-subgroup case.
+# ``groups`` is a static partition of the axis indices, e.g. [[0,1,2],
+# [3,4,5,6,7]] — subgroup sizes may differ.  Without ``groups`` the whole
+# axis is one group (the homogeneous case).
+#
+# XLA's AllReduce takes unequal replica groups natively (axis_index_groups);
+# AllGather/ReduceScatter are shape-uniform in SPMD, so the unequal cases
+# pad to the largest subgroup: split_all_gather returns
+# max_group_size*shard rows per rank (rows beyond the own group's
+# contribution are zero), split_reduce_scatter returns L//min(group sizes)
+# rows (rows beyond the own rank's L//group_size chunk are zero).  The
+# per-rank valid extents are static, derivable from ``groups`` — the same
+# contract as the reference's per-group tensor lists.
 
-def split_all_reduce(x: jax.Array, subgroup_axis: str) -> jax.Array:
-    return lax.psum(x, subgroup_axis)
+
+def _norm_groups(groups, n: int):
+    """Validate + normalize a static group partition of range(n)."""
+    gs = [list(map(int, g)) for g in groups]
+    flat = sorted(i for g in gs for i in g)
+    if flat != list(range(n)):
+        raise ValueError(
+            f"groups {gs} must partition the {n} axis indices exactly")
+    return gs
+
+
+def _group_tables(groups, n: int):
+    """(group_id [n], members [n_groups, max_g] padded with -1,
+    rank_in_group [n], group_size [n]) as numpy arrays."""
+    import numpy as np
+    gid = np.zeros(n, np.int32)
+    rin = np.zeros(n, np.int32)
+    gsz = np.zeros(n, np.int32)
+    max_g = max(len(g) for g in groups)
+    members = np.full((len(groups), max_g), -1, np.int32)
+    for g_i, g in enumerate(groups):
+        for r, dev in enumerate(g):
+            gid[dev] = g_i
+            rin[dev] = r
+            gsz[dev] = len(g)
+            members[g_i, r] = dev
+    return gid, members, rin, gsz
+
+
+def split_all_reduce(x: jax.Array, subgroup_axis: str,
+                     groups: Optional[Sequence[Sequence[int]]] = None
+                     ) -> jax.Array:
+    """AllReduce within each (possibly unequal) subgroup
+    (SplitAllReduceOp, ops/Communication.h:718)."""
+    if groups is None:
+        return lax.psum(x, subgroup_axis)
+    n = lax.axis_size(subgroup_axis)
+    gs = _norm_groups(groups, n)
+    return lax.psum(x, subgroup_axis,
+                    axis_index_groups=[tuple(g) for g in gs])
 
 
 def split_all_gather(x: jax.Array, subgroup_axis: str,
-                     gather_dim: int = 0) -> jax.Array:
-    return lax.all_gather(x, subgroup_axis, axis=gather_dim, tiled=True)
+                     gather_dim: int = 0,
+                     groups: Optional[Sequence[Sequence[int]]] = None
+                     ) -> jax.Array:
+    """AllGather within each subgroup (SplitAllGatherOp,
+    ops/Communication.h:655).  With unequal ``groups`` the result is
+    padded to max group size: shape[gather_dim] ==
+    max_g * x.shape[gather_dim]; each rank's first
+    own_group_size * shard rows are its group's concatenated shards, the
+    rest zeros."""
+    if groups is None:
+        return lax.all_gather(x, subgroup_axis, axis=gather_dim, tiled=True)
+    gather_dim = gather_dim % x.ndim
+    n = lax.axis_size(subgroup_axis)
+    gs = _norm_groups(groups, n)
+    sizes = {len(g) for g in gs}
+    if len(sizes) == 1:
+        return lax.all_gather(x, subgroup_axis, axis=gather_dim, tiled=True,
+                              axis_index_groups=[tuple(g) for g in gs])
+    gid_t, members_t, _, _ = _group_tables(gs, n)
+    my = lax.axis_index(subgroup_axis)
+    # full-axis gather, then select own group's members (padded to max_g)
+    allx = lax.all_gather(x, subgroup_axis, axis=0, tiled=False)  # [n, ...]
+    members = jnp.asarray(members_t)[jnp.asarray(gid_t)[my]]      # [max_g]
+    picked = jnp.take(allx, jnp.maximum(members, 0), axis=0)
+    mask_shape = [members.shape[0]] + [1] * (picked.ndim - 1)
+    picked = jnp.where((members >= 0).reshape(mask_shape), picked, 0)
+    # tile into gather_dim:  [max_g, ..., s, ...] -> [..., max_g*s, ...]
+    picked = jnp.moveaxis(picked, 0, gather_dim)
+    shape = list(x.shape)
+    shape[gather_dim] = members.shape[0] * x.shape[gather_dim]
+    return picked.reshape(shape)
 
 
 def split_reduce_scatter(x: jax.Array, subgroup_axis: str,
-                         scatter_dim: int = 0) -> jax.Array:
-    return lax.psum_scatter(x, subgroup_axis, scatter_dimension=scatter_dim,
-                            tiled=True)
+                         scatter_dim: int = 0,
+                         groups: Optional[Sequence[Sequence[int]]] = None
+                         ) -> jax.Array:
+    """ReduceScatter within each subgroup (SplitReduceScatterOp,
+    ops/Communication.h:782).  With unequal ``groups`` the result is
+    padded to the largest chunk (L // min group size); each rank's first
+    L // own_group_size rows are its chunk of the group-reduced tensor,
+    the rest zeros."""
+    if groups is None:
+        return lax.psum_scatter(x, subgroup_axis,
+                                scatter_dimension=scatter_dim, tiled=True)
+    scatter_dim = scatter_dim % x.ndim
+    n = lax.axis_size(subgroup_axis)
+    gs = _norm_groups(groups, n)
+    sizes = {len(g) for g in gs}
+    if len(sizes) == 1:
+        return lax.psum_scatter(x, subgroup_axis,
+                                scatter_dimension=scatter_dim, tiled=True,
+                                axis_index_groups=[tuple(g) for g in gs])
+    L = x.shape[scatter_dim]
+    for g in gs:
+        if L % len(g) != 0:
+            raise ValueError(
+                f"scatter dim {L} not divisible by subgroup size {len(g)}")
+    max_chunk = L // min(sizes)
+    gid_t, _, rin_t, gsz_t = _group_tables(gs, n)
+    my = lax.axis_index(subgroup_axis)
+    reduced = lax.psum(x, subgroup_axis,
+                       axis_index_groups=[tuple(g) for g in gs])
+    chunk = L // jnp.asarray(gsz_t)[my]                # traced per-rank
+    offset = jnp.asarray(rin_t)[my] * chunk
+    # static-size slice of max_chunk starting at offset (pad tail so the
+    # slice never clamps into another rank's chunk), then mask the excess
+    pad = [(0, 0)] * x.ndim
+    pad[scatter_dim] = (0, max_chunk)
+    padded = jnp.pad(reduced, pad)
+    starts = [jnp.int32(0)] * x.ndim
+    starts[scatter_dim] = offset
+    sizes_out = list(x.shape)
+    sizes_out[scatter_dim] = max_chunk
+    out = lax.dynamic_slice(padded, starts, sizes_out)
+    pos_shape = [1] * x.ndim
+    pos_shape[scatter_dim] = max_chunk
+    pos = jnp.arange(max_chunk).reshape(pos_shape)
+    return jnp.where(pos < chunk, out, 0)

@@ -28,8 +28,12 @@ Split patterns (reference ``SplitPattern`` NORMAL/SYM,
 Variable per-rank sequence lengths (reference ``_seq_len_list``) and
 packed/varlen sequences ride the same mechanism: local segment ids
 (global doc ids, ``-1`` = padding) travel the ring *with* their KV block
-and mask score entries whose q/kv ids differ — supported in the NORMAL
-pattern, where contiguity keeps global causal order per segment.
+and mask score entries whose q/kv ids differ.  This works under BOTH
+split patterns: the segment mask is an id-equality test — independent of
+position order — so it composes multiplicatively with the SYM structural
+masks (CAUSAL_SYM/COL/ROW), each branch slicing the travelling id pair to
+its q/kv halves (reference supports ``_seq_len_list`` under SYM,
+``ParallelAttention.h:342``, ``.cc:140-200``).
 
 Usage: inside ``shard_map`` with the sequence dim sharded over
 ``axis_name``; or via :func:`ring_attention_sharded` which wraps the
@@ -52,6 +56,17 @@ from ..ops.pallas.flash_attention import _flash_bwd, _flash_fwd
 # at runtime they are compressed into per-pattern 0..2 branch indices
 # (see _mask_kind) so only reachable branches compile
 CAUSAL, FULL, EMPTY, CAUSAL_SYM, COL, ROW = range(6)
+
+
+def _seg_slice(segs, qs, ks):
+    """Slice a (q_ids, kv_ids) tuple to the given q/kv ranges; None
+    ranges keep the full side, segs=None stays None (shared by the SYM
+    fwd/bwd branches so their masks cannot diverge)."""
+    if segs is None:
+        return None
+    q_ids, kv_ids = segs
+    return (q_ids if qs is None else q_ids[:, qs],
+            kv_ids if ks is None else kv_ids[:, ks])
 
 
 def _merge(acc, o_r, lse_r):
@@ -79,8 +94,8 @@ def _pair_fwd(q, k, v, scale, mask_kind, segs, pattern, causal):
     ``mask_kind`` is a 0..2 class index whose meaning depends on the
     static ``pattern`` (normal: CAUSAL/FULL/EMPTY; sym:
     CAUSAL_SYM/COL/ROW) so only the three reachable branches compile;
-    ``segs`` is None or a ``(q_ids [b,s], kv_ids [b,s])`` tuple (NORMAL
-    pattern only).
+    ``segs`` is None or a ``(q_ids [b,s], kv_ids [b,s])`` tuple — under
+    SYM each branch slices the pair to its q/kv halves.
     """
     b, s, h, d = q.shape
     sh = s // 2
@@ -101,20 +116,23 @@ def _pair_fwd(q, k, v, scale, mask_kind, segs, pattern, causal):
         # [[causal, empty], [full, causal]] on (head, tail) halves:
         # qh vs kh causal; qt vs full kv causal shifted by sh
         o1, l1 = _flash_fwd(q[:, :sh], k[:, :sh], v[:, :sh], scale, True,
-                            None)
-        o2, l2 = _flash_fwd(q[:, sh:], k, v, scale, True, None,
+                            _seg_slice(segs, slice(None, sh), slice(None, sh)))
+        o2, l2 = _flash_fwd(q[:, sh:], k, v, scale, True,
+                            _seg_slice(segs, slice(sh, None), None),
                             causal_offset=sh)
         return (jnp.concatenate([o1, o2], axis=1).astype(jnp.float32),
                 jnp.concatenate([l1, l2], axis=2))
 
     def col_fn(_):
         # all q rows see only the kv head half (earlier chunk)
-        o, lse = _flash_fwd(q, k[:, :sh], v[:, :sh], scale, False, None)
+        o, lse = _flash_fwd(q, k[:, :sh], v[:, :sh], scale, False,
+                            _seg_slice(segs, None, slice(None, sh)))
         return o.astype(jnp.float32), lse
 
     def row_fn(_):
         # only the q tail half sees this (later) rank's kv
-        o2, l2 = _flash_fwd(q[:, sh:], k, v, scale, False, None)
+        o2, l2 = _flash_fwd(q[:, sh:], k, v, scale, False,
+                            _seg_slice(segs, slice(sh, None), None))
         o = jnp.concatenate(
             [jnp.zeros((b, sh, h, d), jnp.float32), o2.astype(jnp.float32)],
             axis=1)
@@ -147,11 +165,11 @@ def _pair_bwd(q, k, v, do, out, lse, scale, mask_kind, segs, pattern,
 
     def causal_sym_fn(_):
         dq1, dk1, dv1 = _flash_bwd(
-            scale, True, None,
+            scale, True, _seg_slice(segs, slice(None, sh), slice(None, sh)),
             (q[:, :sh], k[:, :sh], v[:, :sh], out[:, :sh], lse[:, :, :sh]),
             do[:, :sh])
         dq2, dk2, dv2 = _flash_bwd(
-            scale, True, None,
+            scale, True, _seg_slice(segs, slice(sh, None), None),
             (q[:, sh:], k, v, out[:, sh:], lse[:, :, sh:]),
             do[:, sh:], causal_offset=sh)
         dq = jnp.concatenate([dq1, dq2], axis=1)
@@ -162,7 +180,7 @@ def _pair_bwd(q, k, v, do, out, lse, scale, mask_kind, segs, pattern,
 
     def col_fn(_):
         dq, dkh, dvh = _flash_bwd(
-            scale, False, None,
+            scale, False, _seg_slice(segs, None, slice(None, sh)),
             (q, k[:, :sh], v[:, :sh], out, lse), do)
         pad = jnp.zeros((b, s - sh, h, d), dkh.dtype)
         return (dq, jnp.concatenate([dkh, pad], axis=1),
@@ -170,7 +188,7 @@ def _pair_bwd(q, k, v, do, out, lse, scale, mask_kind, segs, pattern,
 
     def row_fn(_):
         dq2, dk, dv = _flash_bwd(
-            scale, False, None,
+            scale, False, _seg_slice(segs, slice(sh, None), None),
             (q[:, sh:], k, v, out[:, sh:], lse[:, :, sh:]), do[:, sh:])
         dq = jnp.concatenate(
             [jnp.zeros((b, sh, h, d), dq2.dtype), dq2], axis=1)
@@ -357,7 +375,8 @@ def ring_attention(q, k, v, axis_name: str = "cp", causal: bool = True,
     ``split_pattern``: "normal" (contiguous) or "sym" (symmetric causal
     load balancing; shard with :func:`sym_shard`).
     ``segment_ids``: local [b, s_local] global doc ids for packed
-    sequences; ``-1`` marks padding (NORMAL pattern only).
+    sequences; ``-1`` marks padding.  Under SYM the ids are in the
+    rank's local (head+tail chunk) layout and ride the ring with the KV.
     ``seq_len``: this rank's valid length (scalar; positions >= seq_len
     are padding) — the reference's per-rank ``_seq_len_list``.  May be
     combined with ``segment_ids``.
@@ -366,10 +385,6 @@ def ring_attention(q, k, v, axis_name: str = "cp", causal: bool = True,
         else 1.0 / math.sqrt(q.shape[-1])
     b, s = q.shape[0], q.shape[1]
     use_segs = segment_ids is not None or seq_len is not None
-    if use_segs and split_pattern == "sym":
-        raise NotImplementedError(
-            "varlen/packed ring attention requires the NORMAL split "
-            "pattern (SYM chunking would break global segment order)")
     if split_pattern == "sym" and s % 2 != 0:
         raise ValueError(f"sym split needs an even local seq, got {s}")
     if segment_ids is None:
@@ -419,6 +434,11 @@ def ring_attention_sharded(q, k, v, mesh, axis_name: str = "cp",
         b, s = q.shape[0], q.shape[1]
         segs = jnp.zeros((b, s), jnp.int32) if segment_ids is None \
             else segment_ids.astype(jnp.int32)
+        if split_pattern == "sym":
+            # ids follow their tokens into the SYM layout; seq_lens below
+            # then mask per-rank LOCAL tail positions (the reference's
+            # _seq_len_list semantics), i.e. in the reordered frame.
+            segs = sym_shard(segs, cp, axis=1)
         if seq_lens is not None:
             s_local = s // cp
             pos = jnp.arange(s, dtype=jnp.int32)[None, :]
@@ -433,7 +453,10 @@ def ring_attention_sharded(q, k, v, mesh, axis_name: str = "cp",
                 segment_ids=sg),
             mesh, (spec, spec, spec, P(axis_or_none(batch_axis),
                                        axis_name)), spec)
-        return fn(q, k, v, segs)
+        out = fn(q, k, v, segs)
+        if split_pattern == "sym":
+            out = sym_unshard(out, cp, axis=1)
+        return out
 
     fn = shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis_name, causal,

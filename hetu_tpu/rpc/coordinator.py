@@ -372,4 +372,7 @@ def distributed_init(server_address: str, num_hosts: int,
                                    process_id=rank,
                                    local_device_ids=None)
     client.barrier("init", world_size=num_hosts)
+    # route host-level comm.barrier() through the coordinator from now on
+    from ..parallel.comm import set_coordinator
+    set_coordinator(client)
     return client

@@ -66,3 +66,73 @@ class TestCollectives:
                                                    concat_dim=0),
                    x, P("x", None), P(None, "x"))
         np.testing.assert_allclose(np.asarray(out), x)
+
+
+class TestSplitCollectives:
+    """Unequal-subgroup split collectives (reference SplitAllReduce /
+    SplitAllGather / SplitReduceScatter, ops/Communication.h:655-845) —
+    oracle is the dense per-group numpy computation."""
+
+    GROUPS = [[0, 1, 2], [3, 4, 5, 6, 7]]  # unequal 3 + 5
+
+    def test_split_all_reduce_unequal(self, devices8):
+        mesh = create_mesh({"x": 8}, devices8)
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        out = _run(mesh,
+                   lambda v: comm.split_all_reduce(v, "x", self.GROUPS),
+                   x, P("x"), P("x"))
+        expect = np.zeros(8, np.float32)
+        for g in self.GROUPS:
+            expect[g] = sum(float(i) for i in g)
+        np.testing.assert_allclose(np.asarray(out).ravel(), expect)
+
+    def test_split_all_reduce_equal_groups(self, devices8):
+        mesh = create_mesh({"x": 8}, devices8)
+        groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        out = _run(mesh, lambda v: comm.split_all_reduce(v, "x", groups),
+                   x, P("x"), P("x"))
+        np.testing.assert_allclose(np.asarray(out).ravel(),
+                                   np.array([6.0] * 4 + [22.0] * 4))
+
+    def test_split_all_gather_unequal(self, devices8):
+        mesh = create_mesh({"x": 8}, devices8)
+        # each rank holds 2 rows; groups of 3 and 5 -> padded to 5*2 rows
+        x = np.arange(16, dtype=np.float32).reshape(16, 1)
+        f = shard_map(
+            lambda v: comm.split_all_gather(v, "x", 0, self.GROUPS),
+            create_mesh({"x": 8}, devices8), (P("x"),), P("x"))
+        out = np.asarray(jax.jit(f)(x))          # [8 * 10, 1]
+        out = out.reshape(8, 10)
+        for g in self.GROUPS:
+            rows = np.concatenate(
+                [np.arange(2 * r, 2 * r + 2, dtype=np.float32) for r in g])
+            for r in g:
+                np.testing.assert_allclose(out[r, :len(rows)], rows)
+                np.testing.assert_allclose(out[r, len(rows):], 0.0)
+
+    def test_split_reduce_scatter_unequal(self, devices8):
+        mesh = create_mesh({"x": 8}, devices8)
+        # every rank holds a full 30-vector (divisible by 3 and 5);
+        # rank r contributes r everywhere
+        L = 30
+        x = np.repeat(np.arange(8, dtype=np.float32), L).reshape(8 * L, 1)
+        f = shard_map(
+            lambda v: comm.split_reduce_scatter(v, "x", 0, self.GROUPS),
+            mesh, (P("x"),), P("x"))
+        out = np.asarray(jax.jit(f)(x)).reshape(8, -1)  # padded to L//3=10
+        for g in self.GROUPS:
+            gsum = sum(float(i) for i in g)
+            chunk = L // len(g)
+            for pos, r in enumerate(g):
+                np.testing.assert_allclose(out[r, :chunk], gsum)
+                np.testing.assert_allclose(out[r, chunk:], 0.0)
+
+    def test_split_groups_must_partition(self, devices8):
+        mesh = create_mesh({"x": 8}, devices8)
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        import pytest
+        with pytest.raises(ValueError, match="partition"):
+            _run(mesh,
+                 lambda v: comm.split_all_reduce(v, "x", [[0, 1], [2, 3]]),
+                 x, P("x"), P("x"))

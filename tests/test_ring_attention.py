@@ -249,11 +249,78 @@ class TestVarlenRing:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-4)
 
-    def test_sym_plus_varlen_raises(self, devices8):
-        mesh = ht.create_mesh({"cp": 4}, devices8[:4])
-        q, k, v = _mk(s=128)
-        with pytest.raises(NotImplementedError):
-            ring_attention_sharded(q, k, v, mesh, causal=True,
-                                   batch_axis=None, head_axis=None,
-                                   split_pattern="sym",
-                                   seq_lens=np.array([32, 32, 32, 16]))
+    def test_sym_packed_segments_match_oracle(self, devices8):
+        """SYM + packed docs (reference supports _seq_len_list/varlen
+        under SplitPattern::SYM, ParallelAttention.h:342): the segment
+        mask is order-independent so it composes with the SYM classes."""
+        cp, s = 4, 256
+        mesh = ht.create_mesh({"cp": cp}, devices8[:4])
+        q, k, v = _mk(s=s)
+        doc = np.zeros(s, np.int32)
+        doc[100:180] = 1
+        doc[180:] = 2
+        segs = np.broadcast_to(doc, (q.shape[0], s)).copy()
+
+        out = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                     batch_axis=None, head_axis=None,
+                                     split_pattern="sym",
+                                     segment_ids=jnp.asarray(segs))
+        ref = sdpa_reference(q, k, v, causal=True,
+                             segment_ids=jnp.asarray(segs))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_sym_unequal_per_rank_lengths_match_oracle(self, devices8):
+        """SYM + per-rank _seq_len_list: rank-local tail positions (in
+        the SYM head+tail chunk layout) are padding."""
+        from hetu_tpu.parallel.ring_attention import sym_indices
+        cp, s_local = 4, 64
+        s = cp * s_local
+        mesh = ht.create_mesh({"cp": cp}, devices8[:4])
+        q, k, v = _mk(s=s)
+        lens = np.array([64, 32, 48, 16], np.int32)
+
+        out = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                     batch_axis=None, head_axis=None,
+                                     split_pattern="sym", seq_lens=lens)
+        # oracle: valid mask is defined in the SYM (reordered) frame;
+        # map it back to global token order through sym_indices
+        pos_r = np.arange(s)
+        valid_r = (pos_r % s_local) < lens[pos_r // s_local]
+        fwd = sym_indices(s, cp)
+        valid = np.empty(s, bool)
+        valid[fwd] = valid_r
+        segs = np.where(valid, 0, -1 - np.arange(s)).astype(np.int32)
+        segs = np.broadcast_to(segs, (q.shape[0], s))
+        ref = sdpa_reference(q, k, v, causal=True,
+                             segment_ids=jnp.asarray(segs))
+        ov = np.asarray(out)[:, valid]
+        rv = np.asarray(ref)[:, valid]
+        np.testing.assert_allclose(ov, rv, rtol=1e-4, atol=1e-4)
+
+    def test_sym_varlen_bwd(self, devices8):
+        cp, s = 4, 128
+        mesh = ht.create_mesh({"cp": cp}, devices8[:4])
+        q, k, v = _mk(s=s)
+        doc = np.zeros(s, np.int32)
+        doc[50:] = 1
+        segs = np.broadcast_to(doc, (q.shape[0], s)).copy()
+
+        def loss_ring(q, k, v):
+            o = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                       batch_axis=None, head_axis=None,
+                                       split_pattern="sym",
+                                       segment_ids=jnp.asarray(segs))
+            return jnp.sum(o ** 2)
+
+        def loss_ref(q, k, v):
+            o = sdpa_reference(q, k, v, causal=True,
+                               segment_ids=jnp.asarray(segs))
+            return jnp.sum(o ** 2)
+
+        g1 = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-3,
+                                       err_msg=f"d{name}")
