@@ -37,9 +37,12 @@ def parse_args():
     # parallel layout
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1, help="pipeline stages")
     p.add_argument("--sp", action="store_true", help="sequence parallel")
+    p.add_argument("--zero", type=int, default=0, choices=[0, 1, 2, 3],
+                   help="ZeRO level for optimizer state/grad/param sharding")
     p.add_argument("--ds-config", type=str, default=None,
-                   help="ds_parallel_config JSON path (overrides dp/tp)")
+                   help="ds_parallel_config JSON path (overrides dp/tp/pp)")
     # training
     p.add_argument("--global-batch", type=int, default=16)
     p.add_argument("--micro-batch", type=int, default=None)
@@ -67,32 +70,36 @@ def main():
 
     log = get_logger("train_gpt")
     n_dev = len(jax.devices())
-    dp, tp = args.dp, args.tp
+    dp, tp, pp, zero = args.dp, args.tp, args.pp, args.zero
     if args.ds_config:
         with open(args.ds_config) as f:
             cfg_json = json.load(f)
         ncfg = len(cfg_json["devices"])
         assert ncfg <= n_dev, f"config wants {ncfg} devices, have {n_dev}"
-        first = cfg_json["input"]
-        dp = first["split"]["0"][0]
-        tp = first["dup"][0]
-        stage_groups = {tuple(b["attn"]["qkv"]["device_group_union"][0])
-                        for b in cfg_json["gpt"]["blocks"].values()}
-        if len(stage_groups) > 1:
-            sys.exit(f"config has pp={len(stage_groups)} pipeline stages; "
-                     "this script runs the SPMD (dp x tp) path — use "
-                     "hetu_tpu.models.GPTPipelineModel for pipelined "
-                     "training")
-    assert dp * tp <= n_dev, f"dp*tp={dp * tp} > devices={n_dev}"
+        from hetu_tpu.utils.ds_config import parse_layout
+        dp, tp, pp, cfg_zero = parse_layout(cfg_json)
+        if cfg_zero:
+            zero = max(zero, 1)
+    assert dp * tp * pp <= n_dev, \
+        f"dp*tp*pp={dp * tp * pp} > devices={n_dev}"
 
-    mesh = ht.create_mesh({"dp": dp, "tp": tp},
-                          jax.devices()[:dp * tp]) if dp * tp > 1 else None
+    if pp > 1:
+        mesh = ht.create_mesh({"pp": pp, "dp": dp, "tp": tp},
+                              jax.devices()[:dp * tp * pp])
+    elif dp * tp > 1:
+        mesh = ht.create_mesh({"dp": dp, "tp": tp},
+                              jax.devices()[:dp * tp])
+    else:
+        mesh = None
     micro = args.micro_batch or max(1, args.global_batch // dp)
     num_micro = max(1, args.global_batch // (micro * dp))
     mk = llama_config if args.model == "llama" else GPTConfig
+    if args.sp and pp > 1:
+        log.warning("--sp is not supported with pipeline parallelism; "
+                    "training pp=%d WITHOUT sequence parallelism", pp)
     cfg = mk(vocab_size=args.vocab_size, hidden_size=args.hidden,
              num_layers=args.layers, num_heads=args.heads,
-             max_seq_len=args.seq_len, sp=args.sp,
+             max_seq_len=args.seq_len, sp=args.sp and pp == 1,
              dtype="bfloat16" if args.bf16 else "float32")
 
     # data: token stream -> fixed windows through the native loader
@@ -113,9 +120,14 @@ def main():
         labels = ht.parallel_placeholder(
             "int32", batch_shape, pspec=P("dp", None) if mesh else None,
             name="labels")
-        model = GPTLMHeadModel(cfg)
-        loss = model(ids, labels)
-        train_op = optim.AdamOptimizer(lr=args.lr).minimize(loss)
+        if pp > 1:
+            from hetu_tpu.models.gpt_pipeline import GPTPipelineModel
+            model = GPTPipelineModel(cfg, num_stages=pp)
+            loss = model(ids, labels, num_micro_batches=num_micro)
+        else:
+            model = GPTLMHeadModel(cfg)
+            loss = model(ids, labels)
+        train_op = optim.AdamOptimizer(lr=args.lr, zero=zero).minimize(loss)
         if args.load:
             from hetu_tpu.utils.checkpoint import load_model
             load_model(model, args.load)
@@ -132,8 +144,9 @@ def main():
                 else:                          # native matrix layout
                     x, y = batch[:, :args.seq_len], batch[:, args.seq_len:]
                 with sp_prof:
+                    # pp>1: micro-batching happens inside pipeline_spmd
                     out = g.run(loss, [loss, train_op], {ids: x, labels: y},
-                                num_micro_batches=num_micro)
+                                num_micro_batches=1 if pp > 1 else num_micro)
                 step += 1
                 if step % args.log_every == 0 or step == args.steps:
                     st = sp_prof.stats()

@@ -24,7 +24,7 @@ from jax.sharding import PartitionSpec as P
 from .. import ops as _ops
 from ..graph.ctor import NormalInitializer, parallel_parameter
 from ..nn import Module, VocabParallelEmbedding, vocab_parallel_cross_entropy
-from ..nn.parallel import ParallelRMSNorm, sharded
+from ..nn.parallel import ParallelLayerNorm, ParallelRMSNorm, sharded
 from ..ops.attention import sdpa
 from ..parallel.pipeline import pipeline_spmd
 from .gpt import GPTConfig
@@ -51,8 +51,18 @@ def _rms(x, w, eps=1e-6):
     return (out * w.astype(jnp.float32)).astype(x.dtype)
 
 
+def _layernorm(x, w, b, eps=1e-5):
+    # mirrors ops.layer_norm (input-dtype math) so pipelined GPT-2 blocks
+    # match the non-pipelined model numerically
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=-1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * w + b
+
+
 def block_fn(params, x, *, cfg: GPTConfig, mesh=None):
-    """One LLaMA-style block (rmsnorm/rotary/swiglu), pure function.
+    """One transformer block, pure function: LLaMA-style
+    (rmsnorm/rotary/swiglu, bias-free) or GPT-2-style
+    (layernorm/learned-positions/gelu, with biases) by ``cfg``.
 
     params: dict of this layer's weights; x: [b, s, h].
     """
@@ -63,18 +73,27 @@ def block_fn(params, x, *, cfg: GPTConfig, mesh=None):
         if mesh is None:
             return v
         return lax.with_sharding_constraint(v, NamedSharding(mesh, spec))
-    b, s, hdim = x.shape
-    cos, sin = _rotary_tables(s, c.head_dim)
 
-    h = _rms(x, params["ln1"])
+    def _norm(x, which):
+        if c.norm == "rmsnorm":
+            return _rms(x, params[which])
+        return _layernorm(x, params[which], params[which + "_b"])
+
+    b, s, hdim = x.shape
+
+    h = _norm(x, "ln1")
     qkv = jnp.einsum("bsh,oh->bso", h, params["qkv"])
+    if "qkv_b" in params:
+        qkv = qkv + params["qkv_b"]
     qkv = _wsc(qkv, P(c.dp_axis, None, c.tp_axis))
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, s, c.num_heads, c.head_dim)
     k = k.reshape(b, s, c.num_heads, c.head_dim)
     v = v.reshape(b, s, c.num_heads, c.head_dim)
-    q = _apply_rotary(q, cos, sin)
-    k = _apply_rotary(k, cos, sin)
+    if c.position == "rotary":
+        cos, sin = _rotary_tables(s, c.head_dim)
+        q = _apply_rotary(q, cos, sin)
+        k = _apply_rotary(k, cos, sin)
     spec4 = P(c.dp_axis, None, c.tp_axis, None)
     q = _wsc(q, spec4)
     k = _wsc(k, spec4)
@@ -83,15 +102,24 @@ def block_fn(params, x, *, cfg: GPTConfig, mesh=None):
     attn = attn.reshape(b, s, c.num_heads * c.head_dim)
     attn = _wsc(attn, P(c.dp_axis, None, c.tp_axis))
     attn_out = jnp.einsum("bso,ho->bsh", attn, params["attn_out"])
+    if "attn_out_b" in params:
+        attn_out = attn_out + params["attn_out_b"]
     attn_out = _wsc(attn_out, P(c.dp_axis, None, None))
     x = x + attn_out
 
-    h = _rms(x, params["ln2"])
+    h = _norm(x, "ln2")
     up = jnp.einsum("bsh,oh->bso", h, params["mlp_up"])
+    if "mlp_up_b" in params:
+        up = up + params["mlp_up_b"]
     up = _wsc(up, P(c.dp_axis, None, c.tp_axis))
-    u1, u2 = jnp.split(up, 2, axis=-1)
-    act = jax.nn.silu(u1) * u2
+    if c.activation == "swiglu":
+        u1, u2 = jnp.split(up, 2, axis=-1)
+        act = jax.nn.silu(u1) * u2
+    else:
+        act = jax.nn.gelu(up, approximate=True)
     down = jnp.einsum("bso,ho->bsh", act, params["mlp_down"])
+    if "mlp_down_b" in params:
+        down = down + params["mlp_down_b"]
     down = _wsc(down, P(c.dp_axis, None, None))
     return x + down
 
@@ -107,18 +135,11 @@ class GPTPipelineModel(Module):
                  pp_axis: str = "pp"):
         super().__init__()
         assert config.num_layers % num_stages == 0
-        # block_fn implements a dense swiglu/rotary/rmsnorm MHA block; fail
-        # loudly on config fields it does not honor rather than silently
-        # building the wrong architecture
+        # fail loudly on config fields block_fn does not honor rather than
+        # silently building the wrong architecture
         if config.num_kv_heads not in (None, config.num_heads):
             raise NotImplementedError("pipelined blocks are MHA-only "
                                       "(num_kv_heads must equal num_heads)")
-        for fld, want in (("activation", "swiglu"), ("norm", "rmsnorm"),
-                          ("position", "rotary")):
-            if getattr(config, fld) != want:
-                raise NotImplementedError(
-                    f"pipelined blocks only support {fld}={want!r}, "
-                    f"got {getattr(config, fld)!r}")
         if config.dropout:
             raise NotImplementedError("pipelined blocks do not support "
                                       "dropout")
@@ -127,13 +148,23 @@ class GPTPipelineModel(Module):
         self.pp_axis = pp_axis
         self.layers_per_stage = config.num_layers // num_stages
         c = config
+        biased = c.activation == "gelu"   # GPT-2 convention (models/gpt.py)
 
         self.wte = VocabParallelEmbedding(
             c.vocab_size, c.hidden_size, dp_axis=c.dp_axis, tp_axis=c.tp_axis,
             dtype=c.dtype, init=NormalInitializer(0.0, c.init_std), name="wte")
-        self.ln_f = ParallelRMSNorm(c.hidden_size, sp=False,
-                                    dp_axis=c.dp_axis, tp_axis=c.tp_axis,
-                                    dtype=c.dtype, name="ln_f")
+        if c.position == "learned":
+            self.wpe = parallel_parameter(
+                NormalInitializer(0.0, c.init_std),
+                (c.max_seq_len, c.hidden_size), pspec=P(None, None),
+                dtype=c.dtype, name="wpe")
+        else:
+            self.wpe = None
+        norm_cls = ParallelRMSNorm if c.norm == "rmsnorm" \
+            else ParallelLayerNorm
+        self.ln_f = norm_cls(c.hidden_size, sp=False,
+                             dp_axis=c.dp_axis, tp_axis=c.tp_axis,
+                             dtype=c.dtype, name="ln_f")
         self.lm_head = parallel_parameter(
             NormalInitializer(0.0, c.init_std), (c.vocab_size, c.hidden_size),
             pspec=P(c.tp_axis, None), dtype=c.dtype, name="lm_head")
@@ -143,25 +174,38 @@ class GPTPipelineModel(Module):
         # spec entries.
         S, L = num_stages, self.layers_per_stage
         h, f = c.hidden_size, c.ffn_size
+        self._stacked = {}
 
         def stacked(name, shape, pspec_tail, std):
-            return parallel_parameter(
+            t = parallel_parameter(
                 NormalInitializer(0.0, std), (S, L, *shape),
                 pspec=P(pp_axis, None, *pspec_tail), dtype=c.dtype,
                 name=f"blocks.{name}")
+            self._stacked[name] = t
+            setattr(self, f"blk_{name}", t)
+            return t
 
         depth_std = c.init_std / math.sqrt(2 * c.num_layers)
-        self.blk_ln1 = stacked("ln1", (h,), (None,), 0.0)
-        self.blk_qkv = stacked("qkv", (3 * h, h), (c.tp_axis, None),
-                               c.init_std)
-        self.blk_attn_out = stacked("attn_out", (h, h), (None, c.tp_axis),
-                                    depth_std)
-        self.blk_ln2 = stacked("ln2", (h,), (None,), 0.0)
-        self.blk_mlp_up = stacked("mlp_up", (2 * f, h), (c.tp_axis, None),
-                                  c.init_std)
-        self.blk_mlp_down = stacked("mlp_down", (h, f), (None, c.tp_axis),
-                                    depth_std)
-        # norms init to 1
+        up_rows = (2 if c.activation == "swiglu" else 1) * f
+        stacked("ln1", (h,), (None,), 0.0)
+        if c.norm == "layernorm":
+            stacked("ln1_b", (h,), (None,), 0.0)
+        stacked("qkv", (3 * h, h), (c.tp_axis, None), c.init_std)
+        if biased:
+            stacked("qkv_b", (3 * h,), (c.tp_axis,), 0.0)
+        stacked("attn_out", (h, h), (None, c.tp_axis), depth_std)
+        if biased:
+            stacked("attn_out_b", (h,), (None,), 0.0)
+        stacked("ln2", (h,), (None,), 0.0)
+        if c.norm == "layernorm":
+            stacked("ln2_b", (h,), (None,), 0.0)
+        stacked("mlp_up", (up_rows, h), (c.tp_axis, None), c.init_std)
+        if biased:
+            stacked("mlp_up_b", (up_rows,), (c.tp_axis,), 0.0)
+        stacked("mlp_down", (h, f), (None, c.tp_axis), depth_std)
+        if biased:
+            stacked("mlp_down_b", (h,), (None,), 0.0)
+        # norm scales init to 1
         g = self.blk_ln1.graph
         g.reset_variable(self.blk_ln1, np.ones((S, L, h), np.float32))
         g.reset_variable(self.blk_ln2, np.ones((S, L, h), np.float32))
@@ -171,12 +215,14 @@ class GPTPipelineModel(Module):
         c = self.config
         mesh = self.wte.weight.graph.mesh
         x = self.wte(input_ids)
+        if self.wpe is not None:
+            seq_len = input_ids.shape[-1]
+            pos = _ops.getitem(self.wpe, slice(0, seq_len))
+            x = x + pos
+        keys = list(self._stacked.keys())
 
-        def _impl(x, ln1, qkv, attn_out, ln2, mlp_up, mlp_down,
-                  num_micro_batches=1):
-            stage_params = {"ln1": ln1, "qkv": qkv, "attn_out": attn_out,
-                            "ln2": ln2, "mlp_up": mlp_up,
-                            "mlp_down": mlp_down}
+        def _impl(x, *stacked_arrays, num_micro_batches=1):
+            stage_params = dict(zip(keys, stacked_arrays))
 
             def stage_fn(params, x_mb):
                 # scan this stage's layer range (leading dim L/S)
@@ -190,8 +236,7 @@ class GPTPipelineModel(Module):
 
         x = _ops.functional._op(
             "pipeline_transformer", _impl,
-            [x, self.blk_ln1, self.blk_qkv, self.blk_attn_out,
-             self.blk_ln2, self.blk_mlp_up, self.blk_mlp_down],
+            [x, *self._stacked.values()],
             {"num_micro_batches": num_micro_batches})
 
         x = self.ln_f(x)

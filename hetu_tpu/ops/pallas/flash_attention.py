@@ -519,8 +519,10 @@ def _flash_bwd(scale, causal, segment_ids, res, g, causal_offset=0):
     do = g[0] if isinstance(g, (tuple, list)) else g
     q, k, v, out, lse = res
     sk, d = k.shape[1], k.shape[3]
-    # fused needs two full-sk fp32 scratch planes in VMEM
-    if 2 * sk * d * 4 <= _FUSED_DKV_VMEM_BYTES:
+    # fused pins two full-sk fp32 scratch planes PLUS the full-sk dk/dv
+    # output blocks (constant-index out_specs) in VMEM per bh iteration
+    dkv_bytes = 2 * sk * d * (4 + jnp.dtype(k.dtype).itemsize)
+    if dkv_bytes <= _FUSED_DKV_VMEM_BYTES:
         return _flash_bwd_fused(scale, causal, segment_ids,
                                 (q, k, v, out, lse), do, causal_offset)
     return _flash_bwd_split(scale, causal, segment_ids,

@@ -9,8 +9,19 @@ optimizer-state variables, multi-zero awareness) and the Python wrappers
 ``DefineAndRunGraph.run``; under jit the whole fwd+bwd+update is one XLA
 program with donated parameter/state buffers (the analogue of the
 reference's fused param/grad buffers + fused Optimizers.cu kernels).
-ZeRO: when a parameter's DS carries the ``zero`` flag, optimizer states are
-sharded over the dup axis via GSPMD sharding annotations.
+ZeRO levels (reference ``zero`` DS flag, ``distributed_states.h:69``,
+grad reduce-scatter / param allgather comm ops ``Communication.h:583``),
+expressed as GSPMD sharding annotations instead of explicit collectives —
+the XLA partitioner then emits the reduce-scatter/all-gather pairs:
+
+- ``zero=1`` — optimizer states sharded over the dp axis.
+- ``zero=2`` — + gradients constrained to the same dp-sharded spec inside
+  the update (XLA turns the dp grad all-reduce into reduce-scatter and
+  gathers the updated params back).
+- ``zero=3`` — + parameters stored dp-sharded at rest (FSDP); forward /
+  backward all-gathers are inserted by the partitioner on demand.
+
+``zero=True`` keeps its historical meaning of level 1.
 """
 from __future__ import annotations
 
@@ -25,13 +36,17 @@ from ..graph.tensor import Tensor
 
 class Optimizer:
     def __init__(self, params: Optional[Sequence[Tensor]] = None,
-                 lr: float = 0.01, zero: bool = False, dp_axis: str = "dp"):
+                 lr: float = 0.01, zero: int = 0, dp_axis: str = "dp"):
         self.lr = lr
         self.params = list(params) if params is not None else None
-        self.zero = zero          # ZeRO: shard optimizer states over dp
+        self.zero = int(zero)     # ZeRO level 0-3 (True -> 1)
+        if not 0 <= self.zero <= 3:
+            raise ValueError(f"zero level must be 0..3, got {zero}")
         self.dp_axis = dp_axis
         self._state: Dict[str, Any] = {}
         self._shardings: Dict[int, Any] = {}  # tid -> NamedSharding of states
+        self._param_shardings: Dict[int, Any] = {}  # tid -> zero-3 sharding
+        self._param_base_shardings: Dict[int, Any] = {}  # tid -> own spec
 
     # -- graph API (reference Optimizer::Minimize) ---------------------------
 
@@ -91,6 +106,37 @@ class Optimizer:
                         if sharding is not None:
                             tree[tid] = jax.device_put(arr, sharding)
                             self._shardings[tid] = sharding
+        if self.zero in (1, 2) and graph.mesh is not None \
+                and not self._param_base_shardings:
+            # pin updated params to their OWN spec (replicated over dp):
+            # with dp-sharded states XLA would otherwise freely emit
+            # dp-sharded params, silently turning zero-1/2 into FSDP
+            from jax.sharding import NamedSharding, PartitionSpec
+            for t in xs:
+                arr = var_state.get(t.id)
+                if arr is None or not hasattr(arr, "ndim"):
+                    continue
+                base = graph._pspec_for(t)
+                spec = list(base) if base is not None else []
+                spec += [None] * (arr.ndim - len(spec))
+                self._param_base_shardings[t.id] = NamedSharding(
+                    graph.mesh, PartitionSpec(*spec))
+        if self.zero >= 3:
+            # FSDP: parameters live dp-sharded at rest.  Re-assert every
+            # step (device_put on an already-sharded array is a no-op) so
+            # checkpoint loads / hot switches can't silently unshard.
+            for t in xs:
+                arr = var_state.get(t.id)
+                if arr is None or not hasattr(arr, "shape"):
+                    continue
+                sh = self._param_shardings.get(t.id)
+                if sh is None:
+                    sh = self._state_sharding(t, arr, graph)
+                    if sh is None:
+                        continue
+                    self._param_shardings[t.id] = sh
+                var_state[t.id] = jax.device_put(arr, sh)
+                graph._var_data[t.id] = var_state[t.id]
         return self._state
 
     def _c(self, tid: int, arr):
@@ -98,6 +144,23 @@ class Optimizer:
         (XLA would otherwise choose output shardings freely)."""
         sh = self._shardings.get(tid)
         return jax.lax.with_sharding_constraint(arr, sh) if sh is not None else arr
+
+    def _c_grad(self, tid: int, g):
+        """ZeRO>=2: constrain the gradient to the dp-sharded state spec —
+        the partitioner then reduce-scatters the dp gradient sum instead
+        of all-reducing it (reference SplitReduceScatter under zero,
+        Communication.h:583)."""
+        return self._c(tid, g) if self.zero >= 2 else g
+
+    def _c_param(self, tid: int, p):
+        """ZeRO-3: keep the updated parameter dp-sharded at rest;
+        ZeRO-1/2: pin it to its own (dp-replicated) spec — the param
+        allgather of the reference's zero pairing."""
+        sh = self._param_shardings.get(tid) if self.zero >= 3 \
+            else self._param_base_shardings.get(tid)
+        if sh is not None:
+            return jax.lax.with_sharding_constraint(p, sh)
+        return p
 
     def _store_state(self, state: Dict[str, Any]) -> None:
         self._state = dict(state)
@@ -166,16 +229,18 @@ class SGDOptimizer(Optimizer):
         new_opt = dict(opt_state)
         if self.momentum == 0.0:
             for t in xs:
-                g = grads[t.id].astype(var_state[t.id].dtype)
-                new_vars[t.id] = var_state[t.id] - self.lr * g
+                g = self._c_grad(t.id, grads[t.id].astype(var_state[t.id].dtype))
+                new_vars[t.id] = self._c_param(
+                    t.id, var_state[t.id] - self.lr * g)
             return new_vars, new_opt
         vel = dict(opt_state["velocity"])
         for t in xs:
-            g = grads[t.id].astype(var_state[t.id].dtype)
+            g = self._c_grad(t.id, grads[t.id].astype(var_state[t.id].dtype))
             v = self._c(t.id, self.momentum * vel[t.id] + g)
             vel[t.id] = v
             upd = g + self.momentum * v if self.nesterov else v
-            new_vars[t.id] = var_state[t.id] - self.lr * upd
+            new_vars[t.id] = self._c_param(
+                t.id, var_state[t.id] - self.lr * upd)
         new_opt["velocity"] = vel
         return new_vars, new_opt
 
@@ -212,7 +277,7 @@ class AdamOptimizer(Optimizer):
         bc1 = 1.0 - b1 ** step.astype(jnp.float32)
         bc2 = 1.0 - b2 ** step.astype(jnp.float32)
         for t in xs:
-            g = grads[t.id].astype(jnp.float32)
+            g = self._c_grad(t.id, grads[t.id].astype(jnp.float32))
             p = var_state[t.id]
             if self.weight_decay and not self.decoupled_weight_decay:
                 g = g + self.weight_decay * p.astype(jnp.float32)  # Adam-L2
@@ -223,7 +288,8 @@ class AdamOptimizer(Optimizer):
             upd = self.lr * m_hat / (jnp.sqrt(v_hat) + self.eps)
             if self.weight_decay and self.decoupled_weight_decay:
                 upd = upd + self.lr * self.weight_decay * p.astype(jnp.float32)
-            new_vars[t.id] = (p.astype(jnp.float32) - upd).astype(p.dtype)
+            new_vars[t.id] = self._c_param(
+                t.id, (p.astype(jnp.float32) - upd).astype(p.dtype))
         return new_vars, {"step": step, "m": m, "v": v}
 
 

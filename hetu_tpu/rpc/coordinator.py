@@ -372,7 +372,11 @@ def distributed_init(server_address: str, num_hosts: int,
                                    process_id=rank,
                                    local_device_ids=None)
     client.barrier("init", world_size=num_hosts)
-    # route host-level comm.barrier() through the coordinator from now on
+    # route host-level comm.barrier() through the coordinator from now on;
+    # the server may have been started without world_size, so pin the one
+    # we were given (plain comm.barrier() relies on it)
+    if client.world_size is None:
+        client.world_size = num_hosts
     from ..parallel.comm import set_coordinator
     set_coordinator(client)
     return client

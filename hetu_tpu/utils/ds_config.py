@@ -144,6 +144,30 @@ def save_ds_config(cfg: Dict, path: str) -> None:
         json.dump(cfg, f, indent=2)
 
 
+def parse_layout(cfg: Dict):
+    """Derive the (dp, tp, pp, zero) layout from a ds_parallel_config —
+    the entry-path inverse of :func:`generate_gpt_3d_config` (reference
+    reads the same fields in ``examples/gpt/train_hetu.py:256-335``).
+
+    ``pp`` = number of distinct block device groups, in layer order
+    (each stage's blocks share a DeviceGroupUnion).
+    """
+    first = cfg["input"]
+    dp = first["split"].get("0", [1])[0]
+    tp = first["dup"][0]
+    seen: List[tuple] = []
+    blocks = sorted(cfg["gpt"]["blocks"].items(),
+                    key=lambda kv: kv[1].get("range", [0])[0])
+    for _, block in blocks:
+        grp = tuple(block["attn"]["qkv"]["device_group_union"][0])
+        if grp not in seen:
+            seen.append(grp)
+    pp = max(1, len(seen))
+    zero = bool(cfg.get("zero")) or any(
+        e.get("zero") for _, _, e in iter_block_entries(cfg))
+    return dp, tp, pp, zero
+
+
 def iter_block_entries(cfg: Dict):
     """Yield (block_range, sub_name, entry) for every leaf block entry."""
     for bname, block in cfg["gpt"]["blocks"].items():

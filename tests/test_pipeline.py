@@ -15,11 +15,12 @@ from hetu_tpu.models.gpt import llama_config
 from hetu_tpu.models.gpt_pipeline import GPTPipelineModel
 
 
-def _train(mesh_shape, num_stages, steps=3, nmb=2, seed=555):
+def _train(mesh_shape, num_stages, steps=3, nmb=2, seed=555, mk=None):
     ctor._seed_counter[0] = seed
     mesh = ht.create_mesh(mesh_shape)
-    cfg = llama_config(vocab_size=64, hidden_size=32, num_layers=4,
-                       num_heads=4, max_seq_len=16, sp=False)
+    mk = mk or llama_config
+    cfg = mk(vocab_size=64, hidden_size=32, num_layers=4,
+             num_heads=4, max_seq_len=16, sp=False)
     with ht.graph("define_and_run", create_new=True, mesh=mesh) as g:
         ids = ht.parallel_placeholder("int32", (8, 16), pspec=P("dp", None),
                                       name="ids")
@@ -51,6 +52,16 @@ class TestPipeline:
         a = _train({"pp": 2, "dp": 1, "tp": 1}, 2, nmb=2)
         b = _train({"pp": 2, "dp": 1, "tp": 1}, 2, nmb=4)
         np.testing.assert_allclose(a, b, rtol=3e-3, atol=1e-4)
+
+    def test_gpt2_blocks_pipeline(self, devices8):
+        """GPT-2-style blocks (gelu/layernorm/learned positions, biases)
+        pipeline too — the flagship bench config is no longer barred from
+        pp (reference places the same blocks across stages regardless of
+        architecture, examples/gpt/train_hetu.py:256)."""
+        from hetu_tpu.models.gpt import GPTConfig
+        base = _train({"pp": 1, "dp": 1, "tp": 1}, 1, mk=GPTConfig)
+        pp2 = _train({"pp": 2, "dp": 2, "tp": 2}, 2, mk=GPTConfig)
+        np.testing.assert_allclose(base, pp2, rtol=3e-3, atol=1e-4)
 
     def test_layers_not_divisible_raises(self, devices8):
         mesh = ht.create_mesh({"pp": 4, "dp": 2, "tp": 1})
