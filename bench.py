@@ -2,14 +2,25 @@
 
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 
-Metric is GPT-2 (124M-class) training tokens/sec/chip (BASELINE.json north
-star).  vs_baseline reports measured MFU relative to the 40%-MFU target
-(1.0 == 40% MFU), since the reference repo publishes no raw numbers
-(BASELINE.md).
+Primary metric is GPT-2 (124M-class) training tokens/sec/chip
+(BASELINE.json north star).  vs_baseline reports measured MFU relative to
+the 40%-MFU target (1.0 == 40% MFU), since the reference repo publishes
+no raw numbers (BASELINE.md).  MFU counts matmul FLOPs only (embedding
+gathers excluded) with a causal attention term — see mfu_formula in the
+output.
+
+The BASELINE.json metric list also names BERT-base samples/sec and
+multi-chip scaling efficiency; both are measured here and reported in
+"extra": BERT on the same chip, scaling on a virtual 8-device CPU mesh
+(an upper bound on dispatch/collective overhead — real multi-chip
+hardware is not available to this harness; the dp-8 mesh path itself is
+validated by dryrun_multichip).
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -32,15 +43,21 @@ def peak_flops_per_chip() -> float:
     return 197e12  # conservative default (also used for CPU smoke runs)
 
 
-def main():
+def _sync_vars(g):
+    # block_until_ready can be a no-op under remote-relay PJRT backends;
+    # force a real host fetch of one element of the first/last updated
+    # tensors (waits for the optimizer update)
+    arrs = list(g._var_data.values())
+    for arr in (arrs[0], arrs[-1]):
+        np.asarray(arr.ravel()[0])
+
+
+def bench_gpt2(on_tpu: bool):
     import jax
-    import jax.numpy as jnp
     import hetu_tpu as ht
     from hetu_tpu import optim
     from hetu_tpu.models import GPTConfig, GPTLMHeadModel
 
-    on_tpu = jax.devices()[0].platform == "tpu"
-    # GPT-2 small-class config; trimmed when benching on CPU fallback.
     if on_tpu:
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                         num_heads=12, max_seq_len=1024, sp=False,
@@ -64,55 +81,181 @@ def main():
         IDS = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
         L = np.roll(IDS, -1, axis=1)
 
-        def _sync():
-            # block_until_ready can be a no-op under remote-relay PJRT
-            # backends; force a real host fetch of one element of every
-            # updated tensor class: a param (waits for the optimizer update)
-            arrs = list(g._var_data.values())
-            for arr in (arrs[0], arrs[-1]):
-                np.asarray(arr.ravel()[0])
-
         for _ in range(warmup):
             g.run(loss, [loss, train_op], {ids: IDS, labels: L})
-            _sync()
+            _sync_vars(g)
         t0 = time.perf_counter()
         for _ in range(steps):
             g.run(loss, [loss, train_op], {ids: IDS, labels: L})
-        _sync()
+        _sync_vars(g)
         dt = (time.perf_counter() - t0) / steps
 
-    n_params = sum(
-        int(np.prod(t.concrete_shape())) for t in g._var_tensors.values())
-    # Honest matmul-FLOP accounting: embedding tables are gathers, not
-    # matmuls — exclude wte/wpe from the 6N term.  (lm_head is untied here
-    # and IS a matmul, so it stays in n_matmul.)  Attention scores/values
-    # add 12*L*S*H per token for full attention; causal halves it to
-    # 6*L*S*H (fwd=2*S*H per layer causal, bwd=2x fwd).
-    n_matmul = sum(
-        int(np.prod(t.concrete_shape())) for t in g._var_tensors.values()
-        if not (t.name and ("wte" in t.name or "wpe" in t.name)))
-    tokens_per_step = batch * seq
-    tokens_per_sec = tokens_per_step / dt
-    n_chips = 1  # bench runs single-chip
-    tps_per_chip = tokens_per_sec / n_chips
+        n_params = sum(
+            int(np.prod(t.concrete_shape())) for t in g._var_tensors.values())
+        # Honest matmul-FLOP accounting: embedding tables are gathers, not
+        # matmuls — exclude wte/wpe from the 6N term.  (lm_head is untied
+        # here and IS a matmul, so it stays in n_matmul.)  Attention
+        # scores/values add 12*L*S*H per token full, 6*L*S*H causal
+        # (fwd=2*S*H per layer causal, bwd=2x fwd).
+        n_matmul = sum(
+            int(np.prod(t.concrete_shape())) for t in g._var_tensors.values()
+            if not (t.name and ("wte" in t.name or "wpe" in t.name)))
+
+    tokens_per_sec = batch * seq / dt
     attn_flops_per_token = 6.0 * cfg.num_layers * seq * cfg.hidden_size
     flops_per_token = 6.0 * n_matmul + attn_flops_per_token
     mfu = flops_per_token * tokens_per_sec / peak_flops_per_chip()
+    return {
+        "tokens_per_sec": tokens_per_sec,
+        "step_time_s": dt,
+        "mfu": mfu,
+        "params": n_params,
+        "params_matmul": n_matmul,
+        "batch": batch, "seq": seq,
+    }
+
+
+def bench_bert(on_tpu: bool):
+    """BERT-base pretraining samples/sec (BASELINE.json metric 2;
+    reference tests/hetu_bert.py setup: MLM + NSP)."""
+    import hetu_tpu as ht
+    from hetu_tpu import optim
+    from hetu_tpu.models.bert import BertConfig, BertForPreTraining
+
+    if on_tpu:
+        cfg = BertConfig(vocab_size=30528, hidden_size=768, num_layers=12,
+                         num_heads=12, max_seq_len=512, dtype="bfloat16")
+        batch, seq, steps, warmup = 32, 128, 10, 3
+    else:
+        cfg = BertConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                         num_heads=4, max_seq_len=128, dtype="float32")
+        batch, seq, steps, warmup = 4, 64, 3, 1
+
+    with ht.graph("define_and_run", create_new=True) as g:
+        ids = ht.placeholder("int32", (batch, seq), name="input_ids")
+        mlm = ht.placeholder("int32", (batch, seq), name="mlm_labels")
+        nsp = ht.placeholder("int32", (batch,), name="nsp_labels")
+        model = BertForPreTraining(cfg)
+        loss = model(ids, mlm_labels=mlm, nsp_labels=nsp)
+        train_op = optim.AdamOptimizer(lr=1e-4).minimize(loss)
+
+        rng = np.random.RandomState(0)
+        IDS = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        MLM = np.where(rng.rand(batch, seq) < 0.15, IDS, -100).astype(np.int32)
+        NSP = rng.randint(0, 2, (batch,)).astype(np.int32)
+        feed = {ids: IDS, mlm: MLM, nsp: NSP}
+
+        for _ in range(warmup):
+            g.run(loss, [loss, train_op], feed)
+            _sync_vars(g)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            g.run(loss, [loss, train_op], feed)
+        _sync_vars(g)
+        dt = (time.perf_counter() - t0) / steps
+    return {"samples_per_sec": batch / dt, "step_time_s": dt,
+            "batch": batch, "seq": seq}
+
+
+def bench_scaling_virtual(n_devices: int = 8) -> dict:
+    """dp-scaling efficiency on a virtual CPU mesh (dispatch/collective
+    overhead bound; BASELINE.json metric 3 proxy — no multi-chip hardware
+    in this harness).  Runs in a JAX_PLATFORMS=cpu subprocess so the
+    default backend is never touched (round-3 postmortem)."""
+    code = (
+        "import os, sys, json, time\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import hetu_tpu as ht\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from hetu_tpu import optim\n"
+        "from hetu_tpu.models import GPTConfig, GPTLMHeadModel\n"
+        "def tput(dp):\n"
+        "    cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,\n"
+        "                    num_heads=4, max_seq_len=128, sp=False)\n"
+        "    mesh = ht.create_mesh({'dp': dp}, jax.devices()[:dp]) \\\n"
+        "        if dp > 1 else None\n"
+        "    batch = 4 * dp\n"
+        "    with ht.graph('define_and_run', create_new=True, mesh=mesh) as g:\n"
+        "        ids = ht.parallel_placeholder('int32', (batch, 128),\n"
+        "            pspec=P('dp', None) if mesh else None, name='ids')\n"
+        "        lbl = ht.parallel_placeholder('int32', (batch, 128),\n"
+        "            pspec=P('dp', None) if mesh else None, name='lbl')\n"
+        "        model = GPTLMHeadModel(cfg)\n"
+        "        loss = model(ids, lbl)\n"
+        "        op = optim.AdamOptimizer(lr=1e-4).minimize(loss)\n"
+        "        I = np.random.RandomState(0).randint(0, 512, (batch, 128))\n"
+        "        I = I.astype(np.int32)\n"
+        "        feed = {ids: I, lbl: np.roll(I, -1, 1)}\n"
+        "        def sync():\n"
+        "            arrs = list(g._var_data.values())\n"
+        "            np.asarray(arrs[0].ravel()[0])\n"
+        "            np.asarray(arrs[-1].ravel()[0])\n"
+        "        for _ in range(2):\n"
+        "            g.run(loss, [loss, op], feed)\n"
+        "        sync()\n"
+        "        t0 = time.perf_counter()\n"
+        "        for _ in range(5):\n"
+        "            g.run(loss, [loss, op], feed)\n"
+        "        sync()\n"
+        "        dt = (time.perf_counter() - t0) / 5\n"
+        "    return batch * 128 / dt\n"
+        f"t1 = tput(1)\n"
+        f"tn = tput({n_devices})\n"
+        f"print(json.dumps({{'t1': t1, 'tn': tn,"
+        f" 'efficiency': tn / ({n_devices} * t1),"
+        f" 'efficiency_vs_shared_host': tn / t1}}))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    import re
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True, timeout=1200)
+        lines = proc.stdout.strip().splitlines()
+        if not lines:
+            return {"error": f"rc={proc.returncode}: "
+                             f"{proc.stderr.strip()[-400:]}"}
+        return json.loads(lines[-1])
+    except Exception as e:  # never fail the headline bench on this
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def main():
+    import jax
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    gpt = bench_gpt2(on_tpu)
+    bert = bench_bert(on_tpu)
+    scaling = bench_scaling_virtual(8)
+
+    mfu = gpt["mfu"]
     result = {
         "metric": "gpt2_tokens_per_sec_per_chip",
-        "value": round(tps_per_chip, 1),
+        "value": round(gpt["tokens_per_sec"], 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
         "extra": {
-            "step_time_s": round(dt, 4),
+            "step_time_s": round(gpt["step_time_s"], 4),
             "mfu": round(mfu, 4),
             "mfu_formula": "(6*n_matmul + 6*L*S*H_causal_attn)*tok/s "
                            "/ peak; embedding gathers excluded",
-            "params": n_params,
-            "params_matmul": n_matmul,
+            "params": gpt["params"],
+            "params_matmul": gpt["params_matmul"],
             "platform": jax.devices()[0].platform,
             "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
-            "batch": batch, "seq": seq,
+            "batch": gpt["batch"], "seq": gpt["seq"],
+            "bert_samples_per_sec": round(bert["samples_per_sec"], 2),
+            "bert_step_time_s": round(bert["step_time_s"], 4),
+            "bert_batch": bert["batch"], "bert_seq": bert["seq"],
+            "scaling_virtual8": scaling,
         },
     }
     print(json.dumps(result))

@@ -58,6 +58,7 @@ class Bucket:
         self._padded_cu: List[np.ndarray] = []
         self._packed: Optional[np.ndarray] = None
         self._packed_cu: List[np.ndarray] = []
+        self._packed_lens: List[np.ndarray] = []
 
     def add_data(self, sequence: np.ndarray, valid_tokens: int) -> None:
         seq = np.asarray(sequence).reshape(-1)[:valid_tokens]
@@ -118,21 +119,25 @@ class Bucket:
                 raise ValueError(
                     f"packed row {gi} needs {need} aligned tokens, exceeds "
                     f"max_seqlen {self.max_seqlen}")
-        rows, cus = [], []
+        rows, cus, lens = [], [], []
         for g in groups:
             row = np.full(self.max_seqlen, self.pad_token, np.int64)
             cu = [0]
+            ln = []
             off = 0
             for i in g:
                 seq = self._seqs[i]
                 row[off:off + len(seq)] = seq
                 off = _align_up(off + len(seq), self.alignment)
                 cu.append(off)
+                ln.append(len(seq))
             rows.append(row)
             cus.append(np.asarray(cu, np.int32))
+            lens.append(np.asarray(ln, np.int32))
         self._packed = np.stack(rows) if rows else \
             np.zeros((0, self.max_seqlen), np.int64)
         self._packed_cu = cus
+        self._packed_lens = lens
 
     # -- accessors (reference property surface) ----------------------------
 
@@ -167,6 +172,12 @@ class Bucket:
     @property
     def packed_cu_seqlens_list(self) -> List[np.ndarray]:
         return self._packed_cu
+
+    @property
+    def packed_valid_lens_list(self) -> List[np.ndarray]:
+        """Per packed row: each doc's VALID token count (cu offsets are
+        alignment-padded; doc k occupies [cu[k], cu[k]+lens[k]))."""
+        return self._packed_lens
 
 
 def _valid_lens(batch: np.ndarray, pad_token: int) -> np.ndarray:

@@ -127,6 +127,18 @@ class StrategyModel:
         self.layer_comm_cost = layer_comm_cost
         self.pipeline_p2p_cost = pipeline_p2p_cost
 
+    @classmethod
+    def from_calibration(cls, calibration, num_devices: int,
+                         num_layers: int, batch: int, seq: int,
+                         hidden: int, ffn: int, **kw) -> "StrategyModel":
+        """Build with MEASURED comm/compute ratios instead of the default
+        constants (planner.profile_hardware.Calibration; reference
+        profile_hardware.py feeding the Galvatron cost model)."""
+        consts = calibration.elastic_constants(batch, seq, hidden, ffn)
+        kw.setdefault("layer_comm_cost", consts["layer_comm_cost"])
+        kw.setdefault("pipeline_p2p_cost", consts["pipeline_p2p_cost"])
+        return cls(num_devices, num_layers, **kw)
+
     # -- TP grouping (reference solve_tp_arrangments_new) --------------------
 
     def solve_tp_arrangements(self, ratios: Sequence[float], tp: int

@@ -6,12 +6,14 @@ Covers the reference's v1 PS/embedding stack: ps-lite
 """
 from .cache import CachePolicy
 from .cached import CachedEmbedding
-from .compression import (AutoDimEmbedding, CompositionalEmbedding,
+from .compression import (AdaptiveEmbedding, ALPTEmbedding,
+                          AutoDimEmbedding, AutoSrhEmbedding,
+                          CompositionalEmbedding, DedupEmbedding,
                           DeepLightEmbedding, DHEEmbedding, DPQEmbedding,
                           HashEmbedding, LowRankEmbedding, MGQEEmbedding,
                           MixedDimensionEmbedding, OptEmbedEmbedding,
                           PEPEmbedding, QuantizedEmbedding, ROBEEmbedding,
-                          TensorTrainEmbedding)
+                          SparseEmbedding, TensorTrainEmbedding)
 from .host import HostParameterServer
 
 __all__ = [
@@ -20,5 +22,6 @@ __all__ = [
     "DHEEmbedding", "DPQEmbedding", "HashEmbedding", "LowRankEmbedding",
     "MGQEEmbedding", "MixedDimensionEmbedding", "OptEmbedEmbedding",
     "PEPEmbedding", "QuantizedEmbedding", "ROBEEmbedding",
-    "TensorTrainEmbedding",
+    "TensorTrainEmbedding", "AdaptiveEmbedding", "ALPTEmbedding",
+    "AutoSrhEmbedding", "DedupEmbedding", "SparseEmbedding",
 ]

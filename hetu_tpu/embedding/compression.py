@@ -45,7 +45,8 @@ __all__ = [
     "DHEEmbedding", "DPQEmbedding", "MGQEEmbedding", "QuantizedEmbedding",
     "TensorTrainEmbedding", "LowRankEmbedding", "DeepLightEmbedding",
     "PEPEmbedding", "OptEmbedEmbedding", "MixedDimensionEmbedding",
-    "AutoDimEmbedding",
+    "AutoDimEmbedding", "AdaptiveEmbedding", "ALPTEmbedding",
+    "AutoSrhEmbedding", "DedupEmbedding", "SparseEmbedding",
 ]
 
 _P1 = 2654435761  # Knuth multiplicative hashing constants
@@ -537,3 +538,169 @@ def _factor3(n: int) -> Sequence[int]:
                 if rest % bb == 0:
                     return sorted((a, bb, rest // bb))
     return (1, 1, n)
+
+
+class AdaptiveEmbedding(_CompressedEmbedding):
+    """DeepRec adaptive embedding (adapt.py): frequent ids get private
+    rows in a full-dim table, rare ids share a small hashed table; every
+    lookup is freq_row(remap) + rare_row(hash) so the two tiers blend."""
+
+    def __init__(self, num_embeddings, embedding_dim, num_freq: int,
+                 num_rare: int, remap_indices: Sequence[int],
+                 scale: float = 0.01, name: str = "adapt_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        assert len(remap_indices) == num_embeddings
+        self.num_freq = num_freq
+        self.num_rare = num_rare
+        self.freq_table = parameter(NormalInitializer(0.0, scale),
+                                    (num_freq, embedding_dim),
+                                    name=f"{name}.freq")
+        self.rare_table = parameter(NormalInitializer(0.0, scale),
+                                    (num_rare, embedding_dim),
+                                    name=f"{name}.rare")
+        self._remap = np.asarray(remap_indices, np.int32)
+
+    def forward(self, ids):
+        remap_np = jnp.asarray(self._remap)
+        n_rare = self.num_rare
+
+        def _impl(freq, rare, i):
+            r = remap_np[i]                      # frequency-ranked id
+            is_freq = (r < freq.shape[0])[..., None]
+            # rare ids must NOT touch any frequent id's private row
+            hi = jnp.where(is_freq,
+                           freq[jnp.clip(r, 0, freq.shape[0] - 1)], 0.0)
+            lo = rare[r % n_rare]
+            return hi + lo
+
+        return ops.functional._op("adapt_lookup", _impl,
+                                  [self.freq_table, self.rare_table, ids])
+
+
+class ALPTEmbedding(QuantizedEmbedding):
+    """ALPT (alpt.py): low-precision table with a learned per-row scale
+    trained jointly (adaptive step size).  The quantize-dequantize
+    round-trip with the LSQ straight-through estimator is shared with
+    :class:`QuantizedEmbedding`; ALPT's distinguishing digit widths
+    (8/16) are enforced here."""
+
+    def __init__(self, num_embeddings, embedding_dim, digit: int = 8,
+                 init_scale: float = 0.01, name: str = "alpt_emb"):
+        assert digit in (8, 16), "ALPT supports digit in (8, 16)"
+        super().__init__(num_embeddings, embedding_dim, bits=digit,
+                         scale=init_scale, name=name)
+        self.digit = digit
+
+
+class AutoSrhEmbedding(_CompressedEmbedding):
+    """AutoSrh (autosrh.py): a full table gated by per-frequency-group,
+    per-dimension trainable ``alpha``; after the search phase the alphas
+    are frozen/thresholded (``retrain=True``) so near-zero entries prune
+    (soft row-dimension sparsity)."""
+
+    def __init__(self, num_embeddings, embedding_dim, nsplit: int,
+                 group_indices: Sequence[int], scale: float = 0.01,
+                 retrain: bool = False, prune_rate: float = 0.0,
+                 name: str = "autosrh_emb"):
+        super().__init__(num_embeddings, embedding_dim)
+        assert len(group_indices) == num_embeddings
+        self.nsplit = nsplit
+        self.retrain = retrain
+        self.prune_rate = prune_rate
+        self.table = parameter(NormalInitializer(0.0, scale),
+                               (num_embeddings, embedding_dim),
+                               name=f"{name}.table")
+        self.alpha = parameter(ConstantInitializer(1.0),
+                               (nsplit, embedding_dim),
+                               name=f"{name}.alpha")
+        self._groups = np.asarray(group_indices, np.int32)
+
+    def forward(self, ids):
+        groups_np = jnp.asarray(self._groups)
+        retrain = self.retrain
+        rate = self.prune_rate
+
+        def _impl(table, alpha, i):
+            e = table[i]
+            a = alpha[groups_np[i]]
+            if retrain:
+                a = jax.lax.stop_gradient(a)      # frozen after search
+                if rate > 0:
+                    thresh = jnp.quantile(jnp.abs(alpha), rate)
+                    a = jnp.where(jnp.abs(a) >= thresh, a, 0.0)
+            return e * a
+
+        return ops.functional._op("autosrh_lookup", _impl,
+                                  [self.table, self.alpha, ids])
+
+
+class DedupEmbedding(_CompressedEmbedding):
+    """Deduplication (deduplication.py): rows are grouped into blocks of
+    ``nemb_per_block``; duplicate blocks share storage through a
+    block-remap, so the stored table has only the unique blocks."""
+
+    def __init__(self, dedup_table: np.ndarray,
+                 remap_indices: Sequence[int], nemb_per_block: int,
+                 num_embeddings: Optional[int] = None,
+                 trainable: bool = True, name: str = "dedup_emb"):
+        n_blocks = len(remap_indices)
+        num_embeddings = num_embeddings or n_blocks * nemb_per_block
+        super().__init__(num_embeddings, dedup_table.shape[1])
+        self.nemb_per_block = nemb_per_block
+        self.trainable = trainable
+        self.table = parameter(np.asarray(dedup_table, np.float32),
+                               dedup_table.shape, name=f"{name}.table")
+        self._remap = np.asarray(remap_indices, np.int32)
+
+    def forward(self, ids):
+        remap_np = jnp.asarray(self._remap)
+        npb = self.nemb_per_block
+        trainable = self.trainable
+
+        def _impl(table, i):
+            block = remap_np[i // npb]            # unique-block index
+            row = block * npb + i % npb
+            out = table[row]
+            return out if trainable else jax.lax.stop_gradient(out)
+
+        return ops.functional._op("dedup_lookup", _impl,
+                                  [self.table, ids])
+
+
+class SparseEmbedding(_CompressedEmbedding):
+    """Inference-form sparse table (sparse.py / AutoSrhRetrain's csr
+    form): each row stores only its ``nnz_per_row`` largest-magnitude
+    values + their column indices (padded CSR — static shapes for
+    XLA).  Built FROM a dense (possibly pruned) table."""
+
+    def __init__(self, dense_table: np.ndarray, nnz_per_row: int,
+                 name: str = "sparse_emb"):
+        n, d = dense_table.shape
+        super().__init__(n, d)
+        assert 0 < nnz_per_row <= d
+        self.nnz = nnz_per_row
+        order = np.argsort(-np.abs(dense_table), axis=1)[:, :nnz_per_row]
+        cols = np.sort(order, axis=1).astype(np.int32)
+        vals = np.take_along_axis(dense_table, cols, axis=1)
+        self._cols = cols                        # [n, nnz] static buffers
+        self.values = parameter(vals.astype(np.float32), vals.shape,
+                                name=f"{name}.values")
+
+    def forward(self, ids):
+        cols_np = jnp.asarray(self._cols)
+        d = self.embedding_dim
+
+        def _impl(values, i):
+            v = values[i]                        # [..., nnz]
+            c = cols_np[i]                       # [..., nnz]
+            out = jnp.zeros((*v.shape[:-1], d), v.dtype)
+            return jnp.put_along_axis(out, c, v, axis=-1,
+                                      inplace=False)
+
+        return ops.functional._op("sparse_lookup", _impl,
+                                  [self.values, ids])
+
+    def compression_ratio(self) -> float:
+        full = self.num_embeddings * self.embedding_dim * 32
+        mine = self.num_embeddings * self.nnz * (32 + 32)  # val + col idx
+        return full / mine

@@ -136,7 +136,7 @@ class ParallelAttentionBlock(Module):
             self._rotary_cache[seq_len] = (cos, sin)
         return self._rotary_cache[seq_len]
 
-    def forward(self, x, seq_len: int):
+    def forward(self, x, seq_len: int, segment_ids=None):
         c = self.config
         qkv = self.qkv(x)  # [b, s, (nh + 2*nkv) * hd], tp-sharded on last dim
         b_spec = P(c.dp_axis, c.cp_axis, c.tp_axis, None)
@@ -162,9 +162,11 @@ class ParallelAttentionBlock(Module):
         if c.cp_axis:
             attn = ops.parallel_attention(
                 q, k, v, causal=True, cp_axis=c.cp_axis,
-                batch_axis=c.dp_axis, head_axis=c.tp_axis)
+                batch_axis=c.dp_axis, head_axis=c.tp_axis,
+                segment_ids=segment_ids)
         else:
-            attn = ops.attention(q, k, v, causal=True)
+            attn = ops.attention(q, k, v, causal=True,
+                                 segment_ids=segment_ids)
         attn = sharded(attn, b_spec)
         attn = attn.reshape((-1, seq_len, q_size))
         attn = sharded(attn, P(c.dp_axis, c.cp_axis, c.tp_axis))
@@ -244,8 +246,8 @@ class GPTBlock(Module):
         self.mlp = MoEMLP(config, layer_idx) if use_moe \
             else ParallelMLP(config, layer_idx)
 
-    def forward(self, x, seq_len: int):
-        x = x + self.attn(self.ln_1(x), seq_len)
+    def forward(self, x, seq_len: int, segment_ids=None):
+        x = x + self.attn(self.ln_1(x), seq_len, segment_ids=segment_ids)
         x = x + self.mlp(self.ln_2(x))
         return x
 
@@ -271,7 +273,8 @@ class GPTModel(Module):
         self.h = ModuleList([GPTBlock(c, i) for i in range(c.num_layers)])
         self.ln_f = _norm(config, "ln_f")
 
-    def forward(self, input_ids, seq_len: Optional[int] = None):
+    def forward(self, input_ids, seq_len: Optional[int] = None,
+                segment_ids=None):
         c = self.config
         if seq_len is None:
             seq_len = input_ids.shape[-1]
@@ -284,7 +287,7 @@ class GPTModel(Module):
         if self.drop is not None:
             x = self.drop(x)
         for block in self.h:
-            x = block(x, seq_len)
+            x = block(x, seq_len, segment_ids=segment_ids)
         return self.ln_f(x)
 
 
@@ -305,9 +308,10 @@ class GPTLMHeadModel(Module):
                 dtype=c.dtype,
                 init=NormalInitializer(0.0, c.init_std), name="lm_head")
 
-    def logits(self, input_ids, seq_len: Optional[int] = None):
+    def logits(self, input_ids, seq_len: Optional[int] = None,
+               segment_ids=None):
         c = self.config
-        x = self.transformer(input_ids, seq_len)
+        x = self.transformer(input_ids, seq_len, segment_ids=segment_ids)
         if self.lm_head is None:
             logits = ops.matmul(x, self.transformer.wte.weight, trans_b=True)
             logits = sharded(logits, P(c.dp_axis, c.cp_axis, c.tp_axis))
@@ -315,9 +319,13 @@ class GPTLMHeadModel(Module):
             logits = self.lm_head(x)
         return logits
 
-    def forward(self, input_ids, labels=None, seq_len: Optional[int] = None):
+    def forward(self, input_ids, labels=None,
+                seq_len: Optional[int] = None, segment_ids=None):
+        """``segment_ids``: [b, s] packed doc ids (-1 pad) — the
+        reference's cu_seqlens varlen path (ops/Attention.h:286),
+        Hydraulis packed training."""
         c = self.config
-        logits = self.logits(input_ids, seq_len)
+        logits = self.logits(input_ids, seq_len, segment_ids=segment_ids)
         if labels is None:
             return logits
         loss = vocab_parallel_cross_entropy(

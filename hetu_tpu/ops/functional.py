@@ -578,28 +578,40 @@ def rotary_embed(x, cos, sin, interleaved=False):
 # attention (ops/Attention.cc; pallas flash kernel on TPU)
 # ---------------------------------------------------------------------------
 
-def attention(q, k, v, causal=True, softmax_scale=None, use_flash=None):
+def attention(q, k, v, causal=True, softmax_scale=None, use_flash=None,
+              segment_ids=None):
     """Scaled-dot-product attention on [batch, seq, heads, head_dim]
     (reference ops/Attention.cc wrapping flash-attn2).
 
     On TPU, dispatches to the Pallas flash-attention kernel when available;
     the jnp fallback is used on CPU/simulation (XLA still fuses well).
+    ``segment_ids`` ([b, s] int, -1 pad) gives packed/varlen masking —
+    the reference's cu_seqlens path (ops/Attention.h:286).
     """
     from .attention import sdpa  # local import to avoid cycle
-    def _impl(q, k, v, causal=True, softmax_scale=None):
+    if segment_ids is None:
+        def _impl(q, k, v, causal=True, softmax_scale=None):
+            return sdpa(q, k, v, causal=causal, softmax_scale=softmax_scale,
+                        use_flash=use_flash)
+        return _op("attention", _impl, [q, k, v],
+                   {"causal": causal, "softmax_scale": softmax_scale})
+
+    def _impl(q, k, v, segs, causal=True, softmax_scale=None):
         return sdpa(q, k, v, causal=causal, softmax_scale=softmax_scale,
-                    use_flash=use_flash)
-    return _op("attention", _impl, [q, k, v],
+                    use_flash=use_flash, segment_ids=segs)
+    return _op("attention", _impl, [q, k, v, segment_ids],
                {"causal": causal, "softmax_scale": softmax_scale})
 
 
 def parallel_attention(q, k, v, causal=True, softmax_scale=None,
                        cp_axis: str = "cp", batch_axis: str = "dp",
-                       head_axis: str = "tp"):
+                       head_axis: str = "tp", segment_ids=None):
     """Context-parallel (ring) attention op (reference ParallelAttentionOp,
     ops/ParallelAttention.h:425): sequence sharded over ``cp_axis``, KV
     ring via ppermute, online LSE correction.  Requires the owning graph to
     carry a mesh with the cp axis; otherwise falls back to plain attention.
+    ``segment_ids`` ([b, s] global doc ids, -1 pad) rides the KV ring —
+    the reference's packed/varlen path (``ParallelAttention.cc:1061``).
     """
     g = _graph_of(q, k, v)
     mesh = getattr(g, "mesh", None)
@@ -610,16 +622,24 @@ def parallel_attention(q, k, v, causal=True, softmax_scale=None,
             f"runs instead of silently dropping context parallelism.")
     if mesh.shape[cp_axis] == 1:
         # degenerate ring: identical semantics, skip the shard_map
-        return attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+        return attention(q, k, v, causal=causal, softmax_scale=softmax_scale,
+                         segment_ids=segment_ids)
     from ..parallel.ring_attention import ring_attention_sharded
 
-    def _impl(q, k, v, causal=True, softmax_scale=None):
+    def _impl(q, k, v, segment_ids=None, causal=True, softmax_scale=None):
         return ring_attention_sharded(q, k, v, mesh, axis_name=cp_axis,
                                       causal=causal,
                                       softmax_scale=softmax_scale,
                                       batch_axis=batch_axis,
-                                      head_axis=head_axis)
-    return _op("parallel_attention", _impl, [q, k, v],
+                                      head_axis=head_axis,
+                                      segment_ids=segment_ids)
+    inputs = [q, k, v] if segment_ids is None else [q, k, v, segment_ids]
+    if segment_ids is None:
+        impl = lambda q, k, v, causal=True, softmax_scale=None: _impl(
+            q, k, v, None, causal, softmax_scale)
+    else:
+        impl = _impl
+    return _op("parallel_attention", impl, inputs,
                {"causal": causal, "softmax_scale": softmax_scale})
 
 

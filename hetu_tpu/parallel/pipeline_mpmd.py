@@ -28,6 +28,7 @@ tests can assert the 1F1B < GPipe activation high-water directly.
 from __future__ import annotations
 
 import math
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -104,6 +105,13 @@ class StepStats:
     stash_peak: List[int] = field(default_factory=list)      # per (pipe,stage)
     stash_peak_bytes: List[int] = field(default_factory=list)
     schedule: str = ""
+    # controller dispatch accounting: wall time of the host task loop
+    # (device work is dispatched async inside it) and the final
+    # loss-fetch sync, so dispatch overhead is measurable (the per-stage
+    # jit-call MPMD design trades this for flexibility)
+    controller_seconds: float = 0.0
+    sync_seconds: float = 0.0
+    num_tasks: int = 0
 
     @property
     def max_stash(self) -> int:
@@ -231,6 +239,8 @@ class MPMDPipelineRuntime:
         # reference's CrucialRun task loop, one controller instead of one
         # process per rank)
         remaining = sum(len(s) for sch in scheds for s in sch)
+        stats.num_tasks = remaining
+        t_ctrl = time.perf_counter()
         while remaining:
             progress = False
             for p in range(P_n):
@@ -245,11 +255,16 @@ class MPMDPipelineRuntime:
                         remaining -= 1
                         progress = True
             assert progress, "pipeline schedule deadlocked"
+        stats.controller_seconds = time.perf_counter() - t_ctrl
 
         # weighted mean loss (micro-batch losses are per-mb means); pipes
         # live on disjoint submeshes, so the cross-pipe sum happens on
-        # host at the step boundary (the loss fetch syncs anyway)
-        loss = sum(float(x) for l in losses for x in l) / M_total
+        # host at the step boundary — ONE stacked fetch per pipe, not a
+        # device->host sync per micro-batch
+        t_sync = time.perf_counter()
+        loss = sum(float(np.asarray(jnp.stack(l)).sum())
+                   for l in losses if l) / M_total
+        stats.sync_seconds = time.perf_counter() - t_sync
         for p in range(P_n):
             stats.stash_peak.extend(stash_peak[p])
             stats.stash_peak_bytes.extend(stash_bytes[p])

@@ -14,6 +14,9 @@ from .dispatch import (DispatchStrategy, batching_strategy, dynamic_dispatch,
                        max_seqlen_for, quadratic_predict,
                        solve_micro_batches, static_dispatch)
 from .dp_solver import solve_layer_strategies, solve_pipeline_partition
+from .profile_hardware import (Calibration, profile_and_calibrate,
+                               profile_collectives, profile_hbm,
+                               profile_matmul, validate_step_prediction)
 from .search import PlanResult, SearchEngine
 from .strategies import (BaseSearching, FlexFlowSearching, GPipeSearching,
                          OptCNNSearching, PipeDreamSearching,
@@ -28,6 +31,8 @@ __all__ = [
     "DispatchStrategy", "batching_strategy", "dynamic_dispatch",
     "fit_cost_model", "generate_strategy_pool", "max_seqlen_for",
     "quadratic_predict", "solve_micro_batches", "static_dispatch",
+    "Calibration", "profile_and_calibrate", "profile_collectives",
+    "profile_hbm", "profile_matmul", "validate_step_prediction",
     "PlanResult", "SearchEngine",
     "BaseSearching", "FlexFlowSearching", "GPipeSearching",
     "OptCNNSearching", "PipeDreamSearching", "PipeOptSearching",

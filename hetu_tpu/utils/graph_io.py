@@ -78,7 +78,10 @@ def export_graph_json(graph, targets=None, path: Optional[str] = None
             "outputs": [
                 {"id": t.id, "name": t.name,
                  "shape": [int(d) for d in t.concrete_shape()],
-                 "dtype": str(t.dtype)}
+                 # canonical short string ("float32"), importable by the
+                 # dtype parser (str(DataType.X) is 'DataType.X')
+                 "dtype": t.dtype.value if hasattr(t.dtype, "value")
+                 else str(t.dtype)}
                 for t in node.outputs],
             "attrs": {k: _jsonable(v) for k, v in node.attrs.items()
                       if not k.startswith("_") and not _is_function(v)},
@@ -232,3 +235,192 @@ def export_onnx(graph, targets, path: str):
     onnx.checker.check_model(model)
     onnx.save(model, path)
     return model
+
+
+# ---------------------------------------------------------------------------
+# import (counterpart of the reference's hetu/v1/python/hetu/onnx importers)
+# ---------------------------------------------------------------------------
+
+def _unjsonable(v: Any):
+    if isinstance(v, dict) and "__ndarray__" in v:
+        return np.asarray(v["__ndarray__"], dtype=v["dtype"])
+    if isinstance(v, list):
+        return [_unjsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _unjsonable(x) for k, x in v.items()}
+    return v
+
+
+def import_graph_json(spec, graph=None):
+    """Rebuild a graph from :func:`export_graph_json` output.
+
+    Ops are re-bound by op_type through the public op surface
+    (``hetu_tpu.ops.<op_type>``), placeholders/variables through the
+    graph constructors; attrs become keyword arguments.  Returns
+    ``(graph, tensors)`` where ``tensors`` maps exported tensor ids to
+    the rebuilt Tensor objects (variables are created zero-initialized —
+    load real values with the checkpoint machinery).
+
+    Counterpart of the reference's ONNX importer
+    (``hetu/v1/python/hetu/onnx/onnx_opset/``) for the native format.
+    """
+    import hetu_tpu as ht
+    from .. import ops as ops_mod
+    from ..graph.ctor import parameter
+
+    if isinstance(spec, (str, bytes)):
+        with open(spec) as f:
+            spec = json.load(f)
+    if spec.get("format") != "hetu_tpu.graph.v1":
+        raise ValueError(f"not a hetu_tpu graph export: "
+                         f"{spec.get('format')!r}")
+    if graph is None:
+        from ..graph.graph import get_default_graph
+        graph = get_default_graph()
+
+    tensors: Dict[int, Any] = {}
+    for op in spec["ops"]:
+        op_type = op["op_type"]
+        outs = op["outputs"]
+        attrs = _unjsonable(op.get("attrs", {}))
+        if op_type == "placeholder":
+            o = outs[0]
+            tensors[o["id"]] = ht.placeholder(
+                o["dtype"], tuple(o["shape"]), name=o["name"])
+            continue
+        if op_type == "variable":
+            o = outs[0]
+            t = parameter(np.zeros(o["shape"],
+                                   np.dtype(o["dtype"])
+                                   if o["dtype"] != "bfloat16"
+                                   else np.float32),
+                          shape=tuple(o["shape"]), dtype=o["dtype"],
+                          name=o["name"])
+            tensors[o["id"]] = t
+            continue
+        if op_type == "constant":
+            o = outs[0]
+            val = attrs.get("value", np.zeros(o["shape"]))
+            tensors[o["id"]] = ops_mod.constant(
+                np.asarray(val), dtype=o["dtype"], name=o["name"]) \
+                if hasattr(ops_mod, "constant") else parameter(
+                    np.asarray(val), shape=tuple(o["shape"]),
+                    dtype=o["dtype"], name=o["name"])
+            continue
+        fn = getattr(ops_mod, op_type, None)
+        if fn is None:
+            raise ValueError(
+                f"cannot re-bind op_type {op_type!r}: no public "
+                f"hetu_tpu.ops function of that name")
+        ins = [tensors[i] for i in op["inputs"]]
+        try:
+            result = fn(*ins, **attrs)
+        except TypeError:
+            # some attrs are derived (not ctor kwargs); strip only the
+            # UNKNOWN kwargs — dropping all attrs would silently rebuild
+            # a semantically different op
+            import inspect
+            try:
+                sig = inspect.signature(fn)
+                known = {k: v for k, v in attrs.items()
+                         if k in sig.parameters}
+            except (TypeError, ValueError):
+                known = {}
+            if known == attrs:
+                raise
+            result = fn(*ins, **known)
+        rs = result if isinstance(result, (tuple, list)) else [result]
+        for o, r in zip(outs, rs):
+            tensors[o["id"]] = r
+    return graph, tensors
+
+
+_ONNX_TO_OP = {v: k for k, v in _ONNX_OPS.items() if v != "MatMul"}
+_ONNX_TO_OP["MatMul"] = "matmul"
+
+
+def import_onnx(path, graph=None):
+    """Import an ONNX model (the op subset of ``_ONNX_OPS``):
+    graph inputs -> placeholders, initializers -> variables (with their
+    values), nodes -> ops.  Returns (graph, outputs) with ``outputs`` the
+    list of target tensors.  Requires the ``onnx`` package.
+
+    Counterpart of the reference's v1 ONNX import
+    (``hetu/v1/python/hetu/onnx/``).
+    """
+    try:
+        import onnx
+        from onnx import numpy_helper
+    except ImportError as e:
+        raise ImportError(
+            "ONNX import needs the `onnx` package; it is not installed "
+            "in this environment. Use import_graph_json() for the native "
+            "JSON graph format instead.") from e
+    import hetu_tpu as ht
+    from .. import ops as ops_mod
+    from ..graph.ctor import parameter
+
+    if graph is None:
+        from ..graph.graph import get_default_graph
+        graph = get_default_graph()
+    model = onnx.load(path) if isinstance(path, (str, bytes)) else path
+    g = model.graph
+    tensors: Dict[str, Any] = {}
+    for init in g.initializer:
+        arr = numpy_helper.to_array(init)
+        tensors[init.name] = parameter(arr, shape=arr.shape,
+                                       dtype=str(arr.dtype),
+                                       name=init.name)
+    for vi_ in g.input:
+        if vi_.name in tensors:
+            continue
+        shape = [d.dim_value for d in vi_.type.tensor_type.shape.dim]
+        dt = onnx.helper.tensor_dtype_to_np_dtype(
+            vi_.type.tensor_type.elem_type)
+        tensors[vi_.name] = ht.placeholder(str(dt), tuple(shape),
+                                           name=vi_.name)
+
+    for node in g.node:
+        op_type = _ONNX_TO_OP.get(node.op_type)
+        attrs = {a.name: onnx.helper.get_attribute_value(a)
+                 for a in node.attribute}
+        ins = [tensors[n] for n in node.input if n in tensors]
+        if node.op_type == "Transpose":
+            out = ops_mod.transpose(ins[0], perm=list(attrs.get(
+                "perm", range(len(ins[0].shape))[::-1])))
+        elif node.op_type == "MatMul":
+            out = ops_mod.matmul(ins[0], ins[1])
+        elif node.op_type == "Reshape":
+            # shape arrives as an initializer input; read its value
+            shp_t = tensors[node.input[1]]
+            shp = [int(x) for x in
+                   np.asarray(graph._materialize_var(shp_t)).ravel()]
+            out = ops_mod.reshape(ins[0], tuple(shp))
+        elif node.op_type in ("ReduceSum", "ReduceMean", "ReduceMax"):
+            kw = {"keepdims": bool(attrs.get("keepdims", 0))}
+            if len(node.input) > 1 and node.input[1] in tensors:
+                ax_t = tensors[node.input[1]]
+                ax = [int(x) for x in
+                      np.asarray(graph._materialize_var(ax_t)).ravel()]
+                kw["axis"] = ax[0] if len(ax) == 1 else tuple(ax)
+            out = getattr(ops_mod, op_type)(ins[0], **kw)
+        elif op_type == "gelu":
+            # ONNX spec default for Gelu.approximate is "none" (exact)
+            approx = attrs.get("approximate", b"none")
+            if isinstance(approx, bytes):
+                approx = approx.decode()
+            out = ops_mod.gelu(ins[0], approximate=approx != "none")
+        elif op_type in ("softmax", "log_softmax", "concat"):
+            out = getattr(ops_mod, op_type)(
+                *ins, axis=int(attrs.get("axis", -1)))
+        elif op_type == "embedding_lookup":
+            out = ops_mod.embedding_lookup(ins[0], ins[1])
+        elif op_type is not None and hasattr(ops_mod, op_type):
+            out = getattr(ops_mod, op_type)(*ins)
+        else:
+            raise ValueError(f"unsupported ONNX op {node.op_type!r}")
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        for name, t in zip(node.output, outs):
+            tensors[name] = t
+    outputs = [tensors[o.name] for o in g.output if o.name in tensors]
+    return graph, outputs

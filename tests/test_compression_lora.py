@@ -5,13 +5,16 @@ from jax.sharding import PartitionSpec as P
 
 import hetu_tpu as ht
 from hetu_tpu import nn, ops, optim
-from hetu_tpu.embedding import (AutoDimEmbedding, CompositionalEmbedding,
+from hetu_tpu.embedding import (AdaptiveEmbedding, ALPTEmbedding,
+                                AutoDimEmbedding, AutoSrhEmbedding,
+                                CompositionalEmbedding, DedupEmbedding,
                                 DeepLightEmbedding, DHEEmbedding,
                                 DPQEmbedding, HashEmbedding,
                                 LowRankEmbedding, MGQEEmbedding,
                                 MixedDimensionEmbedding, OptEmbedEmbedding,
                                 PEPEmbedding, QuantizedEmbedding,
-                                ROBEEmbedding, TensorTrainEmbedding)
+                                ROBEEmbedding, SparseEmbedding,
+                                TensorTrainEmbedding)
 from hetu_tpu.models.ctr import WDL, ctr_loss
 from hetu_tpu.nn.lora import (LoRAColumnParallelLinear, LoRAEmbedding,
                               LoRARowParallelLinear,
@@ -21,6 +24,23 @@ N, D = 64, 16
 
 
 def _make(cls):
+    if cls is DedupEmbedding:
+        # 8-row blocks, half the blocks deduplicated away
+        rng = np.random.RandomState(3)
+        uniq = rng.randn(N // 2, D).astype(np.float32)
+        remap = rng.randint(0, (N // 2) // 8, N // 8)
+        return DedupEmbedding(uniq, remap, nemb_per_block=8,
+                              num_embeddings=N)
+    if cls is SparseEmbedding:
+        dense = np.random.RandomState(4).randn(N, D).astype(np.float32)
+        return SparseEmbedding(dense, nnz_per_row=4)
+    if cls is AdaptiveEmbedding:
+        remap = np.random.RandomState(5).permutation(N)
+        return AdaptiveEmbedding(N, D, num_freq=16, num_rare=8,
+                                 remap_indices=remap)
+    if cls is AutoSrhEmbedding:
+        groups = (np.arange(N) * 4) // N
+        return AutoSrhEmbedding(N, D, nsplit=4, group_indices=groups)
     kwargs = {
         HashEmbedding: dict(table_size=16),
         CompositionalEmbedding: dict(num_buckets=8),
@@ -37,6 +57,7 @@ def _make(cls):
         OptEmbedEmbedding: dict(),
         MixedDimensionEmbedding: dict(hot_fraction=0.25, cold_dim=4),
         AutoDimEmbedding: dict(candidate_dims=(2, 8)),
+        ALPTEmbedding: dict(digit=8),
     }[cls]
     return cls(N, D, **kwargs)
 
@@ -45,7 +66,9 @@ ALL_METHODS = [HashEmbedding, CompositionalEmbedding, ROBEEmbedding,
                DHEEmbedding, DPQEmbedding, MGQEEmbedding,
                QuantizedEmbedding, TensorTrainEmbedding, LowRankEmbedding,
                DeepLightEmbedding, PEPEmbedding, OptEmbedEmbedding,
-               MixedDimensionEmbedding, AutoDimEmbedding]
+               MixedDimensionEmbedding, AutoDimEmbedding,
+               AdaptiveEmbedding, ALPTEmbedding, AutoSrhEmbedding,
+               DedupEmbedding]
 
 
 class TestCompressionMethods:
@@ -288,3 +311,60 @@ class TestLoRA:
         l1 = run(None)
         l2 = run({"dp": 2, "tp": 4})
         np.testing.assert_allclose(l1, l2, rtol=1e-4, atol=1e-6)
+
+
+class TestNewCompressionMethods:
+    """Round-4 additions (adapt.py / alpt.py / autosrh.py /
+    deduplication.py / sparse.py reference parity)."""
+
+    def test_dedup_shares_block_storage(self):
+        rng = np.random.RandomState(0)
+        uniq = rng.randn(16, D).astype(np.float32)
+        # blocks of 8 rows; logical blocks [0,1,2,3] -> unique [0,1,0,1]
+        remap = np.array([0, 1, 0, 1])
+        with ht.graph("define_and_run", create_new=True) as g:
+            emb = DedupEmbedding(uniq, remap, nemb_per_block=8,
+                                 num_embeddings=32)
+            ph = ht.placeholder("int32", (4,), name="ids")
+            out = emb(ph)
+            # id 3 (block 0) and id 19 (block 2 -> same unique block 0)
+            (val,) = g.run(out, [out],
+                           {ph: np.array([3, 19, 8, 24], np.int32)})
+        v = np.asarray(val)
+        np.testing.assert_allclose(v[0], v[1])   # deduplicated rows equal
+        np.testing.assert_allclose(v[2], v[3])
+
+    def test_sparse_matches_pruned_dense(self):
+        dense = np.random.RandomState(1).randn(N, D).astype(np.float32)
+        with ht.graph("define_and_run", create_new=True) as g:
+            emb = SparseEmbedding(dense, nnz_per_row=4)
+            assert emb.compression_ratio() >= 2.0
+            ph = ht.placeholder("int32", (8,), name="ids")
+            out = emb(ph)
+            ids = np.arange(8, dtype=np.int32)
+            (val,) = g.run(out, [out], {ph: ids})
+        v = np.asarray(val)
+        # each row: exactly the 4 largest-|.| entries of dense, rest 0
+        for r, i in enumerate(ids):
+            keep = np.argsort(-np.abs(dense[i]))[:4]
+            want = np.zeros(D, np.float32)
+            want[keep] = dense[i, keep]
+            np.testing.assert_allclose(v[r], want, rtol=1e-6)
+
+    def test_autosrh_retrain_freezes_alpha(self):
+        groups = (np.arange(N) * 4) // N
+        from hetu_tpu.graph import ctor
+        ctor._seed_counter[0] = 9
+        ids = np.arange(8, dtype=np.int32)
+        with ht.graph("define_and_run", create_new=True) as g:
+            emb = AutoSrhEmbedding(N, D, nsplit=4, group_indices=groups,
+                                   retrain=True)
+            ph = ht.placeholder("int32", (8,), name="ids")
+            out = emb(ph)
+            loss = ops.reduce_mean((out - 1.0) ** 2)
+            op = optim.AdamOptimizer(lr=1e-2).minimize(loss)
+            a0 = np.asarray(g._materialize_var(emb.alpha)).copy()
+            for _ in range(3):
+                g.run(loss, [loss, op], {ph: ids})
+            a1 = np.asarray(g.get_tensor_value(emb.alpha))
+        np.testing.assert_allclose(a0, a1)  # alpha frozen under retrain

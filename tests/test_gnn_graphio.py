@@ -176,3 +176,67 @@ class TestGraphIO:
         else:
             with pytest.raises(ImportError, match="onnx"):
                 export_onnx(g, [y], str(tmp_path / "m.onnx"))
+
+
+class TestGraphImport:
+    """Round-trip import (reference hetu/v1/python/hetu/onnx importers)."""
+
+    def _graph(self):
+        from hetu_tpu.graph.ctor import NormalInitializer, parameter
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (2, 4), name="x")
+            w = parameter(NormalInitializer(0.0, 0.1), (4, 3), name="w")
+            y = ops.softmax(ops.relu(ops.matmul(x, w)))
+        return g, x, w, y
+
+    def test_json_roundtrip_executes(self):
+        from hetu_tpu.utils.graph_io import (export_graph_json,
+                                             import_graph_json)
+        import numpy as np
+        g, x, w, y = self._graph()
+        wval = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        g.reset_variable(w, wval)
+        spec = export_graph_json(g, [y])
+        X = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+        (want,) = g.run(y, [y], {x: X})
+
+        with ht.graph("define_and_run", create_new=True) as g2:
+            g2b, tensors = import_graph_json(spec, graph=g2)
+            # rebuilt tensors keyed by exported ids
+            x2 = tensors[x.id]
+            w2 = tensors[w.id]
+            y2 = tensors[y.id]
+            g2.reset_variable(w2, wval)
+            (got,) = g2.run(y2, [y2], {x2: X})
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_import_rejects_foreign_format(self):
+        from hetu_tpu.utils.graph_io import import_graph_json
+        with pytest.raises(ValueError, match="not a hetu_tpu graph"):
+            import_graph_json({"format": "other"})
+
+    def test_onnx_import_gated(self, tmp_path):
+        from hetu_tpu.utils.graph_io import export_onnx, import_onnx
+        import numpy as np
+        try:
+            import onnx  # noqa: F401
+        except ImportError:
+            with pytest.raises(ImportError, match="onnx"):
+                import_onnx(str(tmp_path / "m.onnx"))
+            return
+        g, x, w, y = self._graph()
+        wval = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        g.reset_variable(w, wval)
+        p = str(tmp_path / "m.onnx")
+        export_onnx(g, [y], p)
+        X = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+        (want,) = g.run(y, [y], {x: X})
+        with ht.graph("define_and_run", create_new=True) as g2:
+            _, outs = import_onnx(p, graph=g2)
+            # find the placeholder via the op list
+            x2 = next(t for op in g2.ops if op.op_type == "placeholder"
+                      for t in op.outputs)
+            (got,) = g2.run(outs[0], [outs[0]], {x2: X})
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)

@@ -1,0 +1,86 @@
+"""Measured hardware profiling (planner.profile_hardware) tests.
+
+Counterpart of the reference's profile_hardware pass
+(tools/Galvatron/galvatron/profile_hardware/profile_hardware.py): the
+constants the planner and elastic solver consume must come from (or be
+checkable against) live measurements, not datasheets.
+"""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.planner import (Calibration, profile_and_calibrate,
+                              profile_collectives, profile_hbm,
+                              profile_matmul, validate_step_prediction)
+
+
+@pytest.fixture(scope="module")
+def calibration(devices8_module):
+    mesh = ht.create_mesh({"x": 4}, devices8_module[:4])
+    return profile_and_calibrate(
+        mesh=mesh, axis="x", matmul_sizes=(256, 512), hbm_bytes=1 << 22,
+        coll_sizes=(1 << 12, 1 << 14, 1 << 16), reps=3)
+
+
+@pytest.fixture(scope="module")
+def devices8_module():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8
+    return devs[:8]
+
+
+class TestProfiling:
+    def test_matmul_and_hbm_positive(self, calibration):
+        assert calibration.best_matmul_flops > 0
+        assert calibration.hbm_bw > 0
+        assert all(v > 0 for v in calibration.matmul_flops.values())
+
+    def test_collective_fits(self, calibration):
+        assert set(calibration.collectives) == {
+            "all_reduce", "all_gather", "reduce_scatter", "p2p"}
+        for name, (alpha, beta) in calibration.collectives.items():
+            assert alpha >= 0 and beta >= 0, (name, alpha, beta)
+
+    def test_chip_spec_folding(self, calibration):
+        spec = calibration.to_chip_spec()
+        # measured throughput = peak * efficiency by construction
+        assert spec.peak_flops * spec.mxu_efficiency \
+            == pytest.approx(calibration.best_matmul_flops, rel=1e-6)
+        assert spec.hbm_bw == pytest.approx(calibration.hbm_bw)
+        if calibration.collectives.get("all_reduce", (0, 0))[1] > 0:
+            assert spec.ici_bw == pytest.approx(
+                1.0 / calibration.collectives["all_reduce"][1])
+
+    def test_elastic_constants_measured(self, calibration):
+        consts = calibration.elastic_constants(batch=4, seq=128,
+                                               hidden=128, ffn=512)
+        assert consts["layer_comm_cost"] >= 0
+        assert consts["pipeline_p2p_cost"] >= 0
+        from hetu_tpu.elastic.strategy import StrategyModel
+        sm = StrategyModel.from_calibration(
+            calibration, num_devices=4, num_layers=8, batch=4, seq=128,
+            hidden=128, ffn=512)
+        assert sm.layer_comm_cost == consts["layer_comm_cost"]
+        assert sm.pipeline_p2p_cost == consts["pipeline_p2p_cost"]
+
+    def test_save_load_roundtrip(self, calibration, tmp_path):
+        p = str(tmp_path / "calib.json")
+        calibration.save(p)
+        back = Calibration.load(p)
+        assert back.matmul_flops == calibration.matmul_flops
+        assert back.collectives == calibration.collectives
+        assert back.hbm_bw == calibration.hbm_bw
+
+    @pytest.mark.slow
+    def test_step_prediction_closes_loop(self, calibration):
+        """Predicted vs measured step time: the ratio must be finite and
+        positive (on the CPU simulator only sanity is asserted; on real
+        TPU the reference expects same-order-of-magnitude)."""
+        r = validate_step_prediction(calibration, batch=2, seq=64,
+                                     hidden=64, num_layers=2, vocab=128)
+        assert r["measured_s"] > 0
+        assert np.isfinite(r["predicted_s"]) and r["predicted_s"] > 0
+        import jax
+        if jax.devices()[0].platform == "tpu":
+            assert 0.1 < r["ratio"] < 10.0, r
