@@ -228,8 +228,30 @@ def bench_scaling_virtual(n_devices: int = 8) -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _probe_backend(timeout_s: float = 180.0) -> str:
+    """Probe the default backend in a SUBPROCESS with a timeout: a wedged
+    TPU runtime hangs on init (round-3 postmortem: BENCH_r03 rc=1 /
+    MULTICHIP_r03 rc=124) — it must never hang the bench itself.
+    Returns the platform string, or "cpu" on hang/failure."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        if proc.returncode == 0 and proc.stdout.strip():
+            return proc.stdout.strip().splitlines()[-1]
+    except Exception:
+        pass
+    return "cpu"
+
+
 def main():
+    platform = _probe_backend()
     import jax
+    if platform == "cpu":
+        # hardware backend unavailable/hung: pin cpu so the bench still
+        # produces a valid (clearly-labeled) JSON line
+        jax.config.update("jax_platforms", "cpu")
     on_tpu = jax.devices()[0].platform == "tpu"
 
     gpt = bench_gpt2(on_tpu)

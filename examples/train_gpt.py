@@ -78,8 +78,7 @@ def main():
         assert ncfg <= n_dev, f"config wants {ncfg} devices, have {n_dev}"
         from hetu_tpu.utils.ds_config import parse_layout
         dp, tp, pp, cfg_zero = parse_layout(cfg_json)
-        if cfg_zero:
-            zero = max(zero, 1)
+        zero = max(zero, int(cfg_zero))  # config may carry level 0-3
     assert dp * tp * pp <= n_dev, \
         f"dp*tp*pp={dp * tp * pp} > devices={n_dev}"
 

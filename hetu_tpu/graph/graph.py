@@ -428,8 +428,11 @@ class DefineAndRunGraph(Graph):
     def __init__(self, name: str = "define_and_run"):
         super().__init__(name)
         self._plan_pool: Dict[Tuple, Any] = {}
+        self._abstract_pool: Dict[Tuple, Any] = {}  # plan key -> arg specs
+        self._cost_cache: Dict[int, Any] = {}       # id(plan) -> cost dict
         self._shape_buckets: Optional[List[int]] = None
         self._bucket_pad_values: Dict[int, Any] = {}
+        self._memory_profiler = None  # lazy (env-gated) MemoryProfiler
 
     # -- shape-plan bucketing ------------------------------------------------
 
@@ -700,6 +703,30 @@ class DefineAndRunGraph(Graph):
 
     # -- hot switch ----------------------------------------------------------
 
+    def cost_analysis(self):
+        """XLA cost analysis of the last executed step program (flops,
+        bytes accessed, ...): metrics from INSIDE the compiled program,
+        complementing the eager-replay OpProfiler (reference op-level
+        TimeCost + CUDAProfiler counters, hetu/graph/profiler.h:30-66).
+
+        Returns the XLA cost dict (keys like "flops",
+        "bytes accessed") or None when no step has run yet."""
+        jit_step = getattr(self, "_last_plan", None)
+        key = getattr(self, "_last_plan_key", None)
+        spec = self._abstract_pool.get(key)
+        if jit_step is None or spec is None:
+            return None
+        if id(jit_step) in self._cost_cache:       # invariant per plan
+            return self._cost_cache[id(jit_step)]
+        compiled = jit_step.lower(*spec).compile()
+        costs = compiled.cost_analysis()
+        # jax returns either a dict or a 1-element list of dicts
+        if isinstance(costs, (list, tuple)):
+            costs = costs[0] if costs else {}
+        out = dict(costs) if costs else None
+        self._cost_cache[id(jit_step)] = out
+        return out
+
     def switch_strategy(self, new_mesh, pspec_overrides=None, optimizer=None,
                         mode=None, dtype=None):
         """Hot-switch params/optimizer states/grads to a new mesh and/or
@@ -777,6 +804,8 @@ class DefineAndRunGraph(Graph):
                 real_fetches, feed_tensors, num_micro_batches, run_level,
                 update_node)
         jit_step = self._plan_pool[key]
+        self._last_plan = jit_step  # for cost_analysis()
+        self._last_plan_key = key
 
         feeds = {}
         for t, v in feed_dict.items():
@@ -803,6 +832,15 @@ class DefineAndRunGraph(Graph):
                 opt_state["_scaler"] = scaler.init_state()
         grad_accum = dict(self._grad_accum)
 
+        if key not in self._abstract_pool:
+            # arg specs for cost_analysis(); shapes are invariant per plan
+            # key, so this traversal runs once per compiled plan
+            self._abstract_pool[key] = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    np.shape(a), np.asarray(a).dtype)
+                if not hasattr(a, "aval") else
+                jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (var_state, opt_state, grad_accum, feeds_mb))
         fetch_vals, new_vars, new_opt, new_accum = jit_step(
             var_state, opt_state, grad_accum, feeds_mb)
 
@@ -813,6 +851,16 @@ class DefineAndRunGraph(Graph):
                 scaler.store_state(new_opt.pop("_scaler"))
             update_node.attrs["optimizer"]._store_state(new_opt)
         self._grad_accum = dict(new_accum)
+        # per-step memory snapshot when HETU_MEMORY_PROFILE is set
+        # (reference executable_graph.cc:1738 memory profile levels; the
+        # SPMD micro-batch loop is one compiled program, so the runtime
+        # granularity here is the step — the MPMD runtime snapshots per
+        # micro-batch)
+        if self._memory_profiler is None:
+            from ..utils.profiler import MemoryProfiler
+            self._memory_profiler = MemoryProfiler()
+        if self._memory_profiler.enabled:
+            self._memory_profiler.snapshot("step")
         # restore fetch arity: update-op positions yield None
         out = list(fetch_vals)
         for i in update_positions:

@@ -82,6 +82,35 @@ def _padded_segs(segment_ids, b, h, sq, sk):
     return q_segs, kv_segs
 
 
+def _seg_operands(segment_ids, b, h, sq, sk, bq, bk):
+    """(in_specs, operands) for the segment-id streams — empty when
+    segments are unused, so the common no-packing case pays zero extra
+    HBM traffic for them."""
+    if segment_ids is None:
+        return [], []
+    q_segs, kv_segs = _padded_segs(segment_ids, b, h, sq, sk)
+    specs = [
+        pl.BlockSpec((1, bq, SUBLANES), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, SUBLANES, bk), lambda bh, i, j: (bh, 0, j)),
+    ]
+    return specs, [q_segs, kv_segs]
+
+
+def _dim_semantics(*sem):
+    """Mosaic dimension semantics (parallel dims may split across
+    TensorCores); None on toolchains without CompilerParams."""
+    try:
+        return pltpu.CompilerParams(dimension_semantics=sem)
+    except (AttributeError, TypeError):
+        return None
+
+
+def _nosegs_kernel(kernel, *refs, **kw):
+    """Adapter: invoke a seg-aware kernel with no segment operands
+    (use_segs=False guarantees the seg refs are never read)."""
+    return kernel(None, None, *refs, **kw)
+
+
 def _block_sizes(s: int, d: int, dtype) -> Tuple[int, int]:
     """Pick q/kv block sizes.  Blocks must divide s AND satisfy TPU tiling
     (last-two-dims rule); a block equal to the full dim is always legal, so
@@ -165,18 +194,19 @@ def _flash_fwd(q, k, v, scale, causal, segment_ids, causal_offset=0):
     num_q, num_kv = sq // bq, sk // bk
 
     use_segs = segment_ids is not None
-    q_segs, kv_segs = _padded_segs(segment_ids, b, h, sq, sk)
+    seg_specs, seg_args = _seg_operands(segment_ids, b, h, sq, sk, bq, bk)
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, offset=causal_offset,
         bq=bq, bk=bk, num_kv=num_kv, use_segs=use_segs)
+    if not use_segs:
+        kernel = functools.partial(_nosegs_kernel, kernel)
 
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, num_q, num_kv),
         in_specs=[
-            pl.BlockSpec((1, bq, SUBLANES), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, SUBLANES, bk), lambda bh, i, j: (bh, 0, j)),
+            *seg_specs,
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
@@ -194,8 +224,9 @@ def _flash_fwd(q, k, v, scale, causal, segment_ids, causal_offset=0):
             pltpu.VMEM((bq, LANES), jnp.float32),
             pltpu.VMEM((bq, LANES), jnp.float32),
         ],
+        compiler_params=_dim_semantics("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
-    )(q_segs, kv_segs, qr, kr, vr)
+    )(*seg_args, qr, kr, vr)
     out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     lse = lse[:, :, 0].reshape(b, h, sq)
     return out, lse
@@ -292,17 +323,18 @@ def _flash_bwd_fused(scale, causal, segment_ids, res, do, causal_offset):
     num_q, num_kv = sq // bq, sk // bk
 
     use_segs = segment_ids is not None
-    q_segs, kv_segs = _padded_segs(segment_ids, b, h, sq, sk)
+    seg_specs, seg_args = _seg_operands(segment_ids, b, h, sq, sk, bq, bk)
 
     kernel = functools.partial(
         _bwd_fused_kernel, scale=scale, causal=causal, offset=causal_offset,
         bq=bq, bk=bk, num_q=num_q, num_kv=num_kv, use_segs=use_segs)
+    if not use_segs:
+        kernel = functools.partial(_nosegs_kernel, kernel)
     dq, dk, dv = pl.pallas_call(
         kernel,
         grid=(b * h, num_q, num_kv),
         in_specs=[
-            pl.BlockSpec((1, bq, SUBLANES), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, SUBLANES, bk), lambda bh, i, j: (bh, 0, j)),
+            *seg_specs,
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
@@ -326,8 +358,9 @@ def _flash_bwd_fused(scale, causal, segment_ids, res, do, causal_offset):
             pltpu.VMEM((sk, d), jnp.float32),
             pltpu.VMEM((bq, LANES), jnp.float32),
         ],
+        compiler_params=_dim_semantics("parallel", "arbitrary", "arbitrary"),
         interpret=_interpret(),
-    )(q_segs, kv_segs, qr, kr, vr, dor, outr, lser)
+    )(*seg_args, qr, kr, vr, dor, outr, lser)
     dq = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     dk = dk.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
     dv = dv.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
@@ -454,17 +487,18 @@ def _flash_bwd_split(scale, causal, segment_ids, res, do, causal_offset):
     num_q, num_kv = sq // bq, sk // bk
 
     use_segs = segment_ids is not None
-    q_segs, kv_segs = _padded_segs(segment_ids, b, h, sq, sk)
+    seg_specs, seg_args = _seg_operands(segment_ids, b, h, sq, sk, bq, bk)
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal, offset=causal_offset,
         bq=bq, bk=bk, num_kv=num_kv, use_segs=use_segs)
+    if not use_segs:
+        dq_kernel = functools.partial(_nosegs_kernel, dq_kernel)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b * h, num_q, num_kv),
         in_specs=[
-            pl.BlockSpec((1, bq, SUBLANES), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, SUBLANES, bk), lambda bh, i, j: (bh, 0, j)),
+            *seg_specs,
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
@@ -475,18 +509,24 @@ def _flash_bwd_split(scale, causal, segment_ids, res, do, causal_offset):
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_dim_semantics("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
-    )(q_segs, kv_segs, qr, kr, vr, dor, lser, delta)
+    )(*seg_args, qr, kr, vr, dor, lser, delta)
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, causal=causal, offset=causal_offset,
         bq=bq, bk=bk, num_q=num_q, use_segs=use_segs)
+    if not use_segs:
+        dkv_kernel = functools.partial(_nosegs_kernel, dkv_kernel)
+    dkv_seg_specs = [] if not use_segs else [
+        pl.BlockSpec((1, bq, SUBLANES), lambda bh, j, i: (bh, i, 0)),
+        pl.BlockSpec((1, SUBLANES, bk), lambda bh, j, i: (bh, 0, j)),
+    ]
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(b * h, num_kv, num_q),
         in_specs=[
-            pl.BlockSpec((1, bq, SUBLANES), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, SUBLANES, bk), lambda bh, j, i: (bh, 0, j)),
+            *dkv_seg_specs,
             pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
@@ -506,8 +546,9 @@ def _flash_bwd_split(scale, causal, segment_ids, res, do, causal_offset):
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
+        compiler_params=_dim_semantics("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
-    )(q_segs, kv_segs, qr, kr, vr, dor, lser, delta)
+    )(*seg_args, qr, kr, vr, dor, lser, delta)
 
     dq = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     dk = dk.reshape(b, h, sk, d).transpose(0, 2, 1, 3)

@@ -139,6 +139,11 @@ class MPMDPipelineRuntime:
         self.schedule_name = schedule
         for p in self.pipes:
             assert p[-1].is_last and not any(st.is_last for st in p[:-1])
+        # per-(pipe, stage, micro-batch) memory snapshots when enabled via
+        # HETU_MEMORY_PROFILE=MICRO_BATCH (reference
+        # executable_graph.cc:1738-1761 _all_micro_batches_memory_info)
+        from ..utils.profiler import MemoryProfiler
+        self.memory_profiler = MemoryProfiler()
 
     def _schedule(self, M: int) -> List[List[Task]]:
         gen = (generate_pipedream_flush_schedule if self.schedule_name ==
@@ -251,6 +256,10 @@ class MPMDPipelineRuntime:
                     t = scheds[p][s][i]
                     if ready(p, s, t):
                         run_task(p, s, t)
+                        if self.memory_profiler.enabled:
+                            self.memory_profiler.snapshot(
+                                f"pipe{p}.stage{s}.{t.kind}",
+                                micro_batch_id=t.micro_batch)
                         ptr[p][s] = i + 1
                         remaining -= 1
                         progress = True

@@ -466,3 +466,56 @@ def ring_attention_sharded(q, k, v, mesh, axis_name: str = "cp",
     if split_pattern == "sym":
         out = sym_unshard(out, cp, axis=1)
     return out
+
+
+def profile_ring_rounds(q, k, v, mesh, axis_name: str = "cp",
+                        causal: bool = True,
+                        split_pattern: str = "normal",
+                        softmax_scale: Optional[float] = None,
+                        reps: int = 3):
+    """Measured per-round wall times of the KV ring (the reference's
+    optional AttnCommRing per-round profiling, ParallelAttention.h:411-413).
+
+    Each round r is executed as its own jitted program (KV pre-shifted by
+    r hops, one _pair_fwd per rank), so the per-(rank, round) cost —
+    which pair_score_area predicts analytically — can be measured.
+    Returns a list of ``cp`` median times in seconds.
+    """
+    import time as _time
+    from jax.sharding import PartitionSpec as P
+    from .comm import shard_map
+
+    cp = mesh.shape[axis_name]
+    scale = softmax_scale if softmax_scale is not None \
+        else 1.0 / math.sqrt(q.shape[-1])
+    if split_pattern == "sym":
+        q, k, v = (sym_shard(x, cp, axis=1) for x in (q, k, v))
+    spec = P(None, axis_name, None, None)
+
+    def round_fn(r):
+        def f(q, k, v):
+            my = lax.axis_index(axis_name)
+            perm = [(i, (i + r) % cp) for i in range(cp)]
+            k_r = lax.ppermute(k, axis_name, perm) if r else k
+            v_r = lax.ppermute(v, axis_name, perm) if r else v
+            kind = _mask_kind(my, (my - r) % cp, causal, split_pattern)
+            o, lse = _pair_fwd(q, k_r, v_r, scale, kind, None,
+                               split_pattern, causal)
+            return o
+        return jax.jit(shard_map(f, mesh, (spec, spec, spec), spec))
+
+    times = []
+    for r in range(cp):
+        fn = round_fn(r)
+        out = fn(q, k, v)         # compile + warm
+        jax.block_until_ready(out)
+        np.asarray(out.ravel()[0])
+        ts = []
+        for _ in range(reps):
+            t0 = _time.perf_counter()
+            out = fn(q, k, v)
+            jax.block_until_ready(out)
+            np.asarray(out.ravel()[0])
+            ts.append(_time.perf_counter() - t0)
+        times.append(float(np.median(ts)))
+    return times

@@ -69,6 +69,11 @@ class PlanResult:
                         "dup": ([st.dp] if split else [st.dp * st.tp]),
                         "device_group_union": group,
                         "zero": st.zero > 0,
+                        # full searched level (0-3), recorded for
+                        # downstream tooling (ds_config.parse_layout
+                        # surfaces it); the bool "zero" stays the
+                        # reference-schema ds flag
+                        "zero_stage": int(st.zero),
                         "recompute": st.recompute,
                     }
 

@@ -163,8 +163,12 @@ def parse_layout(cfg: Dict):
         if grp not in seen:
             seen.append(grp)
     pp = max(1, len(seen))
-    zero = bool(cfg.get("zero")) or any(
-        e.get("zero") for _, _, e in iter_block_entries(cfg))
+    # "zero" is the reference-schema bool ds flag; planner-emitted configs
+    # also carry "zero_stage" (0-3) — surface the strongest level found
+    levels = [int(e.get("zero_stage", 1 if e.get("zero") else 0))
+              for _, _, e in iter_block_entries(cfg)]
+    zero = int(cfg.get("zero_stage", 1 if cfg.get("zero") else 0))
+    zero = max([zero] + levels)
     return dp, tp, pp, zero
 
 

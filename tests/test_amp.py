@@ -33,6 +33,7 @@ def test_autocast_casts_matmul_down_and_loss_up():
         assert out.dtype == jnp.bfloat16
 
 
+@pytest.mark.slow
 def test_autocast_training_step_runs():
     with ht.graph("define_and_run", create_new=True) as g:
         cfg = _tiny_cfg(dtype="float32")
@@ -81,6 +82,7 @@ def test_grad_scaler_skips_nonfinite_step():
         assert scaler.scale == 32.0           # backed off
 
 
+@pytest.mark.slow
 def test_recompute_context_matches_baseline():
     def _train(ctx):
         from hetu_tpu.graph import ctor

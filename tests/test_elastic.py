@@ -20,6 +20,10 @@ from hetu_tpu.models import GPTConfig, GPTLMHeadModel
 # Straggler
 # ---------------------------------------------------------------------------
 
+# full-model training loops: excluded from the dev fast path
+pytestmark = pytest.mark.slow
+
+
 def test_straggler_env_injection(monkeypatch):
     monkeypatch.setenv("HETU_TPU_STRAGGLER_RATIOS", "2.0,1.0,1.0,1.0")
     s = Straggler(4)

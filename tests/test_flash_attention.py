@@ -61,6 +61,7 @@ class TestFlashForward:
                                    rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 class TestFlashBackward:
     def test_grads_match_reference(self):
         q, k, v = _mk()
@@ -98,6 +99,7 @@ class TestFlashBackward:
                                        err_msg=f"d{name}")
 
 
+@pytest.mark.slow
 class TestSplitBackwardPath:
     """The long-sequence fallback (split dq / dkv kernels) must stay
     correct even though short tests route to the fused kernel."""

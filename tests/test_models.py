@@ -30,6 +30,7 @@ def _bert_cfg(**kw):
     return BertConfig(**kw)
 
 
+@pytest.mark.slow
 class TestBert:
     def test_pretraining_loss_decreases(self):
         _fix_seed()
@@ -101,6 +102,7 @@ class TestBert:
         np.testing.assert_allclose(l1, l2, rtol=2e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 class TestCNN:
     def test_simple_cnn_trains(self):
         _fix_seed()
@@ -217,6 +219,7 @@ class TestDSConfigGenerator:
             config2ds(entry)  # parses
 
 
+@pytest.mark.slow
 class TestPackedVarlen:
     """Packed (cu_seqlens-style) training through the model surface
     (reference ops/Attention.h:286 varlen path; Hydraulis packing)."""

@@ -19,6 +19,10 @@ from hetu_tpu.nn.moe import (BalanceGate, Experts, HashGate, KTop1Gate,
                              sam_gating_impl, topk_gating_impl)
 
 
+# full-model training loops: excluded from the dev fast path
+pytestmark = pytest.mark.slow
+
+
 def _fix_seed():
     from hetu_tpu.graph import ctor
     ctor._seed_counter[0] = 777

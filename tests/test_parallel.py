@@ -48,6 +48,7 @@ def _train_gpt(mesh_shape, steps=4, seed=0, sp=True, devices=None):
     return losses, params
 
 
+@pytest.mark.slow
 class TestStrategyEquivalence:
     """Same model, different layouts -> identical training trajectories."""
 
@@ -126,6 +127,7 @@ class TestParallelLayers:
         np.testing.assert_allclose(np.asarray(val), ref, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 class TestZeRO:
     def test_zero_shards_optimizer_state(self, devices8):
         """ZeRO: Adam m/v shards over dp (reference `zero` ds flag ->

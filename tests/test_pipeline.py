@@ -15,6 +15,10 @@ from hetu_tpu.models.gpt import llama_config
 from hetu_tpu.models.gpt_pipeline import GPTPipelineModel
 
 
+# full-model training loops: excluded from the dev fast path
+pytestmark = pytest.mark.slow
+
+
 def _train(mesh_shape, num_stages, steps=3, nmb=2, seed=555, mk=None):
     ctor._seed_counter[0] = seed
     mesh = ht.create_mesh(mesh_shape)

@@ -17,6 +17,10 @@ from hetu_tpu.parallel.schedule import (generate_gpipe_schedule,
                                         max_in_flight, validate_schedule)
 
 
+# full-model training loops: excluded from the dev fast path
+pytestmark = pytest.mark.slow
+
+
 def _cfg(**kw):
     kw.setdefault("vocab_size", 96)
     kw.setdefault("hidden_size", 48)

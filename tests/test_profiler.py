@@ -122,3 +122,81 @@ class TestLoggingUtils:
         assert lg.level == logging.DEBUG
         set_log_level("ERROR", "envtest")
         assert lg.level == logging.ERROR
+
+
+class TestRuntimeMemorySnapshots:
+    """Per-micro-batch (MPMD) / per-step (SPMD) memory snapshots, enabled
+    by HETU_TPU_MEMORY_PROFILE (reference executable_graph.cc:1738-1761
+    MICRO_BATCH level)."""
+
+    def test_spmd_step_snapshot(self, monkeypatch, tmp_path):
+        import hetu_tpu as ht
+        from hetu_tpu import ops, optim
+        log = str(tmp_path / "mem.jsonl")
+        monkeypatch.setenv("HETU_TPU_MEMORY_PROFILE", "MICRO_BATCH")
+        monkeypatch.setenv("HETU_TPU_MEMORY_LOG_FILE", log)
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (4, 8), name="x")
+            w = ht.parameter(np.zeros((8, 4), np.float32), (8, 4), name="w")
+            loss = ops.reduce_mean(ops.matmul(x, w) ** 2 + 1.0)
+            op = optim.SGDOptimizer(lr=0.1).minimize(loss)
+            X = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+            g.run(loss, [loss, op], {x: X})
+            g.run(loss, [loss, op], {x: X})
+        assert g._memory_profiler is not None
+        snaps = g._memory_profiler.snapshots
+        assert len(snaps) == 2 and all(s["tag"] == "step" for s in snaps)
+        import json as _json
+        lines = [_json.loads(l) for l in open(log)]
+        assert len(lines) == 2
+
+    @pytest.mark.slow
+    def test_mpmd_per_microbatch_snapshots(self, monkeypatch, devices8):
+        monkeypatch.setenv("HETU_TPU_MEMORY_PROFILE", "MICRO_BATCH")
+        monkeypatch.delenv("HETU_TPU_MEMORY_LOG_FILE", raising=False)
+        from jax.sharding import Mesh
+        from tests.test_pipeline_mpmd import _cfg, _data
+        from hetu_tpu.models.gpt_mpmd import MPMDGPT
+        cfg = _cfg(num_layers=4)
+        ids, labels = _data(cfg, batch=4)
+        meshes = [[Mesh(np.array(devices8[2 * s:2 * s + 2]).reshape(1, 2),
+                        ("dp", "tp")) for s in range(2)]]
+        model = MPMDGPT(cfg, stage_layers=[[2, 2]], meshes=meshes, seed=0)
+        runtime = model.runtime
+        data = model.split_micro_batches(ids, labels, [2])
+        _, _, stats = runtime.train_step(data)
+        snaps = runtime.memory_profiler.snapshots
+        # one snapshot per executed task, tagged pipe/stage/kind + mb id
+        assert len(snaps) == stats.num_tasks
+        assert all(s["micro_batch_id"] >= 0 for s in snaps)
+        tags = {s["tag"] for s in snaps}
+        assert any(t.endswith(".F") for t in tags)
+        assert any(t.endswith(".B") for t in tags)
+
+
+class TestCostAnalysis:
+    """XLA cost analysis of the compiled step (in-program metrics,
+    reference op TimeCost / CUDAProfiler counters)."""
+
+    def test_flops_reported_and_scale(self):
+        import hetu_tpu as ht
+        from hetu_tpu import ops, optim
+
+        def step_flops(n):
+            with ht.graph("define_and_run", create_new=True) as g:
+                x = ht.placeholder("float32", (8, n), name="x")
+                w = ht.parameter(np.zeros((n, n), np.float32), (n, n),
+                                 name="w")
+                loss = ops.reduce_mean(ops.matmul(x, w) ** 2)
+                op = optim.SGDOptimizer(lr=0.1).minimize(loss)
+                assert g.cost_analysis() is None  # nothing ran yet
+                X = np.random.RandomState(0).randn(8, n).astype(np.float32)
+                g.run(loss, [loss, op], {x: X})
+                costs = g.cost_analysis()
+            assert costs is not None and "flops" in costs
+            return float(costs["flops"])
+
+        f64, f128 = step_flops(64), step_flops(128)
+        assert f64 > 0
+        # quadrupling the weight quadruples the dominant matmul flops
+        assert f128 > 3.0 * f64, (f64, f128)

@@ -36,6 +36,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow
     def test_bwd_matches_dense(self, devices8):
         mesh = ht.create_mesh({"cp": 4}, devices8[:4])
         q, k, v = _mk()
@@ -66,6 +67,7 @@ class TestRingAttention:
                                    rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 class TestGPTWithCP:
     def test_gpt_cp_matches_single_device(self, devices8):
         def train(mesh_shape, cp_axis=None, steps=3):
@@ -120,6 +122,7 @@ class TestRingRegressions:
                 _ops.parallel_attention(x, x, x)
 
 
+@pytest.mark.slow
 class TestSymSplitPattern:
     """SYM causal load balancing (reference SplitPattern::SYM,
     ParallelAttention.h:19, .cc:140-200)."""
@@ -173,6 +176,7 @@ class TestSymSplitPattern:
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+@pytest.mark.slow
 class TestVarlenRing:
     """Per-rank variable seq lens (_seq_len_list) + packed segments in
     the ring (reference ParallelAttention.cc:1061 varlen path)."""
@@ -324,3 +328,19 @@ class TestVarlenRing:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-3, atol=1e-3,
                                        err_msg=f"d{name}")
+
+
+@pytest.mark.slow
+class TestRingRoundProfiling:
+    """Per-round ring timing (reference AttnCommRing optional profiling,
+    ParallelAttention.h:411-413)."""
+
+    def test_round_times_measured(self, devices8):
+        from hetu_tpu.parallel.ring_attention import profile_ring_rounds
+        mesh = ht.create_mesh({"cp": 4}, devices8[:4])
+        q, k, v = _mk(s=128)
+        for pattern in ("normal", "sym"):
+            times = profile_ring_rounds(q, k, v, mesh, causal=True,
+                                        split_pattern=pattern, reps=2)
+            assert len(times) == 4
+            assert all(t > 0 and np.isfinite(t) for t in times), times

@@ -115,6 +115,7 @@ c.exit()
 """
 
 
+@pytest.mark.slow
 def test_launcher_local_workers(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER.format(repo="/root/repo"))
@@ -123,6 +124,7 @@ def test_launcher_local_workers(tmp_path):
     assert ok == 3
 
 
+@pytest.mark.slow
 def test_launcher_restart_policy(tmp_path):
     # worker crashes on first attempt (per-rank marker file), then succeeds
     script = tmp_path / "flaky.py"
@@ -144,6 +146,7 @@ def test_launcher_restart_policy(tmp_path):
     assert any(e["event"] == "restart" for e in l.events)
 
 
+@pytest.mark.slow
 def test_launcher_gives_up_after_budget(tmp_path):
     script = tmp_path / "dead.py"
     script.write_text("import sys; sys.exit(3)\n")

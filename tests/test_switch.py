@@ -16,6 +16,10 @@ from hetu_tpu.parallel import (SwitchExecGraph, SwitchMode, SwitchPlan,
                                switch_state)
 
 
+# full-model training loops: excluded from the dev fast path
+pytestmark = pytest.mark.slow
+
+
 def _mesh(devices8, dp, tp):
     return Mesh(np.array(devices8).reshape(dp, tp), ("dp", "tp"))
 
