@@ -59,10 +59,15 @@ def bench_gpt2(on_tpu: bool):
     from hetu_tpu.models import GPTConfig, GPTLMHeadModel
 
     if on_tpu:
+        # fused_lm_ce: the [B*S, V] logits tensor (~3.3GB bf16) is never
+        # stored as a backward residual — chunked recompute instead
+        # (ops/fused_ce.py); disable via HETU_TPU_BENCH_FUSED_CE=0
+        fused = os.environ.get("HETU_TPU_BENCH_FUSED_CE", "1") == "1"
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                         num_heads=12, max_seq_len=1024, sp=False,
                         dtype="bfloat16", position="learned",
-                        activation="gelu", norm="layernorm")
+                        activation="gelu", norm="layernorm",
+                        fused_lm_ce=fused)
         batch, seq, steps, warmup = 32, 1024, 10, 3
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,

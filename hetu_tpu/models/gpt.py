@@ -48,6 +48,9 @@ class GPTConfig:
     dp_axis: str = "dp"
     tp_axis: str = "tp"
     cp_axis: Optional[str] = None   # context parallel (ring attention) axis
+    # fuse lm_head matmul + CE so [B*S, V] logits are never stored
+    # whole (HBM win; scratch/purejax.py "fusedce" variant)
+    fused_lm_ce: bool = False
     # MoE (v1 MoELayer capability): >0 replaces the dense MLP with a
     # mixture of experts every `moe_every` blocks
     num_experts: int = 0
@@ -325,6 +328,13 @@ class GPTLMHeadModel(Module):
         reference's cu_seqlens varlen path (ops/Attention.h:286),
         Hydraulis packed training."""
         c = self.config
+        if labels is not None and c.fused_lm_ce and c.num_experts == 0:
+            x = self.transformer(input_ids, seq_len,
+                                 segment_ids=segment_ids)
+            w = self.lm_head.weight if self.lm_head is not None \
+                else self.transformer.wte.weight
+            return ops.fused_lm_cross_entropy(x, w, labels,
+                                              ignore_index=-100)
         logits = self.logits(input_ids, seq_len, segment_ids=segment_ids)
         if labels is None:
             return logits

@@ -643,6 +643,28 @@ def parallel_attention(q, k, v, causal=True, softmax_scale=None,
                {"causal": causal, "softmax_scale": softmax_scale})
 
 
+def fused_lm_cross_entropy(x, weight, labels, ignore_index=-100,
+                           num_chunks: int = 8, reduction: str = "mean"):
+    """LM-head matmul + CE fused, logits never materialized whole (the
+    reference's VocabParallelCrossEntropyLoss pipeline collapsed into one
+    chunked op — see ops/fused_ce.py).  x: [b, s, h] or [n, h];
+    weight: [vocab, h]; labels match x's leading dims."""
+    from .fused_ce import fused_linear_cross_entropy
+
+    def _impl(x, w, lbl, ignore_index=-100, num_chunks=8,
+              reduction="mean"):
+        n = 1
+        for d in x.shape[:-1]:
+            n *= d
+        return fused_linear_cross_entropy(
+            x.reshape(n, x.shape[-1]), w, lbl.reshape(n),
+            ignore_index, num_chunks, reduction)
+
+    return _op("fused_lm_cross_entropy", _impl, [x, weight, labels],
+               {"ignore_index": ignore_index, "num_chunks": num_chunks,
+                "reduction": reduction})
+
+
 # ---------------------------------------------------------------------------
 # AMP helpers (ops/CheckFinite, update_scale)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,110 @@
+"""Fused LM-head + cross-entropy: logits are never materialized whole.
+
+The reference computes ``logits = lm_head(x)`` then CE
+(``ops/VocabParallelCrossEntropyLoss.cc``) — on TPU the [B*S, V] logits
+tensor (3-7 GB for GPT-2-class configs) dominates HBM traffic because XLA
+keeps it alive as the backward residual.  This op chunks the token dim:
+each chunk's logits are computed, reduced to (lse, picked-logit) and
+discarded; the backward RECOMPUTES chunk logits and accumulates dx/dw —
+the round-3 ``scratch/purejax.py`` "fusedce" variant, landed.
+
+Pure-jax with a custom VJP; shards transparently under GSPMD (tp-sharded
+``w`` keeps the chunk matmuls vocab-parallel).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _num_chunks(n: int, want: int) -> int:
+    want = max(1, min(want, n))
+    while n % want:
+        want -= 1
+    return want
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_linear_cross_entropy(x, w, labels, ignore_index: int = -100,
+                               num_chunks: int = 8,
+                               reduction: str = "mean"):
+    """mean/sum CE of ``x @ w.T`` against ``labels`` without storing the
+    logits.  x: [N, H]; w: [V, H]; labels: [N] (ignore_index masked)."""
+    loss, _ = _fce_fwd_impl(x, w, labels, ignore_index, num_chunks,
+                            reduction)
+    return loss
+
+
+def _fce_fwd_impl(x, w, labels, ignore_index, num_chunks, reduction):
+    n, h = x.shape
+    c = _num_chunks(n, num_chunks)
+    xs = x.reshape(c, n // c, h)
+    ls = labels.reshape(c, n // c)
+
+    def chunk(carry, xl):
+        xc, lc = xl
+        logits = jax.lax.dot_general(
+            xc, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [nc, V]
+        m = jnp.max(logits, axis=-1)
+        lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+        safe = jnp.clip(lc, 0, w.shape[0] - 1)
+        picked = jnp.take_along_axis(logits, safe[:, None], 1)[:, 0]
+        valid = lc != ignore_index
+        losses = jnp.where(valid, lse - picked, 0.0)
+        return carry + jnp.sum(losses), (lse, valid)
+
+    total, (lses, valids) = lax.scan(chunk, jnp.float32(0.0), (xs, ls))
+    n_valid = jnp.maximum(jnp.sum(valids.astype(jnp.float32)), 1.0)
+    loss = total / n_valid if reduction == "mean" else total
+    return loss, (lses.reshape(n), n_valid)
+
+
+def _fce_fwd_rule(x, w, labels, ignore_index, num_chunks, reduction):
+    loss, (lse, n_valid) = _fce_fwd_impl(x, w, labels, ignore_index,
+                                         num_chunks, reduction)
+    return loss, (x, w, labels, lse, n_valid)
+
+
+def _fce_bwd_rule(ignore_index, num_chunks, reduction, res, g):
+    x, w, labels, lse, n_valid = res
+    n, h = x.shape
+    v = w.shape[0]
+    c = _num_chunks(n, num_chunks)
+    xs = x.reshape(c, n // c, h)
+    ls = labels.reshape(c, n // c)
+    lses = lse.reshape(c, n // c)
+    scale = g / n_valid if reduction == "mean" else g
+
+    def chunk(dw_acc, xl):
+        xc, lc, lse_c = xl
+        logits = jax.lax.dot_general(
+            xc, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # recompute
+        p = jnp.exp(logits - lse_c[:, None])           # softmax
+        safe = jnp.clip(lc, 0, v - 1)
+        onehot = jax.nn.one_hot(safe, v, dtype=p.dtype)
+        valid = (lc != ignore_index).astype(p.dtype)[:, None]
+        dlogits = (p - onehot) * valid * scale         # [nc, V] fp32
+        dxc = jax.lax.dot_general(
+            dlogits.astype(w.dtype), w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dw_c = jax.lax.dot_general(
+            dlogits.astype(xc.dtype), xc, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dw_acc + dw_c, dxc
+
+    dw, dxs = lax.scan(chunk, jnp.zeros((v, h), jnp.float32),
+                       (xs, ls, lses))
+    dx = dxs.reshape(n, h).astype(x.dtype)
+    dlabels = np.zeros(labels.shape, jax.dtypes.float0)
+    return dx, dw.astype(w.dtype), dlabels
+
+
+fused_linear_cross_entropy.defvjp(_fce_fwd_rule, _fce_bwd_rule)

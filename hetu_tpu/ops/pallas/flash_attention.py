@@ -111,11 +111,17 @@ def _nosegs_kernel(kernel, *refs, **kw):
     return kernel(None, None, *refs, **kw)
 
 
-def _block_sizes(s: int, d: int, dtype) -> Tuple[int, int]:
+def _block_sizes(s: int, d: int, dtype, role: str = "fwd"
+                 ) -> Tuple[int, int]:
     """Pick q/kv block sizes.  Blocks must divide s AND satisfy TPU tiling
     (last-two-dims rule); a block equal to the full dim is always legal, so
-    sequences with no nice divisor fall back to a single block."""
-    for cand in (512, 256, 128):
+    sequences with no nice divisor fall back to a single block.
+
+    Forward prefers 1024 blocks (fp32 score tile 4MB — the measured sweet
+    spot of the round-3 fa3 prototype); the backward passes carry more
+    scratch per block, so they cap at 512."""
+    cands = (1024, 512, 256, 128) if role == "fwd" and d <= 128         else (512, 256, 128)
+    for cand in cands:
         if s % cand == 0:
             return cand, cand
     return s, s
@@ -154,9 +160,18 @@ def _fwd_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,  # inputs
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
         if causal:
-            rows = q_idx * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = kv_idx * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(cols <= rows + offset, s, DEFAULT_MASK_VALUE)
+            # diag specialization (fa2 sweep): blocks fully below the
+            # diagonal skip the iota mask entirely — half the causal
+            # blocks pay zero masking VPU work
+            def _masked(sv):
+                rows = q_idx * bq + lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0)
+                cols = kv_idx * bk + lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 1)
+                return jnp.where(cols <= rows + offset, sv,
+                                 DEFAULT_MASK_VALUE)
+            is_diag = kv_idx * bk + bk - 1 > q_idx * bq + offset
+            s = lax.cond(is_diag, _masked, lambda sv: sv, s)
         if use_segs:
             qs = q_seg_ref[0, :, 0]        # [bq] (narrow-lane layout)
             ks = kv_seg_ref[0, 0, :]       # [bk] (sublane-padded layout)
@@ -318,8 +333,8 @@ def _flash_bwd_fused(scale, causal, segment_ids, res, do, causal_offset):
     outr = out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     lser = jnp.broadcast_to(lse.reshape(b * h, sq)[:, :, None],
                             (b * h, sq, SUBLANES))
-    bq, _ = _block_sizes(sq, d, q.dtype)
-    _, bk = _block_sizes(sk, d, q.dtype)
+    bq, _ = _block_sizes(sq, d, q.dtype, role="bwd")
+    _, bk = _block_sizes(sk, d, q.dtype, role="bwd")
     num_q, num_kv = sq // bq, sk // bk
 
     use_segs = segment_ids is not None
@@ -482,8 +497,8 @@ def _flash_bwd_split(scale, causal, segment_ids, res, do, causal_offset):
                     axis=-1)
     delta = jnp.broadcast_to(delta[:, :, None], (b * h, sq, SUBLANES))
     lser = jnp.broadcast_to(lser[:, :, None], (b * h, sq, SUBLANES))
-    bq, _ = _block_sizes(sq, d, q.dtype)
-    _, bk = _block_sizes(sk, d, q.dtype)
+    bq, _ = _block_sizes(sq, d, q.dtype, role="bwd")
+    _, bk = _block_sizes(sk, d, q.dtype, role="bwd")
     num_q, num_kv = sq // bq, sk // bk
 
     use_segs = segment_ids is not None

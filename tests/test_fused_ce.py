@@ -1,0 +1,131 @@
+"""Fused LM-head + CE (logits never materialized) tests.
+
+Oracle: the unfused lm_head matmul -> softmax CE path (itself validated
+against torch in test_ops.py).  The fused op is the round-3
+scratch/purejax.py "fusedce" variant landed as a real op.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import hetu_tpu as ht
+from hetu_tpu import ops, optim
+from hetu_tpu.ops.fused_ce import fused_linear_cross_entropy
+
+N, H, V = 64, 32, 97
+
+
+def _data(seed=0, ignore_frac=0.0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(N, H), jnp.float32)
+    w = jnp.asarray(rng.randn(V, H) * 0.05, jnp.float32)
+    lbl = rng.randint(0, V, N)
+    if ignore_frac:
+        lbl[rng.rand(N) < ignore_frac] = -100
+    return x, w, jnp.asarray(lbl, jnp.int32)
+
+
+def _oracle(x, w, lbl, reduction="mean"):
+    logits = (x @ w.T).astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    safe = jnp.clip(lbl, 0, V - 1)
+    picked = jnp.take_along_axis(logits, safe[:, None], 1)[:, 0]
+    valid = lbl != -100
+    losses = jnp.where(valid, lse - picked, 0.0)
+    if reduction == "mean":
+        return jnp.sum(losses) / jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(losses)
+
+
+class TestFusedLinearCE:
+    @pytest.mark.parametrize("chunks", [1, 4, 8])
+    def test_forward_matches_oracle(self, chunks):
+        x, w, lbl = _data()
+        got = fused_linear_cross_entropy(x, w, lbl, -100, chunks, "mean")
+        want = _oracle(x, w, lbl)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+    def test_ignore_index(self):
+        x, w, lbl = _data(ignore_frac=0.3)
+        got = fused_linear_cross_entropy(x, w, lbl, -100, 4, "mean")
+        want = _oracle(x, w, lbl)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+    @pytest.mark.parametrize("reduction", ["mean", "sum"])
+    def test_grads_match_oracle(self, reduction):
+        x, w, lbl = _data(ignore_frac=0.2)
+        g1 = jax.grad(lambda x, w: fused_linear_cross_entropy(
+            x, w, lbl, -100, 4, reduction), argnums=(0, 1))(x, w)
+        g2 = jax.grad(lambda x, w: _oracle(x, w, lbl, reduction),
+                      argnums=(0, 1))(x, w)
+        for name, a, b in zip("xw", g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6,
+                                       err_msg=f"d{name}")
+
+    def test_non_divisible_chunks_fall_back(self):
+        x, w, lbl = _data()
+        # 7 does not divide 64 -> falls back to nearest divisor
+        got = fused_linear_cross_entropy(x, w, lbl, -100, 7, "mean")
+        want = _oracle(x, w, lbl)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+
+@pytest.mark.slow
+class TestModelFusedCE:
+    def test_gpt_fused_ce_matches_unfused(self, devices8):
+        """fused_lm_ce=True trains on the same trajectory as the unfused
+        vocab-parallel CE path (tp-sharded lm_head under the mesh)."""
+        from hetu_tpu.graph import ctor
+        from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+
+        def train(fused):
+            ctor._seed_counter[0] = 4242
+            mesh = ht.create_mesh({"dp": 2, "tp": 4})
+            cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                            num_heads=4, max_seq_len=32, sp=False,
+                            fused_lm_ce=fused)
+            with ht.graph("define_and_run", create_new=True,
+                          mesh=mesh) as g:
+                ids = ht.parallel_placeholder("int32", (4, 32),
+                                              pspec=P("dp", None),
+                                              name="ids")
+                lbl = ht.parallel_placeholder("int32", (4, 32),
+                                              pspec=P("dp", None),
+                                              name="lbl")
+                m = GPTLMHeadModel(cfg)
+                loss = m(ids, lbl)
+                op = optim.AdamOptimizer(lr=1e-2).minimize(loss)
+                rng = np.random.RandomState(0)
+                I = rng.randint(0, 128, (4, 32)).astype(np.int32)
+                L = np.roll(I, -1, 1)
+                return [float(np.asarray(
+                    g.run(loss, [loss, op], {ids: I, lbl: L})[0]))
+                    for _ in range(4)]
+
+        unfused = train(False)
+        fused = train(True)
+        np.testing.assert_allclose(unfused, fused, rtol=3e-4, atol=1e-5)
+
+    def test_tied_embeddings_fused(self):
+        from hetu_tpu.graph import ctor
+        from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+        ctor._seed_counter[0] = 7
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=4, max_seq_len=16, sp=False,
+                        tie_embeddings=True, fused_lm_ce=True)
+        with ht.graph("define_and_run", create_new=True) as g:
+            ids = ht.placeholder("int32", (2, 16), name="ids")
+            lbl = ht.placeholder("int32", (2, 16), name="lbl")
+            m = GPTLMHeadModel(cfg)
+            loss = m(ids, lbl)
+            op = optim.AdamOptimizer(lr=1e-2).minimize(loss)
+            I = np.random.RandomState(0).randint(0, 64, (2, 16))
+            I = I.astype(np.int32)
+            losses = [float(np.asarray(g.run(
+                loss, [loss, op], {ids: I, lbl: np.roll(I, -1, 1)})[0]))
+                for _ in range(4)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0], losses
