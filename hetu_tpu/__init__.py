@@ -37,3 +37,17 @@ def gradients(loss, xs):
     """Reverse-mode autodiff entry (reference hetu.gradients -> Graph::Gradients)."""
     g = loss.graph or get_default_graph()
     return g.make_gradients(loss, list(xs))
+
+
+def set_seed(seed: int) -> None:
+    """Reset the global parameter-init and dropout RNG streams (reference
+    per-device seeded RNG state, ``hetu/impl/random/``).  Subsequent
+    variable initializers draw keys derived from ``seed`` in creation
+    order, and graphs built afterwards draw a deterministic dropout seed
+    (``Graph._rng_seed`` comes from the numpy global stream) — so two
+    models built after identical ``set_seed`` calls get identical weights
+    AND identical dropout masks."""
+    import numpy as _np
+    from .graph import ctor
+    ctor._seed_counter[0] = int(seed)
+    _np.random.seed(int(seed) & 0x7FFFFFFF)

@@ -37,6 +37,16 @@ class Strategy:
     tp_group_times: List[float] = field(default_factory=list)
 
     @property
+    def is_hetero(self) -> bool:
+        """True when the plan needs MPMD execution: unequal micro-batch
+        apportionment or per-pipeline layer splits that differ — work a
+        single rectangular SPMD program cannot make unequal (masking
+        would burn the same wall clock on every device; reference
+        ``DeducePipeline``, ``define_and_run_graph.cc:139``)."""
+        return (len(set(self.micro_batches)) > 1
+                or len({tuple(s) for s in self.stage_layers}) > 1)
+
+    @property
     def mesh_shape(self) -> Dict[str, int]:
         # always emit all three axes (size-1 axes are legal meshes): dropping
         # e.g. 'tp' would strip it from param PartitionSpecs on a hot switch

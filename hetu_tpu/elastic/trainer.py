@@ -38,7 +38,11 @@ class Trainer:
                  solver: StrategyModel,
                  num_micro_batches: int = 1,
                  straggler: Optional[Straggler] = None,
-                 switch_threshold: float = 0.05):
+                 switch_threshold: float = 0.05,
+                 hetero: str = "project"):
+        if hetero not in ("project", "error"):
+            raise ValueError(f"hetero must be 'project' or 'error', "
+                             f"got {hetero!r}")
         self.graph = graph
         self.loss = loss
         self.train_op = train_op
@@ -50,6 +54,11 @@ class Trainer:
             else [jax.devices()[0]]
         self.straggler = straggler or Straggler(len(self.devices))
         self.switch_threshold = switch_threshold
+        # SPMD meshes are rectangular: a hetero plan (unequal per-pipeline
+        # micro-batches / layer splits) is executed here as its homogeneous
+        # projection ("project"); pass hetero="error" to fail instead and
+        # route to ElasticMPMDTrainer, which executes hetero plans exactly.
+        self.hetero = hetero
         self.current_strategy: Optional[Strategy] = None
         self.history: List[Dict[str, Any]] = []
         self.step_idx = 0
@@ -94,6 +103,13 @@ class Trainer:
         return True
 
     def _apply_strategy(self, strat: Strategy) -> None:
+        if strat.is_hetero and self.hetero == "error":
+            raise RuntimeError(
+                f"solved plan is heterogeneous ({strat.describe()}); the "
+                "SPMD Trainer would only execute its homogeneous "
+                "projection — use hetu_tpu.elastic.ElasticMPMDTrainer for "
+                "exact hetero execution, or hetero='project' to accept "
+                "the projection")
         devices = [self.devices[i] for i in strat.device_order]
         new_mesh = create_mesh(strat.mesh_shape, devices)
         cur = self.graph.mesh
@@ -111,6 +127,7 @@ class Trainer:
         self.history.append({
             "step": self.step_idx,
             "strategy": strat.describe(),
+            "hetero_projected": strat.is_hetero,
             "switch_seconds": time.perf_counter() - t0,
             "switch_profile": prof.as_dict() if prof is not None else None,
         })

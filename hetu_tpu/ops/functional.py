@@ -234,6 +234,23 @@ def slice(a, begin, size):  # noqa: A001
                [a], {"begin": tuple(begin), "size": tuple(size)})
 
 
+def as_strided(a, shape, strides, storage_offset=0):
+    """Strided view over ``a``'s flattened storage (reference
+    ``ops/Views.h`` AsStrided / ``impl/kernel`` AsStrided).  ``strides``
+    are element strides into the flattened input, as in torch.  XLA has
+    no aliasing views, so this materializes a gather — overlapping
+    windows are supported (the reference's main AsStrided use case)."""
+    def _impl(x, shape=None, strides=None, offset=0):
+        flat = x.reshape(-1)
+        idx = jnp.asarray(offset, jnp.int32)
+        for dim, st in zip(shape, strides):
+            idx = idx[..., None] + jnp.arange(dim, dtype=jnp.int32) * st
+        return flat[idx.reshape(shape)]
+    return _op("as_strided", _impl, [a],
+               {"shape": tuple(shape), "strides": tuple(strides),
+                "offset": int(storage_offset)})
+
+
 def split(a, num_chunks, axis=0):
     return _op("split",
                lambda x, n=2, axis=0: tuple(jnp.split(x, n, axis=axis)),

@@ -42,6 +42,11 @@ def fused_linear_cross_entropy(x, w, labels, ignore_index: int = -100,
 
 
 def _fce_fwd_impl(x, w, labels, ignore_index, num_chunks, reduction):
+    if reduction not in ("mean", "sum"):
+        raise ValueError(
+            f"fused_linear_cross_entropy supports reduction 'mean'/'sum', "
+            f"got {reduction!r} (use the unfused softmax_cross_entropy "
+            f"for 'none')")
     n, h = x.shape
     c = _num_chunks(n, num_chunks)
     xs = x.reshape(c, n // c, h)

@@ -43,6 +43,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
+# Scores are computed as base-2 logits: the softmax scale AND log2(e) are
+# folded into the q operand (one [s, d] multiply outside the kernel
+# instead of a [s, s] multiply per block inside), and exp/log become
+# exp2/log2 — the VPU-native transcendentals.  LSE stays natural-log at
+# every API boundary (ring correction, backward, tests).
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
+
 
 def _interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
@@ -111,6 +119,19 @@ def _nosegs_kernel(kernel, *refs, **kw):
     return kernel(None, None, *refs, **kw)
 
 
+def _causal_mask(s, q_idx, kv_idx, bq, bk, offset):
+    """Apply the causal mask to a score block — diag-specialized (fa2
+    sweep): blocks fully below the diagonal skip the iota mask entirely,
+    so half the causal blocks pay zero masking VPU work.  Shared by all
+    four kernels so fwd/bwd masking can never desynchronize."""
+    def _masked(sv):
+        rows = q_idx * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = kv_idx * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        return jnp.where(cols <= rows + offset, sv, DEFAULT_MASK_VALUE)
+    is_diag = kv_idx * bk + bk - 1 > q_idx * bq + offset
+    return lax.cond(is_diag, _masked, lambda sv: sv, s)
+
+
 def _block_sizes(s: int, d: int, dtype, role: str = "fwd"
                  ) -> Tuple[int, int]:
     """Pick q/kv block sizes.  Blocks must divide s AND satisfy TPU tiling
@@ -134,10 +155,43 @@ def _block_sizes(s: int, d: int, dtype, role: str = "fwd"
 def _fwd_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,  # inputs
                 o_ref, lse_ref,                              # outputs
                 acc_ref, m_ref, l_ref,                       # scratch
-                *, scale: float, causal: bool, offset: int, bq: int,
+                *, causal: bool, offset: int, bq: int,
                 bk: int, num_kv: int, use_segs: bool):
+    # q arrives pre-scaled by softmax_scale * LOG2E: scores are base-2
+    # logits and all exps are exp2 (see module constant note).
     kv_idx = pl.program_id(2)
     q_idx = pl.program_id(1)
+
+    def _scores():
+        q = q_ref[0]                       # [bq, d]
+        k = k_ref[0]                       # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk] base-2
+        if causal:
+            s = _causal_mask(s, q_idx, kv_idx, bq, bk, offset)
+        if use_segs:
+            qs = q_seg_ref[0, :, 0]        # [bq] (narrow-lane layout)
+            ks = kv_seg_ref[0, 0, :]       # [bk] (sublane-padded layout)
+            seg_ok = qs[:, None] == ks[None, :]
+            s = jnp.where(seg_ok, s, DEFAULT_MASK_VALUE)
+        return s
+
+    if num_kv == 1 and (not causal or offset == 0):
+        # single-kv-block fast path (the whole kv sequence is one block,
+        # and the block is never fully skipped): no online-softmax carry,
+        # no scratch traffic, outputs written directly
+        s = _scores()
+        m = jnp.max(s, axis=1)
+        p = jnp.exp2(s - m[:, None])
+        l = jnp.sum(p, axis=1)             # >= 1: exp2(0) at the max
+        o_ref[0] = (jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) / l[:, None]
+        ).astype(o_ref.dtype)
+        lse = (m + jnp.log2(l)) * LN2
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
+        return
 
     @pl.when(kv_idx == 0)
     def _init():
@@ -154,33 +208,11 @@ def _fwd_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,  # inputs
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0]                       # [bq, d]
-        k = k_ref[0]                       # [bk, d]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
-            # diag specialization (fa2 sweep): blocks fully below the
-            # diagonal skip the iota mask entirely — half the causal
-            # blocks pay zero masking VPU work
-            def _masked(sv):
-                rows = q_idx * bq + lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 0)
-                cols = kv_idx * bk + lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 1)
-                return jnp.where(cols <= rows + offset, sv,
-                                 DEFAULT_MASK_VALUE)
-            is_diag = kv_idx * bk + bk - 1 > q_idx * bq + offset
-            s = lax.cond(is_diag, _masked, lambda sv: sv, s)
-        if use_segs:
-            qs = q_seg_ref[0, :, 0]        # [bq] (narrow-lane layout)
-            ks = kv_seg_ref[0, 0, :]       # [bk] (sublane-padded layout)
-            seg_ok = qs[:, None] == ks[None, :]
-            s = jnp.where(seg_ok, s, DEFAULT_MASK_VALUE)
+        s = _scores()
         m_prev = m_ref[:, 0]               # [bq]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_cur[:, None])
-        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp2(s - m_cur[:, None])
+        alpha = jnp.exp2(m_prev - m_cur)
         l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
         l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
         acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
@@ -194,14 +226,17 @@ def _fwd_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,  # inputs
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
         m = m_ref[:, 0]
-        lse = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(safe_l))
+        lse = jnp.where(l == 0.0, -jnp.inf, (m + jnp.log2(safe_l)) * LN2)
         lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
 def _flash_fwd(q, k, v, scale, causal, segment_ids, causal_offset=0):
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    # fold softmax scale + log2(e) into q (one [s, d] multiply; scores
+    # come out of the kernel's matmul as base-2 logits)
+    qr = (q * (scale * LOG2E)).astype(q.dtype) \
+        .transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     bq, _ = _block_sizes(sq, d, q.dtype)
@@ -212,7 +247,7 @@ def _flash_fwd(q, k, v, scale, causal, segment_ids, causal_offset=0):
     seg_specs, seg_args = _seg_operands(segment_ids, b, h, sq, sk, bq, bk)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, offset=causal_offset,
+        _fwd_kernel, causal=causal, offset=causal_offset,
         bq=bq, bk=bk, num_kv=num_kv, use_segs=use_segs)
     if not use_segs:
         kernel = functools.partial(_nosegs_kernel, kernel)
@@ -257,6 +292,10 @@ def _bwd_fused_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
                       dq_acc, dk_acc, dv_acc, delta_scr,
                       *, scale, causal, offset, bq, bk, num_q, num_kv,
                       use_segs):
+    # q and lse arrive pre-scaled by LOG2E (q also by softmax_scale), so
+    # p = exp2(s2 - lse2) with no per-element scale multiplies; the
+    # deferred scales land on the [*, d] accumulators at finalize:
+    # dq *= scale, dk /= LOG2E (dk was accumulated against the scaled q).
     q_idx = pl.program_id(1)
     kv_idx = pl.program_id(2)
 
@@ -285,25 +324,26 @@ def _bwd_fused_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
         v = v_ref[0]
         do = do_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32)
         if causal:
-            rows = q_idx * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = kv_idx * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(cols <= rows + offset, s, DEFAULT_MASK_VALUE)
+            s = _causal_mask(s, q_idx, kv_idx, bq, bk, offset)
         if use_segs:
             seg_ok = (q_seg_ref[0, :, 0][:, None]
                       == kv_seg_ref[0, 0, :][None, :])
             s = jnp.where(seg_ok, s, DEFAULT_MASK_VALUE)
         lse = lse_ref[0, :, 0]
-        p = jnp.exp(s - lse[:, None])
-        p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
+        p = jnp.exp2(s - lse[:, None])
+        if use_segs or offset != 0:
+            # fully-skipped q rows carry lse == -inf (never occurs in the
+            # plain causal path — every row sees its diagonal)
+            p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
         dv_acc[pl.dslice(kv_idx * bk, bk), :] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         delta = delta_scr[:, 0]
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta[:, None])
         dsl = ds.astype(q.dtype)
         dq_acc[:] += jax.lax.dot_general(
             dsl, k, (((1,), (0,)), ((), ())),
@@ -314,11 +354,11 @@ def _bwd_fused_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
 
     @pl.when(kv_idx == num_kv - 1)
     def _fin_q():
-        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
 
     @pl.when(jnp.logical_and(q_idx == num_q - 1, kv_idx == num_kv - 1))
     def _fin_kv():
-        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_acc[:] * (1.0 / LOG2E)).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
@@ -326,12 +366,13 @@ def _flash_bwd_fused(scale, causal, segment_ids, res, do, causal_offset):
     q, k, v, out, lse = res
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    qr = (q * (scale * LOG2E)).astype(q.dtype) \
+        .transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     dor = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     outr = out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    lser = jnp.broadcast_to(lse.reshape(b * h, sq)[:, :, None],
+    lser = jnp.broadcast_to((lse * LOG2E).reshape(b * h, sq)[:, :, None],
                             (b * h, sq, SUBLANES))
     bq, _ = _block_sizes(sq, d, q.dtype, role="bwd")
     _, bk = _block_sizes(sk, d, q.dtype, role="bwd")
@@ -407,28 +448,27 @@ def _bwd_dq_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
         v = v_ref[0]
         do = do_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32)
         if causal:
-            rows = q_idx * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = kv_idx * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(cols <= rows + offset, s, DEFAULT_MASK_VALUE)
+            s = _causal_mask(s, q_idx, kv_idx, bq, bk, offset)
         if use_segs:
             seg_ok = q_seg_ref[0, :, 0][:, None] == kv_seg_ref[0, 0, :][None, :]
             s = jnp.where(seg_ok, s, DEFAULT_MASK_VALUE)
         lse = lse_ref[0, :, 0]
-        p = jnp.exp(s - lse[:, None])
-        p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
+        p = jnp.exp2(s - lse[:, None])
+        if use_segs or offset != 0:
+            p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         delta = delta_ref[0, :, 0]
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta[:, None])
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kv_idx == num_kv - 1)
     def _finalize():
-        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
@@ -454,31 +494,30 @@ def _bwd_dkv_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref,
         v = v_ref[0]
         do = do_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32)
         if causal:
-            rows = q_idx * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = kv_idx * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(cols <= rows + offset, s, DEFAULT_MASK_VALUE)
+            s = _causal_mask(s, q_idx, kv_idx, bq, bk, offset)
         if use_segs:
             seg_ok = q_seg_ref[0, :, 0][:, None] == kv_seg_ref[0, 0, :][None, :]
             s = jnp.where(seg_ok, s, DEFAULT_MASK_VALUE)
         lse = lse_ref[0, :, 0]
-        p = jnp.exp(s - lse[:, None])
-        p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
+        p = jnp.exp2(s - lse[:, None])
+        if use_segs or offset != 0:
+            p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         delta = delta_ref[0, :, 0]
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta[:, None])
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(q_idx == num_q - 1)
     def _finalize():
-        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_acc[:] * (1.0 / LOG2E)).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
@@ -486,12 +525,13 @@ def _flash_bwd_split(scale, causal, segment_ids, res, do, causal_offset):
     q, k, v, out, lse = res
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    qr = (q * (scale * LOG2E)).astype(q.dtype) \
+        .transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     dor = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     outr = out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    lser = lse.reshape(b * h, sq)
+    lser = (lse * LOG2E).reshape(b * h, sq)
     # delta = rowsum(do * o)  [bh, sq] -> narrow-lane [bh, sq, SUBLANES]
     delta = jnp.sum(dor.astype(jnp.float32) * outr.astype(jnp.float32),
                     axis=-1)

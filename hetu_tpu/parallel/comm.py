@@ -143,6 +143,30 @@ def barrier(coordinator=None, name: str = "default",
             jax.pmap(lambda v: lax.psum(v, "i"), axis_name="i")(x))
 
 
+def partial_reduce(x: jax.Array, axis: str, participating,
+                   op: str = "mean") -> jax.Array:
+    """Partial (asynchronous-DP) reduce — v1's ``PartialReduce``
+    (``v1/python/hetu/preduce.py:8``): only the *ready* subset of ranks
+    contributes; everyone receives the subset's mean (or sum).
+
+    ``participating`` is a per-rank scalar (bool/0-1, may be traced):
+    unlike the reference, which forms an ad-hoc NCCL group from the ranks
+    that arrived within a time window, XLA groups are static — so the
+    subset is expressed as a mask and lowered to one full-axis ``psum``
+    of masked contributions plus a participant count.  Ranks outside the
+    subset still receive the reduced value (the v1 semantics: stale
+    workers adopt the fresh average on their next partial round).
+    """
+    p = jnp.asarray(participating, x.dtype)
+    total = lax.psum(x * p, axis)
+    if op == "sum":
+        return total
+    if op == "mean":
+        count = lax.psum(p, axis)
+        return total / jnp.maximum(count, 1)
+    raise ValueError(f"unsupported partial_reduce op {op!r}")
+
+
 _COORDINATOR: list = [None]
 
 

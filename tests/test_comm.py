@@ -136,3 +136,41 @@ class TestSplitCollectives:
             _run(mesh,
                  lambda v: comm.split_all_reduce(v, "x", [[0, 1], [2, 3]]),
                  x, P("x"), P("x"))
+
+
+class TestPartialReduce:
+    """v1 PartialReduce (preduce.py:8): reduce over the ready subset."""
+
+    def test_partial_mean_subset(self, devices8):
+        mesh = create_mesh({"x": 8}, devices8)
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        ready = np.array([1, 0, 1, 1, 0, 0, 1, 0], np.float32).reshape(8, 1)
+
+        def f(v, p):
+            return comm.partial_reduce(v, "x", p[0, 0], op="mean")
+        g = shard_map(f, mesh, (P("x"), P("x")), P("x"))
+        out = np.asarray(jax.jit(g)(x, ready))
+        want = (0 + 2 + 3 + 6) / 4.0  # mean over ready ranks
+        np.testing.assert_allclose(out, np.full((8, 1), want))
+
+    def test_partial_sum_all_ready_matches_psum(self, devices8):
+        mesh = create_mesh({"x": 8}, devices8)
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        ones = np.ones((8, 1), np.float32)
+
+        def f(v, p):
+            return comm.partial_reduce(v, "x", p[0, 0], op="sum")
+        g = shard_map(f, mesh, (P("x"), P("x")), P("x"))
+        out = np.asarray(jax.jit(g)(x, ones))
+        np.testing.assert_allclose(out, np.full((8, 1), 28.0))
+
+    def test_partial_mean_none_ready_is_zero(self, devices8):
+        mesh = create_mesh({"x": 8}, devices8)
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        zeros = np.zeros((8, 1), np.float32)
+
+        def f(v, p):
+            return comm.partial_reduce(v, "x", p[0, 0], op="mean")
+        g = shard_map(f, mesh, (P("x"), P("x")), P("x"))
+        out = np.asarray(jax.jit(g)(x, zeros))
+        np.testing.assert_allclose(out, 0.0)  # count clamped to 1

@@ -103,6 +103,40 @@ def test_make_plans_straggler_changes_layout():
     assert slow_stage_layers < max(max(s) for s in plan.stage_layers)
 
 
+def test_strategy_is_hetero():
+    homo = Strategy(tp=2, pp=2, dp=2, device_order=list(range(8)),
+                    stage_layers=[[4, 4], [4, 4]], micro_batches=[2, 2],
+                    est_step_time=1.0)
+    assert not homo.is_hetero
+    uneven_mb = Strategy(tp=2, pp=2, dp=2, device_order=list(range(8)),
+                         stage_layers=[[4, 4], [4, 4]], micro_batches=[3, 1],
+                         est_step_time=1.0)
+    assert uneven_mb.is_hetero
+    uneven_layers = Strategy(tp=2, pp=2, dp=2, device_order=list(range(8)),
+                             stage_layers=[[5, 3], [4, 4]],
+                             micro_batches=[2, 2], est_step_time=1.0)
+    assert uneven_layers.is_hetero
+
+
+def test_trainer_hetero_error_policy(devices8):
+    """hetero='error' refuses to silently project a hetero plan onto a
+    rectangular SPMD mesh (routes users to ElasticMPMDTrainer)."""
+    import pytest
+    from hetu_tpu.elastic.trainer import Trainer
+    trainer = Trainer.__new__(Trainer)
+    trainer.hetero = "error"
+    trainer.devices = list(devices8)
+    trainer.graph = type("G", (), {"mesh": None})()
+    hetero = Strategy(tp=1, pp=2, dp=4, device_order=list(range(8)),
+                      stage_layers=[[5, 3], [4, 4], [4, 4], [4, 4]],
+                      micro_batches=[1, 1, 1, 1], est_step_time=1.0)
+    with pytest.raises(RuntimeError, match="ElasticMPMDTrainer"):
+        trainer._apply_strategy(hetero)
+    with pytest.raises(ValueError, match="hetero"):
+        Trainer(graph=None, loss=None, train_op=None, optimizer=None,
+                data_provider=None, solver=None, hetero="bogus")
+
+
 def test_strategy_mesh_shape():
     s = Strategy(tp=2, pp=2, dp=2, device_order=list(range(8)),
                  stage_layers=[[4, 4], [4, 4]], micro_batches=[2, 2],

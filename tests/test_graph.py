@@ -406,3 +406,21 @@ class TestShapeBuckets:
             g.set_shape_buckets([8])
             with pytest.raises(ValueError, match="exceeds"):
                 g.run([out], feed_dict={x: np.ones((1, 9), np.float32)})
+
+
+def test_set_seed_reproducible_init():
+    """ht.set_seed resets the init-key stream (reference per-device RNG,
+    hetu/impl/random/)."""
+    import numpy as np
+    import hetu_tpu as ht
+
+    def build():
+        ht.set_seed(123)
+        with ht.graph("define_and_run", create_new=True) as g:
+            w = ht.parameter(ht.NormalInitializer(stddev=1.0), (8, 8),
+                             name="w")
+            g._materialize_var(w)
+            return np.asarray(g._var_data[w.id])
+
+    a, b = build(), build()
+    np.testing.assert_array_equal(a, b)

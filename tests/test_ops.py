@@ -214,6 +214,15 @@ class TestShapes:
         np.testing.assert_array_equal(_np(ops.embedding_lookup(table, ids)),
                                       table[ids])
 
+    def test_as_strided_vs_torch(self):
+        import torch
+        x = np.arange(24, dtype=np.float32)
+        # overlapping sliding windows: shape (5, 4), stride (2, 1), offset 3
+        want = torch.as_strided(torch.from_numpy(x), (5, 4), (2, 1), 3)
+        got = _np(ops.as_strided(x.reshape(4, 6), (5, 4), (2, 1),
+                                 storage_offset=3))
+        np.testing.assert_array_equal(got, want.numpy())
+
     def test_triu_pad(self):
         x = np.ones((4, 4), np.float32)
         np.testing.assert_array_equal(_np(ops.triu(x)), np.triu(x))
