@@ -68,7 +68,8 @@ def bench_gpt2(on_tpu: bool):
                         dtype="bfloat16", position="learned",
                         activation="gelu", norm="layernorm",
                         fused_lm_ce=fused)
-        batch, seq, steps, warmup = 32, 1024, 10, 3
+        batch = int(os.environ.get("HETU_TPU_BENCH_BATCH", "32"))
+        seq, steps, warmup = 1024, 10, 3
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
                         num_heads=8, max_seq_len=256, sp=False,

@@ -40,14 +40,19 @@ def gradients(loss, xs):
 
 
 def set_seed(seed: int) -> None:
-    """Reset the global parameter-init and dropout RNG streams (reference
+    """Reset the parameter-init and dropout RNG streams (reference
     per-device seeded RNG state, ``hetu/impl/random/``).  Subsequent
     variable initializers draw keys derived from ``seed`` in creation
-    order, and graphs built afterwards draw a deterministic dropout seed
-    (``Graph._rng_seed`` comes from the numpy global stream) — so two
-    models built after identical ``set_seed`` calls get identical weights
-    AND identical dropout masks."""
+    order, and graphs built afterwards draw deterministic dropout seeds
+    from a dedicated stream — so two models built after identical
+    ``set_seed`` calls get identical weights AND identical dropout masks.
+    numpy's process-global RNG is left untouched."""
     import numpy as _np
+    import importlib
     from .graph import ctor
+    # hetu_tpu.graph re-exports a `graph` context manager that shadows the
+    # graph.py submodule — resolve the MODULE explicitly
+    _graph_module = importlib.import_module("hetu_tpu.graph.graph")
     ctor._seed_counter[0] = int(seed)
-    _np.random.seed(int(seed) & 0x7FFFFFFF)
+    _graph_module._GRAPH_SEED_STREAM[0] = _np.random.RandomState(
+        int(seed) & 0x7FFFFFFF)

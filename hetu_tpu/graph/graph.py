@@ -40,6 +40,11 @@ from .tensor import SymbolicDim, Tensor, concrete_shape
 
 _op_ids = itertools.count()
 
+# dedicated stream for per-graph dropout seeds: ht.set_seed reseeds THIS
+# (not numpy's process-global RNG), so framework reproducibility and user
+# np.random usage never interfere with each other
+_GRAPH_SEED_STREAM = [np.random.RandomState()]
+
 
 class RunLevel(enum.Enum):
     TOPO = "topo"
@@ -84,7 +89,7 @@ class Graph:
         self._placeholders: Dict[int, Tensor] = {}
         self._grad_accum: Dict[int, jax.Array] = {}
         self._rng_tensor: Optional[Tensor] = None
-        self._rng_seed = np.random.randint(0, 2**31 - 1)
+        self._rng_seed = _GRAPH_SEED_STREAM[0].randint(0, 2**31 - 1)
         self._run_counter = 0
 
     # -- construction -------------------------------------------------------

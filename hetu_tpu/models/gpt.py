@@ -47,7 +47,8 @@ class GPTConfig:
     dtype: str = "float32"
     dp_axis: str = "dp"
     tp_axis: str = "tp"
-    cp_axis: Optional[str] = None   # context parallel (ring attention) axis
+    cp_axis: Optional[str] = None   # context parallel axis
+    cp_impl: str = "ring"           # "ring" (AttnCommRing) | "ulysses"
     # fuse lm_head matmul + CE so [B*S, V] logits are never stored
     # whole (HBM win; scratch/purejax.py "fusedce" variant)
     fused_lm_ce: bool = False
@@ -166,7 +167,7 @@ class ParallelAttentionBlock(Module):
             attn = ops.parallel_attention(
                 q, k, v, causal=True, cp_axis=c.cp_axis,
                 batch_axis=c.dp_axis, head_axis=c.tp_axis,
-                segment_ids=segment_ids)
+                segment_ids=segment_ids, cp_impl=c.cp_impl)
         else:
             attn = ops.attention(q, k, v, causal=True,
                                  segment_ids=segment_ids)

@@ -240,6 +240,20 @@ def as_strided(a, shape, strides, storage_offset=0):
     are element strides into the flattened input, as in torch.  XLA has
     no aliasing views, so this materializes a gather — overlapping
     windows are supported (the reference's main AsStrided use case)."""
+    ash = a.concrete_shape() if hasattr(a, "concrete_shape") else a.shape
+    size = 1
+    for d in ash:
+        size *= int(d)
+    lo = int(storage_offset) + sum(
+        (d - 1) * st for d, st in zip(shape, strides) if st < 0)
+    hi = int(storage_offset) + sum(
+        (d - 1) * st for d, st in zip(shape, strides) if st > 0)
+    if lo < 0 or hi >= size:
+        raise ValueError(
+            f"as_strided window [{lo}, {hi}] exceeds storage of {size} "
+            f"elements (shape={tuple(shape)}, strides={tuple(strides)}, "
+            f"storage_offset={storage_offset})")
+
     def _impl(x, shape=None, strides=None, offset=0):
         flat = x.reshape(-1)
         idx = jnp.asarray(offset, jnp.int32)
@@ -622,13 +636,19 @@ def attention(q, k, v, causal=True, softmax_scale=None, use_flash=None,
 
 def parallel_attention(q, k, v, causal=True, softmax_scale=None,
                        cp_axis: str = "cp", batch_axis: str = "dp",
-                       head_axis: str = "tp", segment_ids=None):
-    """Context-parallel (ring) attention op (reference ParallelAttentionOp,
-    ops/ParallelAttention.h:425): sequence sharded over ``cp_axis``, KV
-    ring via ppermute, online LSE correction.  Requires the owning graph to
-    carry a mesh with the cp axis; otherwise falls back to plain attention.
+                       head_axis: str = "tp", segment_ids=None,
+                       cp_impl: str = "ring"):
+    """Context-parallel attention op (reference ParallelAttentionOp,
+    ops/ParallelAttention.h:425): sequence sharded over ``cp_axis``.
+    Requires the owning graph to carry a mesh with the cp axis; otherwise
+    falls back to plain attention.
     ``segment_ids`` ([b, s] global doc ids, -1 pad) rides the KV ring —
     the reference's packed/varlen path (``ParallelAttention.cc:1061``).
+
+    ``cp_impl``: "ring" (KV ring via ppermute + online LSE correction,
+    the reference's AttnCommRing) or "ulysses" (all-to-all head scatter;
+    no reference counterpart — TPU-native extension, needs heads
+    divisible by the cp size).
     """
     g = _graph_of(q, k, v)
     mesh = getattr(g, "mesh", None)
@@ -637,19 +657,25 @@ def parallel_attention(q, k, v, causal=True, softmax_scale=None,
             f"parallel_attention requires a graph mesh with axis "
             f"{cp_axis!r}; got mesh={mesh}. Use ops.attention for non-CP "
             f"runs instead of silently dropping context parallelism.")
+    if cp_impl not in ("ring", "ulysses"):
+        raise ValueError(f"cp_impl must be 'ring' or 'ulysses', "
+                         f"got {cp_impl!r}")
     if mesh.shape[cp_axis] == 1:
         # degenerate ring: identical semantics, skip the shard_map
         return attention(q, k, v, causal=causal, softmax_scale=softmax_scale,
                          segment_ids=segment_ids)
     from ..parallel.ring_attention import ring_attention_sharded
+    from ..parallel.ulysses import ulysses_attention_sharded
+    sharded_attn = ring_attention_sharded if cp_impl == "ring" \
+        else ulysses_attention_sharded
 
     def _impl(q, k, v, segment_ids=None, causal=True, softmax_scale=None):
-        return ring_attention_sharded(q, k, v, mesh, axis_name=cp_axis,
-                                      causal=causal,
-                                      softmax_scale=softmax_scale,
-                                      batch_axis=batch_axis,
-                                      head_axis=head_axis,
-                                      segment_ids=segment_ids)
+        return sharded_attn(q, k, v, mesh, axis_name=cp_axis,
+                            causal=causal,
+                            softmax_scale=softmax_scale,
+                            batch_axis=batch_axis,
+                            head_axis=head_axis,
+                            segment_ids=segment_ids)
     inputs = [q, k, v] if segment_ids is None else [q, k, v, segment_ids]
     if segment_ids is None:
         impl = lambda q, k, v, causal=True, softmax_scale=None: _impl(

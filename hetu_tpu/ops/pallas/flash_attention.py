@@ -140,8 +140,16 @@ def _block_sizes(s: int, d: int, dtype, role: str = "fwd"
 
     Forward prefers 1024 blocks (fp32 score tile 4MB — the measured sweet
     spot of the round-3 fa3 prototype); the backward passes carry more
-    scratch per block, so they cap at 512."""
-    cands = (1024, 512, 256, 128) if role == "fwd" and d <= 128         else (512, 256, 128)
+    scratch per block, so they cap at 512.  ``HETU_TPU_FLASH_BLOCK_FWD``
+    / ``HETU_TPU_FLASH_BLOCK_BWD`` override the preference for sweeps."""
+    import os
+    env = os.environ.get(f"HETU_TPU_FLASH_BLOCK_{role.upper()}")
+    if env:
+        want = int(env)
+        if s % want == 0:
+            return want, want
+    cands = (1024, 512, 256, 128) if role == "fwd" and d <= 128 \
+        else (512, 256, 128)
     for cand in cands:
         if s % cand == 0:
             return cand, cand

@@ -1,0 +1,113 @@
+"""Ulysses sequence parallelism — all-to-all head-scatter attention.
+
+DeepSpeed-Ulysses-style context parallelism (Jacobs et al., 2023),
+provided as a second CP implementation NEXT TO ring attention.  The
+reference has no Ulysses path (SURVEY.md §2.3: ring CP only) — this is a
+TPU-native extension: ``lax.all_to_all`` maps directly onto ICI and, for
+a single all-to-all pair per layer, moves less data than a
+ring of ppermutes whenever the per-chip sequence fits.
+
+Mechanics (inside shard_map over the ``cp`` axis):
+
+1. inputs arrive sequence-sharded ``[b, s_local, h, d]``;
+2. ``all_to_all`` scatters heads / gathers sequence ->
+   ``[b, s_global, h/cp, d]`` — every rank now holds the FULL sequence
+   for a head slice, so plain (flash) attention applies with no online
+   cross-rank LSE correction and no SYM causal rebalancing: Ulysses is
+   load-balanced by construction (each rank computes the same causal
+   triangle over fewer heads);
+3. attention (Pallas flash kernel);
+4. reverse ``all_to_all`` restores ``[b, s_local, h, d]``.
+
+Packed/varlen sequences: the [b, s_local] segment ids are all-gathered
+(tiny int32 traffic) so the full-sequence attention sees global doc
+boundaries — equivalent to the ring path's ids-ride-the-ring.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.pallas.flash_attention import flash_attention
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = "cp", causal: bool = True,
+                      softmax_scale: Optional[float] = None,
+                      segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """All-to-all sequence-parallel attention on sequence-sharded
+    ``[b, s_local, h, d]`` inputs.  Must run inside shard_map/pjit with
+    ``axis_name`` in scope; ``h`` must be divisible by the axis size.
+
+    ``segment_ids``: local ``[b, s_local]`` global doc ids (-1 pad) for
+    packed sequences.
+    """
+    cp = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % cp != 0:
+        raise ValueError(
+            f"ulysses needs heads ({h}) divisible by the {axis_name!r} "
+            f"axis size ({cp}); use ring_attention for h < cp")
+    scale = softmax_scale if softmax_scale is not None \
+        else 1.0 / math.sqrt(q.shape[-1])
+    if cp == 1:
+        return flash_attention(q, k, v, causal=causal, softmax_scale=scale,
+                               segment_ids=segment_ids)
+
+    def seq_gather_head_scatter(x):
+        # [b, s_local, h, d] -> [b, s_global, h/cp, d]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qg = seq_gather_head_scatter(q)
+    kg = seq_gather_head_scatter(k)
+    vg = seq_gather_head_scatter(v)
+    segs = None
+    if segment_ids is not None:
+        # global ids on every rank (the full sequence is local now)
+        segs = lax.all_gather(segment_ids.astype(jnp.int32), axis_name,
+                              axis=1, tiled=True)          # [b, s_global]
+    out = flash_attention(qg, kg, vg, causal=causal, softmax_scale=scale,
+                          segment_ids=segs)
+    # [b, s_global, h/cp, d] -> [b, s_local, h, d] (heads reassembled in
+    # rank order = original order)
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, axis_name: str = "cp",
+                              causal: bool = True,
+                              softmax_scale: Optional[float] = None,
+                              batch_axis: Optional[str] = "dp",
+                              head_axis: Optional[str] = "tp",
+                              segment_ids: Optional[jax.Array] = None
+                              ) -> jax.Array:
+    """Convenience wrapper for GLOBAL [b, s, h, d] arrays: shard the
+    sequence over ``axis_name`` (batch over ``batch_axis``, heads over
+    ``head_axis`` — TP + CP compose; the head constraint applies to the
+    per-TP-rank head count) and run :func:`ulysses_attention`."""
+    from jax.sharding import PartitionSpec as P
+    from .comm import shard_map
+
+    def axis_or_none(name):
+        return name if (name and name in mesh.axis_names) else None
+
+    bspec = axis_or_none(batch_axis)
+    hspec = axis_or_none(head_axis)
+    spec = P(bspec, axis_name, hspec, None)
+    if segment_ids is None:
+        f = shard_map(
+            lambda q, k, v: ulysses_attention(
+                q, k, v, axis_name, causal, softmax_scale),
+            mesh, (spec, spec, spec), spec)
+        return f(q, k, v)
+    sspec = P(bspec, axis_name)
+    f = shard_map(
+        lambda q, k, v, s: ulysses_attention(
+            q, k, v, axis_name, causal, softmax_scale, segment_ids=s),
+        mesh, (spec, spec, spec, sspec), spec)
+    return f(q, k, v, segment_ids.astype(jnp.int32))

@@ -424,3 +424,39 @@ def test_set_seed_reproducible_init():
 
     a, b = build(), build()
     np.testing.assert_array_equal(a, b)
+
+
+def test_set_seed_dropout_stream_decoupled_from_numpy():
+    """set_seed must reproduce dropout seeds without touching (or being
+    disturbed by) numpy's process-global RNG."""
+    import numpy as np
+    import hetu_tpu as ht
+
+    def seed_of():
+        with ht.graph("define_and_run", create_new=True) as g:
+            return g._rng_seed
+
+    ht.set_seed(5)
+    a = seed_of()
+    np.random.seed(999)       # user reseeds global numpy...
+    np.random.rand(10)        # ...and draws from it
+    ht.set_seed(5)
+    b = seed_of()
+    assert a == b             # framework stream unaffected
+    np.random.seed(42)
+    u1 = np.random.rand()
+    np.random.seed(42)
+    ht.set_seed(7)            # must not disturb the global stream
+    u2 = np.random.rand()
+    assert u1 == u2
+
+
+def test_as_strided_out_of_bounds_raises():
+    import numpy as np
+    import pytest
+    from hetu_tpu import ops
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    with pytest.raises(ValueError, match="exceeds storage"):
+        ops.as_strided(x, (5, 4), (2, 1), storage_offset=18)
+    with pytest.raises(ValueError, match="exceeds storage"):
+        ops.as_strided(x, (2, 2), (-3, 1), storage_offset=0)

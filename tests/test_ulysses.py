@@ -1,0 +1,89 @@
+"""Ulysses all-to-all sequence-parallel attention (TPU-native extension;
+the reference has ring CP only — SURVEY.md §2.3)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import hetu_tpu as ht
+from hetu_tpu import optim
+from hetu_tpu.graph import ctor
+from hetu_tpu.models import llama_config, GPTLMHeadModel
+from hetu_tpu.ops.attention import sdpa_reference
+from hetu_tpu.parallel.ulysses import ulysses_attention_sharded
+
+
+def _qkv(b=2, s=64, h=8, d=16, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.randn(b, s, h, d).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+class TestUlyssesOracle:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, devices8, causal):
+        mesh = ht.create_mesh({"cp": 4}, devices8[:4])
+        q, k, v = _qkv()
+        out = ulysses_attention_sharded(q, k, v, mesh, causal=causal,
+                                        batch_axis=None, head_axis=None)
+        ref = sdpa_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_with_dp_and_tp(self, devices8):
+        mesh = ht.create_mesh({"dp": 2, "cp": 2, "tp": 2}, devices8)
+        q, k, v = _qkv()
+        out = ulysses_attention_sharded(q, k, v, mesh, causal=True)
+        ref = sdpa_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_packed_segments(self, devices8):
+        mesh = ht.create_mesh({"cp": 4}, devices8[:4])
+        q, k, v = _qkv(seed=3)
+        segs = np.repeat(np.arange(4), 16)[None, :].repeat(2, 0)  # 4 docs
+        out = ulysses_attention_sharded(q, k, v, mesh, causal=True,
+                                        batch_axis=None, head_axis=None,
+                                        segment_ids=segs)
+        ref = sdpa_reference(q, k, v, causal=True, segment_ids=segs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_head_divisibility_error(self, devices8):
+        mesh = ht.create_mesh({"cp": 4}, devices8[:4])
+        q, k, v = _qkv(h=6)
+        with pytest.raises(Exception, match="divisible|ulysses"):
+            jax.block_until_ready(ulysses_attention_sharded(
+                q, k, v, mesh, batch_axis=None, head_axis=None))
+
+
+@pytest.mark.slow
+class TestGPTWithUlysses:
+    def test_gpt_ulysses_matches_single_device(self, devices8):
+        def train(mesh_shape, cp_axis=None, steps=3):
+            ctor._seed_counter[0] = 4242
+            mesh = ht.create_mesh(mesh_shape) if mesh_shape else None
+            cfg = llama_config(vocab_size=64, hidden_size=32, num_layers=2,
+                               num_heads=4, max_seq_len=32, sp=False,
+                               cp_axis=cp_axis, cp_impl="ulysses")
+            with ht.graph("define_and_run", create_new=True, mesh=mesh) as g:
+                ids = ht.parallel_placeholder(
+                    "int32", (4, 32),
+                    pspec=P("dp", None) if mesh else None, name="ids")
+                lbl = ht.parallel_placeholder(
+                    "int32", (4, 32),
+                    pspec=P("dp", None) if mesh else None, name="lbl")
+                m = GPTLMHeadModel(cfg)
+                loss = m(ids, lbl)
+                op = optim.AdamOptimizer(lr=1e-2).minimize(loss)
+                rng = np.random.RandomState(0)
+                I = rng.randint(0, 64, (4, 32)).astype(np.int32)
+                L = np.roll(I, -1, 1)
+                return [float(np.asarray(
+                    g.run(loss, [loss, op], {ids: I, lbl: L})[0]))
+                    for _ in range(steps)]
+
+        base = train(None)
+        uly = train({"dp": 2, "cp": 2, "tp": 2}, cp_axis="cp")
+        np.testing.assert_allclose(base, uly, rtol=3e-3, atol=1e-4)
