@@ -251,6 +251,32 @@ def _probe_backend(timeout_s: float = 180.0) -> str:
     return "cpu"
 
 
+# Deliberately TRACKED in git (not .gitignore'd like PROGRESS.jsonl):
+# the cache is the hardware-evidence trail — when the axon relay is
+# wedged at capture time, the CPU-fallback bench surfaces the last real
+# TPU measurement from here, clearly labeled with its timestamp.
+_CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_CACHE.json")
+
+
+def _load_cache():
+    try:
+        with open(_CACHE_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def _store_cache(result) -> None:
+    try:
+        with open(_CACHE_PATH, "w") as f:
+            json.dump({"cached_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                  time.gmtime()),
+                       "result": result}, f, indent=1)
+    except Exception:
+        pass
+
+
 def main():
     platform = _probe_backend()
     import jax
@@ -286,6 +312,16 @@ def main():
             "scaling_virtual8": scaling,
         },
     }
+    if on_tpu:
+        _store_cache(result)
+    else:
+        # the axon relay wedges when a TPU client is killed (hangs on
+        # init rather than raising; round-3 postmortem): surface the
+        # last REAL TPU measurement, clearly labeled, so transient
+        # relay wedges don't erase hardware evidence
+        cache = _load_cache()
+        if cache is not None:
+            result["extra"]["last_tpu_result"] = cache
     print(json.dumps(result))
 
 

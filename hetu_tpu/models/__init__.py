@@ -6,6 +6,7 @@ from .gnn import GCN, DistGCN15D, GCNLayer, SparseGCNLayer, \
     normalize_adjacency
 from .gpt import (GPTConfig, GPTModel, GPTLMHeadModel, llama_config,
                   LLamaLMHeadModel, LLamaModel)
+from .generate import generate
 from .gpt_pipeline import GPTPipelineModel, block_fn
 from .rnn import GRU, LSTM, RNN, RNNLanguageModel
 
@@ -17,4 +18,4 @@ __all__ = ["GPTConfig", "GPTModel", "GPTLMHeadModel", "llama_config",
            "WDL", "DeepFM", "DCN", "ctr_loss",
            "RNN", "GRU", "LSTM", "RNNLanguageModel",
            "GCN", "DistGCN15D", "GCNLayer", "SparseGCNLayer",
-           "normalize_adjacency"]
+           "normalize_adjacency", "generate"]

@@ -1,0 +1,237 @@
+"""Autoregressive generation with a static KV cache.
+
+Inference companion to the training stack: takes a trained
+:class:`~hetu_tpu.models.gpt.GPTLMHeadModel`'s ``state_dict()`` and
+decodes with XLA-friendly machinery — a preallocated ``[b, max_len]``
+KV cache updated by ``lax.dynamic_update_slice`` and a ``lax.scan``
+token loop, so the whole decode compiles to ONE program with static
+shapes (no per-token retracing, no growing sequence).
+
+The reference is a training system (its examples stop at loss curves);
+this module covers the inference half a switching user expects.  Single
+program = single device or GSPMD-sharded under an outer ``jit`` with
+sharded weights — the weight layouts are exactly the training layouts
+(W [out, in], ``y = x @ W.T``; see nn/parallel.py).
+
+Supported configs: learned or rotary positions, layernorm/rmsnorm,
+gelu/swiglu/silu/relu MLPs, GQA (kv_heads < num_heads), tied or untied
+lm_head.  Dropout is ignored (inference).  MoE decode is not supported.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .gpt import GPTConfig
+
+
+def _norm_apply(cfg: GPTConfig, w, b, x):
+    xf = x.astype(jnp.float32)
+    if cfg.norm == "rmsnorm":
+        xf = xf * lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + 1e-6)
+        return (xf * w).astype(x.dtype)
+    m = jnp.mean(xf, -1, keepdims=True)
+    v = jnp.var(xf, -1, keepdims=True)
+    out = (xf - m) * lax.rsqrt(v + 1e-5) * w + (b if b is not None else 0.0)
+    return out.astype(x.dtype)
+
+
+def _act(cfg: GPTConfig, h):
+    if cfg.activation == "swiglu":
+        x1, x2 = jnp.split(h, 2, axis=-1)  # silu(x1) * x2, as ops.swiglu
+        return jax.nn.silu(x1) * x2
+    if cfg.activation == "gelu":
+        return jax.nn.gelu(h)
+    if cfg.activation == "silu":
+        return jax.nn.silu(h)
+    return jax.nn.relu(h)
+
+
+def _rotary_tables(cfg: GPTConfig, max_len: int):
+    d = cfg.head_dim
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2, dtype=np.float32) / d))
+    ang = np.outer(np.arange(max_len, dtype=np.float32), inv)
+    emb = np.concatenate([ang, ang], axis=-1)
+    return jnp.asarray(np.cos(emb)), jnp.asarray(np.sin(emb))  # [L, d]
+
+
+def _rope(x, cos, sin):
+    # x: [b, s, h, d]; cos/sin: [s, d] (already position-gathered)
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    return x * c + rot * s
+
+
+class _Params:
+    """state_dict view normalizing the two naming conventions: module
+    paths (``transformer.h.0.attn.qkv.weight``, Module.state_dict) and
+    tensor names (``h0.attn.qkv.weight``, checkpoint files)."""
+
+    @staticmethod
+    def _norm(key: str) -> str:
+        if key.startswith("transformer."):
+            key = key[len("transformer."):]
+        if key.startswith("h."):                    # h.0.attn -> h0.attn
+            rest = key[2:]
+            idx, _, tail = rest.partition(".")
+            key = f"h{idx}.{tail}"
+        return key
+
+    def __init__(self, state: Dict[str, Any], cfg: GPTConfig):
+        self.s = {self._norm(k): jnp.asarray(v) for k, v in state.items()}
+        self.cfg = cfg
+
+    def __call__(self, name: str):
+        return self.s.get(name)
+
+    def layer(self, i: int, part: str):
+        return self.s.get(f"h{i}.{part}")
+
+
+def _attn_step(cfg: GPTConfig, p: _Params, i: int, x, k_cache, v_cache,
+               pos, cos, sin):
+    """One attention pass for s_new tokens starting at position ``pos``
+    against caches holding everything before them.  Returns
+    (out [b, s_new, H], new caches)."""
+    b, s_new, _ = x.shape
+    c = cfg
+    hd, nh, nkv = c.head_dim, c.num_heads, c.kv_heads
+    qkv = x @ p.layer(i, "attn.qkv.weight").T
+    qb = p.layer(i, "attn.qkv.bias")
+    if qb is not None:
+        qkv = qkv + qb
+    q_size, kv_size = nh * hd, nkv * hd
+    q = qkv[..., :q_size].reshape(b, s_new, nh, hd)
+    k = qkv[..., q_size:q_size + kv_size].reshape(b, s_new, nkv, hd)
+    v = qkv[..., q_size + kv_size:].reshape(b, s_new, nkv, hd)
+    if c.position == "rotary":
+        idx = pos + jnp.arange(s_new)
+        q = _rope(q, cos[idx], sin[idx])
+        k = _rope(k, cos[idx], sin[idx])
+    k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
+                                       (0, pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
+                                       (0, pos, 0, 0))
+    L = k_cache.shape[1]
+    kk = jnp.repeat(k_cache, nh // nkv, axis=2) if nkv != nh else k_cache
+    vv = jnp.repeat(v_cache, nh // nkv, axis=2) if nkv != nh else v_cache
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        kk.astype(jnp.float32)) / math.sqrt(hd)
+    kpos = jnp.arange(L)[None, None, None, :]
+    qpos = (pos + jnp.arange(s_new))[None, None, :, None]
+    scores = jnp.where(kpos <= qpos, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                      vv.astype(jnp.float32)).astype(x.dtype)
+    attn = attn.reshape(b, s_new, nh * hd)
+    out = attn @ p.layer(i, "attn.out.weight").T
+    ob = p.layer(i, "attn.out.bias")
+    if ob is not None:
+        out = out + ob
+    return out, k_cache, v_cache
+
+
+def _forward(cfg: GPTConfig, p: _Params, ids, caches, pos, cos, sin):
+    """Stack forward for ``ids`` [b, s_new] at absolute position ``pos``;
+    returns (logits of the LAST position [b, V], new caches)."""
+    c = cfg
+    x = p("wte.weight")[ids].astype(jnp.bfloat16 if c.dtype == "bfloat16"
+                                    else jnp.float32)
+    if c.position == "learned":
+        idx = pos + jnp.arange(ids.shape[1])
+        x = x + p("wpe")[idx].astype(x.dtype)
+    new_caches = []
+    for i in range(c.num_layers):
+        k_cache, v_cache = caches[i]
+        h = _norm_apply(c, p.layer(i, "ln_1.weight"),
+                        p.layer(i, "ln_1.bias"), x)
+        a, k_cache, v_cache = _attn_step(c, p, i, h, k_cache, v_cache,
+                                         pos, cos, sin)
+        x = x + a
+        h = _norm_apply(c, p.layer(i, "ln_2.weight"),
+                        p.layer(i, "ln_2.bias"), x)
+        h = _act(c, h @ p.layer(i, "mlp.up.weight").T +
+                 (p.layer(i, "mlp.up.bias") if p.layer(i, "mlp.up.bias")
+                  is not None else 0.0))
+        h = h @ p.layer(i, "mlp.down.weight").T
+        db = p.layer(i, "mlp.down.bias")
+        if db is not None:
+            h = h + db
+        x = x + h
+        new_caches.append((k_cache, v_cache))
+    x = _norm_apply(c, p("ln_f.weight"), p("ln_f.bias"), x)
+    head = p("lm_head.weight")
+    w = head if head is not None else p("wte.weight")
+    logits = (x[:, -1].astype(jnp.float32)
+              @ w.T.astype(jnp.float32))           # [b, V]
+    return logits, new_caches
+
+
+def generate(state: Dict[str, Any], cfg: GPTConfig, prompt_ids,
+             max_new_tokens: int, temperature: float = 0.0,
+             top_k: int = 0, seed: int = 0) -> jax.Array:
+    """Decode ``max_new_tokens`` tokens after ``prompt_ids`` [b, s0].
+
+    ``temperature == 0`` -> greedy; otherwise softmax sampling, with
+    optional ``top_k`` truncation.  Returns [b, s0 + max_new_tokens].
+    The token loop is a single ``lax.scan`` (one compile, static shapes).
+    """
+    if cfg.num_experts > 0:
+        raise NotImplementedError("MoE decode is not supported")
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    if max_new_tokens == 0:
+        return prompt_ids
+    p = _Params(state, cfg)
+    b, s0 = prompt_ids.shape
+    max_len = s0 + max_new_tokens
+    if cfg.position == "learned" and max_len > cfg.max_seq_len:
+        raise ValueError(f"max_len {max_len} exceeds learned-position "
+                         f"table {cfg.max_seq_len}")
+    cdt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    caches = [(jnp.zeros((b, max_len, cfg.kv_heads, cfg.head_dim), cdt),
+               jnp.zeros((b, max_len, cfg.kv_heads, cfg.head_dim), cdt))
+              for _ in range(cfg.num_layers)]
+    cos, sin = (_rotary_tables(cfg, max_len) if cfg.position == "rotary"
+                else (None, None))
+
+    def pick(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lg = logits / temperature
+        if top_k > 0:
+            kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        return jax.random.categorical(key, lg).astype(jnp.int32)
+
+    @jax.jit
+    def run(prompt_ids):
+        logits, cs = _forward(cfg, p, prompt_ids, caches, 0, cos, sin)
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        tok = pick(logits, sub)
+
+        def step(carry, _):
+            cs, tok, pos, key = carry
+            logits, cs = _forward(cfg, p, tok[:, None], cs, pos, cos, sin)
+            key, sub = jax.random.split(key)
+            nxt = pick(logits, sub)
+            return (cs, nxt, pos + 1, key), tok
+
+        (_, last, _, _), toks = lax.scan(
+            step, (cs, tok, jnp.int32(s0), key), None,
+            length=max_new_tokens - 1) if max_new_tokens > 1 else \
+            ((None, tok, None, None), jnp.zeros((0, b), jnp.int32))
+        seq = jnp.concatenate([toks, last[None]], axis=0)  # [T, b]
+        return jnp.concatenate([prompt_ids, seq.T], axis=1)
+
+    return run(prompt_ids)

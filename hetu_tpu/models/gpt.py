@@ -202,7 +202,14 @@ class ParallelMLP(Module):
 
     def forward(self, x):
         h = self.up(x)
-        h = ops.swiglu(h) if self.activation == "swiglu" else ops.gelu(h)
+        if self.activation == "swiglu":
+            h = ops.swiglu(h)
+        elif self.activation == "silu":
+            h = ops.silu(h)
+        elif self.activation == "relu":
+            h = ops.relu(h)
+        else:
+            h = ops.gelu(h)
         out = self.down(h)
         if self.dropout is not None:
             out = self.dropout(out)

@@ -1,0 +1,116 @@
+"""KV-cache generation vs. full-forward oracle (models/generate.py)."""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.models.generate import generate
+
+
+def _build_state(cfg, seed=3):
+    ht.set_seed(seed)
+    with ht.graph("eager", create_new=True):
+        model = GPTLMHeadModel(cfg)
+        # touch a forward so every parameter materializes
+        ids = np.zeros((1, 4), np.int32)
+        model.logits(ids)
+        state = {k: np.asarray(v) for k, v in model.state_dict().items()}
+    return model, state
+
+
+def _oracle_greedy(model, prompt, n_new):
+    """Append argmax tokens using the full (uncached) model forward."""
+    ids = prompt.copy()
+    with ht.graph("eager", create_new=True):
+        for _ in range(n_new):
+            lg = np.asarray(model.logits(ids).get_data())
+            nxt = lg[:, -1].argmax(-1).astype(np.int32)
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+    return ids
+
+
+CONFIGS = {
+    "gpt2ish": dict(position="learned", norm="layernorm", activation="gelu",
+                    tie_embeddings=False),
+    "llamaish": dict(position="rotary", norm="rmsnorm", activation="swiglu",
+                     tie_embeddings=True),
+    "gqa": dict(position="rotary", norm="rmsnorm", activation="silu",
+                num_kv_heads=2, tie_embeddings=False),
+}
+
+
+@pytest.mark.parametrize("kind", list(CONFIGS))
+def test_generate_matches_full_forward(kind):
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=32, sp=False, dropout=0.0,
+                    **CONFIGS[kind])
+    model, state = _build_state(cfg)
+    prompt = np.array([[5, 17, 2, 9], [1, 1, 4, 88]], np.int32)
+    want = _oracle_greedy(model, prompt, 6)
+    got = np.asarray(generate(state, cfg, prompt, 6, temperature=0.0))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_generate_sampling_shapes_and_determinism():
+    cfg = GPTConfig(vocab_size=61, hidden_size=32, num_layers=1,
+                    num_heads=4, max_seq_len=24, sp=False,
+                    position="learned", activation="gelu")
+    _, state = _build_state(cfg, seed=9)
+    prompt = np.array([[3, 1, 4]], np.int32)
+    a = np.asarray(generate(state, cfg, prompt, 8, temperature=0.8,
+                            top_k=10, seed=42))
+    b = np.asarray(generate(state, cfg, prompt, 8, temperature=0.8,
+                            top_k=10, seed=42))
+    assert a.shape == (1, 11)
+    np.testing.assert_array_equal(a, b)
+    assert (a[:, :3] == prompt).all()
+    assert (a < cfg.vocab_size).all() and (a >= 0).all()
+
+
+def test_generate_rejects_overflow_and_moe():
+    cfg = GPTConfig(vocab_size=31, hidden_size=16, num_layers=1,
+                    num_heads=2, max_seq_len=8, sp=False,
+                    position="learned")
+    _, state = _build_state(cfg, seed=1)
+    with pytest.raises(ValueError, match="exceeds"):
+        generate(state, cfg, np.zeros((1, 6), np.int32), 4)
+    cfg2 = GPTConfig(vocab_size=31, hidden_size=16, num_layers=1,
+                     num_heads=2, max_seq_len=8, num_experts=2)
+    with pytest.raises(NotImplementedError):
+        generate({}, cfg2, np.zeros((1, 2), np.int32), 2)
+
+
+def test_generate_zero_tokens_returns_prompt():
+    cfg = GPTConfig(vocab_size=31, hidden_size=16, num_layers=1,
+                    num_heads=2, max_seq_len=8, sp=False,
+                    position="learned")
+    _, state = _build_state(cfg, seed=2)
+    prompt = np.array([[1, 2]], np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(generate(state, cfg, prompt, 0)), prompt)
+    with pytest.raises(ValueError, match=">= 0"):
+        generate(state, cfg, prompt, -1)
+
+
+def test_training_mlp_respects_silu_activation():
+    """ParallelMLP must apply the CONFIGURED activation (silu configs
+    used to silently train with gelu)."""
+    import hetu_tpu.ops as ops_mod
+    cfg = GPTConfig(vocab_size=31, hidden_size=16, num_layers=1,
+                    num_heads=2, max_seq_len=8, sp=False,
+                    position="learned", activation="silu")
+    ht.set_seed(4)
+    with ht.graph("eager", create_new=True):
+        from hetu_tpu.models.gpt import ParallelMLP
+        mlp = ParallelMLP(cfg)
+        x = np.random.RandomState(0).randn(2, 4, 16).astype(np.float32)
+        got = np.asarray(mlp(x).get_data())
+        w_up = np.asarray(mlp.up.weight.get_data())
+        b_up = np.asarray(mlp.up.bias.get_data()) if mlp.up.bias is not None \
+            else 0.0
+        w_dn = np.asarray(mlp.down.weight.get_data())
+        b_dn = np.asarray(mlp.down.bias.get_data()) \
+            if mlp.down.bias is not None else 0.0
+        h = x @ w_up.T + b_up
+        want = (h * (1.0 / (1.0 + np.exp(-h)))) @ w_dn.T + b_dn  # silu
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
