@@ -210,9 +210,15 @@ def bench_scaling_virtual(n_devices: int = 8) -> dict:
         "    return batch * 128 / dt\n"
         f"t1 = tput(1)\n"
         f"tn = tput({n_devices})\n"
+        # n virtual devices SHARE one host's cores, so tn/(n*t1) is a
+        # lower bound that conflates dispatch overhead with core
+        # contention; the speedup vs one virtual device is the
+        # meaningful dispatch-overhead signal here
         f"print(json.dumps({{'t1': t1, 'tn': tn,"
-        f" 'efficiency': tn / ({n_devices} * t1),"
-        f" 'efficiency_vs_shared_host': tn / t1}}))\n"
+        f" 'speedup_vs_1dev': tn / t1,"
+        f" 'host_bound_efficiency_lower_bound': tn / ({n_devices} * t1),"
+        f" 'note': 'virtual devices share host cores; real scaling "
+        f"needs hardware'}}))\n"
     )
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

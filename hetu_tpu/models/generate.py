@@ -197,10 +197,29 @@ def generate(state: Dict[str, Any], cfg: GPTConfig, prompt_ids,
     if cfg.position == "learned" and max_len > cfg.max_seq_len:
         raise ValueError(f"max_len {max_len} exceeds learned-position "
                          f"table {cfg.max_seq_len}")
+    key = (_dataclasses.astuple(cfg), b, s0, int(max_new_tokens),
+           float(temperature), int(top_k))
+    fn = _DECODE_CACHE.get(key)
+    if fn is None:
+        fn = _build_decode_fn(cfg, b, s0, int(max_new_tokens),
+                              float(temperature), int(top_k))
+        if len(_DECODE_CACHE) >= 16:
+            _DECODE_CACHE.pop(next(iter(_DECODE_CACHE)))
+        _DECODE_CACHE[key] = fn
+    return fn(p.s, prompt_ids, jax.random.PRNGKey(seed))
+
+
+# the decode program is cached by (config, shapes, sampling params) —
+# params/prompt/rng flow as ARGUMENTS, so repeated generate() calls hit
+# the same compiled program instead of retracing per call
+_DECODE_CACHE: Dict[Any, Any] = {}
+import dataclasses as _dataclasses  # noqa: E402
+
+
+def _build_decode_fn(cfg: GPTConfig, b: int, s0: int, max_new_tokens: int,
+                     temperature: float, top_k: int):
+    max_len = s0 + max_new_tokens
     cdt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-    caches = [(jnp.zeros((b, max_len, cfg.kv_heads, cfg.head_dim), cdt),
-               jnp.zeros((b, max_len, cfg.kv_heads, cfg.head_dim), cdt))
-              for _ in range(cfg.num_layers)]
     cos, sin = (_rotary_tables(cfg, max_len) if cfg.position == "rotary"
                 else (None, None))
 
@@ -214,10 +233,14 @@ def generate(state: Dict[str, Any], cfg: GPTConfig, prompt_ids,
         return jax.random.categorical(key, lg).astype(jnp.int32)
 
     @jax.jit
-    def run(prompt_ids):
+    def run(params, prompt_ids, key0):
+        p = _Params.__new__(_Params)
+        p.s, p.cfg = params, cfg
+        caches = [(jnp.zeros((b, max_len, cfg.kv_heads, cfg.head_dim), cdt),
+                   jnp.zeros((b, max_len, cfg.kv_heads, cfg.head_dim), cdt))
+                  for _ in range(cfg.num_layers)]
         logits, cs = _forward(cfg, p, prompt_ids, caches, 0, cos, sin)
-        key = jax.random.PRNGKey(seed)
-        key, sub = jax.random.split(key)
+        key, sub = jax.random.split(key0)
         tok = pick(logits, sub)
 
         def step(carry, _):
@@ -234,4 +257,4 @@ def generate(state: Dict[str, Any], cfg: GPTConfig, prompt_ids,
         seq = jnp.concatenate([toks, last[None]], axis=0)  # [T, b]
         return jnp.concatenate([prompt_ids, seq.T], axis=1)
 
-    return run(prompt_ids)
+    return run

@@ -1,4 +1,5 @@
 from .safetensors_io import (save_model, load_model, save_split, load_split,
+                             save_split_async, AsyncSaveHandle,
                              save_checkpoint, load_checkpoint)
 from .converters import (hf_gpt2_to_ht, ht_to_hf_gpt2,
                          megatron_qkv_to_interleaved,

@@ -156,6 +156,33 @@ def save_split(state: Dict[str, Any], dirpath: str,
     pidx = jax.process_index() if process_index is None else process_index
     pcount = jax.process_count() if num_processes is None else num_processes
 
+    snap = _snapshot_slices(state) if num_shards is None else None
+    _write_split(state, snap, dirpath, pidx, pcount, num_shards)
+
+
+def _snapshot_slices(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Device->host snapshot of every value's addressable slices.
+
+    Runs synchronously so a subsequent training step cannot invalidate
+    donated buffers under an async writer; jax.Arrays are immutable, but
+    donation reuses their buffers."""
+    snap: Dict[str, Any] = {}
+    for name, arr in state.items():
+        gshape = list(np.shape(arr))
+        dtype = str(arr.dtype) if hasattr(arr, "dtype") \
+            else str(np.asarray(arr).dtype)
+        if isinstance(arr, jax.Array) and len(arr.sharding.device_set) > 0:
+            slices = [(idx, np.asarray(data))
+                      for idx, data in _addressable_slices(arr)]
+        else:
+            a = _to_numpy(arr)
+            slices = [(tuple(slice(0, s) for s in a.shape), a)]
+        snap[name] = (gshape, dtype, slices)
+    return snap
+
+
+def _write_split(state, snap, dirpath, pidx, pcount, num_shards,
+                 barrier_fn=None) -> None:
     index: Dict[str, Any] = {"tensors": {}, "num_files": 0}
     files: Dict[str, Dict[str, np.ndarray]] = {}
     metas: Dict[str, Dict[str, str]] = {}
@@ -167,16 +194,8 @@ def save_split(state: Dict[str, Any], dirpath: str,
         fname = _file(pidx, pcount)
         files[fname] = {}
         metas[fname] = {}
-        for name, arr in state.items():
-            gshape = list(np.shape(arr))
-            dtype = str(arr.dtype) if hasattr(arr, "dtype") \
-                else str(np.asarray(arr).dtype)
+        for name, (gshape, dtype, slices) in snap.items():
             ent = {"shape": gshape, "dtype": dtype, "slices": []}
-            if isinstance(arr, jax.Array) and len(arr.sharding.device_set) > 0:
-                slices = list(_addressable_slices(arr))
-            else:
-                a = _to_numpy(arr)
-                slices = [(tuple(slice(0, s) for s in a.shape), a)]
             for k, (idx, data) in enumerate(slices):
                 offs = [[s.start or 0, s.stop if s.stop is not None else dim]
                         for s, dim in zip(idx, gshape)]
@@ -236,7 +255,8 @@ def save_split(state: Dict[str, Any], dirpath: str,
         save_file(tensors, os.path.join(dirpath, fname),
                   metadata={"format": "hetu_tpu_split", **metas[fname]})
     _atomic_json(os.path.join(dirpath, f"index.{pidx}.json"), index)
-    _barrier()
+    barrier = _barrier if barrier_fn is None else barrier_fn
+    barrier()
     if pidx == 0:
         # drop stale per-process indices from a previous save with a
         # different process count, then merge exactly this save's set
@@ -250,7 +270,91 @@ def save_split(state: Dict[str, Any], dirpath: str,
                 if i >= pcount:
                     os.remove(os.path.join(dirpath, fn))
         _merge_indices(dirpath, pcount)
-    _barrier()
+    barrier()
+
+
+class AsyncSaveHandle:
+    """Handle for a background checkpoint write (reference
+    ``temp_save_split``'s background archiving thread,
+    ``ht_safetensors.py:446``)."""
+
+    def __init__(self, thread, errbox):
+        self._thread = thread
+        self._errbox = errbox
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until the write finishes; re-raise any writer error."""
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("checkpoint write still in progress")
+        if self._errbox:
+            raise self._errbox[0]
+
+
+def save_split_async(state: Dict[str, Any], dirpath: str,
+                     num_shards: Optional[int] = None,
+                     process_index: Optional[int] = None,
+                     num_processes: Optional[int] = None,
+                     on_complete=None) -> AsyncSaveHandle:
+    """:func:`save_split` with the file writing on a background thread.
+
+    The device->host snapshot happens synchronously BEFORE returning
+    (training may donate/reuse the parameter buffers on the very next
+    step), so only serialization + disk IO overlap with compute — the
+    same split the reference makes (write tensors, archive in
+    background).  Call :meth:`AsyncSaveHandle.wait` before reading the
+    checkpoint or exiting.  ``on_complete`` runs in the writer thread
+    after a successful write (commit markers belong there, not before
+    the data).
+
+    Multi-process: the synchronous path's cross-process barrier is a
+    device collective, which must NEVER run on a side thread (it would
+    interleave with the main thread's training collectives in different
+    orders on different hosts — deadlock).  Here the barrier routes
+    through the registered host-level coordinator
+    (:func:`hetu_tpu.parallel.comm.set_coordinator`); without one,
+    multi-process background saves are refused loudly.
+    """
+    import threading
+
+    os.makedirs(dirpath, exist_ok=True)
+    pidx = jax.process_index() if process_index is None else process_index
+    pcount = jax.process_count() if num_processes is None else num_processes
+    if pcount > 1 and num_shards is None:
+        from ...parallel import comm as _comm
+        coord = _comm._COORDINATOR[0]
+        if coord is None:
+            raise RuntimeError(
+                "background save with multiple processes needs a "
+                "registered CoordinatorClient (comm.set_coordinator): "
+                "the device-collective barrier cannot run on the writer "
+                "thread")
+        barrier_fn = lambda: _comm.barrier(  # noqa: E731 (host-level TCP)
+            coordinator=coord, name=f"ckpt:{os.path.abspath(dirpath)}")
+    else:
+        barrier_fn = lambda: None  # noqa: E731
+    if num_shards is None:
+        snap, host_state = _snapshot_slices(state), None
+    else:
+        snap = None
+        host_state = {k: _to_numpy(v) for k, v in state.items()}
+    errbox: list = []
+
+    def _run():
+        try:
+            _write_split(host_state, snap, dirpath, pidx, pcount,
+                         num_shards, barrier_fn=barrier_fn)
+            if on_complete is not None:
+                on_complete()
+        except BaseException as e:  # surfaced by wait()
+            errbox.append(e)
+
+    t = threading.Thread(target=_run, name="hetu-ckpt-writer", daemon=True)
+    t.start()
+    return AsyncSaveHandle(t, errbox)
 
 
 def _atomic_json(path: str, obj) -> None:
@@ -342,8 +446,15 @@ def _opt_state_items(optimizer, tid_to_name):
 
 def save_checkpoint(model, optimizer, dirpath: str, step: int = 0,
                     num_shards: Optional[int] = None,
-                    extra: Optional[Dict[str, Any]] = None) -> None:
-    """Save model params + optimizer states + step to ``dirpath``."""
+                    extra: Optional[Dict[str, Any]] = None,
+                    background: bool = False
+                    ) -> Optional["AsyncSaveHandle"]:
+    """Save model params + optimizer states + step to ``dirpath``.
+
+    ``background=True`` snapshots device state synchronously, then
+    writes files on a daemon thread and returns an
+    :class:`AsyncSaveHandle` (reference temp_save_split background
+    archiving); call ``.wait()`` before relying on the checkpoint."""
     os.makedirs(dirpath, exist_ok=True)
     tid_to_name = {p.id: n for n, p in model.named_parameters()}
     # params as live (possibly sharded) arrays so save_split can use shards
@@ -356,10 +467,20 @@ def save_checkpoint(model, optimizer, dirpath: str, step: int = 0,
         for sname, arr, _k, _tid in _opt_state_items(optimizer, tid_to_name):
             state[sname] = arr if hasattr(arr, "shape") \
                 else np.asarray(arr)
+    def _write_marker():
+        # commit marker: written only AFTER the tensor data is on disk,
+        # so a crash mid-write never leaves a directory that claims to
+        # be a valid step-N checkpoint
+        if jax.process_index() == 0:
+            _atomic_json(os.path.join(dirpath, "trainer_state.json"),
+                         {"step": int(step), "extra": extra or {}})
+
+    if background:
+        return save_split_async(state, dirpath, num_shards=num_shards,
+                                on_complete=_write_marker)
     save_split(state, dirpath, num_shards=num_shards)
-    if jax.process_index() == 0:
-        _atomic_json(os.path.join(dirpath, "trainer_state.json"),
-                     {"step": int(step), "extra": extra or {}})
+    _write_marker()
+    return None
 
 
 def load_checkpoint(model, optimizer, dirpath: str) -> Dict[str, Any]:

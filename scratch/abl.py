@@ -1,10 +1,13 @@
 """Ablation timing for bench perf work. Usage: python scratch/abl.py VARIANT
-Variants: base, noflash, noloss, noattn, b64, fp32master
+Variants: base, noflash, noloss, noattn, b64, b16
 """
 import sys, time, os
 import numpy as np
 
 VARIANT = sys.argv[1] if len(sys.argv) > 1 else "base"
+_KNOWN = {"base", "noflash", "noloss", "noattn", "b64", "b16"}
+if VARIANT not in _KNOWN:
+    sys.exit(f"unknown VARIANT {VARIANT!r}; pick one of {sorted(_KNOWN)}")
 
 import jax
 import jax.numpy as jnp
@@ -39,7 +42,11 @@ if VARIANT == "noattn":
     # replace attention output with identity: monkeypatch block fwd
     _orig_fwd = G.ParallelAttentionBlock.forward
     def fwd(self, x, seq_len):
-        return self.out(self.qkv(x)[..., :768])
+        # identity-ish: q slice only; valid only for the MHA (non-GQA,
+        # non-rotary) config above — assert so reuse fails loudly
+        assert cfg.num_heads * cfg.head_dim == cfg.hidden_size \
+            and cfg.position == "learned"
+        return self.out(self.qkv(x)[..., :cfg.hidden_size])
     G.ParallelAttentionBlock.forward = fwd
 
 with ht.graph("define_and_run", create_new=True) as g:

@@ -1,6 +1,8 @@
 """fa3: fwd with 2D lse output + single fused bwd kernel (dq,dk,dv).
 
-Correctness vs dense, then timing, at S=1024 and S=2048.
+Correctness vs dense, then timing, at S=1024 (the fused bwd needs
+the whole sequence as one VMEM block; S=2048 fp32 scores ~16MB
+exceed VMEM — the landed kernel tiles instead).
 """
 import functools, math, sys, time
 import numpy as np

@@ -114,3 +114,22 @@ def test_training_mlp_respects_silu_activation():
         h = x @ w_up.T + b_up
         want = (h * (1.0 / (1.0 + np.exp(-h)))) @ w_dn.T + b_dn  # silu
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_generate_compile_cache_reuse():
+    """Repeated generate() calls with the same shapes/config must reuse
+    one compiled program (params/prompt/seed flow as arguments)."""
+    import importlib
+    gen_mod = importlib.import_module("hetu_tpu.models.generate")
+    cfg = GPTConfig(vocab_size=41, hidden_size=16, num_layers=1,
+                    num_heads=2, max_seq_len=16, sp=False,
+                    position="learned")
+    _, state = _build_state(cfg, seed=6)
+    gen_mod._DECODE_CACHE.clear()
+    prompt = np.array([[1, 2, 3]], np.int32)
+    a = np.asarray(generate(state, cfg, prompt, 4, seed=0))
+    n_after_first = len(gen_mod._DECODE_CACHE)
+    b = np.asarray(generate(state, cfg, prompt + 1, 4, seed=1))
+    assert n_after_first == 1
+    assert len(gen_mod._DECODE_CACHE) == 1   # second call hit the cache
+    assert a.shape == b.shape == (1, 7)
