@@ -1,0 +1,88 @@
+"""Train a tiny GPT, checkpoint it (in the background), restore, and
+decode with the KV-cache generation engine.
+
+The inference half of the reference's GPT recipe (its examples stop at
+training; this closes the loop a switching user expects).  Self-checking:
+trains on a periodic token stream and asserts the generated continuation
+reproduces the period.
+
+Run (CPU sim):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/generate_gpt.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import hetu_tpu as ht  # noqa: E402
+from hetu_tpu import models, optim  # noqa: E402
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel  # noqa: E402
+from hetu_tpu.utils.checkpoint import (load_checkpoint,  # noqa: E402
+                                       save_checkpoint)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--ckpt", type=str, default="")
+    ap.add_argument("--temperature", type=float, default=0.0)
+    args = ap.parse_args()
+    ckpt = args.ckpt or os.path.join(tempfile.mkdtemp(), "gpt")
+
+    cfg = GPTConfig(vocab_size=16, hidden_size=args.hidden, num_layers=2,
+                    num_heads=4, max_seq_len=32, sp=False, dropout=0.0,
+                    position="learned", activation="gelu")
+    period = np.array([3, 7, 1, 12], np.int32)
+    data = np.tile(period, (8, 8))                       # [8, 32]
+
+    ht.set_seed(0)
+    with ht.graph("define_and_run", create_new=True) as g:
+        ids = ht.placeholder("int32", (8, 32), name="ids")
+        lbl = ht.placeholder("int32", (8, 32), name="lbl")
+        model = GPTLMHeadModel(cfg)
+        loss = model(ids, lbl)
+        opt = optim.AdamOptimizer(lr=3e-3)
+        train_op = opt.minimize(loss)
+        feed = {ids: data, lbl: np.roll(data, -1, 1)}
+        first = last = None
+        for step in range(args.steps):
+            out = g.run(loss, [loss, train_op], feed)
+            v = float(np.asarray(out[0]))
+            first = v if first is None else first
+            last = v
+        print(f"trained {args.steps} steps: loss {first:.3f} -> {last:.3f}")
+        # background save: file IO overlaps the remaining work
+        handle = save_checkpoint(model, opt, ckpt, step=args.steps,
+                                 background=True)
+        handle.wait(timeout=300)
+
+    # fresh process-style restore: new graph, zeroed params, load, decode
+    with ht.graph("define_and_run", create_new=True):
+        model2 = GPTLMHeadModel(cfg)
+        ids2 = ht.placeholder("int32", (1, 8), name="warm")
+        model2.logits(ids2)  # materialize params
+        ts = load_checkpoint(model2, None, ckpt)
+        print(f"restored checkpoint at step {ts['step']}")
+        state = {k: np.asarray(v) for k, v in model2.state_dict().items()}
+
+    prompt = np.array([[3, 7, 1, 12, 3, 7]], np.int32)
+    out = np.asarray(models.generate(state, cfg, prompt, 10,
+                                     temperature=args.temperature))
+    print("prompt      :", prompt[0].tolist())
+    print("continuation:", out[0, prompt.shape[1]:].tolist())
+    if args.temperature == 0.0:
+        want = [period[(2 + i) % 4] for i in range(10)]
+        assert out[0, prompt.shape[1]:].tolist() == want, "pattern lost"
+        print("self-check OK: greedy decode reproduces the trained period")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,9 @@
 from .optimizer import (Optimizer, SGDOptimizer, AdamOptimizer,
                         AdamWOptimizer, SGD, Adam, AdamW)
+from .schedules import (constant_schedule, cosine_schedule, linear_schedule,
+                        step_decay_schedule)
 
 __all__ = ["Optimizer", "SGDOptimizer", "AdamOptimizer", "AdamWOptimizer",
-           "SGD", "Adam", "AdamW"]
+           "SGD", "Adam", "AdamW",
+           "constant_schedule", "cosine_schedule", "linear_schedule",
+           "step_decay_schedule"]

@@ -36,13 +36,18 @@ from ..graph.tensor import Tensor
 
 class Optimizer:
     def __init__(self, params: Optional[Sequence[Tensor]] = None,
-                 lr: float = 0.01, zero: int = 0, dp_axis: str = "dp"):
+                 lr=0.01, zero: int = 0, dp_axis: str = "dp",
+                 max_grad_norm: Optional[float] = None):
+        # lr: float, or a schedule callable step -> lr (optim.schedules)
         self.lr = lr
         self.params = list(params) if params is not None else None
         self.zero = int(zero)     # ZeRO level 0-3 (True -> 1)
         if not 0 <= self.zero <= 3:
             raise ValueError(f"zero level must be 0..3, got {zero}")
         self.dp_axis = dp_axis
+        # global-norm gradient clipping (Megatron-style; applied inside
+        # the jitted update, before any optimizer math)
+        self.max_grad_norm = max_grad_norm
         self._state: Dict[str, Any] = {}
         self._shardings: Dict[int, Any] = {}  # tid -> NamedSharding of states
         self._param_shardings: Dict[int, Any] = {}  # tid -> zero-3 sharding
@@ -191,6 +196,23 @@ class Optimizer:
     def _init_state(self, var_state, xs) -> Dict[str, Any]:
         return {}
 
+    def _lr_at(self, step):
+        """Resolve lr: plain float, or a schedule called with the
+        (1-based, traced) step — see optim/schedules.py."""
+        return self.lr(step) if callable(self.lr) else self.lr
+
+    def _clip_grads(self, grads: Dict[int, jax.Array],
+                    xs: Sequence[Tensor]) -> Dict[int, jax.Array]:
+        """Global-norm clip across ALL parameter grads (fp32 norm)."""
+        if self.max_grad_norm is None:
+            return grads
+        sq = sum(jnp.sum(jnp.square(grads[t.id].astype(jnp.float32)))
+                 for t in xs)
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, self.max_grad_norm / (norm + 1e-6))
+        return {t.id: (grads[t.id].astype(jnp.float32) * scale)
+                .astype(grads[t.id].dtype) for t in xs}
+
     def _apply_updates(self, var_state: Dict[int, jax.Array],
                        opt_state: Dict[str, Any],
                        grads: Dict[int, jax.Array],
@@ -219,19 +241,31 @@ class SGDOptimizer(Optimizer):
         self.nesterov = nesterov
 
     def _init_state(self, var_state, xs):
-        if self.momentum == 0.0:
-            return {"_dummy": jnp.zeros(())}
-        return {"velocity": {t.id: jnp.zeros_like(var_state[t.id])
-                             for t in xs}}
+        state = {"step": jnp.zeros((), jnp.int32)}
+        if self.momentum != 0.0:
+            state["velocity"] = {t.id: jnp.zeros_like(var_state[t.id])
+                                 for t in xs}
+        return state
 
     def _apply_updates(self, var_state, opt_state, grads, xs):
+        grads = self._clip_grads(grads, xs)
         new_vars = dict(var_state)
         new_opt = dict(opt_state)
+        # .get: checkpoints from before SGD carried a step counter have
+        # no "step" entry — backfill instead of KeyError on restore
+        step = opt_state.get("step", jnp.zeros((), jnp.int32)) + 1
+        new_opt["step"] = step
+        lr = self._lr_at(step)
+        def apply(p, upd):
+            # fp32 update math, cast back (a scheduled lr is an fp32
+            # scalar; don't let promotion change the stored param dtype)
+            return (p.astype(jnp.float32)
+                    - lr * upd.astype(jnp.float32)).astype(p.dtype)
+
         if self.momentum == 0.0:
             for t in xs:
                 g = self._c_grad(t.id, grads[t.id].astype(var_state[t.id].dtype))
-                new_vars[t.id] = self._c_param(
-                    t.id, var_state[t.id] - self.lr * g)
+                new_vars[t.id] = self._c_param(t.id, apply(var_state[t.id], g))
             return new_vars, new_opt
         vel = dict(opt_state["velocity"])
         for t in xs:
@@ -239,8 +273,7 @@ class SGDOptimizer(Optimizer):
             v = self._c(t.id, self.momentum * vel[t.id] + g)
             vel[t.id] = v
             upd = g + self.momentum * v if self.nesterov else v
-            new_vars[t.id] = self._c_param(
-                t.id, var_state[t.id] - self.lr * upd)
+            new_vars[t.id] = self._c_param(t.id, apply(var_state[t.id], upd))
         new_opt["velocity"] = vel
         return new_vars, new_opt
 
@@ -269,11 +302,13 @@ class AdamOptimizer(Optimizer):
         }
 
     def _apply_updates(self, var_state, opt_state, grads, xs):
+        grads = self._clip_grads(grads, xs)
         new_vars = dict(var_state)
         step = opt_state["step"] + 1
         m = dict(opt_state["m"])
         v = dict(opt_state["v"])
         b1, b2 = self.beta1, self.beta2
+        lr = self._lr_at(step)
         bc1 = 1.0 - b1 ** step.astype(jnp.float32)
         bc2 = 1.0 - b2 ** step.astype(jnp.float32)
         for t in xs:
@@ -285,9 +320,9 @@ class AdamOptimizer(Optimizer):
             v[t.id] = self._c(t.id, b2 * v[t.id] + (1 - b2) * (g * g))
             m_hat = m[t.id] / bc1
             v_hat = v[t.id] / bc2
-            upd = self.lr * m_hat / (jnp.sqrt(v_hat) + self.eps)
+            upd = lr * m_hat / (jnp.sqrt(v_hat) + self.eps)
             if self.weight_decay and self.decoupled_weight_decay:
-                upd = upd + self.lr * self.weight_decay * p.astype(jnp.float32)
+                upd = upd + lr * self.weight_decay * p.astype(jnp.float32)
             new_vars[t.id] = self._c_param(
                 t.id, (p.astype(jnp.float32) - upd).astype(p.dtype))
         return new_vars, {"step": step, "m": m, "v": v}

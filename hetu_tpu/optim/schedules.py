@@ -1,0 +1,65 @@
+"""Learning-rate schedules.
+
+The reference trains with a fixed ``lr`` (``python/hetu/optim/
+optimizer.py``); real pretraining recipes need warmup + decay, so this
+is a beyond-parity addition.  A schedule is a callable ``step -> lr``
+over jnp scalars (the optimizer's step counter is traced — schedules
+compile into the update program, changing the lr costs no retrace).
+Pass one anywhere an optimizer takes ``lr``::
+
+    optim.AdamOptimizer(lr=optim.cosine_schedule(3e-4, 2000, 100_000))
+
+``step`` is 1-based (the value used for the step that is being applied).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def constant_schedule(lr: float):
+    """Fixed lr as a schedule (identity wrapper)."""
+    return lambda step: jnp.float32(lr)
+
+
+def cosine_schedule(peak_lr: float, warmup_steps: int, total_steps: int,
+                    min_lr: float = 0.0):
+    """Linear warmup to ``peak_lr`` over ``warmup_steps``, then cosine
+    decay to ``min_lr`` at ``total_steps`` (the GPT-3/LLaMA recipe)."""
+    if total_steps <= warmup_steps:
+        raise ValueError(f"total_steps {total_steps} must exceed "
+                         f"warmup_steps {warmup_steps}")
+
+    def lr(step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = peak_lr * s / jnp.maximum(1.0, float(warmup_steps))
+        frac = jnp.clip((s - warmup_steps) / (total_steps - warmup_steps),
+                        0.0, 1.0)
+        decay = min_lr + 0.5 * (peak_lr - min_lr) * (
+            1.0 + jnp.cos(jnp.pi * frac))
+        return jnp.where(s <= warmup_steps, warm, decay)
+    return lr
+
+
+def linear_schedule(peak_lr: float, warmup_steps: int, total_steps: int,
+                    min_lr: float = 0.0):
+    """Linear warmup then linear decay to ``min_lr`` (the BERT recipe)."""
+    if total_steps <= warmup_steps:
+        raise ValueError(f"total_steps {total_steps} must exceed "
+                         f"warmup_steps {warmup_steps}")
+
+    def lr(step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = peak_lr * s / jnp.maximum(1.0, float(warmup_steps))
+        frac = jnp.clip((s - warmup_steps) / (total_steps - warmup_steps),
+                        0.0, 1.0)
+        return jnp.where(s <= warmup_steps, warm,
+                         peak_lr + (min_lr - peak_lr) * frac)
+    return lr
+
+
+def step_decay_schedule(lr0: float, decay_rate: float, every: int):
+    """lr0 * decay_rate ** (step // every)."""
+    def lr(step):
+        s = jnp.asarray(step, jnp.float32)
+        return lr0 * jnp.power(decay_rate, jnp.floor(s / float(every)))
+    return lr

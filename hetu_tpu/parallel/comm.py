@@ -109,7 +109,8 @@ def axis_size(axis: str) -> int:
 
 
 def barrier(coordinator=None, name: str = "default",
-            world_size: Optional[int] = None) -> None:
+            world_size: Optional[int] = None,
+            timeout: float = 60.0) -> None:
     """Host-level barrier (reference gRPC Barrier, heturpc.proto:44).
 
     Within a single jit program XLA collectives are self-synchronizing;
@@ -133,7 +134,7 @@ def barrier(coordinator=None, name: str = "default",
             raise ValueError(
                 "coordinator barrier needs a world_size (pass it here or "
                 "start the CoordinatorServer with world_size=N)")
-        coord.barrier(name=name, world_size=ws)
+        coord.barrier(name=name, world_size=ws, timeout=timeout)
         return
     # Tiny all-reduce over all devices, blocking until complete.
     n = jax.device_count()

@@ -332,8 +332,11 @@ def save_split_async(state: Dict[str, Any], dirpath: str,
                 "registered CoordinatorClient (comm.set_coordinator): "
                 "the device-collective barrier cannot run on the writer "
                 "thread")
+        # checkpoint-sized timeout: a slow peer disk must not fail the
+        # whole save (default coordinator barrier timeout is 60s)
         barrier_fn = lambda: _comm.barrier(  # noqa: E731 (host-level TCP)
-            coordinator=coord, name=f"ckpt:{os.path.abspath(dirpath)}")
+            coordinator=coord, name=f"ckpt:{os.path.abspath(dirpath)}",
+            timeout=1800.0)
     else:
         barrier_fn = lambda: None  # noqa: E731
     if num_shards is None:
@@ -467,13 +470,19 @@ def save_checkpoint(model, optimizer, dirpath: str, step: int = 0,
         for sname, arr, _k, _tid in _opt_state_items(optimizer, tid_to_name):
             state[sname] = arr if hasattr(arr, "shape") \
                 else np.asarray(arr)
+    marker = os.path.join(dirpath, "trainer_state.json")
+    if jax.process_index() == 0 and os.path.exists(marker):
+        # re-saving into an existing checkpoint dir: drop the stale
+        # marker FIRST — otherwise a crash mid-write leaves a directory
+        # whose marker claims the old step over mixed-step tensor files
+        os.remove(marker)
+
     def _write_marker():
         # commit marker: written only AFTER the tensor data is on disk,
         # so a crash mid-write never leaves a directory that claims to
         # be a valid step-N checkpoint
         if jax.process_index() == 0:
-            _atomic_json(os.path.join(dirpath, "trainer_state.json"),
-                         {"step": int(step), "extra": extra or {}})
+            _atomic_json(marker, {"step": int(step), "extra": extra or {}})
 
     if background:
         return save_split_async(state, dirpath, num_shards=num_shards,

@@ -293,3 +293,51 @@ def test_background_checkpoint_roundtrip(tmp_path):
             np.testing.assert_allclose(
                 snap[k], np.asarray(state1[k], np.float32),
                 rtol=1e-6, atol=1e-6)
+
+
+def test_resave_into_existing_dir_drops_stale_marker(tmp_path):
+    """Re-saving over an old checkpoint must remove the previous commit
+    marker before tensor data changes (crash-safety contract)."""
+    import os
+    with ht.graph("define_and_run", create_new=True) as g:
+        cfg = _tiny_cfg()
+        model = GPTLMHeadModel(cfg)
+        ids = ht.placeholder("int32", (2, 16))
+        labels = ht.placeholder("int32", (2, 16))
+        loss = model(ids, labels)
+        opt = ht.optim.AdamOptimizer(lr=1e-3)
+        train_op = opt.minimize(loss)
+        rng = np.random.RandomState(0)
+        feed = {ids: rng.randint(0, 96, (2, 16)),
+                labels: rng.randint(0, 96, (2, 16))}
+        g.run(loss, [loss, train_op], feed)
+        d = str(tmp_path / "re")
+        save_checkpoint(model, opt, d, step=1)
+        assert os.path.exists(os.path.join(d, "trainer_state.json"))
+        g.run(loss, [loss, train_op], feed)
+        h = save_checkpoint(model, opt, d, step=2, background=True)
+        h.wait(timeout=120)
+        ts = load_checkpoint(model, opt, d)
+        assert ts["step"] == 2
+
+
+def test_sgd_checkpoint_without_step_backfills(tmp_path):
+    """Pre-step-counter SGD checkpoints (no 'step' state) must restore
+    and keep training (the counter is backfilled, not KeyError'd)."""
+    with ht.graph("define_and_run", create_new=True) as g:
+        cfg = _tiny_cfg()
+        model = GPTLMHeadModel(cfg)
+        ids = ht.placeholder("int32", (2, 16))
+        labels = ht.placeholder("int32", (2, 16))
+        loss = model(ids, labels)
+        opt = ht.optim.SGDOptimizer(lr=0.1, momentum=0.9)
+        train_op = opt.minimize(loss)
+        rng = np.random.RandomState(0)
+        feed = {ids: rng.randint(0, 96, (2, 16)),
+                labels: rng.randint(0, 96, (2, 16))}
+        g.run(loss, [loss, train_op], feed)
+        # simulate a legacy restore: state with velocity but NO step
+        opt._state.pop("step", None)
+        out = g.run(loss, [loss, train_op], feed)
+        assert np.isfinite(float(np.asarray(out[0])))
+        assert "step" in opt._state

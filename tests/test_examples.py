@@ -72,3 +72,8 @@ class TestExamples:
         out = _run_example("train_malleus.py", "--steps", "12",
                            "--calibrate")
         assert "calibrated:" in out and "malleus e2e OK" in out
+
+    def test_generate_gpt(self):
+        out = _run_example("generate_gpt.py", "--steps", "120",
+                           "--hidden", "48")
+        assert "self-check OK" in out

@@ -154,6 +154,68 @@ class TestOptimizerParity:
         ref = self._run_torch(lambda p: torch.optim.Adam(p, lr=0.01))
         np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-4)
 
+    def test_grad_clip_matches_torch(self):
+        ours = self._run_hetu(
+            lambda: optim.SGDOptimizer(lr=0.5, max_grad_norm=0.05))
+
+        def torch_clipped(steps=5):
+            X, Y = _make_data(n=16)
+            w = torch.full((4, 8), 0.05, requires_grad=True)
+            opt = torch.optim.SGD([w], lr=0.5)
+            for _ in range(steps):
+                opt.zero_grad()
+                loss = torch.nn.functional.cross_entropy(
+                    torch.tensor(X) @ w.T, torch.tensor(Y))
+                loss.backward()
+                torch.nn.utils.clip_grad_norm_([w], 0.05)
+                opt.step()
+            return w.detach().numpy()
+        np.testing.assert_allclose(ours, torch_clipped(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_lr_schedule_matches_torch_lambda(self):
+        sched = optim.linear_schedule(0.2, warmup_steps=2, total_steps=10,
+                                      min_lr=0.0)
+        ours = self._run_hetu(lambda: optim.SGDOptimizer(lr=sched), steps=6)
+
+        def torch_sched(steps=6):
+            X, Y = _make_data(n=16)
+            w = torch.full((4, 8), 0.05, requires_grad=True)
+            opt = torch.optim.SGD([w], lr=1.0)
+            # torch's epoch counter is 0-based pre-step; ours is 1-based
+            lam = torch.optim.lr_scheduler.LambdaLR(
+                opt, lambda e: float(np.asarray(sched(e + 1))))
+            for _ in range(steps):
+                opt.zero_grad()
+                loss = torch.nn.functional.cross_entropy(
+                    torch.tensor(X) @ w.T, torch.tensor(Y))
+                loss.backward()
+                opt.step()
+                lam.step()
+            return w.detach().numpy()
+        np.testing.assert_allclose(ours, torch_sched(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_schedule_shapes(self):
+        import jax.numpy as jnp
+        cos = optim.cosine_schedule(1.0, warmup_steps=10, total_steps=110,
+                                    min_lr=0.1)
+        assert float(cos(0)) == 0.0
+        np.testing.assert_allclose(float(cos(10)), 1.0, rtol=1e-6)
+        np.testing.assert_allclose(float(cos(60)), 0.55, rtol=1e-6)
+        np.testing.assert_allclose(float(cos(110)), 0.1, rtol=1e-6)
+        step = optim.step_decay_schedule(1.0, 0.5, every=10)
+        np.testing.assert_allclose(float(step(25)), 0.25, rtol=1e-6)
+        import pytest
+        with pytest.raises(ValueError, match="exceed"):
+            optim.cosine_schedule(1.0, 10, 10)
+
+    def test_adam_with_schedule_trains(self):
+        sched = optim.cosine_schedule(0.05, 1, 20)
+        ours = self._run_hetu(lambda: optim.AdamOptimizer(
+            lr=sched, max_grad_norm=1.0), steps=8)
+        assert np.all(np.isfinite(ours))
+
     def test_adamw_decoupled_matches_torch(self):
         ours = self._run_hetu(
             lambda: optim.AdamWOptimizer(lr=0.01, weight_decay=0.1))
