@@ -1,9 +1,10 @@
 from .optimizer import (Optimizer, SGDOptimizer, AdamOptimizer,
-                        AdamWOptimizer, SGD, Adam, AdamW)
+                        AdamWOptimizer, AdafactorOptimizer,
+                        SGD, Adam, AdamW)
 from .schedules import (constant_schedule, cosine_schedule, linear_schedule,
                         step_decay_schedule)
 
 __all__ = ["Optimizer", "SGDOptimizer", "AdamOptimizer", "AdamWOptimizer",
-           "SGD", "Adam", "AdamW",
+           "AdafactorOptimizer", "SGD", "Adam", "AdamW",
            "constant_schedule", "cosine_schedule", "linear_schedule",
            "step_decay_schedule"]

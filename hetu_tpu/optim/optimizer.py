@@ -98,8 +98,10 @@ class Optimizer:
 
     def _ensure_state(self, var_state: Dict[int, jax.Array],
                       xs: Sequence[Tensor], graph: Graph) -> Dict[str, Any]:
+        just_inited = False
         if not self._state:
             self._state = self._init_state(var_state, xs)
+            just_inited = True
             for key, tree in self._state.items():
                 if isinstance(tree, dict):
                     for tid, arr in tree.items():
@@ -111,6 +113,32 @@ class Optimizer:
                         if sharding is not None:
                             tree[tid] = jax.device_put(arr, sharding)
                             self._shardings[tid] = sharding
+        if getattr(self, "_pending_tree_state", None):
+            # structured state loaded from a checkpoint as ordered leaves
+            # (safetensors_io "@@leaf" entries): graft into the freshly
+            # initialized structure, validating leaf count + shapes.
+            # just-initialized state IS a fresh template; only rebuild
+            # one when stepping had already populated self._state
+            fresh = self._state if just_inited \
+                else self._init_state(var_state, xs)
+            for slot, leaves in self._pending_tree_state.items():
+                if slot not in fresh or isinstance(fresh[slot], dict):
+                    raise ValueError(
+                        f"checkpoint carries structured optimizer state "
+                        f"{slot!r} that this optimizer does not define — "
+                        f"restoring into a different optimizer type?")
+                tdef = jax.tree_util.tree_structure(fresh[slot])
+                ref = jax.tree_util.tree_leaves(fresh[slot])
+                if len(ref) != len(leaves) or any(
+                        getattr(a, "shape", None) != getattr(b, "shape", None)
+                        for a, b in zip(ref, leaves)):
+                    raise ValueError(
+                        f"checkpointed optimizer state {slot!r} does not "
+                        f"match this optimizer/model (leaf count/shapes)")
+                self._state[slot] = jax.tree_util.tree_unflatten(
+                    tdef, [jnp.asarray(l, r.dtype)
+                           for l, r in zip(leaves, ref)])
+            self._pending_tree_state = None
         if self.zero in (1, 2) and graph.mesh is not None \
                 and not self._param_base_shardings:
             # pin updated params to their OWN spec (replicated over dp):
@@ -331,6 +359,59 @@ class AdamOptimizer(Optimizer):
 class AdamWOptimizer(AdamOptimizer):
     """AdamW: decoupled weight decay (torch.optim.AdamW semantics)."""
     decoupled_weight_decay = True
+
+
+class AdafactorOptimizer(Optimizer):
+    """Adafactor (Shazeer & Stern 2018) — the memory-efficient TPU
+    pretraining optimizer (T5 recipe): second moments factored into
+    row/col EMAs, so optimizer state is O(rows+cols) per matrix instead
+    of O(rows*cols).  Beyond the reference (SGD/Adam only).
+
+    Delegates the update math to ``optax.adafactor`` (public, baked-in)
+    under this framework's graph-update machinery, so it composes with
+    define-and-run graphs, donation, and checkpointing like the native
+    optimizers.  ZeRO state sharding is intentionally not applied — the
+    factored state is the memory win already.  ``lr`` may be a float or
+    an ``optim.schedules`` callable (1-based steps, adapted to optax's
+    0-based count).
+    """
+
+    def __init__(self, params=None, lr=None, min_dim_size_to_factor=128,
+                 decay_rate: float = 0.8, clipping_threshold: float = 1.0,
+                 momentum: Optional[float] = None,
+                 weight_decay_rate: Optional[float] = None,
+                 multiply_by_parameter_scale: bool = True,
+                 max_grad_norm: Optional[float] = None, **kw):
+        super().__init__(params, lr, max_grad_norm=max_grad_norm, **kw)
+        import optax
+        if callable(lr):
+            schedule = lambda count: lr(count + 1)  # noqa: E731
+        else:
+            schedule = lr
+        self._tx = optax.adafactor(
+            learning_rate=schedule,
+            min_dim_size_to_factor=min_dim_size_to_factor,
+            decay_rate=decay_rate,
+            clipping_threshold=clipping_threshold,
+            momentum=momentum,
+            weight_decay_rate=weight_decay_rate,
+            multiply_by_parameter_scale=multiply_by_parameter_scale)
+
+    def _init_state(self, var_state, xs):
+        params = {t.id: var_state[t.id].astype(jnp.float32) for t in xs}
+        return {"optax": self._tx.init(params)}
+
+    def _apply_updates(self, var_state, opt_state, grads, xs):
+        grads = self._clip_grads(grads, xs)
+        params = {t.id: var_state[t.id].astype(jnp.float32) for t in xs}
+        gdict = {t.id: grads[t.id].astype(jnp.float32) for t in xs}
+        updates, new_opt = self._tx.update(gdict, opt_state["optax"], params)
+        new_vars = dict(var_state)
+        for t in xs:
+            p = var_state[t.id]
+            new_vars[t.id] = self._c_param(
+                t.id, (params[t.id] + updates[t.id]).astype(p.dtype))
+        return new_vars, {"optax": new_opt}
 
 
 # torch-style aliases

@@ -443,8 +443,15 @@ def _opt_state_items(optimizer, tid_to_name):
             for tid, arr in tree.items():
                 name = tid_to_name.get(tid, str(tid))
                 yield f"opt.{key}.{name}", arr, key, tid
-        else:
+        elif hasattr(tree, "shape"):
             yield f"opt.{key}", tree, key, None
+        else:
+            # structured state (e.g. Adafactor's optax pytree): store the
+            # array leaves in flattening order; the optimizer rebuilds the
+            # structure from a fresh _init_state at restore
+            leaves = jax.tree_util.tree_leaves(tree)
+            for i, leaf in enumerate(leaves):
+                yield f"opt.{key}@@leaf{i:04d}", leaf, key, None
 
 
 def save_checkpoint(model, optimizer, dirpath: str, step: int = 0,
@@ -502,10 +509,16 @@ def load_checkpoint(model, optimizer, dirpath: str) -> Dict[str, Any]:
     if optimizer is not None:
         name_to_p = dict(model.named_parameters())
         new_state: Dict[str, Any] = {}
+        pending_trees: Dict[str, Dict[int, Any]] = {}
         for key, val in state.items():
             if not key.startswith("opt."):
                 continue
             rest = key[len("opt."):]
+            if "@@leaf" in rest:
+                slot, idx = rest.split("@@leaf", 1)
+                pending_trees.setdefault(slot, {})[int(idx)] = \
+                    jax.numpy.asarray(val)
+                continue
             if "." in rest:
                 slot, pname = rest.split(".", 1)
                 p = name_to_p.get(pname)
@@ -524,6 +537,12 @@ def load_checkpoint(model, optimizer, dirpath: str) -> Dict[str, Any]:
                 new_state[rest] = jax.numpy.asarray(val)
         if new_state:
             optimizer._state = new_state
+        if pending_trees:
+            # leaves-by-index, reassembled into the structure the
+            # optimizer builds at its next _ensure_state
+            optimizer._pending_tree_state = {
+                slot: [leaves[i] for i in sorted(leaves)]
+                for slot, leaves in pending_trees.items()}
     ts_path = os.path.join(dirpath, "trainer_state.json")
     if os.path.exists(ts_path):
         with open(ts_path) as f:

@@ -1,0 +1,109 @@
+"""Training metrics recorder (v1 ``python/hetu/metrics.py`` capability).
+
+Scalar time series with windowed smoothing, JSONL persistence, and a
+CSV export — the observability layer between raw logging (TIK/TOK,
+``logging_utils``) and external dashboards.  No TensorBoard/W&B
+dependency (none is baked into the image); the JSONL stream is the
+interchange format.
+
+    rec = Metrics(log_file="run.jsonl")
+    rec.log(step, loss=2.31, lr=3e-4, tokens_per_sec=1.1e5)
+    rec.smoothed("loss")        # windowed mean
+    rec.summary()               # per-key count/mean/min/max/last
+    rec.to_csv("run.csv")
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional
+
+
+class Metrics:
+    def __init__(self, log_file: Optional[str] = None, window: int = 20):
+        self.window = int(window)
+        self._series: Dict[str, List[tuple]] = defaultdict(list)
+        self._recent: Dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=self.window))
+        self._log_file = log_file
+        self._fh = None
+        if log_file:
+            os.makedirs(os.path.dirname(os.path.abspath(log_file)),
+                        exist_ok=True)
+            self._fh = open(log_file, "a")
+
+    # -- recording -----------------------------------------------------------
+
+    def log(self, step: int, **values: Any) -> None:
+        """Record scalar values at ``step`` (jax/np scalars accepted)."""
+        clean = {}
+        for k, v in values.items():
+            v = float(v)
+            self._series[k].append((int(step), v))
+            self._recent[k].append(v)
+            clean[k] = v
+        if self._fh is not None:
+            self._fh.write(json.dumps({"step": int(step), **clean}) + "\n")
+            self._fh.flush()
+
+    # -- reading -------------------------------------------------------------
+
+    def last(self, key: str) -> Optional[float]:
+        s = self._series.get(key)
+        return s[-1][1] if s else None
+
+    def smoothed(self, key: str) -> Optional[float]:
+        """Mean over the most recent ``window`` values."""
+        r = self._recent.get(key)
+        return sum(r) / len(r) if r else None
+
+    def series(self, key: str) -> List[tuple]:
+        return list(self._series.get(key, ()))
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for k, s in self._series.items():
+            vals = [v for _, v in s]
+            out[k] = {"count": len(vals), "mean": sum(vals) / len(vals),
+                      "min": min(vals), "max": max(vals), "last": vals[-1]}
+        return out
+
+    # -- export --------------------------------------------------------------
+
+    def to_csv(self, path: str) -> None:
+        """One row per step, one column per key (blank when missing)."""
+        keys = sorted(self._series)
+        by_step: Dict[int, Dict[str, float]] = defaultdict(dict)
+        for k in keys:
+            for step, v in self._series[k]:
+                by_step[step][k] = v
+        with open(path, "w") as f:
+            f.write(",".join(["step"] + keys) + "\n")
+            for step in sorted(by_step):
+                row = [str(step)] + [
+                    (f"{by_step[step][k]!r}" if k in by_step[step] else "")
+                    for k in keys]
+                f.write(",".join(row) + "\n")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def load_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Read back a Metrics JSONL stream."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -1,0 +1,188 @@
+"""Metrics recorder (v1 metrics.py capability) + Adafactor optimizer."""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import ops, optim
+from hetu_tpu.utils.metrics import Metrics, load_jsonl
+
+
+class TestMetrics:
+    def test_log_smooth_summary_roundtrip(self, tmp_path):
+        p = str(tmp_path / "run.jsonl")
+        with Metrics(log_file=p, window=3) as rec:
+            for s in range(10):
+                rec.log(s, loss=float(10 - s), lr=0.1)
+            assert rec.last("loss") == 1.0
+            assert rec.smoothed("loss") == pytest.approx(2.0)  # mean(3,2,1)
+            summ = rec.summary()
+            assert summ["loss"]["count"] == 10
+            assert summ["loss"]["min"] == 1.0 and summ["loss"]["max"] == 10.0
+        rows = load_jsonl(p)
+        assert len(rows) == 10 and rows[-1]["loss"] == 1.0
+
+    def test_csv_export_with_sparse_keys(self, tmp_path):
+        rec = Metrics()
+        rec.log(0, loss=2.0)
+        rec.log(1, loss=1.5, val_loss=1.8)
+        csv = str(tmp_path / "m.csv")
+        rec.to_csv(csv)
+        lines = open(csv).read().strip().splitlines()
+        assert lines[0] == "step,loss,val_loss"
+        assert lines[1].startswith("0,2.0,")   # missing val_loss -> blank
+        assert lines[1].endswith(",")
+
+
+class TestAdafactor:
+    def _data(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(16, 8).astype(np.float32)
+        Y = rng.randint(0, 4, (16,)).astype(np.int32)
+        return X, Y
+
+    def test_matches_raw_optax(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        X, Y = self._data()
+        W0 = np.full((4, 8), 0.05, np.float32)
+
+        # ours, through the graph machinery
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (16, 8), name="x")
+            y = ht.placeholder("int32", (16,), name="y")
+            w = ht.parameter(W0.copy(), name="w")
+            loss = ops.softmax_cross_entropy(
+                ops.matmul(x, w, trans_b=True), y)
+            train_op = optim.AdafactorOptimizer(lr=0.05).minimize(loss)
+            for _ in range(5):
+                g.run(loss, [loss, train_op], {x: X, y: Y})
+            ours = np.asarray(g.get_tensor_value(w))
+
+        # oracle: raw optax on the same math
+        def loss_fn(w):
+            logits = jnp.asarray(X) @ w.T
+            lp = jax.nn.log_softmax(logits, -1)
+            return -jnp.mean(jnp.take_along_axis(
+                lp, jnp.asarray(Y)[:, None], 1))
+        tx = optax.adafactor(learning_rate=0.05)
+        w_ref = jnp.asarray(W0)
+        st = tx.init(w_ref)
+        for _ in range(5):
+            grad = jax.grad(loss_fn)(w_ref)
+            upd, st = tx.update(grad, st, w_ref)
+            w_ref = w_ref + upd
+        np.testing.assert_allclose(ours, np.asarray(w_ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_factored_state_is_small(self):
+        """The point of Adafactor: O(rows+cols) second moments."""
+        import jax
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (4, 256), name="x")
+            w = ht.parameter(np.zeros((256, 256), np.float32), name="w")
+            loss = ops.reduce_mean(ops.matmul(x, w) ** 2.0)
+            opt = optim.AdafactorOptimizer(lr=0.01)
+            train_op = opt.minimize(loss)
+            g.run(loss, [loss, train_op],
+                  {x: np.ones((4, 256), np.float32)})
+            state_bytes = sum(
+                a.size * a.dtype.itemsize
+                for a in jax.tree_util.tree_leaves(opt._state)
+                if hasattr(a, "size"))
+            # full Adam m+v would be 2*256*256*4 = 512KB; factored is KBs
+            assert state_bytes < 64 * 1024, state_bytes
+
+    def test_with_schedule_and_clip_trains(self):
+        X, Y = self._data()
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (16, 8), name="x")
+            y = ht.placeholder("int32", (16,), name="y")
+            w = ht.parameter(np.full((4, 8), 0.05, np.float32), name="w")
+            loss = ops.softmax_cross_entropy(
+                ops.matmul(x, w, trans_b=True), y)
+            sched = optim.cosine_schedule(0.1, 2, 50)
+            opt = optim.AdafactorOptimizer(lr=sched, max_grad_norm=1.0)
+            train_op = opt.minimize(loss)
+            losses = [float(np.asarray(
+                g.run(loss, [loss, train_op], {x: X, y: Y})[0]))
+                for _ in range(10)]
+            assert losses[-1] < losses[0]
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        """Adafactor's structured optax state must survive
+        save_checkpoint/load_checkpoint (leaf-serialized)."""
+        import jax
+        from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+        from hetu_tpu.utils.checkpoint import (save_checkpoint,
+                                               load_checkpoint)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=4, max_seq_len=16, dropout=0.0,
+                        sp=False)
+        rng = np.random.RandomState(0)
+        I = rng.randint(0, 64, (2, 16)).astype(np.int32)
+
+        def build(seed):
+            ht.set_seed(seed)
+            cm = ht.graph("define_and_run", create_new=True)
+            g = cm.__enter__()
+            g._cm = cm  # keep the context manager for exit
+            model = GPTLMHeadModel(cfg)
+            ids = ht.placeholder("int32", (2, 16), name="ids")
+            lbl = ht.placeholder("int32", (2, 16), name="lbl")
+            loss = model(ids, lbl)
+            opt = optim.AdafactorOptimizer(lr=0.02)
+            op = opt.minimize(loss)
+            feed = {ids: I, lbl: np.roll(I, -1, 1)}
+            return g, model, opt, loss, op, feed
+
+        g, model, opt, loss, op, feed = build(3)
+        for _ in range(3):
+            g.run(loss, [loss, op], feed)
+        d = str(tmp_path / "af")
+        save_checkpoint(model, opt, d, step=3)
+        ref = [float(np.asarray(g.run(loss, [loss, op], feed)[0]))
+               for _ in range(2)]
+        g._cm.__exit__(None, None, None)
+
+        # fresh graph/optimizer: restore and continue — trajectory must
+        # match the uninterrupted run (state really round-tripped)
+        g2, model2, opt2, loss2, op2, feed2 = build(99)
+        load_checkpoint(model2, opt2, d)
+        got = [float(np.asarray(g2.run(loss2, [loss2, op2], feed2)[0]))
+               for _ in range(2)]
+        g2._cm.__exit__(None, None, None)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_restore_into_wrong_optimizer_raises(self, tmp_path):
+        """@@leaf state restored into an optimizer without that slot
+        must fail loudly, not silently reinitialize."""
+        import pytest
+        from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+        from hetu_tpu.utils.checkpoint import (save_checkpoint,
+                                               load_checkpoint)
+        cfg = GPTConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                        num_heads=2, max_seq_len=8, dropout=0.0, sp=False)
+        I = np.random.RandomState(0).randint(0, 32, (2, 8)).astype(np.int32)
+        with ht.graph("define_and_run", create_new=True) as g:
+            ht.set_seed(1)
+            model = GPTLMHeadModel(cfg)
+            ids = ht.placeholder("int32", (2, 8), name="ids")
+            lbl = ht.placeholder("int32", (2, 8), name="lbl")
+            loss = model(ids, lbl)
+            opt = optim.AdafactorOptimizer(lr=0.02)
+            op = opt.minimize(loss)
+            g.run(loss, [loss, op], {ids: I, lbl: np.roll(I, -1, 1)})
+            d = str(tmp_path / "wrong")
+            save_checkpoint(model, opt, d, step=1)
+        with ht.graph("define_and_run", create_new=True) as g2:
+            ht.set_seed(1)
+            model2 = GPTLMHeadModel(cfg)
+            ids = ht.placeholder("int32", (2, 8), name="ids")
+            lbl = ht.placeholder("int32", (2, 8), name="lbl")
+            loss2 = model2(ids, lbl)
+            opt2 = optim.AdamOptimizer(lr=1e-3)   # mismatched type
+            op2 = opt2.minimize(loss2)
+            load_checkpoint(model2, opt2, d)
+            with pytest.raises(ValueError, match="different optimizer"):
+                g2.run(loss2, [loss2, op2], {ids: I, lbl: np.roll(I, -1, 1)})

@@ -150,6 +150,11 @@ class TestZeRO:
             # state sharded over dp on dim 0
             spec = m.sharding.spec
             assert spec and spec[0] == "dp", f"m not dp-sharded: {spec}"
+            # the registration path itself must have run (XLA sharding
+            # propagation can mask a broken _ensure_state loop by
+            # choosing dp layouts on its own — assert the explicit
+            # device_put/constraint machinery engaged)
+            assert w.id in opt._shardings, "state sharding not registered"
 
     def test_zero_levels_loss_equivalent_and_memory(self, devices8):
         """ZeRO-{0,1,2,3} execution (reference zero ds flag,
