@@ -248,7 +248,12 @@ class MPMDGPT:
                  stage_layers: Sequence[Sequence[int]],
                  meshes: Optional[Sequence[Sequence[Optional[Mesh]]]] = None,
                  schedule: str = "1f1b",
+                 num_chunks: int = 1,
                  seed: int = 0):
+        # interleaved virtual stages: stage_layers has S*C entries per
+        # pipeline, meshes repeating with period S (Megatron interleaved
+        # 1F1B; pass schedule="interleaved", num_chunks=C)
+        self.num_chunks = int(num_chunks)
         self.cfg = cfg
         self.stage_layers = [list(sl) for sl in stage_layers]
         P_n = len(self.stage_layers)
@@ -316,7 +321,8 @@ class MPMDGPT:
                 keys_per_stage.append(keys)
             pipes.append(stages)
             self.layer_keys.append(keys_per_stage)
-        self.runtime = MPMDPipelineRuntime(pipes, schedule=schedule)
+        self.runtime = MPMDPipelineRuntime(pipes, schedule=schedule,
+                                           num_chunks=num_chunks)
 
     def _make_stage_fwd(self, lrange: List[int], first: bool, last: bool,
                         mesh: Optional[Mesh]):

@@ -38,6 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .schedule import (Task, generate_gpipe_schedule,
+                       generate_interleaved_1f1b_schedule,
                        generate_pipedream_flush_schedule, max_in_flight,
                        validate_schedule)
 
@@ -131,12 +132,24 @@ class MPMDPipelineRuntime:
     """
 
     def __init__(self, pipes: Sequence[Sequence[Stage]],
-                 schedule: str = "1f1b"):
+                 schedule: str = "1f1b", num_chunks: int = 1):
         assert pipes and all(len(p) == len(pipes[0]) for p in pipes), \
             "all pipelines must have the same number of stages"
         self.pipes = [list(p) for p in pipes]
         self.num_stages = len(self.pipes[0])
+        if schedule not in ("1f1b", "gpipe", "interleaved"):
+            raise ValueError(
+                f"unknown schedule {schedule!r}; pick 1f1b | gpipe | "
+                f"interleaved")
         self.schedule_name = schedule
+        # interleaved virtual stages: pipes carry S*C entries whose meshes
+        # repeat with period S (chunk c of physical stage s at c*S + s)
+        self.num_chunks = int(num_chunks)
+        if schedule == "interleaved":
+            assert self.num_chunks > 1, \
+                "schedule='interleaved' needs num_chunks > 1"
+            assert self.num_stages % self.num_chunks == 0, \
+                (self.num_stages, self.num_chunks)
         for p in self.pipes:
             assert p[-1].is_last and not any(st.is_last for st in p[:-1])
         # per-(pipe, stage, micro-batch) memory snapshots when enabled via
@@ -146,9 +159,13 @@ class MPMDPipelineRuntime:
         self.memory_profiler = MemoryProfiler()
 
     def _schedule(self, M: int) -> List[List[Task]]:
-        gen = (generate_pipedream_flush_schedule if self.schedule_name ==
-               "1f1b" else generate_gpipe_schedule)
-        sched = gen(self.num_stages, M)
+        if self.schedule_name == "interleaved":
+            sched = generate_interleaved_1f1b_schedule(
+                self.num_stages // self.num_chunks, M, self.num_chunks)
+        else:
+            gen = (generate_pipedream_flush_schedule if self.schedule_name
+                   == "1f1b" else generate_gpipe_schedule)
+            sched = gen(self.num_stages, M)
         validate_schedule(sched, M)
         return sched
 

@@ -452,6 +452,15 @@ def _opt_state_items(optimizer, tid_to_name):
             leaves = jax.tree_util.tree_leaves(tree)
             for i, leaf in enumerate(leaves):
                 yield f"opt.{key}@@leaf{i:04d}", leaf, key, None
+    # load->save with no training step in between: restored structured
+    # state still sits un-grafted in _pending_tree_state — pass it
+    # through so a checkpoint copy/reshard can't silently drop it
+    pending = getattr(optimizer, "_pending_tree_state", None) or {}
+    for slot, leaves in pending.items():
+        if slot in (optimizer._state or {}):
+            continue
+        for i, leaf in enumerate(leaves):
+            yield f"opt.{slot}@@leaf{i:04d}", leaf, slot, None
 
 
 def save_checkpoint(model, optimizer, dirpath: str, step: int = 0,

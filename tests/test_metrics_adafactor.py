@@ -186,3 +186,53 @@ class TestAdafactor:
             load_checkpoint(model2, opt2, d)
             with pytest.raises(ValueError, match="different optimizer"):
                 g2.run(loss2, [loss2, op2], {ids: I, lbl: np.roll(I, -1, 1)})
+
+    def test_load_then_save_without_step_preserves_state(self, tmp_path):
+        """Checkpoint copy workflow: load -> save with NO training step
+        in between must not drop the structured (Adafactor) state."""
+        from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+        from hetu_tpu.utils.checkpoint import (save_checkpoint,
+                                               load_checkpoint)
+        cfg = GPTConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                        num_heads=2, max_seq_len=8, dropout=0.0, sp=False)
+        I = np.random.RandomState(0).randint(0, 32, (2, 8)).astype(np.int32)
+        with ht.graph("define_and_run", create_new=True) as g:
+            ht.set_seed(1)
+            model = GPTLMHeadModel(cfg)
+            ids = ht.placeholder("int32", (2, 8), name="ids")
+            lbl = ht.placeholder("int32", (2, 8), name="lbl")
+            loss = model(ids, lbl)
+            opt = optim.AdafactorOptimizer(lr=0.02)
+            op = opt.minimize(loss)
+            for _ in range(2):
+                g.run(loss, [loss, op], {ids: I, lbl: np.roll(I, -1, 1)})
+            d1 = str(tmp_path / "a")
+            save_checkpoint(model, opt, d1, step=2)
+            ref = [float(np.asarray(
+                g.run(loss, [loss, op], {ids: I, lbl: np.roll(I, -1, 1)})[0]))
+                for _ in range(2)]
+        with ht.graph("define_and_run", create_new=True) as g2:
+            ht.set_seed(1)
+            model2 = GPTLMHeadModel(cfg)
+            ids = ht.placeholder("int32", (2, 8), name="ids")
+            lbl = ht.placeholder("int32", (2, 8), name="lbl")
+            loss2 = model2(ids, lbl)
+            opt2 = optim.AdafactorOptimizer(lr=0.02)
+            op2 = opt2.minimize(loss2)
+            load_checkpoint(model2, opt2, d1)
+            d2 = str(tmp_path / "b")
+            save_checkpoint(model2, opt2, d2, step=2)  # copy, no step
+        with ht.graph("define_and_run", create_new=True) as g3:
+            ht.set_seed(1)
+            model3 = GPTLMHeadModel(cfg)
+            ids = ht.placeholder("int32", (2, 8), name="ids")
+            lbl = ht.placeholder("int32", (2, 8), name="lbl")
+            loss3 = model3(ids, lbl)
+            opt3 = optim.AdafactorOptimizer(lr=0.02)
+            op3 = opt3.minimize(loss3)
+            load_checkpoint(model3, opt3, d2)
+            got = [float(np.asarray(
+                g3.run(loss3, [loss3, op3],
+                       {ids: I, lbl: np.roll(I, -1, 1)})[0]))
+                for _ in range(2)]
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

@@ -232,3 +232,60 @@ class TestElasticMPMD:
         l_post = tr.train_steps(3)
         np.testing.assert_allclose(l_pre + l_post, l_base, rtol=2e-4)
         assert tr.history and tr.history[0]["switch_seconds"] > 0
+
+
+class TestInterleaved:
+    """Megatron-style interleaved 1F1B with virtual pipeline stages
+    (beyond the reference: GPipe + plain 1F1B only there)."""
+
+    def test_schedule_valid_and_complete(self):
+        from hetu_tpu.parallel.schedule import (
+            generate_interleaved_1f1b_schedule, validate_schedule)
+        for S, M, C in [(2, 4, 2), (2, 8, 2), (4, 8, 2), (2, 6, 3)]:
+            sched = generate_interleaved_1f1b_schedule(S, M, C)
+            assert len(sched) == S * C
+            validate_schedule(sched, M)
+
+    def test_non_divisible_m_falls_back(self):
+        from hetu_tpu.parallel.schedule import (
+            generate_interleaved_1f1b_schedule,
+            generate_pipedream_flush_schedule, validate_schedule)
+        sched = generate_interleaved_1f1b_schedule(2, 3, 2)
+        validate_schedule(sched, 3)
+        assert sched == generate_pipedream_flush_schedule(4, 3)
+
+    def test_interleaved_matches_single_stage(self, devices8):
+        """2 physical stages x 2 chunks (4 virtual stages, meshes
+        repeating with period 2) trains identically to one stage."""
+        cfg = _cfg()
+        ids, labels = _data(cfg, batch=8)
+
+        ref = MPMDGPT(cfg, stage_layers=[[8]], seed=3)
+        phys = [Mesh(np.array(devices8[2 * s:2 * s + 2]).reshape(1, 2),
+                     ("dp", "tp")) for s in range(2)]
+        # virtual stage v = chunk*S + s -> meshes [p0, p1, p0, p1]
+        meshes = [[phys[0], phys[1], phys[0], phys[1]]]
+        inter = MPMDGPT(cfg, stage_layers=[[2, 2, 2, 2]], meshes=meshes,
+                        schedule="interleaved", num_chunks=2, seed=3)
+
+        opt_r = MPMDAdam(ref.runtime, lr=1e-2)
+        opt_i = MPMDAdam(inter.runtime, lr=1e-2)
+        losses_r, losses_i = [], []
+        for step in range(3):
+            d_r = ref.split_micro_batches(ids, labels, [4])
+            d_i = inter.split_micro_batches(ids, labels, [4])
+            lr_, gr, _ = ref.train_step(d_r)
+            li_, gi, st = inter.train_step(d_i)
+            losses_r.append(float(lr_))
+            losses_i.append(float(li_))
+            opt_r.apply(gr)
+            opt_i.apply(gi)
+        np.testing.assert_allclose(losses_r, losses_i, rtol=2e-4)
+        assert losses_r[-1] < losses_r[0]
+        assert st.num_tasks == 2 * 4 * 4  # F+B x M x virtual stages
+
+    def test_unknown_schedule_rejected(self, devices8):
+        import pytest
+        cfg = _cfg()
+        with pytest.raises(ValueError, match="unknown schedule"):
+            MPMDGPT(cfg, stage_layers=[[8]], schedule="interleave")
