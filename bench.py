@@ -63,12 +63,20 @@ def bench_gpt2(on_tpu: bool):
         # stored as a backward residual — chunked recompute instead
         # (ops/fused_ce.py); disable via HETU_TPU_BENCH_FUSED_CE=0
         fused = os.environ.get("HETU_TPU_BENCH_FUSED_CE", "1") == "1"
-        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
-                        num_heads=12, max_seq_len=1024, sp=False,
+        # HETU_TPU_BENCH_MODEL: gpt2 (124M, default) | gpt2-medium (350M,
+        # the BASELINE.json north-star model)
+        size = os.environ.get("HETU_TPU_BENCH_MODEL", "gpt2")
+        if size not in ("gpt2", "gpt2-medium"):
+            raise ValueError(f"HETU_TPU_BENCH_MODEL must be gpt2 or "
+                             f"gpt2-medium, got {size!r}")
+        h, L, nh = (1024, 24, 16) if size == "gpt2-medium" else (768, 12, 12)
+        cfg = GPTConfig(vocab_size=50304, hidden_size=h, num_layers=L,
+                        num_heads=nh, max_seq_len=1024, sp=False,
                         dtype="bfloat16", position="learned",
                         activation="gelu", norm="layernorm",
                         fused_lm_ce=fused)
-        batch = int(os.environ.get("HETU_TPU_BENCH_BATCH", "32"))
+        batch = int(os.environ.get(
+            "HETU_TPU_BENCH_BATCH", "32" if size == "gpt2" else "16"))
         seq, steps, warmup = 1024, 10, 3
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,

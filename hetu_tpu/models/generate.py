@@ -15,7 +15,9 @@ sharded weights — the weight layouts are exactly the training layouts
 
 Supported configs: learned or rotary positions, layernorm/rmsnorm,
 gelu/swiglu/silu/relu MLPs, GQA (kv_heads < num_heads), tied or untied
-lm_head.  Dropout is ignored (inference).  MoE decode is not supported.
+lm_head.  Dropout is ignored (inference).  MoE blocks decode via a
+dense per-token top-k expert mix (no capacity buckets — every token
+reaches its chosen experts).
 """
 from __future__ import annotations
 
@@ -139,6 +141,40 @@ def _attn_step(cfg: GPTConfig, p: _Params, i: int, x, k_cache, v_cache,
     return out, k_cache, v_cache
 
 
+def _moe_mlp(cfg: GPTConfig, p: _Params, i: int, x):
+    """Dense per-token top-k expert mix for decode (no capacity buckets:
+    every token reaches its chosen experts — exact vs. training when
+    training ran uncongested).  All E experts run batched: one einsum on
+    the MXU beats gather/scatter at decode (s_new=1).  Trade-off: the
+    prefill pass pays E/k x the routed MLP FLOPs over the prompt — fine
+    for the small-E configs this framework trains; long-prompt serving
+    at large E would want a dispatched prefill instead."""
+    def moe_p(part):
+        # module-path keys say "mlp.moe.*" (MoEMLP wraps the layer);
+        # tensor-name keys say "moe.*" (parallel_parameter names)
+        v = p.layer(i, f"mlp.moe.{part}")
+        return v if v is not None else p.layer(i, f"moe.{part}")
+    wg = moe_p("gate.wg")           # [E, d]
+    w1 = moe_p("experts.w1")        # [E, d, f]
+    b1 = moe_p("experts.b1")        # [E, 1, f]
+    w2 = moe_p("experts.w2")        # [E, f, d]
+    b2 = moe_p("experts.b2")        # [E, 1, d]
+    gates = jax.nn.softmax(
+        (x.astype(jnp.float32) @ wg.T.astype(jnp.float32)), axis=-1)
+    topv, topi = lax.top_k(gates, cfg.moe_top_k)           # [b, s, k]
+    weights = jnp.zeros_like(gates)
+    for j in range(cfg.moe_top_k):
+        weights = weights + topv[..., j:j + 1] * jax.nn.one_hot(
+            topi[..., j], gates.shape[-1], dtype=gates.dtype)
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+           "silu": jax.nn.silu}[
+        "silu" if cfg.activation == "swiglu" else cfg.activation]
+    h = act(jnp.einsum("bsd,edf->bsef", x, w1) + b1[:, 0])
+    y = jnp.einsum("bsef,efd->bsed", h, w2) + b2[:, 0]
+    return jnp.einsum("bse,bsed->bsd", weights.astype(y.dtype), y) \
+        .astype(x.dtype)
+
+
 def _forward(cfg: GPTConfig, p: _Params, ids, caches, pos, cos, sin):
     """Stack forward for ``ids`` [b, s_new] at absolute position ``pos``;
     returns (logits of the LAST position [b, V], new caches)."""
@@ -158,13 +194,16 @@ def _forward(cfg: GPTConfig, p: _Params, ids, caches, pos, cos, sin):
         x = x + a
         h = _norm_apply(c, p.layer(i, "ln_2.weight"),
                         p.layer(i, "ln_2.bias"), x)
-        h = _act(c, h @ p.layer(i, "mlp.up.weight").T +
-                 (p.layer(i, "mlp.up.bias") if p.layer(i, "mlp.up.bias")
-                  is not None else 0.0))
-        h = h @ p.layer(i, "mlp.down.weight").T
-        db = p.layer(i, "mlp.down.bias")
-        if db is not None:
-            h = h + db
+        if c.num_experts > 0 and i % max(1, c.moe_every) == 0:
+            h = _moe_mlp(c, p, i, h)
+        else:
+            h = _act(c, h @ p.layer(i, "mlp.up.weight").T +
+                     (p.layer(i, "mlp.up.bias") if p.layer(i, "mlp.up.bias")
+                      is not None else 0.0))
+            h = h @ p.layer(i, "mlp.down.weight").T
+            db = p.layer(i, "mlp.down.bias")
+            if db is not None:
+                h = h + db
         x = x + h
         new_caches.append((k_cache, v_cache))
     x = _norm_apply(c, p("ln_f.weight"), p("ln_f.bias"), x)
@@ -184,8 +223,6 @@ def generate(state: Dict[str, Any], cfg: GPTConfig, prompt_ids,
     optional ``top_k`` truncation.  Returns [b, s0 + max_new_tokens].
     The token loop is a single ``lax.scan`` (one compile, static shapes).
     """
-    if cfg.num_experts > 0:
-        raise NotImplementedError("MoE decode is not supported")
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)

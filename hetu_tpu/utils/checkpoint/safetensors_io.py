@@ -438,7 +438,13 @@ def load_split(dirpath: str, names: Optional[list] = None
 # ---------------------------------------------------------------------------
 
 def _opt_state_items(optimizer, tid_to_name):
+    # restored-but-ungrafted structured state supersedes whatever is in
+    # _state (a load_checkpoint after training leaves the LOADED leaves
+    # in _pending_tree_state while _state still holds pre-load values)
+    pending = getattr(optimizer, "_pending_tree_state", None) or {}
     for key, tree in (optimizer._state or {}).items():
+        if key in pending:
+            continue
         if isinstance(tree, dict):
             for tid, arr in tree.items():
                 name = tid_to_name.get(tid, str(tid))
@@ -455,10 +461,7 @@ def _opt_state_items(optimizer, tid_to_name):
     # load->save with no training step in between: restored structured
     # state still sits un-grafted in _pending_tree_state — pass it
     # through so a checkpoint copy/reshard can't silently drop it
-    pending = getattr(optimizer, "_pending_tree_state", None) or {}
     for slot, leaves in pending.items():
-        if slot in (optimizer._state or {}):
-            continue
         for i, leaf in enumerate(leaves):
             yield f"opt.{slot}@@leaf{i:04d}", leaf, slot, None
 

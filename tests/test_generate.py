@@ -67,17 +67,27 @@ def test_generate_sampling_shapes_and_determinism():
     assert (a < cfg.vocab_size).all() and (a >= 0).all()
 
 
-def test_generate_rejects_overflow_and_moe():
+def test_generate_rejects_overflow():
     cfg = GPTConfig(vocab_size=31, hidden_size=16, num_layers=1,
                     num_heads=2, max_seq_len=8, sp=False,
                     position="learned")
     _, state = _build_state(cfg, seed=1)
     with pytest.raises(ValueError, match="exceeds"):
         generate(state, cfg, np.zeros((1, 6), np.int32), 4)
-    cfg2 = GPTConfig(vocab_size=31, hidden_size=16, num_layers=1,
-                     num_heads=2, max_seq_len=8, num_experts=2)
-    with pytest.raises(NotImplementedError):
-        generate({}, cfg2, np.zeros((1, 2), np.int32), 2)
+
+
+def test_generate_moe_matches_full_forward():
+    """MoE decode (dense top-k expert mix) vs the training stack's
+    full forward; high capacity_factor so training drops no tokens."""
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=32, sp=False, dropout=0.0,
+                    position="learned", activation="gelu",
+                    num_experts=4, moe_top_k=2, moe_capacity_factor=8.0)
+    model, state = _build_state(cfg, seed=5)
+    prompt = np.array([[5, 17, 2, 9]], np.int32)
+    want = _oracle_greedy(model, prompt, 5)
+    got = np.asarray(generate(state, cfg, prompt, 5, temperature=0.0))
+    np.testing.assert_array_equal(got, want)
 
 
 def test_generate_zero_tokens_returns_prompt():
@@ -133,3 +143,18 @@ def test_generate_compile_cache_reuse():
     assert n_after_first == 1
     assert len(gen_mod._DECODE_CACHE) == 1   # second call hit the cache
     assert a.shape == b.shape == (1, 7)
+
+
+def test_generate_moe_with_tensor_name_keys():
+    """MoE decode must also resolve tensor-name state keys
+    ('h0.moe.gate.wg', no 'mlp.' segment — the checkpoint-file naming)."""
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=32, sp=False, dropout=0.0,
+                    position="learned", activation="gelu",
+                    num_experts=4, moe_top_k=2, moe_capacity_factor=8.0)
+    model, state = _build_state(cfg, seed=5)
+    renamed = {k.replace(".mlp.moe.", ".moe."): v for k, v in state.items()}
+    prompt = np.array([[5, 17, 2, 9]], np.int32)
+    want = np.asarray(generate(state, cfg, prompt, 4, temperature=0.0))
+    got = np.asarray(generate(renamed, cfg, prompt, 4, temperature=0.0))
+    np.testing.assert_array_equal(got, want)

@@ -236,3 +236,53 @@ class TestAdafactor:
                        {ids: I, lbl: np.roll(I, -1, 1)})[0]))
                 for _ in range(2)]
         np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_load_over_trained_optimizer_then_save(self, tmp_path):
+        """load_checkpoint onto an optimizer that ALREADY trained, then
+        save with no step: the LOADED state (not the stale pre-load
+        state) must be what gets written."""
+        from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+        from hetu_tpu.utils.checkpoint import (save_checkpoint,
+                                               load_checkpoint)
+        cfg = GPTConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                        num_heads=2, max_seq_len=8, dropout=0.0, sp=False)
+        I = np.random.RandomState(0).randint(0, 32, (2, 8)).astype(np.int32)
+
+        def build(seed):
+            ht.set_seed(seed)
+            cm = ht.graph("define_and_run", create_new=True)
+            g = cm.__enter__()
+            g._cm = cm
+            model = GPTLMHeadModel(cfg)
+            ids = ht.placeholder("int32", (2, 8), name="ids")
+            lbl = ht.placeholder("int32", (2, 8), name="lbl")
+            loss = model(ids, lbl)
+            opt = optim.AdafactorOptimizer(lr=0.02)
+            op = opt.minimize(loss)
+            feed = {ids: I, lbl: np.roll(I, -1, 1)}
+            return g, model, opt, loss, op, feed
+
+        g, model, opt, loss, op, feed = build(3)
+        for _ in range(3):
+            g.run(loss, [loss, op], feed)
+        d1 = str(tmp_path / "src")
+        save_checkpoint(model, opt, d1, step=3)
+        ref = [float(np.asarray(g.run(loss, [loss, op], feed)[0]))
+               for _ in range(2)]
+        g._cm.__exit__(None, None, None)
+
+        # second run: train DIFFERENT steps first, then load d1 and
+        # immediately re-save — the copy must carry d1's state
+        g2, model2, opt2, loss2, op2, feed2 = build(77)
+        g2.run(loss2, [loss2, op2], feed2)   # optimizer now has state
+        load_checkpoint(model2, opt2, d1)
+        d2 = str(tmp_path / "copy")
+        save_checkpoint(model2, opt2, d2, step=3)
+        g2._cm.__exit__(None, None, None)
+
+        g3, model3, opt3, loss3, op3, feed3 = build(55)
+        load_checkpoint(model3, opt3, d2)
+        got = [float(np.asarray(g3.run(loss3, [loss3, op3], feed3)[0]))
+               for _ in range(2)]
+        g3._cm.__exit__(None, None, None)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
