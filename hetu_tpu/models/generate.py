@@ -159,8 +159,12 @@ def _moe_mlp(cfg: GPTConfig, p: _Params, i: int, x):
     b1 = moe_p("experts.b1")        # [E, 1, f]
     w2 = moe_p("experts.w2")        # [E, f, d]
     b2 = moe_p("experts.b2")        # [E, 1, d]
+    # dtype fidelity with training (nn/moe.py): gate LOGITS in model
+    # dtype (ops.linear runs in bf16 for bf16 models — a full-f32 matmul
+    # here could break near-ties and route differently), softmax and the
+    # final combine in fp32
     gates = jax.nn.softmax(
-        (x.astype(jnp.float32) @ wg.T.astype(jnp.float32)), axis=-1)
+        (x @ wg.T.astype(x.dtype)).astype(jnp.float32), axis=-1)
     topv, topi = lax.top_k(gates, cfg.moe_top_k)           # [b, s, k]
     weights = jnp.zeros_like(gates)
     for j in range(cfg.moe_top_k):
@@ -171,8 +175,8 @@ def _moe_mlp(cfg: GPTConfig, p: _Params, i: int, x):
         "silu" if cfg.activation == "swiglu" else cfg.activation]
     h = act(jnp.einsum("bsd,edf->bsef", x, w1) + b1[:, 0])
     y = jnp.einsum("bsef,efd->bsed", h, w2) + b2[:, 0]
-    return jnp.einsum("bse,bsed->bsd", weights.astype(y.dtype), y) \
-        .astype(x.dtype)
+    return jnp.einsum("bse,bsed->bsd", weights,
+                      y.astype(jnp.float32)).astype(x.dtype)
 
 
 def _forward(cfg: GPTConfig, p: _Params, ids, caches, pos, cos, sin):
@@ -194,7 +198,7 @@ def _forward(cfg: GPTConfig, p: _Params, ids, caches, pos, cos, sin):
         x = x + a
         h = _norm_apply(c, p.layer(i, "ln_2.weight"),
                         p.layer(i, "ln_2.bias"), x)
-        if c.num_experts > 0 and i % max(1, c.moe_every) == 0:
+        if c.is_moe_layer(i):
             h = _moe_mlp(c, p, i, h)
         else:
             h = _act(c, h @ p.layer(i, "mlp.up.weight").T +

@@ -76,6 +76,12 @@ class GPTConfig:
     def kv_heads(self) -> int:
         return self.num_kv_heads or self.num_heads
 
+    def is_moe_layer(self, layer_idx: int) -> bool:
+        """Single source of truth for MoE placement — used by both the
+        training blocks (GPTBlock) and the decode engine (generate.py)."""
+        return self.num_experts > 0 and \
+            layer_idx % max(1, self.moe_every) == 0
+
     @property
     def ffn_size(self) -> int:
         if self.ffn_hidden_size:
@@ -252,8 +258,7 @@ class GPTBlock(Module):
         self.ln_1 = _norm(config, f"h{layer_idx}.ln_1")
         self.attn = ParallelAttentionBlock(config, layer_idx)
         self.ln_2 = _norm(config, f"h{layer_idx}.ln_2")
-        use_moe = config.num_experts > 0 and \
-            layer_idx % max(1, config.moe_every) == 0
+        use_moe = config.is_moe_layer(layer_idx)
         self.mlp = MoEMLP(config, layer_idx) if use_moe \
             else ParallelMLP(config, layer_idx)
 

@@ -236,12 +236,15 @@ class SwitchExecGraph:
                 optimizer._shardings = {}
                 for slot, tree in optimizer._state.items():
                     if not isinstance(tree, dict):
-                        # scalar slots (step counters) are committed to the
-                        # old device set after a run — move them as well
-                        if isinstance(tree, jax.Array):
-                            tree = jax.device_put(
-                                tree, NamedSharding(self.new_mesh,
-                                                    PartitionSpec()))
+                        # non-dict slots — scalar step counters AND
+                        # structured pytrees (Adafactor's optax state) —
+                        # are committed to the old device set after a
+                        # run; replicate every array leaf onto the new
+                        # mesh so nothing strands off-device
+                        repl = NamedSharding(self.new_mesh, PartitionSpec())
+                        tree = jax.tree_util.tree_map(
+                            lambda a: jax.device_put(a, repl)
+                            if isinstance(a, jax.Array) else a, tree)
                         new_state[slot] = tree
                         continue
                     slot_dsts = {}

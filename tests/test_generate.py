@@ -158,3 +158,18 @@ def test_generate_moe_with_tensor_name_keys():
     want = np.asarray(generate(state, cfg, prompt, 4, temperature=0.0))
     got = np.asarray(generate(renamed, cfg, prompt, 4, temperature=0.0))
     np.testing.assert_array_equal(got, want)
+
+
+def test_generate_moe_bf16_matches_full_forward():
+    """bf16 MoE decode: gate logits in model dtype (a full-f32 gate
+    matmul could resolve near-ties differently than training)."""
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=32, sp=False, dropout=0.0,
+                    position="learned", activation="gelu",
+                    dtype="bfloat16",
+                    num_experts=4, moe_top_k=2, moe_capacity_factor=8.0)
+    model, state = _build_state(cfg, seed=8)
+    prompt = np.array([[5, 17, 2, 9]], np.int32)
+    want = _oracle_greedy(model, prompt, 4)
+    got = np.asarray(generate(state, cfg, prompt, 4, temperature=0.0))
+    np.testing.assert_array_equal(got, want)

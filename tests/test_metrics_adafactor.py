@@ -286,3 +286,43 @@ class TestAdafactor:
                for _ in range(2)]
         g3._cm.__exit__(None, None, None)
         np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_hot_switch_carries_optax_state(self, devices8):
+        """graph.switch_strategy with Adafactor: the structured optax
+        state must follow the params onto the new mesh and training must
+        continue the same trajectory as an unswitched run."""
+        from jax.sharding import PartitionSpec as P
+        from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+        cfg = GPTConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                        num_heads=2, max_seq_len=8, dropout=0.0, sp=False)
+        I = np.random.RandomState(0).randint(0, 32, (4, 8)).astype(np.int32)
+
+        def run(switch_at=None, steps=6):
+            ht.set_seed(11)
+            mesh = ht.create_mesh({"dp": 4, "tp": 2}, devices8)
+            with ht.graph("define_and_run", create_new=True,
+                          mesh=mesh) as g:
+                model = GPTLMHeadModel(cfg)
+                ids = ht.parallel_placeholder("int32", (4, 8),
+                                              pspec=P("dp", None),
+                                              name="ids")
+                lbl = ht.parallel_placeholder("int32", (4, 8),
+                                              pspec=P("dp", None),
+                                              name="lbl")
+                loss = model(ids, lbl)
+                opt = optim.AdafactorOptimizer(lr=0.02)
+                op = opt.minimize(loss)
+                feed = {ids: I, lbl: np.roll(I, -1, 1)}
+                out = []
+                for s in range(steps):
+                    if s == switch_at:
+                        g.switch_strategy(
+                            ht.create_mesh({"dp": 2, "tp": 4}, devices8),
+                            optimizer=opt)
+                    out.append(float(np.asarray(
+                        g.run(loss, [loss, op], feed)[0])))
+                return out
+
+        base = run(switch_at=None)
+        switched = run(switch_at=3)
+        np.testing.assert_allclose(switched, base, rtol=2e-4, atol=1e-5)
