@@ -239,12 +239,31 @@ class SwitchExecGraph:
                         # non-dict slots — scalar step counters AND
                         # structured pytrees (Adafactor's optax state) —
                         # are committed to the old device set after a
-                        # run; replicate every array leaf onto the new
-                        # mesh so nothing strands off-device
+                        # run.  Param-shaped leaves keyed by tensor id
+                        # (e.g. optax momentum) follow their param's
+                        # sharding; everything else (factored vectors,
+                        # counters) replicates — so a momentum-bearing
+                        # Adafactor can't materialize a full replicated
+                        # state copy per device mid-switch.
                         repl = NamedSharding(self.new_mesh, PartitionSpec())
-                        tree = jax.tree_util.tree_map(
-                            lambda a: jax.device_put(a, repl)
-                            if isinstance(a, jax.Array) else a, tree)
+
+                        def _place(path, a):
+                            if not isinstance(a, jax.Array):
+                                return a
+                            sh = repl
+                            for k in reversed(path):
+                                if isinstance(k, jax.tree_util.DictKey):
+                                    t = tensors.get(k.key)
+                                    if t is not None \
+                                            and tuple(t.concrete_shape()) \
+                                            == tuple(a.shape):
+                                        cand = optimizer._state_sharding(
+                                            t, a, g)
+                                        if cand is not None:
+                                            sh = cand
+                                    break
+                            return jax.device_put(a, sh)
+                        tree = jax.tree_util.tree_map_with_path(_place, tree)
                         new_state[slot] = tree
                         continue
                     slot_dsts = {}

@@ -310,7 +310,7 @@ class TestAdafactor:
                                               pspec=P("dp", None),
                                               name="lbl")
                 loss = model(ids, lbl)
-                opt = optim.AdafactorOptimizer(lr=0.02)
+                opt = optim.AdafactorOptimizer(lr=0.02, momentum=0.9)
                 op = opt.minimize(loss)
                 feed = {ids: I, lbl: np.roll(I, -1, 1)}
                 out = []
@@ -323,6 +323,8 @@ class TestAdafactor:
                         g.run(loss, [loss, op], feed)[0])))
                 return out
 
+        # momentum=0.9 gives the optax state param-shaped leaves, which
+        # must follow their params' shardings on switch (not replicate)
         base = run(switch_at=None)
         switched = run(switch_at=3)
         np.testing.assert_allclose(switched, base, rtol=2e-4, atol=1e-5)
