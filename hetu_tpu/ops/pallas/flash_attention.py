@@ -43,6 +43,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
+
+def _empty_rows(m):
+    """Rows whose max score is the mask fill value saw no valid kv
+    position (ring varlen padding) — real logits can't get near it.
+    Shared by the fast path and the accumulate finalize so the
+    out=0/lse=-inf empty-row contract can't desynchronize."""
+    return m <= DEFAULT_MASK_VALUE * 0.5
+
 # Scores are computed as base-2 logits: the softmax scale AND log2(e) are
 # folded into the q operand (one [s, d] multiply outside the kernel
 # instead of a [s, s] multiply per block inside), and exp/log become
@@ -143,13 +151,20 @@ def _block_sizes(s: int, d: int, dtype, role: str = "fwd"
     scratch per block, so they cap at 512.  ``HETU_TPU_FLASH_BLOCK_FWD``
     / ``HETU_TPU_FLASH_BLOCK_BWD`` override the preference for sweeps."""
     import os
+    cands = (1024, 512, 256, 128) if role == "fwd" and d <= 128 \
+        else (512, 256, 128)
     env = os.environ.get(f"HETU_TPU_FLASH_BLOCK_{role.upper()}")
     if env:
         want = int(env)
-        if s % want == 0:
+        # want == s (single block) is always legal, at any size — the
+        # fallback path emits exactly that for divisor-less sequences
+        if s % want == 0 and (128 <= want <= cands[0] or want == s):
             return want, want
-    cands = (1024, 512, 256, 128) if role == "fwd" and d <= 128 \
-        else (512, 256, 128)
+        import warnings
+        warnings.warn(
+            f"HETU_TPU_FLASH_BLOCK_{role.upper()}={want} ignored: must "
+            f"divide s={s} and lie in [128, {cands[0]}] (or equal s) "
+            f"for role={role}")
     for cand in cands:
         if s % cand == 0:
             return cand, cand
@@ -193,11 +208,18 @@ def _fwd_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,  # inputs
         m = jnp.max(s, axis=1)
         p = jnp.exp2(s - m[:, None])
         l = jnp.sum(p, axis=1)             # >= 1: exp2(0) at the max
-        o_ref[0] = (jax.lax.dot_general(
+        o = jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) / l[:, None]
-        ).astype(o_ref.dtype)
         lse = (m + jnp.log2(l)) * LN2
+        if use_segs:
+            # rows whose every position is seg-masked honor the empty-row
+            # contract — out=0, lse=-inf — instead of averaging V through
+            # exp2(0)=1 at the mask fill value
+            empty = _empty_rows(m)
+            o = jnp.where(empty[:, None], 0.0, o)
+            lse = jnp.where(empty, -jnp.inf, lse)
+        o_ref[0] = o.astype(o_ref.dtype)
         lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
         return
 
@@ -231,10 +253,16 @@ def _fwd_kernel(q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,  # inputs
     @pl.when(kv_idx == num_kv - 1)
     def _finalize():
         l = l_ref[:, 0]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
         m = m_ref[:, 0]
-        lse = jnp.where(l == 0.0, -jnp.inf, (m + jnp.log2(safe_l)) * LN2)
+        empty = l == 0.0
+        if use_segs:
+            # blocks ran but every position was seg-masked: m is the mask
+            # fill value, not a real logit — same empty-row contract
+            empty = jnp.logical_or(empty, _empty_rows(m))
+        safe_l = jnp.where(empty, 1.0, l)
+        o = acc_ref[:] / safe_l[:, None]
+        o_ref[0] = jnp.where(empty[:, None], 0.0, o).astype(o_ref.dtype)
+        lse = jnp.where(empty, -jnp.inf, (m + jnp.log2(safe_l)) * LN2)
         lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 

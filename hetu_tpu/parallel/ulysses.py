@@ -52,6 +52,13 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError(
             f"ulysses needs heads ({h}) divisible by the {axis_name!r} "
             f"axis size ({cp}); use ring_attention for h < cp")
+    for name, x in (("k", k), ("v", v)):
+        if x.shape[2] != h:
+            raise ValueError(
+                f"ulysses needs {name} heads ({x.shape[2]}) equal to q "
+                f"heads ({h}) — the flash kernel takes one head count; "
+                f"repeat GQA kv heads to match q first (the model path "
+                f"does this)")
     scale = softmax_scale if softmax_scale is not None \
         else 1.0 / math.sqrt(q.shape[-1])
     if cp == 1:

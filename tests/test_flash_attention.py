@@ -46,6 +46,34 @@ class TestFlashForward:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-4)
 
+    def test_fully_masked_rows_empty_contract(self):
+        """Rows that see no valid kv position (ring varlen padding, -1 seg
+        ids everywhere) must emit out=0, lse=-inf — the contract
+        ring_attention's _merge/backward guards rely on — in BOTH the
+        single-kv-block fast path and the multi-block accumulate path."""
+        for s in (128, 384):  # 128 -> single-kv fast path; 384 -> 3 blocks
+            # of 128 through the accumulate/_finalize path
+            q, k, v = _mk(s=s)
+            b = q.shape[0]
+            # first half of each batch row is a real doc, second half pad
+            seg = np.zeros((b, s), np.int32)
+            seg[:, s // 2:] = -1
+            # pad ids differ between q and kv so pad rows match NOTHING
+            # (with shared ids, pad attends pad; use distinct sentinel)
+            segs = jnp.asarray(seg)
+            kv_seg = jnp.asarray(np.where(seg < 0, -2, seg))
+            out, lse = flash_attention_with_lse(
+                q, k, v, causal=False, segment_ids=(segs, kv_seg))
+            out = np.asarray(out)
+            lse = np.asarray(lse)
+            assert np.all(out[:, s // 2:] == 0.0), f"s={s}"
+            assert np.all(np.isneginf(lse[:, :, s // 2:])), f"s={s}"
+            # valid rows still match the reference on valid kv
+            ref = sdpa_reference(q[:, : s // 2], k[:, : s // 2],
+                                 v[:, : s // 2], causal=False)
+            np.testing.assert_allclose(out[:, : s // 2], np.asarray(ref),
+                                       rtol=1e-4, atol=1e-4)
+
     def test_lse(self):
         q, k, v = _mk()
         out, lse = flash_attention_with_lse(q, k, v, causal=True)

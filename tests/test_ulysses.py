@@ -57,6 +57,16 @@ class TestUlyssesOracle:
             jax.block_until_ready(ulysses_attention_sharded(
                 q, k, v, mesh, batch_axis=None, head_axis=None))
 
+    def test_gqa_kv_head_error(self, devices8):
+        """Un-repeated GQA kv heads (kv_heads % cp != 0) must raise the
+        descriptive ValueError, not an opaque all_to_all shape error."""
+        mesh = ht.create_mesh({"cp": 4}, devices8[:4])
+        q, _, _ = _qkv(h=8)
+        _, k, v = _qkv(h=2)
+        with pytest.raises(Exception, match="kv heads|repeat GQA"):
+            jax.block_until_ready(ulysses_attention_sharded(
+                q, k, v, mesh, batch_axis=None, head_axis=None))
+
 
 @pytest.mark.slow
 class TestGPTWithUlysses:
