@@ -93,12 +93,9 @@ def main():
     micro = args.micro_batch or max(1, args.global_batch // dp)
     num_micro = max(1, args.global_batch // (micro * dp))
     mk = llama_config if args.model == "llama" else GPTConfig
-    if args.sp and pp > 1:
-        log.warning("--sp is not supported with pipeline parallelism; "
-                    "training pp=%d WITHOUT sequence parallelism", pp)
     cfg = mk(vocab_size=args.vocab_size, hidden_size=args.hidden,
              num_layers=args.layers, num_heads=args.heads,
-             max_seq_len=args.seq_len, sp=args.sp and pp == 1,
+             max_seq_len=args.seq_len, sp=args.sp,
              dtype="bfloat16" if args.bf16 else "float32")
 
     # data: token stream -> fixed windows through the native loader

@@ -62,9 +62,18 @@ def _layernorm(x, w, b, eps=1e-5):
 def block_fn(params, x, *, cfg: GPTConfig, mesh=None):
     """One transformer block, pure function: LLaMA-style
     (rmsnorm/rotary/swiglu, bias-free) or GPT-2-style
-    (layernorm/learned-positions/gelu, with biases) by ``cfg``.
+    (layernorm/learned-positions/gelu, with biases) by ``cfg``; GQA via
+    ``cfg.num_kv_heads`` and MoE MLPs via ``cfg.num_experts`` (params
+    carry ``moe_*`` leaves instead of ``mlp_*``).
+
+    With ``cfg.sp`` the residual stream stays SEQUENCE-sharded over the
+    tp axis between sublayers (Megatron-SP, reference
+    parallel_multi_ds.py:156-170 per-layer ``sp`` flag) — under GSPMD
+    this is purely a constraint change; XLA places the all-gather /
+    reduce-scatter pair at the column/row-parallel boundaries.
 
     params: dict of this layer's weights; x: [b, s, h].
+    Returns ``(x, aux)`` — aux is the MoE balance loss (0 for dense).
     """
     from jax.sharding import NamedSharding
     c = cfg
@@ -72,6 +81,10 @@ def block_fn(params, x, *, cfg: GPTConfig, mesh=None):
     def _wsc(v, spec):
         if mesh is None:
             return v
+        # drop axis names the mesh doesn't have (e.g. no tp axis on a
+        # pp x dp x ep mesh) — same degradation rule as graph._pspec_for
+        names = set(mesh.axis_names)
+        spec = P(*[e if e in names else None for e in spec])
         return lax.with_sharding_constraint(v, NamedSharding(mesh, spec))
 
     def _norm(x, which):
@@ -80,20 +93,31 @@ def block_fn(params, x, *, cfg: GPTConfig, mesh=None):
         return _layernorm(x, params[which], params[which + "_b"])
 
     b, s, hdim = x.shape
+    # residual-stream layout between sublayers: seq-sharded under SP
+    resid_spec = P(c.dp_axis, c.tp_axis, None) if c.sp \
+        else P(c.dp_axis, None, None)
+    nkv = c.num_kv_heads or c.num_heads
+    q_size = c.num_heads * c.head_dim
+    kv_size = nkv * c.head_dim
 
     h = _norm(x, "ln1")
     qkv = jnp.einsum("bsh,oh->bso", h, params["qkv"])
     if "qkv_b" in params:
         qkv = qkv + params["qkv_b"]
     qkv = _wsc(qkv, P(c.dp_axis, None, c.tp_axis))
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, s, c.num_heads, c.head_dim)
-    k = k.reshape(b, s, c.num_heads, c.head_dim)
-    v = v.reshape(b, s, c.num_heads, c.head_dim)
+    q = qkv[..., :q_size].reshape(b, s, c.num_heads, c.head_dim)
+    k = qkv[..., q_size:q_size + kv_size].reshape(b, s, nkv, c.head_dim)
+    v = qkv[..., q_size + kv_size:].reshape(b, s, nkv, c.head_dim)
     if c.position == "rotary":
         cos, sin = _rotary_tables(s, c.head_dim)
         q = _apply_rotary(q, cos, sin)
         k = _apply_rotary(k, cos, sin)
+    if nkv != c.num_heads:
+        # repeat BEFORE constraining (models/gpt.py:165: kv_heads may be
+        # < tp size; a head-dim constraint there forces remat)
+        rep = c.num_heads // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     spec4 = P(c.dp_axis, None, c.tp_axis, None)
     q = _wsc(q, spec4)
     k = _wsc(k, spec4)
@@ -104,24 +128,54 @@ def block_fn(params, x, *, cfg: GPTConfig, mesh=None):
     attn_out = jnp.einsum("bso,ho->bsh", attn, params["attn_out"])
     if "attn_out_b" in params:
         attn_out = attn_out + params["attn_out_b"]
-    attn_out = _wsc(attn_out, P(c.dp_axis, None, None))
+    attn_out = _wsc(attn_out, resid_spec)
     x = x + attn_out
 
     h = _norm(x, "ln2")
-    up = jnp.einsum("bsh,oh->bso", h, params["mlp_up"])
-    if "mlp_up_b" in params:
-        up = up + params["mlp_up_b"]
-    up = _wsc(up, P(c.dp_axis, None, c.tp_axis))
-    if c.activation == "swiglu":
-        u1, u2 = jnp.split(up, 2, axis=-1)
-        act = jax.nn.silu(u1) * u2
+    if "moe_w1" in params:
+        down, aux = _moe_mlp(params, h, cfg=c, wsc=_wsc)
     else:
-        act = jax.nn.gelu(up, approximate=True)
-    down = jnp.einsum("bso,ho->bsh", act, params["mlp_down"])
-    if "mlp_down_b" in params:
-        down = down + params["mlp_down_b"]
-    down = _wsc(down, P(c.dp_axis, None, None))
-    return x + down
+        aux = jnp.zeros((), jnp.float32)
+        up = jnp.einsum("bsh,oh->bso", h, params["mlp_up"])
+        if "mlp_up_b" in params:
+            up = up + params["mlp_up_b"]
+        up = _wsc(up, P(c.dp_axis, None, c.tp_axis))
+        if c.activation == "swiglu":
+            u1, u2 = jnp.split(up, 2, axis=-1)
+            act = jax.nn.silu(u1) * u2
+        else:
+            act = jax.nn.gelu(up, approximate=True)
+        down = jnp.einsum("bso,ho->bsh", act, params["mlp_down"])
+        if "mlp_down_b" in params:
+            down = down + params["mlp_down_b"]
+    down = _wsc(down, resid_spec)
+    return x + down, aux
+
+
+def _moe_mlp(params, h, *, cfg: GPTConfig, wsc):
+    """MoE feed-forward inside a pipelined block (pure-params form of
+    nn/moe.py MoELayer: GShard top-k gate + stacked-expert einsums; EP
+    sharding over ``cfg.ep_axis`` via constraints)."""
+    from ..nn.moe import topk_gating_impl
+    c = cfg
+    b, s, hdim = h.shape
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+           "silu": jax.nn.silu}["silu" if c.activation == "swiglu"
+                                else c.activation]
+    espec = P(c.ep_axis, None, None) if c.ep_axis else P()
+    xt = h.reshape(-1, hdim)                                     # [T, d]
+    logits = jnp.einsum("td,ed->te", xt, params["moe_gate"])
+    l_aux, combine, dispatch = topk_gating_impl(
+        logits, c.moe_top_k, c.moe_capacity_factor)
+    dispatched = jnp.einsum("tec,td->ecd", dispatch.astype(xt.dtype), xt)
+    dispatched = wsc(dispatched, espec)
+    h1 = act(jnp.einsum("ecd,edf->ecf", dispatched, params["moe_w1"])
+             + params["moe_b1"])
+    eout = jnp.einsum("ecf,efd->ecd", h1, params["moe_w2"]) \
+        + params["moe_b2"]
+    eout = wsc(eout, espec)
+    out = jnp.einsum("tec,ecd->td", combine.astype(eout.dtype), eout)
+    return out.reshape(b, s, hdim).astype(h.dtype), l_aux
 
 
 class GPTPipelineModel(Module):
@@ -137,12 +191,22 @@ class GPTPipelineModel(Module):
         assert config.num_layers % num_stages == 0
         # fail loudly on config fields block_fn does not honor rather than
         # silently building the wrong architecture
-        if config.num_kv_heads not in (None, config.num_heads):
-            raise NotImplementedError("pipelined blocks are MHA-only "
-                                      "(num_kv_heads must equal num_heads)")
         if config.dropout:
             raise NotImplementedError("pipelined blocks do not support "
                                       "dropout")
+        if config.num_experts > 0:
+            # lax.scan over a stage needs homogeneous layers: every block
+            # must be MoE (the reference stacks per-layer modules instead)
+            if any(not config.is_moe_layer(i)
+                   for i in range(config.num_layers)):
+                raise NotImplementedError(
+                    "pipelined MoE needs every layer MoE (moe_every=1); "
+                    "mixed dense/MoE stacks use the MPMD path")
+            moe_act = "silu" if config.activation == "swiglu" \
+                else config.activation
+            if moe_act not in ("relu", "gelu", "silu"):
+                raise ValueError(f"MoE experts do not support activation "
+                                 f"{config.activation!r}")
         self.config = config
         self.num_stages = num_stages
         self.pp_axis = pp_axis
@@ -162,7 +226,7 @@ class GPTPipelineModel(Module):
             self.wpe = None
         norm_cls = ParallelRMSNorm if c.norm == "rmsnorm" \
             else ParallelLayerNorm
-        self.ln_f = norm_cls(c.hidden_size, sp=False,
+        self.ln_f = norm_cls(c.hidden_size, sp=c.sp,
                              dp_axis=c.dp_axis, tp_axis=c.tp_axis,
                              dtype=c.dtype, name="ln_f")
         self.lm_head = parallel_parameter(
@@ -187,24 +251,36 @@ class GPTPipelineModel(Module):
 
         depth_std = c.init_std / math.sqrt(2 * c.num_layers)
         up_rows = (2 if c.activation == "swiglu" else 1) * f
+        q_size = c.num_heads * c.head_dim
+        kv_size = (c.num_kv_heads or c.num_heads) * c.head_dim
         stacked("ln1", (h,), (None,), 0.0)
         if c.norm == "layernorm":
             stacked("ln1_b", (h,), (None,), 0.0)
-        stacked("qkv", (3 * h, h), (c.tp_axis, None), c.init_std)
+        stacked("qkv", (q_size + 2 * kv_size, h), (c.tp_axis, None),
+                c.init_std)
         if biased:
-            stacked("qkv_b", (3 * h,), (c.tp_axis,), 0.0)
-        stacked("attn_out", (h, h), (None, c.tp_axis), depth_std)
+            stacked("qkv_b", (q_size + 2 * kv_size,), (c.tp_axis,), 0.0)
+        stacked("attn_out", (h, q_size), (None, c.tp_axis), depth_std)
         if biased:
             stacked("attn_out_b", (h,), (None,), 0.0)
         stacked("ln2", (h,), (None,), 0.0)
         if c.norm == "layernorm":
             stacked("ln2_b", (h,), (None,), 0.0)
-        stacked("mlp_up", (up_rows, h), (c.tp_axis, None), c.init_std)
-        if biased:
-            stacked("mlp_up_b", (up_rows,), (c.tp_axis,), 0.0)
-        stacked("mlp_down", (h, f), (None, c.tp_axis), depth_std)
-        if biased:
-            stacked("mlp_down_b", (h,), (None,), 0.0)
+        if c.num_experts > 0:
+            E = c.num_experts
+            ep = c.ep_axis
+            stacked("moe_gate", (E, h), (None, None), c.init_std)
+            stacked("moe_w1", (E, h, f), (ep, None, None), c.init_std)
+            stacked("moe_b1", (E, 1, f), (ep, None, None), 0.0)
+            stacked("moe_w2", (E, f, h), (ep, None, None), depth_std)
+            stacked("moe_b2", (E, 1, h), (ep, None, None), 0.0)
+        else:
+            stacked("mlp_up", (up_rows, h), (c.tp_axis, None), c.init_std)
+            if biased:
+                stacked("mlp_up_b", (up_rows,), (c.tp_axis,), 0.0)
+            stacked("mlp_down", (h, f), (None, c.tp_axis), depth_std)
+            if biased:
+                stacked("mlp_down_b", (h,), (None,), 0.0)
         # norm scales init to 1
         g = self.blk_ln1.graph
         g.reset_variable(self.blk_ln1, np.ones((S, L, h), np.float32))
@@ -214,36 +290,53 @@ class GPTPipelineModel(Module):
                 num_micro_batches: int = 1):
         c = self.config
         mesh = self.wte.weight.graph.mesh
+        use_moe = c.num_experts > 0
         x = self.wte(input_ids)
         if self.wpe is not None:
             seq_len = input_ids.shape[-1]
             pos = _ops.getitem(self.wpe, slice(0, seq_len))
             x = x + pos
+        if c.sp:
+            x = sharded(x, P(c.dp_axis, c.tp_axis, None))
         keys = list(self._stacked.keys())
 
         def _impl(x, *stacked_arrays, num_micro_batches=1):
             stage_params = dict(zip(keys, stacked_arrays))
 
             def stage_fn(params, x_mb):
-                # scan this stage's layer range (leading dim L/S)
-                def layer(x, layer_params):
-                    return block_fn(layer_params, x, cfg=c, mesh=mesh), None
-                out, _ = lax.scan(layer, x_mb, params)
-                return out
+                # scan this stage's layer range (leading dim L/S),
+                # accumulating the MoE aux loss across layers
+                def layer(carry, layer_params):
+                    x, aux = carry
+                    y, a = block_fn(layer_params, x, cfg=c, mesh=mesh)
+                    return (y, aux + a), None
+                (out, aux), _ = lax.scan(
+                    layer, (x_mb, jnp.zeros((), jnp.float32)), params)
+                return (out, aux) if use_moe else out
 
             return pipeline_spmd(stage_fn, stage_params, x,
-                                 num_micro_batches, mesh, self.pp_axis)
+                                 num_micro_batches, mesh, self.pp_axis,
+                                 with_aux=use_moe)
 
-        x = _ops.functional._op(
-            "pipeline_transformer", _impl,
-            [x, *self._stacked.values()],
-            {"num_micro_batches": num_micro_batches})
+        if use_moe:
+            x, aux = _ops.functional._op(
+                "pipeline_transformer", _impl,
+                [x, *self._stacked.values()],
+                {"num_micro_batches": num_micro_batches}, num_outputs=2)
+        else:
+            x = _ops.functional._op(
+                "pipeline_transformer", _impl,
+                [x, *self._stacked.values()],
+                {"num_micro_batches": num_micro_batches})
 
         x = self.ln_f(x)
         logits = _ops.matmul(x, self.lm_head, trans_b=True)
         logits = sharded(logits, P(c.dp_axis, None, c.tp_axis))
         if labels is None:
             return logits
-        return vocab_parallel_cross_entropy(
+        loss = vocab_parallel_cross_entropy(
             logits, labels, dp_axis=c.dp_axis, tp_axis=c.tp_axis,
             ignore_index=-100)
+        if use_moe and c.moe_aux_coef:
+            loss = loss + c.moe_aux_coef * aux
+        return loss

@@ -28,13 +28,14 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], jax.Array],
+def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], Any],
                   stage_params: Any,
                   x: jax.Array,
                   num_micro_batches: int,
                   mesh: Mesh,
                   pp_axis: str = "pp",
-                  remat: bool = True) -> jax.Array:
+                  remat: bool = True,
+                  with_aux: bool = False):
     """Run ``x`` through S pipeline stages (S = mesh pp size).
 
     stage_params: pytree whose leaves are stacked [S, ...] and sharded over
@@ -43,6 +44,12 @@ def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], jax.Array],
     preserve the activation shape (homogeneous stages — transformer
     blocks).  x: [batch, ...], micro-batched internally along dim 0.
     Returns [batch, ...] last-stage outputs, replicated over pp.
+
+    ``with_aux=True``: stage_fn returns ``(y, aux_scalar)`` (e.g. the MoE
+    balance loss); the pipeline returns ``(out, aux)`` where aux is the
+    micro-batch MEAN of the per-stage aux sums — warmup/drain ticks (which
+    compute on garbage activations) are masked out, matching the pp=1
+    per-micro-batch accumulation exactly.
     """
     S = mesh.shape[pp_axis]
     M = num_micro_batches
@@ -51,11 +58,16 @@ def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], jax.Array],
     if S == 1:
         params0 = jax.tree_util.tree_map(lambda p: p[0], stage_params)
         outs = [stage_fn(params0, mb) for mb in jnp.split(x, M, axis=0)]
+        if with_aux:
+            aux = sum(o[1] for o in outs) / M
+            return jnp.concatenate([o[0] for o in outs], axis=0), aux
         return jnp.concatenate(outs, axis=0)
 
     mb_size = x.shape[0] // M
     x_mb = x.reshape(M, mb_size, *x.shape[1:])
-    body = jax.checkpoint(stage_fn) if remat else stage_fn
+    uniform_fn = stage_fn if with_aux \
+        else (lambda p, v: (stage_fn(p, v), jnp.zeros((), jnp.float32)))
+    body = jax.checkpoint(uniform_fn) if remat else uniform_fn
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
 
     def pp_fn(params_local, x_mb_local):
@@ -64,13 +76,18 @@ def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], jax.Array],
         T = M + S - 1
 
         def tick(carry, t):
-            recv, out_buf = carry
+            recv, out_buf, aux_sum = carry
             # stage 0 consumes micro-batch t (clamped during drain)
             inp_idx = jnp.clip(t, 0, M - 1)
             first_in = lax.dynamic_index_in_dim(x_mb_local, inp_idx, 0,
                                                 keepdims=False)
             x_in = jnp.where(stage == 0, first_in, recv)
-            y = body(params, x_in)
+            y, aux = body(params, x_in)
+            # this stage holds micro-batch t-stage at this tick; outside
+            # [0, M) it's warmup/drain garbage — mask its aux out
+            mb_idx = t - stage
+            live = jnp.logical_and(mb_idx >= 0, mb_idx < M)
+            aux_sum = aux_sum + jnp.where(live, aux.astype(jnp.float32), 0.0)
             # the last stage finishes micro-batch t-(S-1) at this tick
             out_idx = t - (S - 1)
             valid = jnp.logical_and(stage == S - 1,
@@ -84,28 +101,31 @@ def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], jax.Array],
             # hop to the next stage (reference P2P send/recv at stage
             # boundaries); XLA overlaps this with the next tick's compute
             send = lax.ppermute(y, pp_axis, fwd_perm)
-            return (send, out_buf), None
+            return (send, out_buf, aux_sum), None
 
         init_recv = jnp.zeros((mb_size, *x_mb_local.shape[2:]),
                               x_mb_local.dtype)
-        out_sds = jax.eval_shape(
-            lambda p, v: stage_fn(p, v), params,
+        out_sds, _ = jax.eval_shape(
+            lambda p, v: uniform_fn(p, v), params,
             jax.ShapeDtypeStruct(init_recv.shape, init_recv.dtype))
         out_buf0 = jnp.zeros((M, *out_sds.shape), out_sds.dtype)
-        (_, out_buf), _ = lax.scan(tick, (init_recv, out_buf0),
-                                   jnp.arange(T))
+        (_, out_buf, aux_sum), _ = lax.scan(
+            tick, (init_recv, out_buf0, jnp.zeros((), jnp.float32)),
+            jnp.arange(T))
         # out_buf is only valid on the last stage; broadcast it so the
         # (replicated) out_specs is truthful
         mask = (stage == S - 1).astype(out_buf.dtype)
-        return lax.psum(out_buf * mask, pp_axis)
+        return lax.psum(out_buf * mask, pp_axis), \
+            lax.psum(aux_sum, pp_axis) / M
 
     fn = jax.shard_map(
         pp_fn, mesh=mesh,
         in_specs=(P(pp_axis), P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names={pp_axis}, check_vma=False)
-    out_mb = fn(stage_params, x_mb)
-    return out_mb.reshape(M * mb_size, *out_mb.shape[2:])
+    out_mb, aux = fn(stage_params, x_mb)
+    out = out_mb.reshape(M * mb_size, *out_mb.shape[2:])
+    return (out, aux) if with_aux else out
 
 
 def stack_stage_params(per_layer_params: list, num_stages: int):
