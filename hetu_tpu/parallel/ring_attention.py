@@ -421,6 +421,8 @@ def ring_attention_sharded(q, k, v, mesh, axis_name: str = "cp",
     from .comm import shard_map
 
     cp = mesh.shape[axis_name]
+    _maybe_profile_ring(q, k, v, mesh, axis_name, causal, split_pattern,
+                        softmax_scale)
 
     def axis_or_none(name):
         return name if (name and name in mesh.axis_names) else None
@@ -480,6 +482,40 @@ def profile_ring_rounds(q, k, v, mesh, axis_name: str = "cp",
     r hops, one _pair_fwd per rank), so the per-(rank, round) cost —
     which pair_score_area predicts analytically — can be measured.
     Returns a list of ``cp`` median times in seconds.
+
+    For the comm/attn/corr/grad decomposition use
+    :func:`profile_ring_breakdown`.
+    """
+    rows = profile_ring_breakdown(q, k, v, mesh, axis_name, causal,
+                                  split_pattern, softmax_scale, reps,
+                                  include_bwd=False)
+    return [r["attn_s"] for r in rows]
+
+
+def profile_ring_breakdown(q, k, v, mesh, axis_name: str = "cp",
+                           causal: bool = True,
+                           split_pattern: str = "normal",
+                           softmax_scale: Optional[float] = None,
+                           reps: int = 3, include_bwd: bool = True,
+                           metrics=None):
+    """Per-round comm / attn / correction / grad timings of the KV ring —
+    the TPU-native analogue of the reference's event-based per-round
+    instrumentation (``ParallelAttention.h:411-413`` attn/corr events on
+    the comm/attn streams, env-gated).
+
+    XLA fuses the real ring into one program, so intra-program events
+    don't exist; instead each phase of each round is jitted standalone:
+
+    - ``comm_s``  — one KV+ids ring hop (``lax.ppermute`` pair)
+    - ``attn_s``  — ``_pair_fwd`` for that round's mask class
+    - ``corr_s``  — the online-LSE ``_merge`` of the round's partials
+    - ``grad_s``  — ``_pair_bwd`` (when ``include_bwd``)
+
+    Returns a list of ``cp`` dicts (one per round).  Pass a
+    ``utils.metrics.Metrics`` as ``metrics`` to record each round's times
+    as ``ring_{comm,attn,corr,grad}_s`` series (step = round index) — the
+    CP bench table.  Also triggered per-shape inside
+    :func:`ring_attention_sharded` by ``HETU_TPU_RING_PROFILE=1``.
     """
     import time as _time
     from jax.sharding import PartitionSpec as P
@@ -490,32 +526,133 @@ def profile_ring_rounds(q, k, v, mesh, axis_name: str = "cp",
         else 1.0 / math.sqrt(q.shape[-1])
     if split_pattern == "sym":
         q, k, v = (sym_shard(x, cp, axis=1) for x in (q, k, v))
+    b, s = q.shape[0], q.shape[1] // cp
     spec = P(None, axis_name, None, None)
+    sspec = P(None, axis_name)
+    seg0 = jnp.zeros((b, s * cp), jnp.int32)
+    perm1 = [(i, (i + 1) % cp) for i in range(cp)]
 
-    def round_fn(r):
-        def f(q, k, v):
-            my = lax.axis_index(axis_name)
-            perm = [(i, (i + r) % cp) for i in range(cp)]
-            k_r = lax.ppermute(k, axis_name, perm) if r else k
-            v_r = lax.ppermute(v, axis_name, perm) if r else v
-            kind = _mask_kind(my, (my - r) % cp, causal, split_pattern)
-            o, lse = _pair_fwd(q, k_r, v_r, scale, kind, None,
-                               split_pattern, causal)
-            return o
-        return jax.jit(shard_map(f, mesh, (spec, spec, spec), spec))
-
-    times = []
-    for r in range(cp):
-        fn = round_fn(r)
-        out = fn(q, k, v)         # compile + warm
+    def timed(fn, args):
+        out = fn(*args)                      # compile + warm
         jax.block_until_ready(out)
-        np.asarray(out.ravel()[0])
         ts = []
         for _ in range(reps):
             t0 = _time.perf_counter()
-            out = fn(q, k, v)
+            out = fn(*args)
             jax.block_until_ready(out)
-            np.asarray(out.ravel()[0])
             ts.append(_time.perf_counter() - t0)
-        times.append(float(np.median(ts)))
-    return times
+        return float(np.median(ts))
+
+    def comm_fn(k, v, sg):
+        return (lax.ppermute(k, axis_name, perm1),
+                lax.ppermute(v, axis_name, perm1),
+                lax.ppermute(sg, axis_name, perm1))
+
+    # one ring hop: both the timed comm phase AND the between-round KV
+    # rotation, so attn_s times _pair_fwd alone on pre-rotated inputs
+    comm_jit = jax.jit(shard_map(comm_fn, mesh, (spec, spec, sspec),
+                                 (spec, spec, sspec)))
+
+    def attn_fn(r):
+        def f(q, k_r, v_r):
+            my = lax.axis_index(axis_name)
+            kind = _mask_kind(my, (my - r) % cp, causal, split_pattern)
+            o, lse = _pair_fwd(q, k_r, v_r, scale, kind, None,
+                               split_pattern, causal)
+            return o, lse                    # lse: [b, h, s_local]
+        return jax.jit(shard_map(f, mesh, (spec, spec, spec),
+                                 (spec, P(None, None, axis_name))))
+
+    def _corr_impl(o_r, lse_r):
+        bq, sl, h, d = o_r.shape
+        acc = (jnp.full((bq, h, sl), -jnp.inf, jnp.float32),
+               jnp.zeros((bq, h, sl), jnp.float32),
+               jnp.zeros((bq, sl, h, d), jnp.float32))
+        m, denom, out = _merge(acc, o_r.astype(jnp.float32), lse_r)
+        return out
+
+    corr_jit = jax.jit(shard_map(
+        _corr_impl, mesh, (spec, P(None, None, axis_name)), spec))
+
+    def bwd_fn(r):
+        def f(q, k_r, v_r, do, out, lse):
+            my = lax.axis_index(axis_name)
+            kind = _mask_kind(my, (my - r) % cp, causal, split_pattern)
+            return _pair_bwd(q, k_r, v_r, do, out, lse,
+                             scale, kind, None, split_pattern, causal)
+        lspec = P(None, None, axis_name)
+        return jax.jit(shard_map(
+            f, mesh, (spec, spec, spec, spec, spec, lspec),
+            (spec, spec, spec)))
+
+    rows = []
+    k_r, v_r, sg_r = k, v, seg0
+    for r in range(cp):
+        afn = attn_fn(r)
+        o_r, lse_r = afn(q, k_r, v_r)
+        jax.block_until_ready(o_r)
+        row = {
+            "round": r,
+            "comm_s": timed(comm_jit, (k_r, v_r, sg_r)),
+            "attn_s": timed(lambda *a: afn(*a)[0], (q, k_r, v_r)),
+            "corr_s": timed(corr_jit, (o_r, lse_r)),
+        }
+        if include_bwd:
+            bfn = bwd_fn(r)
+            row["grad_s"] = timed(
+                lambda q, kk, vv: bfn(q, kk, vv, o_r, o_r, lse_r)[0],
+                (q, k_r, v_r))
+        rows.append(row)
+        if metrics is not None:
+            metrics.log(r, **{f"ring_{kk[:-2]}_s": vv
+                              for kk, vv in row.items() if kk != "round"})
+        # rotate KV to the next round's position (same hop the ring takes)
+        k_r, v_r, sg_r = comm_jit(k_r, v_r, sg_r)
+        jax.block_until_ready(k_r)
+    return rows
+
+
+def _maybe_profile_ring(q, k, v, mesh, axis_name, causal, split_pattern,
+                        softmax_scale):
+    """HETU_TPU_RING_PROFILE=1: once per (shape, pattern), run the
+    per-round breakdown and log the CP table (reference env
+    HETU_PARALLEL_ATTN_PROFILE gating its ring events)."""
+    import os
+    if os.environ.get("HETU_TPU_RING_PROFILE") != "1":
+        return
+    if any(isinstance(x, jax.core.Tracer) for x in (q, k, v)):
+        # called during tracing (ring inside a jitted step): timings
+        # would be trace-time garbage; profile only eager concrete calls
+        return
+    key = (q.shape, k.shape, causal, split_pattern, mesh.shape[axis_name])
+    if key in _RING_PROFILED:
+        return
+    _RING_PROFILED.add(key)
+    from ..utils.logging_utils import get_logger
+    from ..utils.metrics import Metrics
+    log = get_logger("ring_attention")
+    path = os.environ.get("HETU_TPU_RING_PROFILE_FILE")
+    rec = Metrics(log_file=path) if path else Metrics()
+    try:
+        rows = profile_ring_breakdown(
+            q, k, v, mesh, axis_name, causal, split_pattern, softmax_scale,
+            include_bwd=os.environ.get("HETU_TPU_RING_PROFILE_BWD",
+                                       "1") == "1",
+            metrics=rec)
+    finally:
+        rec.close()
+    hdr = "round   comm_ms   attn_ms   corr_ms" + \
+        ("   grad_ms" if "grad_s" in rows[0] else "")
+    lines = [hdr]
+    for row in rows:
+        cells = [f"{row['round']:5d}"] + [
+            f"{row[c] * 1e3:9.3f}" for c in
+            ("comm_s", "attn_s", "corr_s", "grad_s") if c in row]
+        lines.append(" ".join(cells))
+    log.info("ring attention per-round profile (%s, cp=%d, s_local=%d):\n%s",
+             split_pattern, mesh.shape[axis_name],
+             q.shape[1] // mesh.shape[axis_name], "\n".join(lines))
+    return rows
+
+
+_RING_PROFILED: set = set()
