@@ -52,6 +52,41 @@ def _sync_vars(g):
         np.asarray(arr.ravel()[0])
 
 
+def _auto_plan(cfg, batch, seq, on_tpu: bool):
+    """Close the planner loop (VERDICT r4 #2 / BASELINE north star): let
+    the Galvatron-style search pick the plan the bench runs under —
+    calibrated by profile_hardware on the live chip — instead of a
+    hand-picked config.  Returns (plan_summary_dict, num_micro_batches,
+    recompute_policy_or_None); None summary when planning is disabled
+    (HETU_TPU_BENCH_PLAN=0) or fails."""
+    if os.environ.get("HETU_TPU_BENCH_PLAN", "1") != "1":
+        return None, 1, None
+    try:
+        from hetu_tpu.planner import (plan_for_gpt, plan_summary,
+                                      profile_and_calibrate)
+        cal = profile_and_calibrate(reps=3) if on_tpu else None
+        # this bench measures PER-CHIP throughput on an unmeshed graph, so
+        # the planner's grid is one chip: its free choices are the
+        # micro-batch size, recompute, and (at dp>1 configs it would
+        # reject) zero — the plan the run actually executes under
+        plan = plan_for_gpt(cfg, global_batch=batch, seq=seq, n_chips=1,
+                            calibration=cal)
+        summ = plan_summary(plan)
+        if cal is not None:
+            summ["calibration"] = {
+                "best_matmul_tflops": round(cal.best_matmul_flops / 1e12, 1),
+                "hbm_gbps": round(cal.hbm_bw / 1e9, 1),
+                "device_kind": cal.device_kind,
+            }
+        nmb = max(1, int(plan.num_microbatches))
+        # recompute only when the planner chose it for a majority of layers
+        remat = "nothing_saveable" if (
+            summ["recompute_layers"] * 2 > summ["num_layers"]) else None
+        return summ, nmb, remat
+    except Exception as e:   # planning must never sink the bench
+        return {"error": f"{type(e).__name__}: {e}"}, 1, None
+
+
 def bench_gpt2(on_tpu: bool):
     import jax
     import hetu_tpu as ht
@@ -84,25 +119,39 @@ def bench_gpt2(on_tpu: bool):
                         dtype="float32")
         batch, seq, steps, warmup = 4, 256, 5, 2
 
+    plan, nmb, remat_policy = _auto_plan(cfg, batch, seq, on_tpu)
+    if plan is not None and "error" not in plan and batch % max(nmb, 1):
+        nmb = 1          # schedule must divide the batch
+
+    import contextlib
     with ht.graph("define_and_run", create_new=True) as g:
-        ids = ht.placeholder("int32", (batch, seq), name="input_ids")
-        labels = ht.placeholder("int32", (batch, seq), name="labels")
-        model = GPTLMHeadModel(cfg)
-        loss = model(ids, labels, seq_len=seq)
-        train_op = optim.AdamOptimizer(lr=1e-4, weight_decay=0.01).minimize(loss)
+        # the recompute policy is read at step-BUILD time (inside the
+        # first g.run), so the context must stay open across the runs
+        remat_ctx = ht.recompute(remat_policy) if remat_policy \
+            else contextlib.nullcontext()
+        with remat_ctx:
+            ids = ht.placeholder("int32", (batch, seq), name="input_ids")
+            labels = ht.placeholder("int32", (batch, seq), name="labels")
+            model = GPTLMHeadModel(cfg)
+            loss = model(ids, labels, seq_len=seq)
+            train_op = optim.AdamOptimizer(lr=1e-4,
+                                           weight_decay=0.01).minimize(loss)
 
-        rng = np.random.RandomState(0)
-        IDS = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
-        L = np.roll(IDS, -1, axis=1)
+            rng = np.random.RandomState(0)
+            IDS = rng.randint(0, cfg.vocab_size,
+                              (batch, seq)).astype(np.int32)
+            L = np.roll(IDS, -1, axis=1)
 
-        for _ in range(warmup):
-            g.run(loss, [loss, train_op], {ids: IDS, labels: L})
+            for _ in range(warmup):
+                g.run(loss, [loss, train_op], {ids: IDS, labels: L},
+                      num_micro_batches=nmb)
+                _sync_vars(g)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                g.run(loss, [loss, train_op], {ids: IDS, labels: L},
+                      num_micro_batches=nmb)
             _sync_vars(g)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            g.run(loss, [loss, train_op], {ids: IDS, labels: L})
-        _sync_vars(g)
-        dt = (time.perf_counter() - t0) / steps
+            dt = (time.perf_counter() - t0) / steps
 
         n_params = sum(
             int(np.prod(t.concrete_shape())) for t in g._var_tensors.values())
@@ -126,6 +175,9 @@ def bench_gpt2(on_tpu: bool):
         "params": n_params,
         "params_matmul": n_matmul,
         "batch": batch, "seq": seq,
+        "planner_plan": plan,
+        "num_micro_batches": nmb,
+        "remat": remat_policy or "none",
     }
 
 
@@ -394,6 +446,9 @@ def main():
             "platform": jax.devices()[0].platform,
             "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
             "batch": gpt["batch"], "seq": gpt["seq"],
+            "planner_plan": gpt["planner_plan"],
+            "num_micro_batches": gpt["num_micro_batches"],
+            "remat": gpt["remat"],
             "bert_samples_per_sec": round(bert["samples_per_sec"], 2),
             "bert_step_time_s": round(bert["step_time_s"], 4),
             "bert_batch": bert["batch"], "bert_seq": bert["seq"],

@@ -43,6 +43,14 @@ def parse_args():
                    help="ZeRO level for optimizer state/grad/param sharding")
     p.add_argument("--ds-config", type=str, default=None,
                    help="ds_parallel_config JSON path (overrides dp/tp/pp)")
+    p.add_argument("--auto-parallel", action="store_true",
+                   help="let the Galvatron-style planner pick "
+                        "(dp, tp, pp, zero, micro-batch) for the visible "
+                        "devices (overrides dp/tp/pp/zero flags)")
+    p.add_argument("--calibrate", action="store_true",
+                   help="with --auto-parallel: profile the live backend "
+                        "(matmul/HBM/collectives) to calibrate the "
+                        "planner's cost model first")
     # training
     p.add_argument("--global-batch", type=int, default=16)
     p.add_argument("--micro-batch", type=int, default=None)
@@ -71,6 +79,27 @@ def main():
     log = get_logger("train_gpt")
     n_dev = len(jax.devices())
     dp, tp, pp, zero = args.dp, args.tp, args.pp, args.zero
+    mk = llama_config if args.model == "llama" else GPTConfig
+    cfg = mk(vocab_size=args.vocab_size, hidden_size=args.hidden,
+             num_layers=args.layers, num_heads=args.heads,
+             max_seq_len=args.seq_len, sp=args.sp,
+             dtype="bfloat16" if args.bf16 else "float32")
+    if args.auto_parallel:
+        # closed planner loop (reference Galvatron
+        # hybrid_parallel_config.py:13): search (pp, dp, tp, zero,
+        # recompute, micro-batch) for THIS model on THESE devices
+        from hetu_tpu.planner import (plan_for_gpt, plan_summary,
+                                      profile_and_calibrate)
+        cal = profile_and_calibrate(reps=3) if args.calibrate else None
+        plan = plan_for_gpt(cfg, global_batch=args.global_batch,
+                            seq=args.seq_len, n_chips=n_dev,
+                            calibration=cal)
+        summ = plan_summary(plan)
+        dp, tp, pp = summ["dp"], summ["tp"], summ["pp"]
+        zero = summ["zero"]
+        if args.micro_batch is None and plan.micro_batch:
+            args.micro_batch = plan.micro_batch
+        log.info("auto-parallel plan: %s", json.dumps(summ))
     if args.ds_config:
         with open(args.ds_config) as f:
             cfg_json = json.load(f)
@@ -92,11 +121,6 @@ def main():
         mesh = None
     micro = args.micro_batch or max(1, args.global_batch // dp)
     num_micro = max(1, args.global_batch // (micro * dp))
-    mk = llama_config if args.model == "llama" else GPTConfig
-    cfg = mk(vocab_size=args.vocab_size, hidden_size=args.hidden,
-             num_layers=args.layers, num_heads=args.heads,
-             max_seq_len=args.seq_len, sp=args.sp,
-             dtype="bfloat16" if args.bf16 else "float32")
 
     # data: token stream -> fixed windows through the native loader
     if args.data:

@@ -17,7 +17,7 @@ from .dp_solver import solve_layer_strategies, solve_pipeline_partition
 from .profile_hardware import (Calibration, profile_and_calibrate,
                                profile_collectives, profile_hbm,
                                profile_matmul, validate_step_prediction)
-from .search import PlanResult, SearchEngine
+from .search import PlanResult, SearchEngine, plan_for_gpt, plan_summary
 from .strategies import (BaseSearching, FlexFlowSearching, GPipeSearching,
                          OptCNNSearching, PipeDreamSearching,
                          PipeOptSearching, SearchResult)
@@ -33,7 +33,7 @@ __all__ = [
     "quadratic_predict", "solve_micro_batches", "static_dispatch",
     "Calibration", "profile_and_calibrate", "profile_collectives",
     "profile_hbm", "profile_matmul", "validate_step_prediction",
-    "PlanResult", "SearchEngine",
+    "PlanResult", "SearchEngine", "plan_for_gpt", "plan_summary",
     "BaseSearching", "FlexFlowSearching", "GPipeSearching",
     "OptCNNSearching", "PipeDreamSearching", "PipeOptSearching",
     "SearchResult",

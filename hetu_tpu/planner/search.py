@@ -18,8 +18,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .cost_model import (ClusterSpec, LayerSpec, Strategy, grad_sync_time,
-                         layer_memory, layer_time, pipeline_time)
+from .cost_model import (ClusterSpec, LayerSpec, Strategy,
+                         embedding_layer_spec, grad_sync_time, layer_memory,
+                         layer_time, pipeline_time, transformer_layer_spec)
 from .dp_solver import solve_layer_strategies, solve_pipeline_partition
 
 MEM_UNITS = 64  # memory discretization granularity for the DP
@@ -34,6 +35,7 @@ class PlanResult:
     layer_strategies: List[Strategy]     # one per layer
     num_microbatches: int
     cluster: ClusterSpec
+    micro_batch: Optional[int] = None    # set by plan_for_gpt's mb sweep
 
     def describe(self) -> str:
         lines = [f"pp={self.pp} m={self.num_microbatches} "
@@ -86,6 +88,101 @@ class PlanResult:
                             "dense_4h_to_h": _w({"0": [st.tp]})},
                 }
         return out
+
+
+def plan_for_gpt(cfg, global_batch: int, seq: int, n_chips: int,
+                 calibration=None, micro_batch_options=None,
+                 num_slices: int = 1, mem_fraction: float = 0.9,
+                 max_tp: Optional[int] = None) -> PlanResult:
+    """Close the planner loop for a GPT model: build the layer chain from
+    a ``models.gpt.GPTConfig``, fold a live-hardware
+    :class:`~hetu_tpu.planner.profile_hardware.Calibration` into the chip
+    spec when given, and return the searched plan — the reference's
+    ``get_hybrid_parallel_configs_api`` entry point
+    (``tools/Galvatron/galvatron/core/hybrid_parallel_config.py:13``),
+    consumed by ``bench.py`` and ``examples/train_gpt.py --auto-parallel``.
+
+    The search covers (pp, dp, tp, zero, recompute) jointly with the
+    micro-batch size (``micro_batch_options`` defaults to the powers of
+    two ≤ global_batch/dp candidates the schedule allows).
+    """
+    import jax
+    from .cost_model import CHIPS, ChipSpec
+    from .profile_hardware import _kind_key
+
+    if calibration is not None:
+        chip = calibration.to_chip_spec()
+    else:
+        kind = getattr(jax.devices()[0], "device_kind", "")
+        chip = CHIPS.get(_kind_key(kind), ChipSpec())
+    cluster = ClusterSpec(chip=chip, num_chips=max(1, n_chips // num_slices),
+                          num_slices=num_slices)
+    dtype_bytes = 2 if "bf16" in str(cfg.dtype) or "bfloat16" in \
+        str(cfg.dtype) else 4
+    layers = [embedding_layer_spec(global_batch, seq, cfg.hidden_size,
+                                   cfg.vocab_size, dtype_bytes, name="wte")]
+    layers += [transformer_layer_spec(global_batch, seq, cfg.hidden_size,
+                                      cfg.ffn_size, dtype_bytes,
+                                      name=f"block{i}")
+               for i in range(cfg.num_layers)]
+    # untied LM head: a [h, V] matmul per token
+    layers.append(LayerSpec(
+        name="lm_head", flops=2.0 * global_batch * seq * cfg.hidden_size
+        * cfg.vocab_size,
+        param_bytes=cfg.vocab_size * cfg.hidden_size * dtype_bytes,
+        act_bytes=global_batch * seq * cfg.hidden_size * dtype_bytes,
+        act_io_bytes=global_batch * seq * cfg.hidden_size * dtype_bytes,
+        boundary_bytes=global_batch * seq * cfg.hidden_size * dtype_bytes))
+
+    if micro_batch_options is None:
+        # descending so predicted-time ties keep the LARGEST micro-batch
+        # (fewest micro-batches = least per-dispatch overhead on chip)
+        micro_batch_options = sorted({
+            mb for mb in (1, 2, 4, 8, 16, 32, 64)
+            if mb <= global_batch and global_batch % mb == 0},
+            reverse=True)
+    # pp must divide the transformer stack (the pipelined model places
+    # equal layer ranges; embed/head live outside the pipeline body)
+    total = cluster.total_chips
+    pp_options = [p for p in (1, 2, 4, 8, 16, 32)
+                  if p <= min(total, cfg.num_layers)
+                  and total % p == 0 and cfg.num_layers % p == 0]
+    best: Optional[PlanResult] = None
+    for mb in micro_batch_options:
+        eng = SearchEngine(cluster, layers, global_batch, mb,
+                           mem_fraction=mem_fraction, max_tp=max_tp)
+        try:
+            plan = eng.search(pp_options=pp_options)
+        except RuntimeError:
+            continue
+        if best is None or plan.time < best.time:
+            best = plan
+            best.micro_batch = mb
+    if best is None:
+        raise RuntimeError(
+            "no feasible plan found for any micro-batch size: model does "
+            "not fit in HBM under any searched configuration")
+    return best
+
+
+def plan_summary(plan: PlanResult) -> Dict:
+    """Flat JSON-able description of a plan (bench `extra` reporting)."""
+    from collections import Counter
+    sts = Counter(str(s) for s in plan.layer_strategies)
+    first = plan.layer_strategies[0]
+    return {
+        "pp": plan.pp,
+        "dp": first.dp,
+        "tp": first.tp,
+        "zero": max(s.zero for s in plan.layer_strategies),
+        "recompute_layers": sum(bool(s.recompute)
+                                for s in plan.layer_strategies),
+        "num_layers": len(plan.layer_strategies),
+        "num_microbatches": plan.num_microbatches,
+        "micro_batch": getattr(plan, "micro_batch", None),
+        "est_step_time_ms": round(plan.time * 1e3, 3),
+        "layer_strategy_counts": dict(sts),
+    }
 
 
 class SearchEngine:

@@ -60,6 +60,17 @@ class TestExamples:
             "--global-batch", "8", "--log-every", "2")
         assert "step" in out
 
+    def test_train_gpt_auto_parallel(self):
+        """--auto-parallel: the planner picks (dp, tp, pp, zero,
+        micro-batch) for the visible 8 devices and training runs under
+        the selected plan (the closed Galvatron loop)."""
+        out = _run_example(
+            "train_gpt.py", "--auto-parallel", "--steps", "4",
+            "--hidden", "64", "--layers", "2", "--heads", "4",
+            "--seq-len", "32", "--vocab-size", "128",
+            "--global-batch", "8", "--log-every", "2")
+        assert "step" in out
+
     def test_train_hydraulis(self):
         out = _run_example("train_hydraulis.py", "--steps", "5")
         assert "hydraulis e2e OK" in out

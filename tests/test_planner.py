@@ -127,6 +127,36 @@ class TestSearchEngine:
         with pytest.raises(RuntimeError, match="no feasible plan"):
             eng.search()
 
+    def test_plan_for_gpt_closes_the_loop(self):
+        """plan_for_gpt: GPTConfig -> layer chain -> searched plan with a
+        micro-batch sweep (the bench.py / train_gpt --auto-parallel entry,
+        reference hybrid_parallel_config.py:13)."""
+        from hetu_tpu.models.gpt import GPTConfig
+        from hetu_tpu.planner import plan_for_gpt, plan_summary
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=1024, sp=False,
+                        dtype="bfloat16")
+        # single chip: the only legal layout
+        p1 = plan_for_gpt(cfg, global_batch=32, seq=1024, n_chips=1)
+        s1 = plan_summary(p1)
+        assert (s1["pp"], s1["dp"], s1["tp"]) == (1, 1, 1)
+        assert s1["micro_batch"] is not None
+        assert 32 % s1["micro_batch"] == 0
+        # 8 chips: plan must use the whole grid
+        p8 = plan_for_gpt(cfg, global_batch=64, seq=1024, n_chips=8)
+        s8 = plan_summary(p8)
+        assert s8["pp"] * s8["dp"] * s8["tp"] == 8
+        for key in ("zero", "recompute_layers", "est_step_time_ms",
+                    "num_microbatches"):
+            assert key in s8
+        # calibration folds into the chip spec without breaking the search
+        from hetu_tpu.planner import Calibration
+        cal = Calibration(matmul_flops={1024: 100e12}, hbm_bw=700e9,
+                          device_kind="v5 lite", platform="tpu")
+        pc = plan_summary(plan_for_gpt(cfg, global_batch=32, seq=1024,
+                                       n_chips=1, calibration=cal))
+        assert (pc["pp"], pc["dp"], pc["tp"]) == (1, 1, 1)
+
     def test_ds_parallel_config_roundtrip(self):
         eng = SearchEngine(_cluster(), _gpt_layers(n=8), global_batch=64,
                            micro_batch=8)
