@@ -141,42 +141,119 @@ def _attn_step(cfg: GPTConfig, p: _Params, i: int, x, k_cache, v_cache,
     return out, k_cache, v_cache
 
 
-def _moe_mlp(cfg: GPTConfig, p: _Params, i: int, x):
-    """Dense per-token top-k expert mix for decode (no capacity buckets:
-    every token reaches its chosen experts — exact vs. training when
-    training ran uncongested).  All E experts run batched: one einsum on
-    the MXU beats gather/scatter at decode (s_new=1).  Trade-off: the
-    prefill pass pays E/k x the routed MLP FLOPs over the prompt — fine
-    for the small-E configs this framework trains; long-prompt serving
-    at large E would want a dispatched prefill instead."""
+def _moe_params(p: _Params, i: int):
     def moe_p(part):
         # module-path keys say "mlp.moe.*" (MoEMLP wraps the layer);
         # tensor-name keys say "moe.*" (parallel_parameter names)
         v = p.layer(i, f"mlp.moe.{part}")
         return v if v is not None else p.layer(i, f"moe.{part}")
-    wg = moe_p("gate.wg")           # [E, d]
-    w1 = moe_p("experts.w1")        # [E, d, f]
-    b1 = moe_p("experts.b1")        # [E, 1, f]
-    w2 = moe_p("experts.w2")        # [E, f, d]
-    b2 = moe_p("experts.b2")        # [E, 1, d]
-    # dtype fidelity with training (nn/moe.py): gate LOGITS in model
-    # dtype (ops.linear runs in bf16 for bf16 models — a full-f32 matmul
-    # here could break near-ties and route differently), softmax and the
-    # final combine in fp32
+    return (moe_p("gate.wg"), moe_p("experts.w1"), moe_p("experts.b1"),
+            moe_p("experts.w2"), moe_p("experts.b2"))
+
+
+def _moe_route(cfg: GPTConfig, wg, x):
+    """Top-k routing shared by the dense and dispatched paths — identical
+    gate arithmetic so the two can never route differently.  dtype
+    fidelity with training (nn/moe.py): gate LOGITS in model dtype (a
+    full-f32 matmul could break near-ties), softmax in fp32."""
     gates = jax.nn.softmax(
         (x @ wg.T.astype(x.dtype)).astype(jnp.float32), axis=-1)
     topv, topi = lax.top_k(gates, cfg.moe_top_k)           # [b, s, k]
+    return gates, topv, topi
+
+
+def _moe_act(cfg: GPTConfig):
+    return {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+            "silu": jax.nn.silu}[
+        "silu" if cfg.activation == "swiglu" else cfg.activation]
+
+
+def _moe_mlp(cfg: GPTConfig, p: _Params, i: int, x):
+    """Dense per-token top-k expert mix for decode (no capacity buckets:
+    every token reaches its chosen experts — exact vs. training when
+    training ran uncongested).  All E experts run batched: one einsum on
+    the MXU beats gather/scatter at decode (s_new=1).  The prefill pass
+    (s_new > 1) routes through :func:`_moe_mlp_dispatched` instead, whose
+    FLOPs scale with k/E rather than running every expert on every token
+    (reference moe_layer.py:45 dispatches via layout_transform+AllToAll)."""
+    wg, w1, b1, w2, b2 = _moe_params(p, i)
+    if x.shape[1] > 1:
+        return _moe_mlp_dispatched(cfg, x, wg, w1, b1, w2, b2)
+    gates, topv, topi = _moe_route(cfg, wg, x)
     weights = jnp.zeros_like(gates)
     for j in range(cfg.moe_top_k):
         weights = weights + topv[..., j:j + 1] * jax.nn.one_hot(
             topi[..., j], gates.shape[-1], dtype=gates.dtype)
-    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
-           "silu": jax.nn.silu}[
-        "silu" if cfg.activation == "swiglu" else cfg.activation]
+    act = _moe_act(cfg)
     h = act(jnp.einsum("bsd,edf->bsef", x, w1) + b1[:, 0])
     y = jnp.einsum("bsef,efd->bsed", h, w2) + b2[:, 0]
     return jnp.einsum("bse,bsed->bsd", weights,
                       y.astype(jnp.float32)).astype(x.dtype)
+
+
+def _moe_block_size(n_assign: int, num_experts: int) -> int:
+    """Group-GEMM block: large enough to keep the MXU busy, small enough
+    that per-expert padding (< E blocks of waste) stays a minor fraction
+    of the T*k real assignments."""
+    for cand in (512, 256, 128, 64, 32, 16, 8):
+        if n_assign >= num_experts * cand:
+            return cand
+    return 8
+
+
+def _moe_mlp_dispatched(cfg: GPTConfig, x, wg, w1, b1, w2, b2):
+    """Capacity-FREE dispatched MoE for prefill: blocked group-GEMM.
+
+    Assignments (token, expert) are sorted by expert and each expert's
+    group padded to a block multiple, so every [B, d] token block
+    multiplies exactly ONE expert's weights — three einsums over
+    ``G = ceil(sum padded / B)`` blocks.  FLOPs = N_pad * (2df + 2fd)
+    with ``N_pad <= T*k + E*(B-1)``, i.e. ~k/E of the dense all-experts
+    path, with NO dropped tokens (exact equivalence — the test asserts
+    it).  The sort/offset arithmetic is static-shape throughout (runs
+    under jit); the reference reaches the same dataflow with
+    layout_transform + AllToAll ops (v1 moe_layer.py:45)."""
+    b, s, d = x.shape
+    E = wg.shape[0]
+    k = cfg.moe_top_k
+    T = b * s
+    xt = x.reshape(T, d)
+    gates, topv, topi = _moe_route(cfg, wg, x)
+    e_flat = topi.reshape(-1)                        # [T*k] expert ids
+    t_flat = jnp.repeat(jnp.arange(T, dtype=jnp.int32), k)
+    w_flat = topv.reshape(-1)                        # fp32 gate weights
+    n = T * k
+    B = _moe_block_size(n, E)
+    # stable sort by expert keeps token order inside each group
+    order = jnp.argsort(e_flat, stable=True)
+    e_sorted = e_flat[order]
+    t_sorted = t_flat[order]
+    w_sorted = w_flat[order]
+    counts = jnp.bincount(e_flat, length=E)          # [E] tokens/expert
+    padded = ((counts + B - 1) // B) * B
+    src_off = jnp.cumsum(counts) - counts            # group starts, sorted
+    dst_off = jnp.cumsum(padded) - padded            # block-aligned starts
+    pos_in_e = jnp.arange(n, dtype=jnp.int32) - src_off[e_sorted]
+    dst = (dst_off[e_sorted] + pos_in_e).astype(jnp.int32)
+    n_pad = ((n + E * (B - 1)) // B + 1) * B         # static upper bound
+    slot_tok = jnp.full((n_pad,), -1, jnp.int32).at[dst].set(t_sorted)
+    slot_w = jnp.zeros((n_pad,), jnp.float32).at[dst].set(w_sorted)
+    G = n_pad // B
+    # each block lies inside one expert's padded region: its expert is
+    # the first e whose region end exceeds the block start
+    blk_start = jnp.arange(G, dtype=jnp.int32) * B
+    blk_e = jnp.clip(jnp.searchsorted(jnp.cumsum(padded), blk_start,
+                                      side="right"), 0, E - 1)
+    live = slot_tok >= 0
+    xg = jnp.where(live[:, None], xt[jnp.clip(slot_tok, 0)], 0.0)
+    xg = xg.reshape(G, B, d)
+    act = _moe_act(cfg)
+    h = act(jnp.einsum("gbd,gdf->gbf", xg, w1[blk_e]) + b1[blk_e])
+    y = jnp.einsum("gbf,gfd->gbd", h, w2[blk_e]) + b2[blk_e]
+    y = y.reshape(n_pad, d).astype(jnp.float32) * slot_w[:, None]
+    out = jnp.zeros((T, d), jnp.float32).at[jnp.clip(slot_tok, 0)].add(
+        jnp.where(live[:, None], y, 0.0))
+    return out.reshape(b, s, d).astype(x.dtype)
 
 
 def _forward(cfg: GPTConfig, p: _Params, ids, caches, pos, cos, sin):

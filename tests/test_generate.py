@@ -90,6 +90,61 @@ def test_generate_moe_matches_full_forward():
     np.testing.assert_array_equal(got, want)
 
 
+def test_dispatched_prefill_matches_dense_all_experts():
+    """Capacity-free blocked group-GEMM prefill == the dense all-experts
+    mix, bit-for-bit routing (shared _moe_route) and allclose outputs —
+    while touching ~k/E of the expert FLOPs (reference moe_layer.py:45
+    dispatch without its capacity drop)."""
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.models.generate import (_moe_act, _moe_mlp_dispatched,
+                                          _moe_route)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=64, sp=False,
+                    position="learned", activation="gelu",
+                    num_experts=8, moe_top_k=2)
+    rng = np.random.RandomState(3)
+    b, s, d, f, E = 2, 24, 32, 64, 8
+    x = jnp.asarray(rng.randn(b, s, d), jnp.float32)
+    wg = jnp.asarray(rng.randn(E, d), jnp.float32)
+    w1 = jnp.asarray(rng.randn(E, d, f) * 0.1, jnp.float32)
+    b1 = jnp.asarray(rng.randn(E, 1, f) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rng.randn(E, f, d) * 0.1, jnp.float32)
+    b2 = jnp.asarray(rng.randn(E, 1, d) * 0.1, jnp.float32)
+
+    # dense all-experts oracle (the old prefill path)
+    gates, topv, topi = _moe_route(cfg, wg, x)
+    weights = jnp.zeros_like(gates)
+    for j in range(cfg.moe_top_k):
+        weights = weights + topv[..., j:j + 1] * jax.nn.one_hot(
+            topi[..., j], E, dtype=gates.dtype)
+    act = _moe_act(cfg)
+    h = act(jnp.einsum("bsd,edf->bsef", x, w1) + b1[:, 0])
+    y = jnp.einsum("bsef,efd->bsed", h, w2) + b2[:, 0]
+    want = jnp.einsum("bse,bsed->bsd", weights, y)
+
+    got = _moe_mlp_dispatched(cfg, x, wg, w1, b1, w2, b2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # works under jit too (static-shape dispatch arithmetic)
+    got_jit = jax.jit(lambda x: _moe_mlp_dispatched(
+        cfg, x, wg, w1, b1, w2, b2))(x)
+    np.testing.assert_allclose(np.asarray(got_jit), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_dispatched_prefill_flops_bound():
+    """The padded assignment count (what the group-GEMM multiplies) is
+    bounded by T*k + E*B — i.e. prefill FLOPs scale with top-k, not E."""
+    from hetu_tpu.models.generate import _moe_block_size
+    T, k, E = 4096, 2, 64
+    B = _moe_block_size(T * k, E)
+    n_pad_max = T * k + E * (B - 1) + B
+    dense_cost = T * E          # all-experts path multiplies T*E blocks
+    assert n_pad_max < 0.1 * dense_cost * k, \
+        (n_pad_max, dense_cost)
+
+
 def test_generate_zero_tokens_returns_prompt():
     cfg = GPTConfig(vocab_size=31, hidden_size=16, num_layers=1,
                     num_heads=2, max_seq_len=8, sp=False,
