@@ -532,14 +532,18 @@ def profile_ring_breakdown(q, k, v, mesh, axis_name: str = "cp",
     seg0 = jnp.zeros((b, s * cp), jnp.int32)
     perm1 = [(i, (i + 1) % cp) for i in range(cp)]
 
-    def timed(fn, args):
-        out = fn(*args)                      # compile + warm
+    def fetch(out):
+        # block_until_ready can be a no-op under remote-relay PJRT
+        # backends (bench.py:47): force a real host fetch of one element
         jax.block_until_ready(out)
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+
+    def timed(fn, args):
+        fetch(fn(*args))                     # compile + warm
         ts = []
         for _ in range(reps):
             t0 = _time.perf_counter()
-            out = fn(*args)
-            jax.block_until_ready(out)
+            fetch(fn(*args))
             ts.append(_time.perf_counter() - t0)
         return float(np.median(ts))
 

@@ -172,6 +172,29 @@ def parse_layout(cfg: Dict):
     return dp, tp, pp, zero
 
 
+def parse_hetero_layout(cfg: Dict) -> List[Dict]:
+    """Inverse of :func:`generate_gpt_hetero_3d_config`: recover the
+    per-stage ``{"dp", "tp", "devices", "layers"}`` dicts from a hetero
+    ds_parallel_config so the MPMD runtime can be built straight from the
+    JSON (reference train_hetu.py:256-335 reads hetero configs the same
+    way)."""
+    stages: List[Dict] = []
+    blocks = sorted(cfg["gpt"]["blocks"].items(),
+                    key=lambda kv: kv[1].get("range", [0])[0])
+    for _, block in blocks:
+        qkv = block["attn"]["qkv"]
+        devices = list(qkv["device_group_union"][0])
+        tp = qkv["split"].get("1", [1])[0]
+        dp = qkv["dup"][0]
+        st = {"dp": dp, "tp": tp, "devices": devices,
+              "layers": list(block["range"])}
+        if stages and stages[-1]["devices"] == devices:
+            stages[-1]["layers"][1] = st["layers"][1]
+        else:
+            stages.append(st)
+    return stages
+
+
 def iter_block_entries(cfg: Dict):
     """Yield (block_range, sub_name, entry) for every leaf block entry."""
     for bname, block in cfg["gpt"]["blocks"].items():
