@@ -57,6 +57,21 @@ def _tree_bytes(tree) -> int:
                if hasattr(a, "dtype"))
 
 
+# grad scale+accumulate as ONE jitted call per B task (donated
+# accumulator) — per-leaf eager dispatch here was the dominant controller
+# cost per task (the reference keeps its hot loop free of per-tensor host
+# work, executable_graph.cc:1424).  Module-level so every stage shares
+# one jit cache; `w.astype(a.dtype)` keeps grad dtypes (bf16 stages must
+# not be promoted to f32 by a strongly-typed scalar).
+_scale_grads = jax.jit(
+    lambda dp, w: jax.tree_util.tree_map(
+        lambda a: a * w.astype(a.dtype), dp))
+_accum_grads = jax.jit(
+    lambda acc, dp, w: jax.tree_util.tree_map(
+        lambda a, b: a + b * w.astype(b.dtype), acc, dp),
+    donate_argnums=0)
+
+
 class Stage:
     """One pipeline stage: a forward program (+ derived backward) on a
     device submesh.
@@ -157,6 +172,10 @@ class MPMDPipelineRuntime:
         # executable_graph.cc:1738-1761 _all_micro_batches_memory_info)
         from ..utils.profiler import MemoryProfiler
         self.memory_profiler = MemoryProfiler()
+        # per-(P, counts) jitted rng-table builders: fold_in costs ~5ms
+        # of host dispatch per eager call, so the whole table is built in
+        # ONE jit call per step instead of 2 fold_ins per task
+        self._fold_cache: Dict[Tuple, Any] = {}
 
     def _schedule(self, M: int) -> List[List[Task]]:
         if self.schedule_name == "interleaved":
@@ -206,8 +225,21 @@ class MPMDPipelineRuntime:
             for m, (x_mb, _) in enumerate(data[p]):
                 acts[(p, 0, m)] = x_mb
 
+        fold_key = (P_n, tuple(counts))
+        fold_fn = self._fold_cache.get(fold_key)
+        if fold_fn is None:
+            def _rng_table(r, _counts=tuple(counts), _P=P_n):
+                return [[jax.random.fold_in(jax.random.fold_in(r, p), m)
+                         for m in range(_counts[p])] for p in range(_P)]
+            fold_fn = jax.jit(_rng_table)
+            self._fold_cache[fold_key] = fold_fn
+        # host numpy keys: uncommitted inputs keep every stage's jit call
+        # on the C++ fast path (a device-committed key from the default
+        # device forces a slow-path reshard per call on the submeshes)
+        rngs = jax.device_get(fold_fn(rng))
+
         def mb_rng(p, m):
-            return jax.random.fold_in(jax.random.fold_in(rng, p), m)
+            return rngs[p][m]
 
         def ready(p, s, t: Task) -> bool:
             if t.kind == "F":
@@ -216,10 +248,11 @@ class MPMDPipelineRuntime:
                 return (p, s, t.micro_batch) in acts
             return (p, s, t.micro_batch) in gin
 
+        w_arr = jnp.float32(1.0 / M_total)   # hoisted: one host->dev put
+
         def run_task(p, s, t: Task) -> None:
             stage = self.pipes[p][s]
             m = t.micro_batch
-            w = 1.0 / M_total
             if t.kind == "F":
                 x = acts.pop((p, s, m))
                 if stage.is_last:
@@ -247,9 +280,9 @@ class MPMDPipelineRuntime:
                 stash_live[p][s] -= 1
                 dy = gin.pop((p, s, m))
                 dp, dx = stage.bwd_jit(stage.params, x, mb_rng(p, m), dy)
-            dp = jax.tree_util.tree_map(lambda a: a * w, dp)
-            grads[p][s] = dp if grads[p][s] is None else \
-                jax.tree_util.tree_map(jnp.add, grads[p][s], dp)
+            grads[p][s] = _scale_grads(dp, w_arr) \
+                if grads[p][s] is None \
+                else _accum_grads(grads[p][s], dp, w_arr)
             if s > 0:
                 # dx has the shape/spec of THIS stage's input activation;
                 # it lands on the previous stage's submesh

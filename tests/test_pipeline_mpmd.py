@@ -5,6 +5,7 @@ in-flight, hetero stage_layers actually executing, per-pipeline
 micro-batch counts, shared-embedding grad handling.
 """
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
@@ -289,3 +290,21 @@ class TestInterleaved:
         cfg = _cfg()
         with pytest.raises(ValueError, match="unknown schedule"):
             MPMDGPT(cfg, stage_layers=[[8]], schedule="interleave")
+
+    def test_bf16_grad_scale_accum_keeps_dtype(self):
+        """The shared grad scale/accumulate jits must not promote bf16
+        grads to f32 (a strongly-typed f32 scale factor would; MPMDGPT
+        itself keeps f32 master params, but Stage is generic and bf16
+        stages are the natural TPU use)."""
+        from hetu_tpu.parallel.pipeline_mpmd import (_accum_grads,
+                                                     _scale_grads)
+        dp = {"w": jnp.ones((4, 4), jnp.bfloat16),
+              "b": jnp.ones((4,), jnp.float32)}
+        w = jnp.float32(0.25)
+        scaled = _scale_grads(dp, w)
+        assert scaled["w"].dtype == jnp.bfloat16
+        assert scaled["b"].dtype == jnp.float32
+        acc = _accum_grads(scaled, dp, w)
+        assert acc["w"].dtype == jnp.bfloat16
+        assert acc["b"].dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(acc["b"]), 0.5)
