@@ -647,8 +647,8 @@ def parallel_attention(q, k, v, causal=True, softmax_scale=None,
 
     ``cp_impl``: "ring" (KV ring via ppermute + online LSE correction,
     the reference's AttnCommRing) or "ulysses" (all-to-all head scatter;
-    no reference counterpart — TPU-native extension, needs heads
-    divisible by the cp size).
+    no reference counterpart — TPU-native extension; indivisible head
+    counts are zero-padded up to the cp(x tp) multiple).
     """
     g = _graph_of(q, k, v)
     mesh = getattr(g, "mesh", None)

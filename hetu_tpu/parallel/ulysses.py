@@ -96,25 +96,48 @@ def ulysses_attention_sharded(q, k, v, mesh, axis_name: str = "cp",
     """Convenience wrapper for GLOBAL [b, s, h, d] arrays: shard the
     sequence over ``axis_name`` (batch over ``batch_axis``, heads over
     ``head_axis`` — TP + CP compose; the head constraint applies to the
-    per-TP-rank head count) and run :func:`ulysses_attention`."""
+    per-TP-rank head count) and run :func:`ulysses_attention`.
+
+    Head counts that don't divide cp (x tp) are zero-PADDED up to the
+    next multiple and the pad heads sliced off the output — attention is
+    per-head, so pad heads never touch real ones (the ROADMAP GQA
+    head-divisibility relaxation; compute waste is pad/h)."""
     from jax.sharding import PartitionSpec as P
     from .comm import shard_map
 
     def axis_or_none(name):
         return name if (name and name in mesh.axis_names) else None
 
+    h = q.shape[2]
+    for name, x in (("k", k), ("v", v)):
+        if x.shape[2] != h:
+            raise ValueError(
+                f"ulysses needs {name} heads ({x.shape[2]}) equal to q "
+                f"heads ({h}) — repeat GQA kv heads to match q first "
+                f"(the model path does this); padding cannot substitute "
+                f"for repetition")
     bspec = axis_or_none(batch_axis)
     hspec = axis_or_none(head_axis)
+    unit = mesh.shape[axis_name] * (mesh.shape[hspec] if hspec else 1)
+    pad = (-h) % unit
+    if pad:
+        def zpad(x):
+            z = jnp.zeros((*x.shape[:2], pad, x.shape[3]), x.dtype)
+            return jnp.concatenate([x, z], axis=2)
+        q, k, v = zpad(q), zpad(k), zpad(v)
+
     spec = P(bspec, axis_name, hspec, None)
     if segment_ids is None:
         f = shard_map(
             lambda q, k, v: ulysses_attention(
                 q, k, v, axis_name, causal, softmax_scale),
             mesh, (spec, spec, spec), spec)
-        return f(q, k, v)
-    sspec = P(bspec, axis_name)
-    f = shard_map(
-        lambda q, k, v, s: ulysses_attention(
-            q, k, v, axis_name, causal, softmax_scale, segment_ids=s),
-        mesh, (spec, spec, spec, sspec), spec)
-    return f(q, k, v, segment_ids.astype(jnp.int32))
+        out = f(q, k, v)
+    else:
+        sspec = P(bspec, axis_name)
+        f = shard_map(
+            lambda q, k, v, s: ulysses_attention(
+                q, k, v, axis_name, causal, softmax_scale, segment_ids=s),
+            mesh, (spec, spec, spec, sspec), spec)
+        out = f(q, k, v, segment_ids.astype(jnp.int32))
+    return out[:, :, :h] if pad else out

@@ -50,12 +50,29 @@ class TestUlyssesOracle:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
 
-    def test_head_divisibility_error(self, devices8):
+    def test_indivisible_heads_padded(self, devices8):
+        """heads % cp != 0 is handled by zero-padding the head dim up to
+        the next cp multiple (the GQA head-divisibility relaxation) —
+        results still match the dense oracle exactly."""
         mesh = ht.create_mesh({"cp": 4}, devices8[:4])
         q, k, v = _qkv(h=6)
-        with pytest.raises(Exception, match="divisible|ulysses"):
-            jax.block_until_ready(ulysses_attention_sharded(
-                q, k, v, mesh, batch_axis=None, head_axis=None))
+        out = ulysses_attention_sharded(q, k, v, mesh, causal=True,
+                                        batch_axis=None, head_axis=None)
+        ref = sdpa_reference(q, k, v, causal=True)
+        assert out.shape == q.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_indivisible_heads_padded_with_tp(self, devices8):
+        """Padding accounts for the tp head split too (per-TP-rank head
+        count must divide cp)."""
+        mesh = ht.create_mesh({"cp": 2, "tp": 2}, devices8[:4])
+        q, k, v = _qkv(h=6)
+        out = ulysses_attention_sharded(q, k, v, mesh, causal=True,
+                                        batch_axis=None)
+        ref = sdpa_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
 
     def test_gqa_kv_head_error(self, devices8):
         """Un-repeated GQA kv heads (kv_heads % cp != 0) must raise the
