@@ -136,6 +136,8 @@ class StrategyModel:
         self.pp_candidates = list(pp_candidates) if pp_candidates else None
         self.layer_comm_cost = layer_comm_cost
         self.pipeline_p2p_cost = pipeline_p2p_cost
+        # per-(stage times) layer-partition memo; reset per _solve_one
+        self._pipe_cache: Dict[Tuple, Tuple] = {}
 
     @classmethod
     def from_calibration(cls, calibration, num_devices: int,
@@ -218,37 +220,112 @@ class StrategyModel:
         return self._step_time(strat.micro_batches, pipe_tmax, pp,
                                sum(strat.micro_batches))
 
+    def _solve_pipe(self, pipe: Sequence[int], gtimes: List[float],
+                    tp: int, pp: int) -> Tuple[List[int], float]:
+        """Layer partition + bottleneck time of ONE pipeline (cached per
+        sorted group-times tuple: swaps re-solve only touched pipelines,
+        and permutations of the same groups share an entry)."""
+        per_layer = self._per_layer_cost(tp)
+        stimes = tuple(gtimes[g] * per_layer for g in pipe[:pp])
+        hit = self._pipe_cache.get(stimes)
+        if hit is None:
+            hit = _partition_layers(self.num_layers, list(stimes))
+            self._pipe_cache[stimes] = hit
+        return hit
+
+    def _finish_eval(self, stage_layers, pipe_tmax, pp: int, dp: int):
+        total_mb = self.M * dp
+        mb = _apportion(total_mb, [1.0 / t for t in pipe_tmax]) \
+            if dp > 1 else [total_mb]
+        step = self._step_time(mb, pipe_tmax, pp, total_mb)
+        return stage_layers, pipe_tmax, mb, float(step)
+
+    def _eval_assignment(self, pipelines: List[List[int]],
+                         gtimes: List[float], tp: int, pp: int, dp: int):
+        """(stage_layers, pipe_tmax, mb, step) of one group->pipeline
+        assignment: per-pipeline layer partition (slower stages get fewer
+        layers) + Malleus micro-batch apportionment."""
+        solved = [self._solve_pipe(p, gtimes, tp, pp) for p in pipelines]
+        return self._finish_eval([s[0] for s in solved],
+                                 [s[1] for s in solved], pp, dp)
+
     def _solve_one(self, tp: int, pp: int, dp: int,
                    groups: List[List[int]], gtimes: List[float]
                    ) -> Optional[Strategy]:
         if pp > self.num_layers:
             return None
-        # assign TP groups to pipelines: round-robin over sorted groups so
-        # every pipeline gets a mix (reference enumerate_pp_pattern searches
-        # patterns; round-robin is its balanced pattern)
+        # Assign TP groups to pipelines: the reference ENUMERATES pp
+        # patterns and solves arrangements (enumerate_pp_pattern,
+        # strategy.py:562).  Equivalent search here: three seed patterns
+        # over the speed-sorted groups —
+        #   round-robin: every pipeline gets a speed mix,
+        #   blocked:     stragglers quarantined into one slow pipeline
+        #                (which then receives few micro-batches),
+        #   snake:       boustrophedon balance of group sums —
+        # each refined by pairwise-swap local search under the TRUE step
+        # objective (layer partition + apportionment re-solved per move).
         order = sorted(range(len(groups)), key=lambda g: gtimes[g])
-        pipelines = [[] for _ in range(dp)]
-        for i, g in enumerate(order):
-            pipelines[i % dp].append(g)
-        # per-layer compute cost at this tp per unit of data:
-        # 1/tp compute + ICI-collective overhead growing with tp
-        per_layer = self._per_layer_cost(tp)
-        stage_layers: List[List[int]] = []
-        pipe_tmax: List[float] = []
-        for pipe in pipelines:
-            stage_groups = pipe[:pp]
-            # slower groups get fewer layers
-            stimes = [gtimes[g] * per_layer for g in stage_groups]
-            layers, tmax = _partition_layers(self.num_layers, stimes)
-            stage_layers.append(layers)
-            pipe_tmax.append(tmax)
-        # Micro-batch apportionment (Malleus per-dp micro-batch counts):
-        # M*dp uniform-size micro-batch tasks split ∝ pipeline speed; with
-        # 1F1B, pipeline p finishes in (m_p + pp - 1) * tmax_p * task_size.
-        total_mb = self.M * dp
-        mb = _apportion(total_mb, [1.0 / t for t in pipe_tmax]) \
-            if dp > 1 else [total_mb]
-        step = self._step_time(mb, pipe_tmax, pp, total_mb)
+
+        def rr():
+            ps = [[] for _ in range(dp)]
+            for i, g in enumerate(order):
+                ps[i % dp].append(g)
+            return ps
+
+        def blocked():
+            return [order[p * pp:(p + 1) * pp] for p in range(dp)]
+
+        def snake():
+            ps = [[] for _ in range(dp)]
+            for i, g in enumerate(order):
+                row, col = divmod(i, dp)
+                ps[col if row % 2 == 0 else dp - 1 - col].append(g)
+            return ps
+
+        self._pipe_cache: Dict[Tuple, Tuple] = {}
+        best = None
+        # evaluation budget: the swap search is a refinement, not an
+        # exhaustive enumeration — on big pods the seeds alone already
+        # capture the quarantine-vs-mix tradeoff
+        budget = 500
+        for seed in (rr, blocked, snake):
+            pipelines = seed()
+            sl, tmax, mb, step = self._eval_assignment(
+                pipelines, gtimes, tp, pp, dp)
+            improved, rounds = True, 0
+            while improved and rounds < 20 and budget > 0:
+                improved = False
+                rounds += 1
+                for p1 in range(dp):
+                    for p2 in range(p1 + 1, dp):
+                        for i1 in range(pp):
+                            for i2 in range(pp):
+                                a, b = pipelines[p1][i1], pipelines[p2][i2]
+                                if gtimes[a] == gtimes[b]:
+                                    continue  # no-op move
+                                if budget <= 0:
+                                    break
+                                budget -= 1
+                                pipelines[p1][i1], pipelines[p2][i2] = b, a
+                                # only the two touched pipelines re-solve
+                                r1 = self._solve_pipe(pipelines[p1],
+                                                      gtimes, tp, pp)
+                                r2 = self._solve_pipe(pipelines[p2],
+                                                      gtimes, tp, pp)
+                                sl2 = list(sl)
+                                tm2 = list(tmax)
+                                sl2[p1], tm2[p1] = r1
+                                sl2[p2], tm2[p2] = r2
+                                s2 = self._finish_eval(sl2, tm2, pp, dp)
+                                if s2[3] < step - 1e-12:
+                                    sl, tmax, mb, step = s2
+                                    improved = True
+                                else:
+                                    pipelines[p1][i1], \
+                                        pipelines[p2][i2] = a, b
+            if best is None or step < best[4]:
+                best = ([list(p) for p in pipelines], sl, tmax, mb, step)
+        pipelines, stage_layers, pipe_tmax, mb, step = best
         # device order: pipeline-major, stage-major, tp-minor — mesh axes
         # (pp, dp, tp) expect stage-outermost ordering
         device_order: List[int] = []

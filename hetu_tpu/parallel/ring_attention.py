@@ -535,8 +535,11 @@ def profile_ring_breakdown(q, k, v, mesh, axis_name: str = "cp",
     def fetch(out):
         # block_until_ready can be a no-op under remote-relay PJRT
         # backends (bench.py:47): force a real host fetch of one element
+        # (plain first-element slice — ravel would gather the whole
+        # sharded array and pollute the timing)
         jax.block_until_ready(out)
-        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        np.asarray(leaf[(0,) * leaf.ndim])
 
     def timed(fn, args):
         fetch(fn(*args))                     # compile + warm

@@ -277,3 +277,30 @@ def test_trainer_run_with_profile_interval(devices8):
     losses = trainer.run(6, profile_interval=3)
     assert len(losses) == 6
     assert losses[-1] < losses[0]
+
+
+def test_assignment_search_beats_or_matches_round_robin():
+    """The pattern-enumeration + swap search must never be worse than the
+    plain round-robin assignment it replaced, and on a quarantine-shaped
+    straggler pattern (one very slow device) it should strictly beat it —
+    the reference's enumerate_pp_pattern motivation (strategy.py:562)."""
+    m = StrategyModel(num_devices=8, num_layers=8, num_micro_batches=8,
+                      tp_candidates=[1], pp_candidates=[2])
+    # two stragglers of DIFFERENT severity: round-robin spreads them into
+    # two pipelines (both slowed); quarantining them into one pipeline
+    # that then receives few micro-batches is strictly better
+    ratios = [1.0] * 6 + [2.0, 4.0]     # tp=1 pp=2 dp=4
+    (plan,) = m.make_plans(ratios, top_k=1)
+
+    # hand-computed round-robin baseline through the same evaluator
+    groups, gtimes = m.solve_tp_arrangements(ratios, 1)
+    order = sorted(range(len(groups)), key=lambda g: gtimes[g])
+    rr = [[] for _ in range(4)]
+    for i, g in enumerate(order):
+        rr[i % 4].append(g)
+    _, _, _, rr_step = m._eval_assignment(rr, gtimes, tp=1, pp=2, dp=4)
+    assert plan.est_step_time <= rr_step + 1e-9
+    # quarantining the slow device into one pipeline (which then gets
+    # fewer micro-batches) must beat mixing it into a fast pipeline
+    assert plan.est_step_time < rr_step - 1e-6
+    assert min(plan.micro_batches) < max(plan.micro_batches)
