@@ -222,9 +222,10 @@ class StrategyModel:
 
     def _solve_pipe(self, pipe: Sequence[int], gtimes: List[float],
                     tp: int, pp: int) -> Tuple[List[int], float]:
-        """Layer partition + bottleneck time of ONE pipeline (cached per
-        sorted group-times tuple: swaps re-solve only touched pipelines,
-        and permutations of the same groups share an entry)."""
+        """Layer partition + bottleneck time of ONE pipeline, memoized by
+        the STAGE-ORDERED group-times tuple (order matters: the returned
+        stage_layers align with stages) — swaps re-solve only the two
+        touched pipelines."""
         per_layer = self._per_layer_cost(tp)
         stimes = tuple(gtimes[g] * per_layer for g in pipe[:pp])
         hit = self._pipe_cache.get(stimes)

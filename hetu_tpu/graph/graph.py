@@ -499,7 +499,32 @@ class DefineAndRunGraph(Graph):
 
     # -- plan construction ---------------------------------------------------
 
+    @staticmethod
+    def _leaf_dims(dim):
+        from .tensor import DerivedDim
+        out = []
+        stack = [dim]
+        while stack:
+            d = stack.pop()
+            if isinstance(d, DerivedDim):
+                stack.extend(p for p in d._parents
+                             if isinstance(p, SymbolicDim))
+            elif isinstance(d, SymbolicDim):
+                out.append(d)
+        return out
+
     def _bind_symbolic_dims(self, feed_dict: Dict[Tensor, Any]) -> None:
+        from .tensor import DerivedDim
+        # two passes: leaf symbols bind from feeds first, then DERIVED
+        # dims (IntSymbol arithmetic, e.g. seq // cp) are CHECKED against
+        # their computed value — a mismatched feed must raise, not
+        # silently override the expression.  The check only fires when
+        # every leaf was bound by THIS feed pass (stale advisory
+        # bindings from make_op's provisional set(16) must not reject
+        # valid feeds) and shape buckets are off (independent padding
+        # legitimately breaks arithmetic relations between dims).
+        derived = []
+        fresh: set = set()
         for t, v in feed_dict.items():
             v_shape = np.shape(v)
             if len(v_shape) != len(t.shape):
@@ -507,12 +532,37 @@ class DefineAndRunGraph(Graph):
                     f"feed for {t.name} has rank {len(v_shape)}, "
                     f"expected {len(t.shape)} ({t.shape})")
             for dim, d in zip(t.shape, v_shape):
-                if isinstance(dim, SymbolicDim):
+                if isinstance(dim, DerivedDim):
+                    derived.append((t, dim, d))
+                elif isinstance(dim, SymbolicDim):
                     dim.set(d)
+                    fresh.add(id(dim))
                 elif int(dim) != d:
                     raise ValueError(
                         f"feed for {t.name} has shape {v_shape}, "
                         f"expected {t.shape}")
+        for _, dim, _ in derived:
+            dim.clear_override()
+        seen: Dict[int, int] = {}
+        for t, dim, d in derived:
+            prev = seen.get(id(dim))
+            if prev is not None and prev != d:
+                raise ValueError(
+                    f"conflicting feeds for derived dim {dim.name}: "
+                    f"{prev} vs {d} (tensor {t.name})")
+            seen[id(dim)] = d
+            enforce = (self._shape_buckets is None
+                       and all(id(l) in fresh
+                               for l in self._leaf_dims(dim))
+                       and dim.is_bound)
+            if enforce:
+                if dim.get() != d:
+                    raise ValueError(
+                        f"feed for {t.name} gives derived dim {dim.name} "
+                        f"= {d}, but its expression evaluates to "
+                        f"{dim.get()}")
+            else:
+                dim.set(d)  # provisional (unbound leaves / bucketing)
 
     def _plan_key(self, fetches, feed_dict, num_micro_batches, run_level,
                   update_node):

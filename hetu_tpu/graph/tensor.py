@@ -32,6 +32,11 @@ class SymbolicDim:
 
     Carries an optional current binding so eager execution works; under
     define-and-run the binding comes from the feed shapes at run time.
+
+    Arithmetic composes symbols into a lazily-evaluated DAG (the
+    reference's IntSymbol operator overloads): ``seq // cp * heads``
+    yields a :class:`DerivedDim` that re-evaluates from its parents at
+    every ``get()`` — rebinding a leaf propagates to every derived dim.
     """
 
     __slots__ = ("name", "_value")
@@ -54,6 +59,95 @@ class SymbolicDim:
 
     def __repr__(self) -> str:
         return f"Sym({self.name}={self._value})"
+
+    # -- IntSymbol arithmetic DAG (core/symbol.h operator overloads) -------
+
+    def _derive(self, op: str, fn, other, swapped: bool = False):
+        if not isinstance(other, (int, SymbolicDim)):
+            return NotImplemented
+        a, b = (other, self) if swapped else (self, other)
+        return DerivedDim(op, fn, (a, b))
+
+    def __add__(self, o):
+        return self._derive("+", lambda a, b: a + b, o)
+
+    def __radd__(self, o):
+        return self._derive("+", lambda a, b: a + b, o, swapped=True)
+
+    def __sub__(self, o):
+        return self._derive("-", lambda a, b: a - b, o)
+
+    def __rsub__(self, o):
+        return self._derive("-", lambda a, b: a - b, o, swapped=True)
+
+    def __mul__(self, o):
+        return self._derive("*", lambda a, b: a * b, o)
+
+    def __rmul__(self, o):
+        return self._derive("*", lambda a, b: a * b, o, swapped=True)
+
+    def __floordiv__(self, o):
+        return self._derive("//", lambda a, b: a // b, o)
+
+    def __rfloordiv__(self, o):
+        return self._derive("//", lambda a, b: a // b, o, swapped=True)
+
+    def __mod__(self, o):
+        return self._derive("%", lambda a, b: a % b, o)
+
+    def __rmod__(self, o):
+        return self._derive("%", lambda a, b: a % b, o, swapped=True)
+
+
+class DerivedDim(SymbolicDim):
+    """A dim computed from other dims (the IntSymbol expression DAG).
+
+    ``get()`` evaluates from the parents every time, so rebinding a leaf
+    symbol is visible everywhere; an explicit ``set()`` installs a
+    provisional override (the shape-bucket pools bind unbound dims
+    provisionally, graph.py) which the next ``set``/parent rebinding via
+    ``clear_override`` controls.
+    """
+
+    __slots__ = ("_op", "_fn", "_parents")
+
+    def __init__(self, op: str, fn, parents):
+        names = [p.name if isinstance(p, SymbolicDim) else str(p)
+                 for p in parents]
+        super().__init__(f"({names[0]}{op}{names[1]})", None)
+        self._op = op
+        self._fn = fn
+        self._parents = tuple(parents)
+
+    @staticmethod
+    def _val(p) -> Optional[int]:
+        if isinstance(p, SymbolicDim):
+            return p.get() if p.is_bound else None
+        return int(p)
+
+    def get(self) -> int:
+        if self._value is not None:       # provisional override
+            return self._value
+        vals = [self._val(p) for p in self._parents]
+        if any(v is None for v in vals):
+            raise ValueError(f"symbolic dim {self.name!r} is unbound "
+                             f"(parent unbound)")
+        return int(self._fn(*vals))
+
+    @property
+    def is_bound(self) -> bool:
+        if self._value is not None:
+            return True
+        return all(self._val(p) is not None for p in self._parents)
+
+    def clear_override(self) -> None:
+        self._value = None
+
+    def __repr__(self) -> str:
+        try:
+            return f"Sym({self.name}={self.get()})"
+        except ValueError:
+            return f"Sym({self.name}=?)"
 
 
 DimLike = Union[int, SymbolicDim]

@@ -100,6 +100,103 @@ class TestDefineAndRun:
             with pytest.raises(ValueError, match="expected"):
                 g.run([out], feed_dict={x: np.ones((8, 5), np.float32)})
 
+    def test_symbolic_dim_arithmetic_dag(self):
+        """IntSymbol-style arithmetic (reference core/symbol.h operator
+        overloads): symbols compose into a lazily-evaluated DAG that
+        tracks rebinding of its leaves."""
+        seq = ht.SymbolicDim("seq")
+        cp = ht.SymbolicDim("cp", 4)
+        local = seq // cp
+        doubled = 2 * local + 1
+        assert not local.is_bound and not doubled.is_bound
+        seq.set(256)
+        assert local.get() == 64
+        assert doubled.get() == 129
+        seq.set(512)                       # leaf rebinding propagates
+        assert local.get() == 128 and doubled.get() == 257
+        assert (seq % 3).get() == 2
+        assert (seq - 12).get() == 500
+        # provisional override (graph.py binds unbound dims this way)
+        e = ht.SymbolicDim("x") + 1
+        assert not e.is_bound
+        e.set(16)
+        assert e.get() == 16 and e.is_bound
+        e.clear_override()
+        assert not e.is_bound
+        assert "seq//cp" in local.name
+
+    def test_symbolic_derived_in_placeholder_shape(self):
+        """A derived dim works as a placeholder dim: binding the leaf
+        from the feed shape sizes every dependent dimension."""
+        seq = ht.SymbolicDim("seq")
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (2, seq, 4), name="x")
+            y = ht.placeholder("float32", (2, seq // 2, 4), name="y")
+            out = ops.concat([x, y], axis=1)
+            for s in (4, 8):
+                X = np.ones((2, s, 4), np.float32)
+                Y = np.ones((2, s // 2, 4), np.float32)
+                (val,) = g.run([out], feed_dict={x: X, y: Y})
+                assert np.asarray(val).shape == (2, s + s // 2, 4)
+
+    def test_symbolic_derived_feed_mismatch_raises(self):
+        """A feed inconsistent with a derived dim's expression must raise
+        rather than silently overriding the arithmetic."""
+        seq = ht.SymbolicDim("seq")
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (2, seq, 4), name="x")
+            y = ht.placeholder("float32", (2, seq // 2, 4), name="y")
+            out = ops.concat([x, y], axis=1)
+            X = np.ones((2, 8, 4), np.float32)
+            bad = np.ones((2, 3, 4), np.float32)      # seq//2 == 4, not 3
+            with pytest.raises(ValueError, match="derived dim"):
+                g.run([out], feed_dict={x: X, y: bad})
+
+    def test_symbolic_derived_leaf_not_fed(self):
+        """Feeding only the derived-dim placeholder (its leaf bound by
+        nothing but make_op's advisory 16) must work — the consistency
+        check only fires when the leaves were bound by THIS feed pass."""
+        seq = ht.SymbolicDim("seq")
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (2, seq, 4), name="x")
+            y = ht.placeholder("float32", (2, seq // 2, 4), name="y")
+            _ = ops.concat([x, y], axis=1)
+            ysum = ops.reduce_sum(y)
+            (val,) = g.run([ysum], feed_dict={y: np.ones((2, 4, 4),
+                                                         np.float32)})
+            assert float(np.asarray(val)) == 32.0
+
+    def test_symbolic_derived_with_shape_buckets(self):
+        """Independent bucket padding legitimately breaks dim arithmetic
+        (x pads 10->12 while y pads 5->8): derived dims fall back to
+        provisional bindings instead of rejecting the feed."""
+        seq = ht.SymbolicDim("seq")
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (2, seq, 4), name="x")
+            y = ht.placeholder("float32", (2, seq // 2, 4), name="y")
+            xs = ops.reduce_sum(x)
+            ys = ops.reduce_sum(y)
+            g.set_shape_buckets(4)
+            X = np.ones((2, 10, 4), np.float32)
+            Y = np.ones((2, 5, 4), np.float32)
+            xv, yv = g.run([xs, ys], feed_dict={x: X, y: Y})
+            # pads are zero so the sums see only real elements
+            assert float(np.asarray(xv)) == 80.0
+            assert float(np.asarray(yv)) == 40.0
+
+    def test_symbolic_derived_conflicting_feeds_raise(self):
+        """Two placeholders sharing an unbound derived dim must agree —
+        last-feed-wins silent override is exactly what the check bans."""
+        seq = ht.SymbolicDim("seq")
+        half = seq // 2
+        with ht.graph("define_and_run", create_new=True) as g:
+            a = ht.placeholder("float32", (half, 4), name="a")
+            b = ht.placeholder("float32", (half, 4), name="b")
+            out = ops.add(a, b)
+            with pytest.raises(ValueError, match="conflicting feeds"):
+                g.run([out], feed_dict={a: np.ones((3, 4), np.float32),
+                                        b: np.ones((5, 4), np.float32)})
+
     def test_symbolic_seq_len(self):
         """Symbolic dims bound from feeds (reference IntSymbol shape plans)."""
         sym = ht.SymbolicDim("seq")
