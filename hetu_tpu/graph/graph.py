@@ -513,6 +513,22 @@ class DefineAndRunGraph(Graph):
                 out.append(d)
         return out
 
+    @staticmethod
+    def _derived_nodes(dim):
+        """Every DerivedDim on the expression DAG rooted at ``dim``
+        (including itself) — overrides must clear along the WHOLE path,
+        or a nested dim evaluates through a stale intermediate."""
+        from .tensor import DerivedDim
+        out = []
+        stack = [dim]
+        while stack:
+            d = stack.pop()
+            if isinstance(d, DerivedDim):
+                out.append(d)
+                stack.extend(p for p in d._parents
+                             if isinstance(p, SymbolicDim))
+        return out
+
     def _bind_symbolic_dims(self, feed_dict: Dict[Tensor, Any]) -> None:
         from .tensor import DerivedDim
         # two passes: leaf symbols bind from feeds first, then DERIVED
@@ -542,7 +558,8 @@ class DefineAndRunGraph(Graph):
                         f"feed for {t.name} has shape {v_shape}, "
                         f"expected {t.shape}")
         for _, dim, _ in derived:
-            dim.clear_override()
+            for node in self._derived_nodes(dim):
+                node.clear_override()
         seen: Dict[int, int] = {}
         for t, dim, d in derived:
             prev = seen.get(id(dim))

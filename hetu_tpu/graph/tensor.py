@@ -109,13 +109,12 @@ class DerivedDim(SymbolicDim):
     ``clear_override`` controls.
     """
 
-    __slots__ = ("_op", "_fn", "_parents")
+    __slots__ = ("_fn", "_parents")
 
     def __init__(self, op: str, fn, parents):
         names = [p.name if isinstance(p, SymbolicDim) else str(p)
                  for p in parents]
         super().__init__(f"({names[0]}{op}{names[1]})", None)
-        self._op = op
         self._fn = fn
         self._parents = tuple(parents)
 

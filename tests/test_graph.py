@@ -184,6 +184,25 @@ class TestDefineAndRun:
             assert float(np.asarray(xv)) == 80.0
             assert float(np.asarray(yv)) == 40.0
 
+    def test_symbolic_nested_derived_stale_intermediate(self):
+        """A nested derived dim must evaluate through FRESH intermediate
+        values: make_op's advisory binding on the intermediate (here
+        half=16 while seq is unbound) must not poison a later consistent
+        feed of (seq, quarter)."""
+        seq = ht.SymbolicDim("seq")
+        half = seq // 2
+        quarter = half // 2
+        with ht.graph("define_and_run", create_new=True) as g:
+            x = ht.placeholder("float32", (2, seq, 4), name="x")
+            y = ht.placeholder("float32", (2, half, 4), name="y")
+            z = ht.placeholder("float32", (2, quarter, 4), name="z")
+            _ = ops.reduce_sum(y)       # make_op advisory-binds half
+            out = ops.concat([x, z], axis=1)
+            X = np.ones((2, 64, 4), np.float32)
+            Z = np.ones((2, 16, 4), np.float32)   # 64//2//2 == 16: valid
+            (val,) = g.run([out], feed_dict={x: X, z: Z})
+            assert np.asarray(val).shape == (2, 80, 4)
+
     def test_symbolic_derived_conflicting_feeds_raise(self):
         """Two placeholders sharing an unbound derived dim must agree —
         last-feed-wins silent override is exactly what the check bans."""
