@@ -192,69 +192,25 @@ def _moe_mlp(cfg: GPTConfig, p: _Params, i: int, x):
 
 
 def _moe_block_size(n_assign: int, num_experts: int) -> int:
-    """Group-GEMM block: large enough to keep the MXU busy, small enough
-    that per-expert padding (< E blocks of waste) stays a minor fraction
-    of the T*k real assignments."""
-    for cand in (512, 256, 128, 64, 32, 16, 8):
-        if n_assign >= num_experts * cand:
-            return cand
-    return 8
+    """Back-compat alias of ops.moe_dispatch.pick_block_size (the FLOPs
+    bound test reads it here)."""
+    from ..ops.moe_dispatch import pick_block_size
+    return pick_block_size(n_assign, num_experts)
 
 
 def _moe_mlp_dispatched(cfg: GPTConfig, x, wg, w1, b1, w2, b2):
-    """Capacity-FREE dispatched MoE for prefill: blocked group-GEMM.
-
-    Assignments (token, expert) are sorted by expert and each expert's
-    group padded to a block multiple, so every [B, d] token block
-    multiplies exactly ONE expert's weights — three einsums over
-    ``G = ceil(sum padded / B)`` blocks.  FLOPs = N_pad * (2df + 2fd)
-    with ``N_pad <= T*k + E*(B-1)``, i.e. ~k/E of the dense all-experts
-    path, with NO dropped tokens (exact equivalence — the test asserts
-    it).  The sort/offset arithmetic is static-shape throughout (runs
-    under jit); the reference reaches the same dataflow with
-    layout_transform + AllToAll ops (v1 moe_layer.py:45)."""
+    """Capacity-FREE dispatched MoE for prefill (blocked group-GEMM,
+    ops/moe_dispatch.py): FLOPs ~k/E of the dense all-experts path with
+    NO dropped tokens — exact equivalence, asserted in tests.  The
+    reference reaches the same dataflow with layout_transform + AllToAll
+    ops (v1 moe_layer.py:45) but drops over-capacity tokens."""
+    from ..ops.moe_dispatch import blocked_group_gemm
     b, s, d = x.shape
-    E = wg.shape[0]
-    k = cfg.moe_top_k
-    T = b * s
-    xt = x.reshape(T, d)
     gates, topv, topi = _moe_route(cfg, wg, x)
-    e_flat = topi.reshape(-1)                        # [T*k] expert ids
-    t_flat = jnp.repeat(jnp.arange(T, dtype=jnp.int32), k)
-    w_flat = topv.reshape(-1)                        # fp32 gate weights
-    n = T * k
-    B = _moe_block_size(n, E)
-    # stable sort by expert keeps token order inside each group
-    order = jnp.argsort(e_flat, stable=True)
-    e_sorted = e_flat[order]
-    t_sorted = t_flat[order]
-    w_sorted = w_flat[order]
-    counts = jnp.bincount(e_flat, length=E)          # [E] tokens/expert
-    padded = ((counts + B - 1) // B) * B
-    src_off = jnp.cumsum(counts) - counts            # group starts, sorted
-    dst_off = jnp.cumsum(padded) - padded            # block-aligned starts
-    pos_in_e = jnp.arange(n, dtype=jnp.int32) - src_off[e_sorted]
-    dst = (dst_off[e_sorted] + pos_in_e).astype(jnp.int32)
-    n_pad = ((n + E * (B - 1)) // B + 1) * B         # static upper bound
-    slot_tok = jnp.full((n_pad,), -1, jnp.int32).at[dst].set(t_sorted)
-    slot_w = jnp.zeros((n_pad,), jnp.float32).at[dst].set(w_sorted)
-    G = n_pad // B
-    # each block lies inside one expert's padded region: its expert is
-    # the first e whose region end exceeds the block start
-    blk_start = jnp.arange(G, dtype=jnp.int32) * B
-    blk_e = jnp.clip(jnp.searchsorted(jnp.cumsum(padded), blk_start,
-                                      side="right"), 0, E - 1)
-    live = slot_tok >= 0
-    xg = jnp.where(live[:, None], xt[jnp.clip(slot_tok, 0)], 0.0)
-    xg = xg.reshape(G, B, d)
-    act = _moe_act(cfg)
-    h = act(jnp.einsum("gbd,gdf->gbf", xg, w1[blk_e]) + b1[blk_e])
-    y = jnp.einsum("gbf,gfd->gbd", h, w2[blk_e]) + b2[blk_e]
-    y = y.reshape(n_pad, d).astype(jnp.float32) * slot_w[:, None]
-    out = jnp.zeros((T, d), jnp.float32).at[jnp.clip(slot_tok, 0)].add(
-        jnp.where(live[:, None], y, 0.0))
+    out = blocked_group_gemm(
+        x.reshape(b * s, d), topi.reshape(b * s, -1),
+        topv.reshape(b * s, -1), w1, b1, w2, b2, _moe_act(cfg))
     return out.reshape(b, s, d).astype(x.dtype)
-
 
 def _forward(cfg: GPTConfig, p: _Params, ids, caches, pos, cos, sin):
     """Stack forward for ``ids`` [b, s_new] at absolute position ``pos``;
