@@ -397,6 +397,95 @@ def bench_mpmd_dispatch_overhead() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def bench_comm_microbench() -> dict:
+    """Gradient-sync comm microbench (ISSUE: coalesced + quantized
+    collectives): collective-call count, analytic bytes-on-wire, and
+    step wall time for fp32/bf16/int8 x per-tensor/bucketed on the
+    virtual 8-device mesh.
+
+    Calls/bytes come from trace-time accounting (``comm.comm_stats`` —
+    1:1 with the collectives in the traced program), so they are valid
+    off-hardware; wall time on the shared-core CPU mesh is only a
+    dispatch-cost sanity signal.  On TPU the same schema is recaptured
+    on hardware and lands in the BENCH_CACHE.json evidence trail
+    (cached-TPU slot).  JAX_PLATFORMS=cpu subprocess — never touches
+    the default backend."""
+    code = (
+        "import os, sys, json, time\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from hetu_tpu.parallel import comm, create_mesh\n"
+        "mesh = create_mesh({'dp': 8}, jax.devices()[:8])\n"
+        # GPT-2-small-shaped gradient set scaled to d=128: 12 layers x\n
+        # (qkv, proj, fc1, fc2 + 4 vecs) + tied head = 98 tensors, ~10MB
+        "d = 128\n"
+        "shapes = []\n"
+        "for _ in range(12):\n"
+        "    shapes += [(d, 3 * d), (d, d), (d, 4 * d), (4 * d, d),\n"
+        "               (3 * d,), (d,), (4 * d,), (d,)]\n"
+        "shapes += [(1024, d), (256, d)]\n"
+        "rng = np.random.RandomState(0)\n"
+        "grads = [rng.randn(*s).astype(np.float32) for s in shapes]\n"
+        "reps = tuple(P() for _ in grads)\n"
+        "def per_tensor(*vals):\n"
+        "    return tuple(comm.all_reduce(v, 'dp') for v in vals)\n"
+        "def bucketed(transport):\n"
+        "    def f(*vals):\n"
+        "        out = comm.all_reduce_coalesced(\n"
+        "            {i: v for i, v in enumerate(vals)}, 'dp',\n"
+        "            bucket_mb=4.0, transport=transport)\n"
+        "        return tuple(out[i] for i in range(len(vals)))\n"
+        "    return f\n"
+        "def measure(fn):\n"
+        "    jf = jax.jit(comm.shard_map(fn, mesh, reps, reps))\n"
+        "    with comm.comm_stats() as s:\n"
+        "        jf.lower(*grads)\n"
+        "    out = jf(*grads)\n"
+        "    jax.block_until_ready(out)\n"
+        "    t0 = time.perf_counter()\n"
+        "    for _ in range(5):\n"
+        "        out = jf(*grads)\n"
+        "    jax.block_until_ready(out)\n"
+        "    dt = (time.perf_counter() - t0) / 5\n"
+        "    return {'collective_calls': s.num_collectives,\n"
+        "            'wire_mb_per_rank': round(s.total_wire_bytes / 2**20,\n"
+        "                                      3),\n"
+        "            'step_time_ms': round(dt * 1e3, 2)}\n"
+        "res = {'grad_tensors': len(shapes),\n"
+        "       'grad_mb': round(sum(g.nbytes for g in grads) / 2**20, 2),\n"
+        "       'per_tensor_fp32': measure(per_tensor)}\n"
+        "for tr in ('fp32', 'bf16', 'int8'):\n"
+        "    res['bucketed_' + tr] = measure(bucketed(tr))\n"
+        "pt = res['per_tensor_fp32']\n"
+        "q = res['bucketed_int8']\n"
+        "res['calls_ratio_per_tensor_vs_int8'] = round(\n"
+        "    pt['collective_calls'] / q['collective_calls'], 2)\n"
+        "res['wire_ratio_per_tensor_vs_int8'] = round(\n"
+        "    pt['wire_mb_per_rank'] / q['wire_mb_per_rank'], 2)\n"
+        "print(json.dumps(res))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    import re
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True, timeout=1200)
+        lines = proc.stdout.strip().splitlines()
+        if not lines:
+            return {"error": f"rc={proc.returncode}: "
+                             f"{proc.stderr.strip()[-400:]}"}
+        return json.loads(lines[-1])
+    except Exception as e:  # never fail the headline bench on this
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _probe_backend(timeout_s: float = 180.0) -> str:
     """Probe the default backend in a SUBPROCESS with a timeout: a wedged
     TPU runtime hangs on init (round-3 postmortem: BENCH_r03 rc=1 /
@@ -453,6 +542,7 @@ def main():
     bert = bench_bert(on_tpu)
     scaling = bench_scaling_virtual(8)
     mpmd = bench_mpmd_dispatch_overhead()
+    comm_micro = bench_comm_microbench()
 
     mfu = gpt["mfu"]
     result = {
@@ -478,6 +568,7 @@ def main():
             "bert_batch": bert["batch"], "bert_seq": bert["seq"],
             "scaling_virtual8": scaling,
             "mpmd_pp2_dispatch": mpmd,
+            "comm_microbench": comm_micro,
         },
     }
     if on_tpu:

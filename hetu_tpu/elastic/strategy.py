@@ -297,33 +297,46 @@ class StrategyModel:
             while improved and rounds < 20 and budget > 0:
                 improved = False
                 rounds += 1
-                for p1 in range(dp):
-                    for p2 in range(p1 + 1, dp):
-                        for i1 in range(pp):
-                            for i2 in range(pp):
-                                a, b = pipelines[p1][i1], pipelines[p2][i2]
-                                if gtimes[a] == gtimes[b]:
-                                    continue  # no-op move
-                                if budget <= 0:
-                                    break
-                                budget -= 1
-                                pipelines[p1][i1], pipelines[p2][i2] = b, a
-                                # only the two touched pipelines re-solve
-                                r1 = self._solve_pipe(pipelines[p1],
-                                                      gtimes, tp, pp)
-                                r2 = self._solve_pipe(pipelines[p2],
-                                                      gtimes, tp, pp)
-                                sl2 = list(sl)
-                                tm2 = list(tmax)
-                                sl2[p1], tm2[p1] = r1
-                                sl2[p2], tm2[p2] = r2
-                                s2 = self._finish_eval(sl2, tm2, pp, dp)
-                                if s2[3] < step - 1e-12:
-                                    sl, tmax, mb, step = s2
-                                    improved = True
-                                else:
+
+                def scan_swaps():
+                    # returns False as soon as the budget runs dry so the
+                    # whole (p1,p2,i1,i2) scan exits, not just the
+                    # innermost loop
+                    nonlocal sl, tmax, mb, step, improved, budget
+                    for p1 in range(dp):
+                        for p2 in range(p1 + 1, dp):
+                            for i1 in range(pp):
+                                for i2 in range(pp):
+                                    a, b = (pipelines[p1][i1],
+                                            pipelines[p2][i2])
+                                    if gtimes[a] == gtimes[b]:
+                                        continue  # no-op move
+                                    if budget <= 0:
+                                        return False
+                                    budget -= 1
                                     pipelines[p1][i1], \
-                                        pipelines[p2][i2] = a, b
+                                        pipelines[p2][i2] = b, a
+                                    # only the two touched pipelines
+                                    # re-solve
+                                    r1 = self._solve_pipe(pipelines[p1],
+                                                          gtimes, tp, pp)
+                                    r2 = self._solve_pipe(pipelines[p2],
+                                                          gtimes, tp, pp)
+                                    sl2 = list(sl)
+                                    tm2 = list(tmax)
+                                    sl2[p1], tm2[p1] = r1
+                                    sl2[p2], tm2[p2] = r2
+                                    s2 = self._finish_eval(sl2, tm2, pp, dp)
+                                    if s2[3] < step - 1e-12:
+                                        sl, tmax, mb, step = s2
+                                        improved = True
+                                    else:
+                                        pipelines[p1][i1], \
+                                            pipelines[p2][i2] = a, b
+                    return True
+
+                if not scan_swaps():
+                    break
             if best is None or step < best[4]:
                 best = ([list(p) for p in pipelines], sl, tmax, mb, step)
         pipelines, stage_layers, pipe_tmax, mb, step = best

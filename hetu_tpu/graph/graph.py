@@ -91,6 +91,10 @@ class Graph:
         self._rng_tensor: Optional[Tensor] = None
         self._rng_seed = _GRAPH_SEED_STREAM[0].randint(0, 2**31 - 1)
         self._run_counter = 0
+        # axes currently traced in shard_map manual mode (explicit
+        # grad-comm path): pspec sharding constraints referencing manual
+        # axes are illegal inside the region and are skipped there
+        self._manual_axes: Tuple[str, ...] = ()
 
     # -- construction -------------------------------------------------------
 
@@ -292,7 +296,8 @@ class Graph:
                 flat = jax.tree_util.tree_leaves(out)
                 for t, v in zip(node.outputs, flat):
                     spec = self._pspec_for(t)
-                    if spec is not None and self.mesh is not None:
+                    if spec is not None and self.mesh is not None \
+                            and not self._manual_axes:
                         v = jax.lax.with_sharding_constraint(
                             v, NamedSharding(self.mesh, spec))
                     env[t.id] = v
@@ -438,6 +443,13 @@ class DefineAndRunGraph(Graph):
         self._shape_buckets: Optional[List[int]] = None
         self._bucket_pad_values: Dict[int, Any] = {}
         self._memory_profiler = None  # lazy (env-gated) MemoryProfiler
+        # every DerivedDim ever seen in a feed/placeholder shape: stale
+        # provisional overrides are cleared for ALL of them on every bind
+        # pass, not only the ones the current feed_dict mentions
+        self._derived_dims: Dict[int, Any] = {}
+        # explicit grad-comm introspection (set at plan-build time)
+        self._grad_comm_active: bool = False
+        self._grad_comm_fallback: Optional[str] = None
 
     # -- shape-plan bucketing ------------------------------------------------
 
@@ -557,9 +569,18 @@ class DefineAndRunGraph(Graph):
                     raise ValueError(
                         f"feed for {t.name} has shape {v_shape}, "
                         f"expected {t.shape}")
-        for _, dim, _ in derived:
-            for node in self._derived_nodes(dim):
-                node.clear_override()
+        # register derived dims reachable from this feed AND from every
+        # placeholder, then clear provisional overrides on ALL of them: a
+        # stale override from an earlier run (unbound leaves/bucketing)
+        # must not shadow a re-evaluation after this pass rebinds leaves
+        for t in itertools.chain(feed_dict.keys(),
+                                 self._placeholders.values()):
+            for dim in t.shape:
+                if isinstance(dim, DerivedDim):
+                    for node in self._derived_nodes(dim):
+                        self._derived_dims[id(node)] = node
+        for node in self._derived_dims.values():
+            node.clear_override()
         seen: Dict[int, int] = {}
         for t, dim, d in derived:
             prev = seen.get(id(dim))
@@ -621,6 +642,96 @@ class DefineAndRunGraph(Graph):
             out[tid] = v.reshape(n, b // n, *v.shape[1:])
         return out
 
+    def _plan_explicit_grad_comm(self, opt, fetches: List[Tensor],
+                                 feed_tensors: List[Tensor],
+                                 num_micro_batches: int,
+                                 loss_t: Optional[Tensor] = None):
+        """Decide whether the explicit coalesced grad-comm path applies
+        and build its shard_map specs.  Returns (plan, None) or
+        (None, reason).
+
+        The path runs fwd+bwd in shard_map MANUAL mode over the dp axis
+        (so gradients stay local until the optimizer's bucketed
+        collectives sync them).  It requires a pure-dp mesh, ZeRO<=2
+        (params replicated over dp at rest), and every non-scalar fetch
+        annotated with a pspec; anything else falls back to the implicit
+        GSPMD per-tensor sync.
+        """
+        dpa = opt.dp_axis
+        mesh = self.mesh
+        if mesh is None:
+            return None, "no mesh on the graph"
+        if tuple(mesh.axis_names) != (dpa,):
+            return None, (f"mesh axes {tuple(mesh.axis_names)} != "
+                          f"({dpa!r},): explicit path needs a pure-dp mesh")
+        if mesh.shape[dpa] <= 1:
+            return None, "dp axis has size 1 (nothing to sync)"
+        if opt.zero >= 3:
+            return None, "zero-3 (FSDP) keeps params dp-sharded at rest"
+
+        def _refs_dp(spec) -> bool:
+            if spec is None:
+                return False
+            for e in spec:
+                ents = e if isinstance(e, tuple) else (e,)
+                if dpa in ents:
+                    return True
+            return False
+
+        for t in self._var_tensors.values():
+            if _refs_dp(self._pspec_for(t)):
+                return None, f"variable {t.name} is sharded over {dpa!r}"
+        # grad sync uses the data-parallel MEAN convention (torch-DDP
+        # semantics): correct for mean-normalized losses (this repo's
+        # convention), 1/dp-scaled for sum-reduced ones.  Mean-ness is
+        # not structurally decidable for composed losses, so — like
+        # torch DDP — the convention is documented (optimizer docstring,
+        # DESIGN.md §7) and only the unambiguous top-level reduce_sum is
+        # caught here as a best-effort guard.
+        loss_id = loss_t.id if loss_t is not None else None
+        if loss_t is not None and loss_t.producer is not None \
+                and loss_t.producer.op_type == "reduce_sum":
+            return None, (f"loss {loss_t.name} is sum-reduced; the "
+                          f"explicit path's dp-mean grad sync assumes "
+                          f"a mean-normalized loss")
+        fetch_specs = []
+        for t in fetches:
+            if len(t.shape) == 0:
+                # only the loss has known (mean) reduction semantics
+                # under manual dp; pmean of an arbitrary scalar (a sum,
+                # max, count...) would silently change its value
+                if loss_id is not None and t.id != loss_id:
+                    return None, (f"scalar fetch {t.name} is not the "
+                                  f"loss (unknown reduction semantics "
+                                  f"under manual dp)")
+                fetch_specs.append(PartitionSpec())
+            else:
+                spec = self._pspec_for(t)
+                # the spec must actually shard over dp: a replicated
+                # annotation on a dp-dependent value would let each rank
+                # return its own local shard as "the" result
+                if spec is None or not _refs_dp(spec):
+                    return None, (f"non-scalar fetch {t.name} has no "
+                                  f"{dpa!r}-sharded pspec (manual region "
+                                  f"cannot place it)")
+                fetch_specs.append(spec)
+        feed_specs = {}
+        tensors = list(feed_tensors)
+        if self._rng_tensor is not None and \
+                all(t.id != self._rng_tensor.id for t in tensors):
+            tensors.append(self._rng_tensor)
+        M = num_micro_batches
+        for t in tensors:
+            base = self._pspec_for(t) or PartitionSpec()
+            if t.ndim == 0:
+                feed_specs[t.id] = PartitionSpec()  # (M,) replicated stack
+            elif M > 1:
+                feed_specs[t.id] = PartitionSpec(None, *base)
+            else:
+                feed_specs[t.id] = base
+        return {"axis": dpa, "feed_specs": feed_specs,
+                "fetch_specs": fetch_specs}, None
+
     def _build_executable(self, fetches: List[Tensor],
                           feed_tensors: List[Tensor],
                           num_micro_batches: int,
@@ -648,13 +759,34 @@ class DefineAndRunGraph(Graph):
         if scaler is not None and not scaler.enabled:
             scaler = None
 
+        # explicit coalesced/quantized gradient sync (optimizer
+        # grad_comm): the fwd+bwd (incl. the micro-batch scan) runs in a
+        # shard_map manual region over the dp axis, so gradients stay
+        # LOCAL until the optimizer's bucketed collective syncs them —
+        # once per step, not once per micro-batch or per parameter.
+        explicit = None
+        gc_state = (False, None)      # (active, fallback_reason) per plan
+        if update_node is not None:
+            opt_gc = update_node.attrs["optimizer"]
+            if getattr(opt_gc, "grad_comm", None) is not None:
+                if scaler is not None:
+                    why = "dynamic loss scaler active"
+                    explicit = None
+                else:
+                    explicit, why = self._plan_explicit_grad_comm(
+                        opt_gc, fetches, feed_tensors, num_micro_batches,
+                        loss_t=update_node.attrs["grad_node"]
+                        .attrs["loss"])
+                gc_state = (explicit is not None,
+                            None if explicit else why)
+
         def step(var_state, opt_state, grad_accum, feeds_mb):
             scale = opt_state["_scaler"]["scale"] if scaler is not None \
                 else None
 
             # feeds_mb: list of per-micro-batch dicts
-            def fwd_bwd(mb_feeds):
-                env = {**var_state, **mb_feeds}
+            def fwd_bwd(mb_feeds, vstate):
+                env = {**vstate, **mb_feeds}
                 if update_node is not None:
                     grad_node = update_node.attrs["grad_node"]
                     xs = grad_node.attrs["xs"]
@@ -710,35 +842,66 @@ class DefineAndRunGraph(Graph):
 
             if update_node is None:
                 if M == 1:
-                    fetch_vals, _ = fwd_bwd(feeds_mb)
+                    fetch_vals, _ = fwd_bwd(feeds_mb, var_state)
                     return fetch_vals, var_state, opt_state, grad_accum
 
                 def body(carry_fv, mb):
-                    fv, _ = fwd_bwd(mb)
+                    fv, _ = fwd_bwd(mb, var_state)
                     return _merge_fetches(carry_fv, fv), None
 
                 first = jax.tree_util.tree_map(lambda v: v[0], feeds_mb)
-                fv_sds, _ = jax.eval_shape(fwd_bwd, first)
+                fv_sds, _ = jax.eval_shape(fwd_bwd, first, var_state)
                 fetch_vals, _ = lax.scan(body, _zeros_of(fv_sds), feeds_mb)
                 out = [v / M if v.ndim == 0 else v for v in fetch_vals]
                 return out, var_state, opt_state, grad_accum
 
-            # grad accumulation across micro-batches
-            if M == 1:
-                fetch_vals, acc_grads = fwd_bwd(feeds_mb)
-            else:
-                def body(carry, mb):
-                    carry_fv, carry_g = carry
-                    fv, g = fwd_bwd(mb)
-                    new_g = {k: carry_g[k] + g[k] for k in g}
-                    return (_merge_fetches(carry_fv, fv), new_g), None
+            def compute_grads(vstate, fmb):
+                # grad accumulation across micro-batches; returns the
+                # merged fetch values and the 1/M-normalized accumulated
+                # grads (LOCAL grads inside a manual region)
+                if M == 1:
+                    fetch_vals, acc_grads = fwd_bwd(fmb, vstate)
+                else:
+                    def body(carry, mb):
+                        carry_fv, carry_g = carry
+                        fv, g = fwd_bwd(mb, vstate)
+                        new_g = {k: carry_g[k] + g[k] for k in g}
+                        return (_merge_fetches(carry_fv, fv), new_g), None
 
-                first = jax.tree_util.tree_map(lambda v: v[0], feeds_mb)
-                fv_sds, g_sds = jax.eval_shape(fwd_bwd, first)
-                (fetch_vals, acc_grads), _ = lax.scan(
-                    body, (_zeros_of(fv_sds), _zeros_of(g_sds)), feeds_mb)
-            acc_grads = {k: g / M for k, g in acc_grads.items()}
-            fetch_vals = [v / M if v.ndim == 0 else v for v in fetch_vals]
+                    first = jax.tree_util.tree_map(lambda v: v[0], fmb)
+                    fv_sds, g_sds = jax.eval_shape(fwd_bwd, first, vstate)
+                    (fetch_vals, acc_grads), _ = lax.scan(
+                        body, (_zeros_of(fv_sds), _zeros_of(g_sds)), fmb)
+                acc_grads = {k: g / M for k, g in acc_grads.items()}
+                fetch_vals = [v / M if v.ndim == 0 else v
+                              for v in fetch_vals]
+                return fetch_vals, acc_grads
+
+            if explicit is not None:
+                dpa = explicit["axis"]
+                opt_sync = update_node.attrs["optimizer"]
+
+                def grad_phase(vstate, fmb):
+                    graph._manual_axes = (dpa,)
+                    try:
+                        fv, acc = compute_grads(vstate, fmb)
+                        # micro-batch-accumulated grads sync ONCE per
+                        # step through fused (quantized) buckets
+                        acc = opt_sync.sync_gradients(acc, dpa)
+                    finally:
+                        graph._manual_axes = ()
+                    fv = [lax.pmean(v, dpa) if v.ndim == 0 else v
+                          for v in fv]
+                    return fv, acc
+
+                from ..parallel import comm as _comm
+                sync_fn = _comm.shard_map(
+                    grad_phase, graph.mesh,
+                    in_specs=(PartitionSpec(), explicit["feed_specs"]),
+                    out_specs=(explicit["fetch_specs"], PartitionSpec()))
+                fetch_vals, acc_grads = sync_fn(var_state, feeds_mb)
+            else:
+                fetch_vals, acc_grads = compute_grads(var_state, feeds_mb)
 
             # fold in persistent accumulation (RunLevel.GRAD across runs)
             if grad_accum:
@@ -771,7 +934,7 @@ class DefineAndRunGraph(Graph):
             return fetch_vals, new_vars, new_opt, new_accum
 
         jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
-        return jit_step
+        return jit_step, gc_state
 
     # -- hot switch ----------------------------------------------------------
 
@@ -875,7 +1038,10 @@ class DefineAndRunGraph(Graph):
             self._plan_pool[key] = self._build_executable(
                 real_fetches, feed_tensors, num_micro_batches, run_level,
                 update_node)
-        jit_step = self._plan_pool[key]
+        jit_step, gc_state = self._plan_pool[key]
+        # introspection tracks the plan actually EXECUTED this run, not
+        # the last grad-comm-requesting build
+        self._grad_comm_active, self._grad_comm_fallback = gc_state
         self._last_plan = jit_step  # for cost_analysis()
         self._last_plan_key = key
 

@@ -340,6 +340,23 @@ class Experts(Module):
             [dispatched, self.w1, self.b1, self.w2, self.b2])
 
 
+def _dropless_impl(xt, logits, w1, b1, w2, b2, *, k, act_name):
+    """Capacity-free top-k dispatch through the blocked group-GEMM
+    (ops/moe_dispatch.py): no token ever dropped, FLOPs ~k/E of dense."""
+    from ..ops.moe_dispatch import blocked_group_gemm
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+           "silu": jax.nn.silu}[act_name]
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = lax.top_k(gates, k)
+    out = blocked_group_gemm(xt.astype(jnp.float32), topi, topv,
+                             w1, b1, w2, b2, act)
+    l_aux = jnp.zeros((), jnp.float32)
+    for i in range(k):
+        m = jax.nn.one_hot(topi[:, i], gates.shape[-1], dtype=jnp.float32)
+        l_aux = l_aux + _balance_loss(gates, m)
+    return out.astype(xt.dtype), l_aux
+
+
 class MoELayer(Module):
     """Gated mixture-of-experts layer (reference MoELayer,
     moe_layer.py:45).
@@ -353,21 +370,57 @@ class MoELayer(Module):
     With ``ep_axis`` set, the [E, C, d] tensors are sharded over the EP
     mesh axis while x is token-sharded — GSPMD inserts the two all-to-alls
     the reference programs by hand (alltoall_op before/after experts).
+
+    ``dispatch_mode``:
+      - ``"capacity"`` (default) — GShard capacity dispatch above; tokens
+        beyond an expert's capacity are dropped.
+      - ``"dropless"``  — capacity-free blocked group-GEMM
+        (ops/moe_dispatch.py): every (token, expert) assignment computes.
+        Needs a :class:`TopKGate` (uses its logits/k directly) and runs
+        as a local (data-parallel) expert compute — ``ep_axis`` sharding
+        of the blocked groups is not supported.
     """
 
     def __init__(self, gate: Module, experts: Experts,
                  ep_axis: Optional[str] = None,
-                 dp_axis: Optional[str] = "dp"):
+                 dp_axis: Optional[str] = "dp",
+                 dispatch_mode: str = "capacity"):
         super().__init__()
+        if dispatch_mode not in ("capacity", "dropless"):
+            raise ValueError(f"dispatch_mode must be 'capacity' or "
+                             f"'dropless', got {dispatch_mode!r}")
+        if dispatch_mode == "dropless":
+            if not isinstance(gate, TopKGate):
+                raise ValueError("dropless dispatch needs a TopKGate "
+                                 "(top-k ids/weights feed the group-GEMM)")
+            if ep_axis:
+                raise ValueError("dropless dispatch is a local expert "
+                                 "compute; ep_axis sharding is not "
+                                 "supported (use dispatch_mode='capacity')")
         self.gate = gate
         self.experts = experts
         self.ep_axis, self.dp_axis = ep_axis, dp_axis
+        self.dispatch_mode = dispatch_mode
 
     def forward(self, x, token_ids=None):
         """x: [..., d] -> (out [..., d], l_aux)."""
         orig_shape = x.shape
         d = orig_shape[-1]
         xt = ops.reshape(x, (-1, d))                              # [T, d]
+        if self.dispatch_mode == "dropless":
+            k, act = self.gate.k, self.experts.activation
+            out, l_aux = ops.functional._op(
+                "moe_dropless",
+                lambda x_, lg, w1, b1, w2, b2:
+                    _dropless_impl(x_, lg, w1, b1, w2, b2,
+                                   k=k, act_name=act),
+                [xt, self.gate.logits(xt), self.experts.w1,
+                 self.experts.b1, self.experts.w2, self.experts.b2],
+                num_outputs=2)
+            if self.dp_axis:
+                out = sharded(out, P(self.dp_axis, None))
+            out = ops.reshape(out, orig_shape)
+            return out, l_aux
         if isinstance(self.gate, HashGate):
             if token_ids is None:
                 raise ValueError("HashGate needs token_ids")
@@ -394,6 +447,7 @@ def make_moe_layer(embed_dim: int, ffn_dim: int, num_experts: int,
                    activation: str = "gelu",
                    ep_axis: Optional[str] = None,
                    num_groups: int = 1, dtype=None,
+                   dispatch_mode: str = "capacity",
                    name: str = "moe") -> MoELayer:
     """Convenience ctor mirroring the reference example wiring
     (``v1/examples/moe/``)."""
@@ -418,4 +472,5 @@ def make_moe_layer(embed_dim: int, ffn_dim: int, num_experts: int,
     experts = Experts(num_experts, embed_dim, ffn_dim,
                       activation=activation, ep_axis=ep_axis, dtype=dtype,
                       name=f"{name}.experts")
-    return MoELayer(gate, experts, ep_axis=ep_axis)
+    return MoELayer(gate, experts, ep_axis=ep_axis,
+                    dispatch_mode=dispatch_mode)

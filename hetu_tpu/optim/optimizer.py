@@ -37,7 +37,9 @@ from ..graph.tensor import Tensor
 class Optimizer:
     def __init__(self, params: Optional[Sequence[Tensor]] = None,
                  lr=0.01, zero: int = 0, dp_axis: str = "dp",
-                 max_grad_norm: Optional[float] = None):
+                 max_grad_norm: Optional[float] = None,
+                 grad_comm: Optional[str] = None,
+                 bucket_mb: float = 4.0):
         # lr: float, or a schedule callable step -> lr (optim.schedules)
         self.lr = lr
         self.params = list(params) if params is not None else None
@@ -48,6 +50,23 @@ class Optimizer:
         # global-norm gradient clipping (Megatron-style; applied inside
         # the jitted update, before any optimizer math)
         self.max_grad_norm = max_grad_norm
+        # explicit gradient-communication transport (reference
+        # AllReduceCoalesce + EQuARX quantized collectives): None keeps
+        # the implicit GSPMD per-tensor sync; "fp32"/"bf16"/"int8"
+        # switches the dp gradient sync to coalesced buckets over the
+        # selected wire format (parallel/comm.py, graph explicit path).
+        # Sync uses the data-parallel MEAN convention (torch-DDP
+        # semantics) and therefore assumes a mean-normalized loss; a
+        # literally sum-reduced loss makes the graph fall back to the
+        # implicit path (graph._grad_comm_fallback records why).
+        from ..parallel.comm import GRAD_COMM_TRANSPORTS
+        if grad_comm is not None and grad_comm not in GRAD_COMM_TRANSPORTS:
+            raise ValueError(f"grad_comm must be None or one of "
+                             f"{GRAD_COMM_TRANSPORTS}, got {grad_comm!r}")
+        self.grad_comm = grad_comm
+        self.bucket_mb = float(bucket_mb)
+        if self.bucket_mb <= 0:
+            raise ValueError(f"bucket_mb must be > 0, got {bucket_mb}")
         self._state: Dict[str, Any] = {}
         self._shardings: Dict[int, Any] = {}  # tid -> NamedSharding of states
         self._param_shardings: Dict[int, Any] = {}  # tid -> zero-3 sharding
@@ -182,8 +201,23 @@ class Optimizer:
         """ZeRO>=2: constrain the gradient to the dp-sharded state spec —
         the partitioner then reduce-scatters the dp gradient sum instead
         of all-reducing it (reference SplitReduceScatter under zero,
-        Communication.h:583)."""
+        Communication.h:583).  Under the explicit grad-comm path the
+        gradient arrives already reduced (coalesced collectives), so this
+        constraint degrades to a local slice — the correct ZeRO-2 layout
+        either way."""
         return self._c(tid, g) if self.zero >= 2 else g
+
+    def sync_gradients(self, grads: Dict[int, jax.Array], axis: str):
+        """Explicit DP gradient sync: coalesced (optionally quantized)
+        mean-allreduce of the micro-batch-accumulated gradient dict —
+        one collective chain per bucket instead of one psum per
+        parameter.  Must run inside a manual (shard_map) region with
+        ``axis`` in scope; the graph executor arranges that
+        (DefineAndRunGraph._build_executable explicit path)."""
+        from ..parallel import comm
+        return comm.all_reduce_coalesced(
+            grads, axis, op="mean", bucket_mb=self.bucket_mb,
+            transport=self.grad_comm or "fp32")
 
     def _c_param(self, tid: int, p):
         """ZeRO-3: keep the updated parameter dp-sharded at rest;

@@ -16,7 +16,9 @@ Mapping table (reference -> ours):
 ``AlltoAll``                    :func:`all_to_all`
 ``Broadcast/Reduce``            :func:`broadcast` / :func:`reduce`
 ``Send/Recv/BatchedISendIRecv`` :func:`ppermute` rings/sets
-``AllReduceCoalesce``           XLA all-reduce combining (automatic)
+``AllReduceCoalesce``           :func:`all_reduce_coalesced` (fused
+                                size-capped buckets, optional EQuARX
+                                bf16/int8 quantized transport)
 ``Barrier``                     :func:`barrier`
 ==============================  =====================================
 
@@ -26,20 +28,55 @@ nn layers arrange that.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import contextlib
+from typing import (Dict, List, Mapping, NamedTuple, Optional, Sequence,
+                    Tuple)
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
-def shard_map(f, mesh, in_specs, out_specs, check_rep: bool = False):
-    """Version-stable shard_map wrapper (jax>=0.8 renamed check_rep)."""
-    return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=check_rep)
+def shard_map(f, mesh, in_specs, out_specs, check_rep: bool = False,
+              axis_names=None):
+    """Version-stable shard_map wrapper.
+
+    jax>=0.8 exposes ``jax.shard_map`` (check_rep renamed to check_vma,
+    partial-manual via ``axis_names``); older jax has
+    ``jax.experimental.shard_map.shard_map`` (check_rep, partial-manual
+    via the complementary ``auto`` set).  ``axis_names``, when given,
+    restricts manual mode to those mesh axes.
+    """
+    if hasattr(jax, "shard_map"):
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_rep, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    if axis_names is not None and \
+            frozenset(axis_names) != frozenset(mesh.axis_names):
+        # old-jax auto= lowering is broken: even trivial partial-manual
+        # programs die in XLA with `Check failed: IsManualSubgroup()`
+        # (spmd_partitioner.cc:512 on jaxlib 0.4.36).  Raise cleanly
+        # instead of letting the compile abort the process.
+        raise NotImplementedError(
+            "partial-manual shard_map (axis_names a proper subset of the "
+            "mesh axes) requires jax>=0.8; this jax's auto= lowering "
+            "hits an XLA IsManualSubgroup check failure")
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_rep)
+
+
+def _operand_bytes(x) -> int:
+    return int(np.prod(np.shape(x))) * np.dtype(jnp.result_type(x)).itemsize
 
 
 def all_reduce(x: jax.Array, axis: str, op: str = "sum") -> jax.Array:
+    if _STATS_STACK:
+        _record("all_reduce", _operand_bytes(x), jnp.result_type(x),
+                axis_size(axis), axis)
     if op == "sum":
         return lax.psum(x, axis)
     if op == "mean":
@@ -54,17 +91,27 @@ def all_reduce(x: jax.Array, axis: str, op: str = "sum") -> jax.Array:
 def all_gather(x: jax.Array, axis: str, gather_dim: int = 0,
                tiled: bool = True) -> jax.Array:
     """Gather shards along ``gather_dim`` (reference AllGather, comm_group.h:95)."""
+    if _STATS_STACK:
+        n = axis_size(axis)
+        _record("all_gather", _operand_bytes(x) * n, jnp.result_type(x),
+                n, axis)
     return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
 
 
 def reduce_scatter(x: jax.Array, axis: str, scatter_dim: int = 0) -> jax.Array:
     """Sum-reduce then scatter along ``scatter_dim`` (comm_group.h:101)."""
+    if _STATS_STACK:
+        _record("reduce_scatter", _operand_bytes(x), jnp.result_type(x),
+                axis_size(axis), axis)
     return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
 
 
 def all_to_all(x: jax.Array, axis: str, split_dim: int,
                concat_dim: int, tiled: bool = True) -> jax.Array:
     """AlltoAll (comm_group.h:77) — the EP/MoE dispatch primitive."""
+    if _STATS_STACK:
+        _record("all_to_all", _operand_bytes(x), jnp.result_type(x),
+                axis_size(axis), axis)
     return lax.all_to_all(x, axis, split_axis=split_dim,
                           concat_axis=concat_dim, tiled=tiled)
 
@@ -72,7 +119,7 @@ def all_to_all(x: jax.Array, axis: str, split_dim: int,
 def broadcast(x: jax.Array, axis: str, root: int = 0) -> jax.Array:
     """Broadcast from ``root`` along ``axis`` (comm_group.h:63)."""
     idx = lax.axis_index(axis)
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     masked = jnp.where(idx == root, x, jnp.zeros_like(x))
     return lax.psum(masked, axis)
 
@@ -95,7 +142,7 @@ def ppermute(x: jax.Array, axis: str,
 def ring_shift(x: jax.Array, axis: str, shift: int = 1) -> jax.Array:
     """Shift shards around the ring formed by ``axis`` — the KV-ring exchange
     of ring attention (``ops/ParallelAttention.cc:611``)."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
@@ -105,7 +152,11 @@ def axis_index(axis: str) -> jax.Array:
 
 
 def axis_size(axis: str) -> int:
-    return lax.axis_size(axis)
+    """Static size of a named axis (jax<0.6 lacks lax.axis_size; the
+    psum-of-1 constant folds to the axis size at trace time)."""
+    if hasattr(lax, "axis_size"):
+        return int(lax.axis_size(axis))
+    return int(lax.psum(1, axis))
 
 
 def barrier(coordinator=None, name: str = "default",
@@ -232,7 +283,7 @@ def split_all_reduce(x: jax.Array, subgroup_axis: str,
     (SplitAllReduceOp, ops/Communication.h:718)."""
     if groups is None:
         return lax.psum(x, subgroup_axis)
-    n = lax.axis_size(subgroup_axis)
+    n = axis_size(subgroup_axis)
     gs = _norm_groups(groups, n)
     return lax.psum(x, subgroup_axis,
                     axis_index_groups=[tuple(g) for g in gs])
@@ -251,7 +302,7 @@ def split_all_gather(x: jax.Array, subgroup_axis: str,
     if groups is None:
         return lax.all_gather(x, subgroup_axis, axis=gather_dim, tiled=True)
     gather_dim = gather_dim % x.ndim
-    n = lax.axis_size(subgroup_axis)
+    n = axis_size(subgroup_axis)
     gs = _norm_groups(groups, n)
     sizes = {len(g) for g in gs}
     if len(sizes) == 1:
@@ -272,6 +323,478 @@ def split_all_gather(x: jax.Array, subgroup_axis: str,
     return picked.reshape(shape)
 
 
+# -- coalesced + quantized gradient collectives ------------------------------
+#
+# Reference AllReduceCoalesce (comm_group.h:27-144): per-tensor gradient
+# allreduce leaves link bandwidth on the table, so same-dtype gradients are
+# flattened into size-capped fused buckets and synced with ONE collective
+# per bucket.  On top of the bucketing sits a quantized transport (EQuARX,
+# PAPERS.md): the payload crosses the wire as bf16 or blockwise-absmax int8
+# while the *reduction* accumulates in fp32, via the two-phase
+#
+#   quantize -> all_to_all (reduce-scatter exchange) -> dequantize ->
+#   accumulate fp32 -> [mean] -> quantize -> all_gather -> dequantize
+#
+# so each element is quantized exactly twice regardless of group size and
+# the reduction error stays bounded per absmax block.  fp32 transport uses
+# a single psum per bucket, which is bit-identical to per-tensor psum
+# (elementwise reduction over the same rank order).
+
+GRAD_COMM_TRANSPORTS = ("fp32", "bf16", "int8")
+
+#: default blockwise-absmax block for the int8 transport (elements/block;
+#: scale sidecar overhead = 4 bytes per block)
+INT8_BLOCK = 256
+
+
+class Bucket(NamedTuple):
+    """One fused bucket: same-dtype tensors flattened back to back."""
+    keys: Tuple             # caller keys, flatten order
+    shapes: Tuple           # original shapes, same order
+    numels: Tuple[int, ...]
+    dtype: str              # canonical numpy dtype name
+    nbytes: int             # payload bytes (sum of tensor bytes)
+
+
+class CommRecord(NamedTuple):
+    kind: str               # all_reduce | reduce_scatter | all_gather | all_to_all
+    payload_bytes: int      # logical payload size (global, pre-sharding)
+    wire_bytes: float       # per-rank bytes on the wire (ring algorithm)
+    dtype: str
+    axis: str
+
+
+class CommStats:
+    """Trace-time collective accounting (bytes-on-wire bookkeeping).
+
+    Collectives recorded while a :func:`comm_stats` scope is active
+    correspond 1:1 to collective ops in the traced XLA program — tracing
+    a jitted function (or ``.lower()``-ing it) under the scope counts
+    exactly what the program will launch per step.
+    """
+
+    def __init__(self):
+        self.records: List[CommRecord] = []
+
+    @property
+    def num_collectives(self) -> int:
+        return len(self.records)
+
+    @property
+    def total_wire_bytes(self) -> float:
+        return sum(r.wire_bytes for r in self.records)
+
+    @property
+    def total_payload_bytes(self) -> int:
+        return sum(r.payload_bytes for r in self.records)
+
+    def by_kind(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for r in self.records:
+            out[r.kind] = out.get(r.kind, 0) + 1
+        return out
+
+    def summary(self) -> dict:
+        return {"num_collectives": self.num_collectives,
+                "wire_bytes_per_rank": round(self.total_wire_bytes, 1),
+                "payload_bytes": self.total_payload_bytes,
+                "by_kind": self.by_kind()}
+
+
+_STATS_STACK: List[CommStats] = []
+
+
+@contextlib.contextmanager
+def comm_stats():
+    """``with comm_stats() as s:`` — record collectives traced inside."""
+    s = CommStats()
+    _STATS_STACK.append(s)
+    try:
+        yield s
+    finally:
+        _STATS_STACK.remove(s)
+
+
+def ring_wire_bytes(kind: str, payload_bytes: float, n: int) -> float:
+    """Per-rank bytes sent over the wire by the ring algorithm for a
+    collective moving ``payload_bytes`` across ``n`` ranks (the standard
+    bandwidth-optimal accounting; ICI all-reduce = RS + AG)."""
+    if n <= 1:
+        return 0.0
+    frac = (n - 1) / n
+    if kind == "all_reduce":
+        return 2.0 * payload_bytes * frac
+    if kind in ("reduce_scatter", "all_gather", "all_to_all"):
+        return payload_bytes * frac
+    raise ValueError(f"unknown collective kind {kind!r}")
+
+
+def _record(kind: str, payload_bytes: int, dtype, n: int, axis: str) -> None:
+    if not _STATS_STACK:
+        return
+    rec = CommRecord(kind, int(payload_bytes),
+                     ring_wire_bytes(kind, payload_bytes, n),
+                     np.dtype(dtype).name, axis)
+    for s in _STATS_STACK:
+        s.records.append(rec)
+
+
+def plan_buckets(entries: Sequence[Tuple],
+                 bucket_mb: float = 4.0) -> List[Bucket]:
+    """Greedy size-capped bucketing of ``(key, shape, dtype)`` entries.
+
+    Order-preserving within each dtype (gradients arrive roughly in
+    reverse-layer order, so adjacent buckets stay adjacent in the
+    backward schedule — the overlap-friendly property of the reference's
+    AllReduceCoalesce grouping).  A tensor larger than the cap gets its
+    own bucket.
+    """
+    cap = max(1, int(float(bucket_mb) * (1 << 20)))
+    buckets: List[Bucket] = []
+    open_idx: Dict[str, int] = {}   # dtype -> index into buckets
+    for key, shape, dtype in entries:
+        dt = np.dtype(dtype)
+        numel = int(np.prod(shape)) if len(tuple(shape)) else 1
+        nbytes = numel * dt.itemsize
+        i = open_idx.get(dt.name)
+        if i is not None and buckets[i].nbytes + nbytes <= cap:
+            b = buckets[i]
+            buckets[i] = Bucket(b.keys + (key,), b.shapes + (tuple(shape),),
+                                b.numels + (numel,), b.dtype,
+                                b.nbytes + nbytes)
+        else:
+            buckets.append(Bucket((key,), (tuple(shape),), (numel,),
+                                  dt.name, nbytes))
+            open_idx[dt.name] = len(buckets) - 1
+    return buckets
+
+
+def _normalize_tree(xs):
+    """(items [(key, arr)], rebuild) for dict / list / tuple inputs."""
+    if isinstance(xs, Mapping):
+        items = list(xs.items())
+        return items, (lambda vals: dict(zip([k for k, _ in items], vals)))
+    items = list(enumerate(xs))
+    return items, (lambda vals: list(vals))
+
+
+def _flatten_bucket(bucket: Bucket, lookup) -> jax.Array:
+    return jnp.concatenate([jnp.ravel(lookup[k]) for k in bucket.keys])
+
+
+def _unflatten_bucket(flat: jax.Array, bucket: Bucket) -> List[jax.Array]:
+    out, off = [], 0
+    for shape, numel in zip(bucket.shapes, bucket.numels):
+        out.append(lax.dynamic_slice_in_dim(flat, off, numel).reshape(shape))
+        off += numel
+    return out
+
+
+def quantized_chunk(numel: int, n: int, block: int = INT8_BLOCK) -> int:
+    """Per-rank chunk length for the two-phase quantized path: the padded
+    flat buffer is ``n * chunk`` with ``chunk`` a block multiple, so int8
+    absmax blocks never straddle rank boundaries."""
+    per = -(-numel // n)             # ceil
+    return -(-per // block) * block
+
+
+def _quantize_rows(rows: jax.Array, block: int):
+    """Blockwise int8 absmax quantize of ``[r, chunk]`` rows
+    (chunk % block == 0, so blocks stay within rows).  Reuses the
+    checkpoint-path quantizer (ops/quantization.py)."""
+    from ..ops.quantization import quantize_int8   # lazy: avoid pkg cycle
+    r, chunk = rows.shape
+    q, scales = quantize_int8(rows, blocksize=block)
+    return q.reshape(r, chunk), scales.reshape(r, chunk // block)
+
+
+def _dequantize_rows(codes: jax.Array, scales: jax.Array,
+                     block: int) -> jax.Array:
+    from ..ops.quantization import dequantize_int8   # lazy: avoid pkg cycle
+    return dequantize_int8(codes.reshape(-1), scales.reshape(-1),
+                           codes.shape, blocksize=block)
+
+
+def _axis_groups(groups, n):
+    if groups is None:
+        return None, n
+    gs = _norm_groups(groups, n)
+    sizes = {len(g) for g in gs}
+    if len(sizes) != 1:
+        raise ValueError(
+            "quantized transports need equal-size subgroups (XLA "
+            f"all_to_all/all_gather are shape-uniform); got {gs}. "
+            "Use transport='fp32' for unequal groups.")
+    return [tuple(g) for g in gs], sizes.pop()
+
+
+def _qreduce_scatter_flat(flat: jax.Array, axis: str, op: str,
+                          transport: str, block: int,
+                          groups=None) -> jax.Array:
+    """Phase 1 of the EQuARX two-phase reduction on a flat fp32 buffer:
+    each rank ends up owning the fully-reduced (fp32-accumulated) chunk
+    at its own rank offset.  Returns the ``[chunk]`` fp32 shard."""
+    n_axis = axis_size(axis)
+    idx_groups, n = _axis_groups(groups, n_axis)
+    N = flat.shape[0]
+    chunk = quantized_chunk(N, n, block)
+    flat = jnp.pad(flat.astype(jnp.float32), (0, n * chunk - N))
+    rows = flat.reshape(n, chunk)
+    if transport == "bf16":
+        payload = rows.astype(jnp.bfloat16)
+        ex = lax.all_to_all(payload, axis, split_axis=0, concat_axis=0,
+                            tiled=False, axis_index_groups=idx_groups)
+        _record("all_to_all", n * chunk * 2, jnp.bfloat16, n, axis)
+        acc = jnp.sum(ex.astype(jnp.float32), axis=0)
+    elif transport == "int8":
+        codes, scales = _quantize_rows(rows, block)
+        exc = lax.all_to_all(codes, axis, split_axis=0, concat_axis=0,
+                             tiled=False, axis_index_groups=idx_groups)
+        exs = lax.all_to_all(scales, axis, split_axis=0, concat_axis=0,
+                             tiled=False, axis_index_groups=idx_groups)
+        _record("all_to_all", n * chunk, jnp.int8, n, axis)
+        _record("all_to_all", n * (chunk // block) * 4, jnp.float32, n, axis)
+        acc = jnp.sum(_dequantize_rows(exc, exs, block), axis=0)
+    else:
+        raise ValueError(f"unknown quantized transport {transport!r}")
+    if op == "mean":
+        acc = acc / n
+    elif op != "sum":
+        raise ValueError(f"unsupported op {op!r} for quantized transport")
+    return acc
+
+
+def _qall_gather_flat(chunk_arr: jax.Array, axis: str, transport: str,
+                      block: int, numel: int, groups=None) -> jax.Array:
+    """Phase 2: broadcast each rank's reduced chunk through the quantized
+    transport; returns the full flat fp32 buffer (length ``numel``)."""
+    n_axis = axis_size(axis)
+    idx_groups, n = _axis_groups(groups, n_axis)
+    chunk = chunk_arr.shape[0]
+    if transport == "bf16":
+        g = lax.all_gather(chunk_arr.astype(jnp.bfloat16), axis,
+                           tiled=False, axis_index_groups=idx_groups)
+        _record("all_gather", n * chunk * 2, jnp.bfloat16, n, axis)
+        full = g.astype(jnp.float32)
+    elif transport == "int8":
+        codes, scales = _quantize_rows(chunk_arr.reshape(1, chunk), block)
+        gc = lax.all_gather(codes[0], axis, tiled=False,
+                            axis_index_groups=idx_groups)
+        gs = lax.all_gather(scales[0], axis, tiled=False,
+                            axis_index_groups=idx_groups)
+        _record("all_gather", n * chunk, jnp.int8, n, axis)
+        _record("all_gather", n * (chunk // block) * 4, jnp.float32, n, axis)
+        full = _dequantize_rows(gc, gs, block)
+    else:
+        raise ValueError(f"unknown quantized transport {transport!r}")
+    return full.reshape(-1)[:numel]
+
+
+def _reduce_flat(flat: jax.Array, axis: str, op: str, transport: str,
+                 block: int, groups) -> jax.Array:
+    """All-reduce one flat bucket through the selected transport."""
+    n = axis_size(axis)
+    # wire accounting: grouped collectives move data within each
+    # subgroup only — record with the largest group's ring factor, not
+    # the full axis's
+    n_rec = n if groups is None else max(len(g) for g in groups)
+    if transport == "fp32":
+        _record("all_reduce", flat.shape[0] * np.dtype(flat.dtype).itemsize,
+                flat.dtype, n_rec, axis)
+        if groups is not None:
+            red = split_all_reduce(flat, axis, groups)
+            if op == "mean":
+                red = red / _own_group_size(axis, groups, n)
+            elif op != "sum":
+                raise ValueError(f"unsupported coalesced op {op!r}")
+            return red
+        if op == "sum":
+            return lax.psum(flat, axis)
+        if op == "mean":
+            return lax.pmean(flat, axis)
+        raise ValueError(f"unsupported coalesced op {op!r}")
+    orig_dtype = flat.dtype
+    shard = _qreduce_scatter_flat(flat, axis, op, transport, block, groups)
+    full = _qall_gather_flat(shard, axis, transport, block, flat.shape[0],
+                             groups)
+    return full.astype(orig_dtype)
+
+
+def _own_group_size(axis: str, groups, n: int):
+    gs = _norm_groups(groups, n)
+    _gid, _members, _rin, gsz = _group_tables(gs, n)
+    return jnp.asarray(gsz, jnp.float32)[lax.axis_index(axis)]
+
+
+def all_reduce_coalesced(xs, axis: str, op: str = "sum",
+                         bucket_mb: float = 4.0,
+                         transport: str = "fp32",
+                         block: int = INT8_BLOCK,
+                         groups: Optional[Sequence[Sequence[int]]] = None):
+    """Bucketed (optionally quantized) all-reduce of a gradient pytree.
+
+    ``xs``: dict or list of arrays; returns the same structure.  Arrays
+    are flattened into same-dtype buckets capped at ``bucket_mb`` MiB and
+    reduced with ONE collective chain per bucket (reference
+    AllReduceCoalesce, comm_group.h:27; EQuARX quantized transport).
+
+    transport:
+      - ``"fp32"`` — one ``psum`` per bucket; bit-identical to per-tensor
+        ``psum`` (elementwise reduction, same rank order).
+      - ``"bf16"`` — payload cast to bf16, fp32 accumulation (two-phase).
+      - ``"int8"`` — blockwise-absmax int8 payload + fp32 scale sidecar,
+        fp32 accumulation; each element quantized exactly twice.
+
+    ``groups``: optional static subgroup partition (SplitAllReduce
+    semantics).  fp32 supports unequal groups; quantized transports need
+    equal-size groups.  Must be called inside shard_map with ``axis``.
+    """
+    if transport not in GRAD_COMM_TRANSPORTS:
+        raise ValueError(f"transport must be one of {GRAD_COMM_TRANSPORTS}, "
+                         f"got {transport!r}")
+    items, rebuild = _normalize_tree(xs)
+    lookup = dict(items)
+    buckets = plan_buckets(
+        [(k, np.shape(v), jnp.result_type(v)) for k, v in items], bucket_mb)
+    out: Dict = {}
+    for b in buckets:
+        flat = _flatten_bucket(b, lookup)
+        red = _reduce_flat(flat, axis, op, transport, block, groups)
+        for k, arr in zip(b.keys, _unflatten_bucket(red, b)):
+            out[k] = arr.astype(lookup[k].dtype)
+    return rebuild([out[k] for k, _ in items])
+
+
+class CoalescedLayout(NamedTuple):
+    """Static layout of a reduce-scattered coalesced gradient set: one
+    entry per bucket, enough to all-gather + unflatten later (the
+    per-group tensor-list contract of the reference's coalesce ops)."""
+    buckets: Tuple[Bucket, ...]
+    chunks: Tuple[int, ...]      # per-bucket per-rank chunk length
+    list_input: bool = False     # rebuild a list (not a dict) on gather
+    groups: Optional[Tuple[Tuple[int, ...], ...]] = None  # split variant
+
+
+def reduce_scatter_coalesced(xs, axis: str, op: str = "sum",
+                             bucket_mb: float = 4.0,
+                             transport: str = "fp32",
+                             block: int = INT8_BLOCK):
+    """Bucketed reduce-scatter: each rank ends up owning the reduced
+    chunk of every bucket at its own rank offset (ZeRO grad sync,
+    reference SplitReduceScatter under zero, Communication.h:583).
+
+    Returns ``(chunks, layout)``: ``chunks[i]`` is this rank's fp32
+    shard of bucket i; complete with :func:`all_gather_coalesced`.
+    """
+    if transport not in GRAD_COMM_TRANSPORTS:
+        raise ValueError(f"transport must be one of {GRAD_COMM_TRANSPORTS}, "
+                         f"got {transport!r}")
+    items, _rebuild = _normalize_tree(xs)
+    lookup = dict(items)
+    buckets = plan_buckets(
+        [(k, np.shape(v), jnp.result_type(v)) for k, v in items], bucket_mb)
+    n = axis_size(axis)
+    chunks, chunk_lens = [], []
+    for b in buckets:
+        flat = _flatten_bucket(b, lookup)
+        chunk = quantized_chunk(flat.shape[0], n, block)
+        if transport == "fp32":
+            padded = jnp.pad(flat.astype(jnp.float32),
+                             (0, n * chunk - flat.shape[0]))
+            _record("reduce_scatter",
+                    padded.shape[0] * np.dtype(padded.dtype).itemsize,
+                    padded.dtype, n, axis)
+            shard = lax.psum_scatter(padded, axis, scatter_dimension=0,
+                                     tiled=True)
+            if op == "mean":
+                shard = shard / n
+            elif op != "sum":
+                raise ValueError(f"unsupported coalesced op {op!r}")
+        else:
+            shard = _qreduce_scatter_flat(flat, axis, op, transport, block)
+        chunks.append(shard)
+        chunk_lens.append(chunk)
+    return chunks, CoalescedLayout(tuple(buckets), tuple(chunk_lens),
+                                   not isinstance(xs, Mapping))
+
+
+def all_gather_coalesced(chunks, layout: CoalescedLayout, axis: str,
+                         transport: str = "fp32",
+                         block: int = INT8_BLOCK):
+    """Inverse of :func:`reduce_scatter_coalesced`: gather every rank's
+    chunks and unflatten back to the original container (dict keyed like
+    the input mapping, or a list when the input was a sequence)."""
+    if layout.groups is not None:
+        # grouped shards are padded per-rank to the largest chunk; a
+        # full-axis gather would interleave groups and padding into
+        # garbage — fail loudly (per-rank valid extents are derivable
+        # from layout.groups, the split_reduce_scatter contract)
+        raise NotImplementedError(
+            "all_gather_coalesced does not support grouped layouts "
+            "(from split_reduce_scatter_coalesced); consume the shards "
+            "with the per-group valid extents from layout.groups")
+    n = axis_size(axis)
+    out: Dict = {}
+    for shard, b, chunk in zip(chunks, layout.buckets, layout.chunks):
+        numel = sum(b.numels)
+        if transport == "fp32":
+            _record("all_gather", n * chunk * 4, jnp.float32, n, axis)
+            full = lax.all_gather(shard, axis, tiled=True)[:numel]
+        else:
+            full = _qall_gather_flat(shard, axis, transport, block, numel)
+        for k, arr in zip(b.keys, _unflatten_bucket(full, b)):
+            out[k] = arr.astype(np.dtype(b.dtype))
+    if layout.list_input:
+        return [out[i] for i in range(len(out))]
+    return out
+
+
+def split_all_reduce_coalesced(xs, subgroup_axis: str,
+                               groups: Optional[Sequence[Sequence[int]]] = None,
+                               op: str = "sum", bucket_mb: float = 4.0,
+                               transport: str = "fp32",
+                               block: int = INT8_BLOCK):
+    """Coalesced SplitAllReduce: one fused collective per bucket, run
+    independently over (possibly unequal) subgroups.  fp32 handles
+    unequal groups natively (psum axis_index_groups); quantized
+    transports require equal-size groups."""
+    return all_reduce_coalesced(xs, subgroup_axis, op=op,
+                                bucket_mb=bucket_mb, transport=transport,
+                                block=block, groups=groups)
+
+
+def split_reduce_scatter_coalesced(xs, subgroup_axis: str,
+                                   groups: Optional[Sequence[Sequence[int]]]
+                                   = None,
+                                   bucket_mb: float = 4.0):
+    """Coalesced SplitReduceScatter over (possibly unequal) subgroups:
+    flattens each bucket, pads to a common multiple of every subgroup
+    size, and runs one :func:`split_reduce_scatter` per bucket.  Returns
+    ``(flat_shards, layout)`` with the padded-to-largest-chunk contract
+    of :func:`split_reduce_scatter`."""
+    items, _rebuild = _normalize_tree(xs)
+    lookup = dict(items)
+    buckets = plan_buckets(
+        [(k, np.shape(v), jnp.result_type(v)) for k, v in items], bucket_mb)
+    n = axis_size(subgroup_axis)
+    sizes = [len(g) for g in groups] if groups is not None else [n]
+    lcm = int(np.lcm.reduce(np.asarray(sizes, np.int64)))
+    shards, chunk_lens = [], []
+    for b in buckets:
+        flat = _flatten_bucket(b, lookup)
+        pad = (-flat.shape[0]) % lcm
+        padded = jnp.pad(flat, (0, pad))
+        _record("reduce_scatter",
+                padded.shape[0] * np.dtype(padded.dtype).itemsize,
+                padded.dtype, max(sizes), subgroup_axis)
+        shards.append(split_reduce_scatter(padded, subgroup_axis, 0, groups))
+        chunk_lens.append(padded.shape[0] // min(sizes))
+    gtuple = tuple(tuple(int(i) for i in g) for g in groups) \
+        if groups is not None else None
+    return shards, CoalescedLayout(tuple(buckets), tuple(chunk_lens),
+                                   not isinstance(xs, Mapping), gtuple)
+
+
 def split_reduce_scatter(x: jax.Array, subgroup_axis: str,
                          scatter_dim: int = 0,
                          groups: Optional[Sequence[Sequence[int]]] = None
@@ -285,7 +808,7 @@ def split_reduce_scatter(x: jax.Array, subgroup_axis: str,
         return lax.psum_scatter(x, subgroup_axis,
                                 scatter_dimension=scatter_dim, tiled=True)
     scatter_dim = scatter_dim % x.ndim
-    n = lax.axis_size(subgroup_axis)
+    n = axis_size(subgroup_axis)
     gs = _norm_groups(groups, n)
     sizes = {len(g) for g in gs}
     if len(sizes) == 1:

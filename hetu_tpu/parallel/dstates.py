@@ -341,6 +341,97 @@ def deduce_comm_kind(src: DistributedStates, dst: DistributedStates) -> str:
     return "reshard"  # generic (BatchedISendIRecv in the reference)
 
 
+# -- coalesced gradient-comm predictions -------------------------------------
+#
+# The comm-op deduction above predicts WHICH collective converts one DS into
+# another; the functions below extend the prediction to the coalesced
+# gradient-sync layer (comm.py all_reduce_coalesced): given the gradient
+# set and transport they enumerate the exact collective sequence the traced
+# program must contain, and `count_hlo_collectives` checks the lowered XLA
+# text against it — the analogue of the reference asserting its
+# AllReduceCoalesce op list at substitution time.
+
+
+def predict_grad_comm_collectives(entries, device_num: int,
+                                  bucket_mb: float = 4.0,
+                                  transport: str = "fp32",
+                                  block: Optional[int] = None) -> List[dict]:
+    """Predict the collectives one coalesced gradient sync emits.
+
+    ``entries``: [(key, shape, dtype)] of the gradient set, in sync
+    order.  Returns one dict per collective: {kind, payload_bytes,
+    wire_bytes, dtype} — fp32 emits one all_reduce per bucket; bf16 one
+    all_to_all + one all_gather per bucket; int8 adds the fp32 absmax
+    sidecar exchange (2 all_to_all + 2 all_gather per bucket).
+    """
+    from .comm import (INT8_BLOCK, plan_buckets, quantized_chunk,
+                       ring_wire_bytes)
+    block = block or INT8_BLOCK
+    n = device_num
+    preds: List[dict] = []
+
+    def _emit(kind, payload, dtype):
+        preds.append({"kind": kind, "payload_bytes": int(payload),
+                      "wire_bytes": ring_wire_bytes(kind, payload, n),
+                      "dtype": dtype})
+
+    for b in plan_buckets(entries, bucket_mb):
+        numel = sum(b.numels)
+        if transport == "fp32":
+            _emit("all_reduce", b.nbytes, b.dtype)
+            continue
+        chunk = quantized_chunk(numel, n, block)
+        if transport == "bf16":
+            _emit("all_to_all", n * chunk * 2, "bfloat16")
+            _emit("all_gather", n * chunk * 2, "bfloat16")
+        elif transport == "int8":
+            _emit("all_to_all", n * chunk, "int8")
+            _emit("all_to_all", n * (chunk // block) * 4, "float32")
+            _emit("all_gather", n * chunk, "int8")
+            _emit("all_gather", n * (chunk // block) * 4, "float32")
+        else:
+            raise ValueError(f"unknown transport {transport!r}")
+    return preds
+
+
+def count_hlo_collectives(hlo_text: str) -> Dict[str, int]:
+    """Count collective ops in lowered StableHLO / HLO text.
+
+    Handles ``stablehlo.all_reduce``, classic ``all-reduce(``, and the
+    async pair spelling after XLA's latency-hiding scheduler
+    (``all-reduce-start(`` — the matching ``-done`` is not counted, so
+    each async collective still counts once)."""
+    import re
+    pats = {
+        "all_reduce": r"stablehlo\.all_reduce|all-reduce(?:-start)?\(",
+        "all_gather": r"stablehlo\.all_gather|all-gather(?:-start)?\(",
+        "all_to_all": r"stablehlo\.all_to_all|all-to-all(?:-start)?\(",
+        "reduce_scatter":
+            r"stablehlo\.reduce_scatter|reduce-scatter(?:-start)?\(",
+    }
+    return {k: len(re.findall(p, hlo_text)) for k, p in pats.items()}
+
+
+def verify_grad_comm_emission(hlo_text: str, prediction: List[dict],
+                              extra: Optional[Dict[str, int]] = None) -> None:
+    """Assert the lowered program contains exactly the predicted
+    collectives (plus ``extra`` known ones, e.g. the scalar-loss pmean of
+    a training step).  Raises AssertionError on mismatch."""
+    want: Dict[str, int] = {}
+    for p in prediction:
+        want[p["kind"]] = want.get(p["kind"], 0) + 1
+    for k, v in (extra or {}).items():
+        want[k] = want.get(k, 0) + v
+    got = count_hlo_collectives(hlo_text)
+    bad = {k: (want.get(k, 0), got.get(k, 0))
+           for k in set(want) | set(got)
+           if want.get(k, 0) != got.get(k, 0)}
+    if bad:
+        raise AssertionError(
+            f"emitted collectives do not match prediction "
+            f"(kind: want/got): {bad}")
+
+
 class SplitPattern:
     """Contiguous vs. non-contiguous split (distributed_states.h:139)."""
 

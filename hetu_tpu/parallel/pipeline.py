@@ -118,11 +118,12 @@ def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], Any],
         return lax.psum(out_buf * mask, pp_axis), \
             lax.psum(aux_sum, pp_axis) / M
 
-    fn = jax.shard_map(
-        pp_fn, mesh=mesh,
+    from .comm import shard_map
+    fn = shard_map(
+        pp_fn, mesh,
         in_specs=(P(pp_axis), P()),
         out_specs=(P(), P()),
-        axis_names={pp_axis}, check_vma=False)
+        axis_names={pp_axis}, check_rep=False)
     out_mb, aux = fn(stage_params, x_mb)
     out = out_mb.reshape(M * mb_size, *out_mb.shape[2:])
     return (out, aux) if with_aux else out

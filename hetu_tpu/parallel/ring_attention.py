@@ -50,6 +50,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .comm import axis_size
+
 from ..ops.pallas.flash_attention import _flash_bwd, _flash_fwd
 
 # pair-mask classes (reference AttnMask, ParallelAttention.h:25);
@@ -226,7 +228,7 @@ def _ring_attn(q, k, v, seg_ids, axis_name, scale, causal, pattern,
 
 def _ring_fwd_impl(q, k, v, seg_ids, axis_name, scale, causal, pattern,
                    use_segs):
-    cp = lax.axis_size(axis_name)
+    cp = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s, h, d = q.shape
     perm = [(i, (i + 1) % cp) for i in range(cp)]
@@ -269,7 +271,7 @@ def _ring_fwd_rule(q, k, v, seg_ids, axis_name, scale, causal, pattern,
 
 def _ring_bwd_rule(axis_name, scale, causal, pattern, use_segs, res, do):
     q, k, v, seg_ids, out, lse = res
-    cp = lax.axis_size(axis_name)
+    cp = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     kv_ids0 = jnp.where(seg_ids < 0, -2, seg_ids)

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .comm import axis_size
+
 from ..ops.pallas.flash_attention import flash_attention
 
 
@@ -46,7 +48,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``segment_ids``: local ``[b, s_local]`` global doc ids (-1 pad) for
     packed sequences.
     """
-    cp = lax.axis_size(axis_name)
+    cp = axis_size(axis_name)
     h = q.shape[2]
     if h % cp != 0:
         raise ValueError(

@@ -375,6 +375,28 @@ class TestModule:
 class TestReviewRegressions:
     """Regressions from code-review findings on the M1 frontend."""
 
+    def test_derived_dim_override_cleared_across_runs(self):
+        """ADVICE r5: a provisional override on a DerivedDim installed by
+        an earlier bind pass (unbound leaves) must not survive a later
+        pass that rebinds only the leaf symbols — the derived dim has to
+        re-evaluate from its expression, even when the later feed does
+        not mention it."""
+        seq = ht.SymbolicDim("seq")
+        half = seq // 2
+        with ht.graph("define_and_run", create_new=True) as g:
+            a = ht.placeholder("float32", (seq, 2), name="a")
+            b = ht.placeholder("float32", (half, 2), name="b")
+            # pass 1: only the derived dim is fed while its leaf is
+            # unbound -> provisional override half=8
+            g._bind_symbolic_dims({b: np.zeros((8, 2), np.float32)})
+            assert half.get() == 8
+            # pass 2: only the leaf is fed; the stale override must be
+            # cleared so half re-evaluates to 10//2
+            g._bind_symbolic_dims({a: np.zeros((10, 2), np.float32)})
+            assert seq.get() == 10
+            assert half.get() == 5, \
+                "stale provisional override shadowed the expression"
+
     def test_eval_then_train_plan_no_collision(self):
         X, Y = _make_data(n=8, d=4, classes=2)
         with ht.graph("define_and_run", create_new=True) as g:
