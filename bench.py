@@ -486,6 +486,133 @@ def bench_comm_microbench() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def bench_serving_microbench() -> dict:
+    """Serving microbench (ISSUE 2): dense-cache ``generate()`` vs the
+    paged continuous-batching engine on a GPT-2-small-proportioned model
+    with mixed-length prompts (64/512/1024 + short traffic).
+
+    Reports per-request KV HBM bytes HELD (dense: every request pays the
+    padded ``[B, max_len]`` cache; paged: ``peak_pages * page_bytes``),
+    tokens/s for both paths, and the engine's compiled-executable count
+    (must stay <= the shape-bucket grid).  The KV accounting is analytic
+    from shapes — valid off-hardware; wall times on CPU are a relative
+    sanity signal only.  Layer count/width are scaled down
+    (HETU_TPU_SERVE_BENCH_{HIDDEN,LAYERS} to override) so the CPU run
+    finishes in seconds; the footprint ratio is width-independent.
+
+    Writes BENCH_SERVING.json next to this file and returns the dict.
+    """
+    code = (
+        "import os, sys, json, time\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from hetu_tpu.models import GPTConfig\n"
+        "from hetu_tpu.models.generate import generate\n"
+        "from hetu_tpu.serving import Engine\n"
+        "H = int(os.environ.get('HETU_TPU_SERVE_BENCH_HIDDEN', '256'))\n"
+        "L = int(os.environ.get('HETU_TPU_SERVE_BENCH_LAYERS', '2'))\n"
+        "V, NH, NKV = 1024, 8, 4\n"
+        "cfg = GPTConfig(vocab_size=V, hidden_size=H, num_layers=L,\n"
+        "                num_heads=NH, num_kv_heads=NKV, max_seq_len=2048,\n"
+        "                sp=False, dropout=0.0, position='rotary',\n"
+        "                norm='rmsnorm', activation='silu',\n"
+        "                tie_embeddings=True)\n"
+        "hd, f = cfg.head_dim, cfg.ffn_size\n"
+        "rng = np.random.RandomState(0)\n"
+        "def w(*s):\n"
+        "    return (rng.randn(*s) * 0.02).astype(np.float32)\n"
+        "state = {'wte.weight': w(V, H), 'ln_f.weight': np.ones(H, np.float32)}\n"
+        "for i in range(L):\n"
+        "    state[f'h{i}.ln_1.weight'] = np.ones(H, np.float32)\n"
+        "    state[f'h{i}.ln_2.weight'] = np.ones(H, np.float32)\n"
+        "    state[f'h{i}.attn.qkv.weight'] = w((NH + 2 * NKV) * hd, H)\n"
+        "    state[f'h{i}.attn.out.weight'] = w(H, NH * hd)\n"
+        "    state[f'h{i}.mlp.up.weight'] = w(f, H)\n"
+        "    state[f'h{i}.mlp.down.weight'] = w(H, f)\n"
+        "lens = [64, 64, 512, 64, 1024, 64]\n"
+        "new = 32\n"
+        "prompts = [rng.randint(1, V, size=n).tolist() for n in lens]\n"
+        "kv_itemsize = 4\n"
+        "\n"
+        "# -- dense baseline: one static batch padded to the longest --\n"
+        "smax = max(lens)\n"
+        "batch = np.zeros((len(lens), smax), np.int32)\n"
+        "for i, p in enumerate(prompts):\n"
+        "    batch[i, :len(p)] = p\n"
+        "t0 = time.perf_counter()\n"
+        "out = np.asarray(generate(state, cfg, batch, new))\n"
+        "dense_wall = time.perf_counter() - t0\n"
+        "dense_tokens = len(lens) * new\n"
+        "dense_bytes_per_req = 2 * L * (smax + new) * NKV * hd * kv_itemsize\n"
+        "\n"
+        "# -- paged engine: continuous batching over the page pool --\n"
+        "eng = Engine(state, cfg, num_pages=24, page_size=128,\n"
+        "             max_batch=8)\n"
+        "t0 = time.perf_counter()\n"
+        "reqs = [eng.add_request(p, new, arrival_time=0.0)\n"
+        "        for p in prompts]\n"
+        "eng.run()\n"
+        "paged_wall = time.perf_counter() - t0\n"
+        "paged_tokens = sum(r.n_generated for r in reqs)\n"
+        "paged_bytes = [r.peak_pages * eng.pool.page_bytes for r in reqs]\n"
+        "m = eng.metrics_summary()\n"
+        "pre_b = sorted(k[1] for k in eng._compiled if k[0] == 'prefill')\n"
+        "dec_b = sorted(k[1] for k in eng._compiled if k[0] == 'decode')\n"
+        "res = {\n"
+        "  'model': {'hidden': H, 'layers': L, 'heads': NH,\n"
+        "            'kv_heads': NKV, 'vocab': V},\n"
+        "  'prompt_lens': lens, 'max_new_tokens': new,\n"
+        "  'page_size': eng.pool.page_size,\n"
+        "  'dense': {'tokens_per_sec': round(dense_tokens / dense_wall, 1),\n"
+        "            'wall_s': round(dense_wall, 2),\n"
+        "            'kv_bytes_per_req': dense_bytes_per_req,\n"
+        "            'recompiles': 1},\n"
+        "  'paged': {'tokens_per_sec': round(paged_tokens / paged_wall, 1),\n"
+        "            'wall_s': round(paged_wall, 2),\n"
+        "            'kv_bytes_per_req_mean': int(np.mean(paged_bytes)),\n"
+        "            'kv_bytes_per_req': paged_bytes,\n"
+        "            'recompiles': int(m['compile_count']),\n"
+        "            'prefill_buckets': pre_b, 'decode_buckets': dec_b,\n"
+        "            'decode_steps': int(m['decode_steps']),\n"
+        "            'preemptions': int(m['preemptions']),\n"
+        "            'ttft_p90_ms': round(m['ttft']['p90'] * 1e3, 1)},\n"
+        "}\n"
+        "res['kv_bytes_ratio_dense_vs_paged'] = round(\n"
+        "    dense_bytes_per_req / np.mean(paged_bytes), 2)\n"
+        "# bound from the THEORETICAL bucket grid (pow2 batch sizes up\n"
+        "# to max_batch, pow2 page counts up to max_pages_per_seq) --\n"
+        "# not from the observed cache, which would be a tautology\n"
+        "grid_bound = (int(np.log2(8)) + 1 +\n"
+        "              int(np.ceil(np.log2(eng.max_pages_per_seq))) + 1)\n"
+        "res['recompile_bound_bucket_grid'] = grid_bound\n"
+        "res['recompiles_bounded'] = m['compile_count'] <= grid_bound\n"
+        "print(json.dumps(res))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True,
+                              timeout=1200)
+        lines = proc.stdout.strip().splitlines()
+        if not lines:
+            return {"error": f"rc={proc.returncode}: "
+                             f"{proc.stderr.strip()[-400:]}"}
+        result = json.loads(lines[-1])
+    except Exception as e:  # never fail the headline bench on this
+        return {"error": f"{type(e).__name__}: {e}"}
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_SERVING.json")
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(result, fh, indent=1)
+    except Exception:
+        pass
+    return result
+
+
 def _probe_backend(timeout_s: float = 180.0) -> str:
     """Probe the default backend in a SUBPROCESS with a timeout: a wedged
     TPU runtime hangs on init (round-3 postmortem: BENCH_r03 rc=1 /
@@ -530,6 +657,21 @@ def _store_cache(result) -> None:
 
 
 def main():
+    # subcommands run ONE suite and print its JSON (the default
+    # argv-less invocation stays the headline training bench):
+    #   python bench.py serving_microbench   (writes BENCH_SERVING.json)
+    #   python bench.py comm_microbench
+    if len(sys.argv) > 1:
+        sub = sys.argv[1]
+        fns = {"serving_microbench": bench_serving_microbench,
+               "comm_microbench": bench_comm_microbench}
+        if sub not in fns:
+            print(json.dumps({"error": f"unknown subcommand {sub!r}; "
+                                       f"have {sorted(fns)}"}))
+            raise SystemExit(2)
+        print(json.dumps(fns[sub]()))
+        return
+
     platform = _probe_backend()
     import jax
     if platform == "cpu":

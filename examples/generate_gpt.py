@@ -28,12 +28,70 @@ from hetu_tpu.utils.checkpoint import (load_checkpoint,  # noqa: E402
                                        save_checkpoint)
 
 
+def serve_demo(state, cfg, args):
+    """Continuous-batching serving demo: N prompts with staggered
+    wall-clock arrivals through hetu_tpu.serving.Engine; prints
+    per-request TTFT/latency and aggregate tokens/s."""
+    import time
+
+    from hetu_tpu.serving import Engine
+
+    rng = np.random.RandomState(0)
+    period = np.array([3, 7, 1, 12], np.int32)
+    eng = Engine(state, cfg, num_pages=64, page_size=8, max_batch=8)
+    n = args.serve_requests
+    t0 = time.monotonic()
+    reqs = []
+    for i in range(n):
+        plen = int(rng.choice([4, 6, 8]))
+        phase = int(rng.randint(4))
+        prompt = [int(period[(phase + j) % 4]) for j in range(plen)]
+        reqs.append(eng.add_request(
+            prompt, max_new_tokens=int(rng.randint(6, 14)),
+            temperature=args.temperature,
+            arrival_time=time.monotonic() + i * args.serve_stagger))
+    eng.run()
+    wall = time.monotonic() - t0
+    total_new = 0
+    for r in reqs:
+        ttft = r.first_token_time - r.submit_time
+        lat = r.finish_time - r.submit_time
+        total_new += r.n_generated
+        print(f"req {r.req_id}: prompt {r.prompt_len:2d} tok, "
+              f"+{r.n_generated:2d} new, ttft {ttft * 1e3:7.1f} ms, "
+              f"latency {lat * 1e3:7.1f} ms, "
+              f"preemptions {r.n_preemptions}")
+        if args.temperature == 0.0:
+            # the engine contract: continuous batching reproduces a solo
+            # dense-cache generate() run bit-for-bit at temperature 0
+            want = np.asarray(models.generate(
+                state, cfg, np.asarray([r.prompt], np.int32),
+                r.n_generated))[0, r.prompt_len:].tolist()
+            assert r.out_tokens == want, (r.req_id, r.out_tokens, want)
+    m = eng.metrics_summary()
+    print(f"served {n} requests / {total_new} tokens in {wall:.2f}s "
+          f"({total_new / wall:.1f} tok/s aggregate)")
+    print(f"engine: {int(m['decode_steps'])} decode steps, "
+          f"{int(m['preemptions'])} preemptions, "
+          f"{int(m['compile_count'])} compiled executables, "
+          f"ttft p90 {m['ttft']['p90'] * 1e3:.1f} ms")
+    if args.temperature == 0.0:
+        print("self-check OK: every served request matches its solo "
+              "generate() run bit-for-bit")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=150)
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--ckpt", type=str, default="")
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--serve", action="store_true",
+                    help="after training, push staggered requests "
+                         "through the continuous-batching engine")
+    ap.add_argument("--serve-requests", type=int, default=6)
+    ap.add_argument("--serve-stagger", type=float, default=0.05,
+                    help="arrival spacing in seconds")
     args = ap.parse_args()
     ckpt = args.ckpt or os.path.join(tempfile.mkdtemp(), "gpt")
 
@@ -82,6 +140,9 @@ def main():
         want = [period[(2 + i) % 4] for i in range(10)]
         assert out[0, prompt.shape[1]:].tolist() == want, "pattern lost"
         print("self-check OK: greedy decode reproduces the trained period")
+
+    if args.serve:
+        serve_demo(state, cfg, args)
 
 
 if __name__ == "__main__":

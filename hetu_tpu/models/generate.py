@@ -212,9 +212,22 @@ def _moe_mlp_dispatched(cfg: GPTConfig, x, wg, w1, b1, w2, b2):
         topv.reshape(b * s, -1), w1, b1, w2, b2, _moe_act(cfg))
     return out.reshape(b, s, d).astype(x.dtype)
 
-def _forward(cfg: GPTConfig, p: _Params, ids, caches, pos, cos, sin):
+def _lm_head(p: _Params, x):
+    """LM-head projection for already-normed hidden states ``x`` [b, H]
+    -> fp32 logits [b, V].  Split out of :func:`_forward` so the serving
+    engine can project at the last TRUE token of a padded prefill."""
+    head = p("lm_head.weight")
+    w = head if head is not None else p("wte.weight")
+    return x.astype(jnp.float32) @ w.T.astype(jnp.float32)
+
+
+def _forward(cfg: GPTConfig, p: _Params, ids, caches, pos, cos, sin,
+             return_hidden: bool = False):
     """Stack forward for ``ids`` [b, s_new] at absolute position ``pos``;
-    returns (logits of the LAST position [b, V], new caches)."""
+    returns (logits of the LAST position [b, V], new caches), plus the
+    final-norm hidden states [b, s_new, H] when ``return_hidden`` (the
+    serving prefill projects logits at the last true token of a padded
+    prompt instead of the last padded position)."""
     c = cfg
     x = p("wte.weight")[ids].astype(jnp.bfloat16 if c.dtype == "bfloat16"
                                     else jnp.float32)
@@ -244,11 +257,25 @@ def _forward(cfg: GPTConfig, p: _Params, ids, caches, pos, cos, sin):
         x = x + h
         new_caches.append((k_cache, v_cache))
     x = _norm_apply(c, p("ln_f.weight"), p("ln_f.bias"), x)
-    head = p("lm_head.weight")
-    w = head if head is not None else p("wte.weight")
-    logits = (x[:, -1].astype(jnp.float32)
-              @ w.T.astype(jnp.float32))           # [b, V]
+    logits = _lm_head(p, x[:, -1])                 # [b, V]
+    if return_hidden:
+        return logits, new_caches, x
     return logits, new_caches
+
+
+def decode_step(cfg: GPTConfig, p: _Params, tokens, caches, pos, cos, sin,
+                return_hidden: bool = False):
+    """Single decode step against dense ``[b, max_len, kvh, hd]`` caches:
+    ``tokens`` [b, s_new] at absolute position ``pos`` -> (last-position
+    logits [b, V], updated caches).
+
+    The one entry point both inference paths share: ``generate()``'s
+    ``lax.scan`` calls it with s_new=1, and the serving engine's prefill
+    executable (``hetu_tpu/serving/decode.py``) calls it over the whole
+    padded prompt (``return_hidden=True``, to re-project logits at the
+    last TRUE token) before scattering the dense caches into KV pages.
+    """
+    return _forward(cfg, p, tokens, caches, pos, cos, sin, return_hidden)
 
 
 def generate(state: Dict[str, Any], cfg: GPTConfig, prompt_ids,
@@ -313,13 +340,13 @@ def _build_decode_fn(cfg: GPTConfig, b: int, s0: int, max_new_tokens: int,
         caches = [(jnp.zeros((b, max_len, cfg.kv_heads, cfg.head_dim), cdt),
                    jnp.zeros((b, max_len, cfg.kv_heads, cfg.head_dim), cdt))
                   for _ in range(cfg.num_layers)]
-        logits, cs = _forward(cfg, p, prompt_ids, caches, 0, cos, sin)
+        logits, cs = decode_step(cfg, p, prompt_ids, caches, 0, cos, sin)
         key, sub = jax.random.split(key0)
         tok = pick(logits, sub)
 
         def step(carry, _):
             cs, tok, pos, key = carry
-            logits, cs = _forward(cfg, p, tok[:, None], cs, pos, cos, sin)
+            logits, cs = decode_step(cfg, p, tok[:, None], cs, pos, cos, sin)
             key, sub = jax.random.split(key)
             nxt = pick(logits, sub)
             return (cs, nxt, pos + 1, key), tok

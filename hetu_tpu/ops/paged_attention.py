@@ -1,0 +1,227 @@
+"""Paged decode attention: GQA decode against a block-paged KV pool.
+
+Serving keeps KV state in a preallocated page pool
+(``hetu_tpu/serving/kv_pool.py``): per layer, ``k_pages``/``v_pages``
+of shape ``[num_pages, page_size, kv_heads, head_dim]``, with each
+request owning a list of pages through an int32 page table.  Decode
+attention then reads *ragged* per-request histories through the page
+table instead of a padded dense ``[B, max_len, ...]`` cache — the
+Ragged Paged Attention recipe (PAPERS.md, arxiv 2604.15464) that lets
+mixed-length requests share one pool with no padding HBM.
+
+Two implementations, numerically interchangeable:
+
+- ``paged_attention_reference`` — gather pages via the page table into a
+  contiguous ``[B, maxp*ps, kvh, hd]`` view and run masked dense
+  attention.  This is the CPU/simulation path and the oracle the kernel
+  is tested against.
+- ``paged_attention_pallas`` — Pallas TPU kernel.  The page table and
+  sequence lengths ride in as **scalar-prefetch** operands
+  (``PrefetchScalarGridSpec``), so the kernel's k/v BlockSpec index maps
+  translate grid position -> physical page id and Mosaic DMAs exactly
+  the pages a request owns; pages past ``seq_len`` are skipped with
+  ``pl.when`` (no gather materialization, no padding FLOPs beyond the
+  last partial page).  Runs in interpret mode off-TPU so the whole path
+  is testable on the simulated mesh.
+
+Layout notes (DESIGN.md §8): ``head_dim`` fills the 128-lane tile;
+``page_size`` is the sublane dim of the per-(page, kv-head) ``[ps, hd]``
+tile and must be a multiple of 8 (f32 sublanes) — multiples of 128
+additionally make one page exactly one MXU-shaped block.  The GQA group
+dim is padded to 8 sublanes for the q/out tiles.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+SUBLANES = 8
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _check_shapes(q, k_pages, v_pages, page_tables, seq_lens):
+    b, nh, hd = q.shape
+    p_, ps, kvh, hd2 = k_pages.shape
+    if v_pages.shape != k_pages.shape:
+        raise ValueError(f"k_pages {k_pages.shape} != v_pages "
+                         f"{v_pages.shape}")
+    if hd != hd2:
+        raise ValueError(f"head_dim mismatch: q {hd} vs pages {hd2}")
+    if nh % kvh != 0:
+        raise ValueError(f"num_heads {nh} not divisible by kv_heads {kvh}")
+    if page_tables.ndim != 2 or page_tables.shape[0] != b:
+        raise ValueError(f"page_tables must be [B, max_pages], got "
+                         f"{page_tables.shape}")
+    if seq_lens.shape != (b,):
+        raise ValueError(f"seq_lens must be [B], got {seq_lens.shape}")
+    return b, nh, hd, ps, kvh
+
+
+# ---------------------------------------------------------------------------
+# reference path (CPU / oracle): gather-via-page-table + masked dense attn
+# ---------------------------------------------------------------------------
+
+def paged_attention_reference(q: jax.Array, k_pages: jax.Array,
+                              v_pages: jax.Array, page_tables: jax.Array,
+                              seq_lens: jax.Array,
+                              softmax_scale: Optional[float] = None
+                              ) -> jax.Array:
+    """q [B, nh, hd] (one decode token per request), pages
+    [P, ps, kvh, hd], page_tables [B, maxp] int32, seq_lens [B] int32
+    (tokens valid, *including* the one just written) -> out [B, nh, hd].
+    """
+    b, nh, hd, ps, kvh = _check_shapes(q, k_pages, v_pages, page_tables,
+                                       seq_lens)
+    maxp = page_tables.shape[1]
+    scale = softmax_scale if softmax_scale is not None else hd ** -0.5
+    # [B, maxp, ps, kvh, hd] -> [B, maxp*ps, kvh, hd]
+    k = k_pages[page_tables].reshape(b, maxp * ps, kvh, hd)
+    v = v_pages[page_tables].reshape(b, maxp * ps, kvh, hd)
+    g = nh // kvh
+    qg = q.reshape(b, kvh, g, hd).astype(jnp.float32)
+    s = jnp.einsum("bhgd,bshd->bhgs", qg,
+                   k.astype(jnp.float32)) * scale       # [B, kvh, g, S]
+    valid = (jnp.arange(maxp * ps)[None] <
+             seq_lens[:, None])[:, None, None, :]       # [B, 1, 1, S]
+    s = jnp.where(valid, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgs,bshd->bhgd", p, v.astype(jnp.float32))
+    return out.reshape(b, nh, hd).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+def _paged_kernel(sl_ref, pt_ref,            # scalar prefetch
+                  q_ref, k_ref, v_ref,       # inputs
+                  o_ref,                     # output
+                  m_scr, l_scr, acc_scr,     # scratch
+                  *, scale: float, ps: int, maxp: int, gp: int):
+    bi = pl.program_id(0)
+    p = pl.program_id(2)
+    seqlen = sl_ref[bi]
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, DEFAULT_MASK_VALUE)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(p * ps < seqlen)
+    def _page():
+        q = q_ref[0, 0].astype(jnp.float32)            # [gp, hd]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)      # [ps, hd]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        cols = p * ps + lax.broadcasted_iota(jnp.int32, (gp, ps), 1)
+        s = jnp.where(cols < seqlen, s, DEFAULT_MASK_VALUE)
+        m_prev = m_scr[:, 0]                           # [gp]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)
+        pexp = jnp.exp(s - m_cur[:, None])             # [gp, ps]
+        l_cur = l_scr[:, 0] * alpha + jnp.sum(pexp, axis=1)
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + lax.dot_general(
+            pexp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_cur[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_cur[:, None], l_scr.shape)
+
+    @pl.when(p == maxp - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)                # empty rows -> 0
+        o_ref[0, 0] = (acc_scr[...] / l[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, page_tables: jax.Array,
+                           seq_lens: jax.Array,
+                           softmax_scale: Optional[float] = None,
+                           interpret: Optional[bool] = None) -> jax.Array:
+    """Pallas paged decode attention (same contract as the reference).
+
+    Grid is ``(B, kvh, maxp)`` with pages innermost (sequential on TPU);
+    the online-softmax state is carried across the page loop in VMEM
+    scratch exactly like the flash forward.  k/v index maps read the
+    prefetched page table, so each grid step DMAs one physical page.
+    """
+    b, nh, hd, ps, kvh = _check_shapes(q, k_pages, v_pages, page_tables,
+                                       seq_lens)
+    maxp = page_tables.shape[1]
+    scale = softmax_scale if softmax_scale is not None else hd ** -0.5
+    if interpret is None:
+        interpret = not _on_tpu()
+    g = nh // kvh
+    gp = max(SUBLANES, ((g + SUBLANES - 1) // SUBLANES) * SUBLANES)
+    qg = q.reshape(b, kvh, g, hd)
+    if gp != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    pt = page_tables.astype(jnp.int32)
+    sl = seq_lens.astype(jnp.int32)
+
+    kernel = functools.partial(_paged_kernel, scale=float(scale), ps=ps,
+                               maxp=maxp, gp=gp)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kvh, maxp),
+        in_specs=[
+            pl.BlockSpec((1, 1, gp, hd),
+                         lambda bi, h, p, sl_r, pt_r: (bi, h, 0, 0)),
+            pl.BlockSpec((1, ps, 1, hd),
+                         lambda bi, h, p, sl_r, pt_r: (pt_r[bi, p], 0, h,
+                                                       0)),
+            pl.BlockSpec((1, ps, 1, hd),
+                         lambda bi, h, p, sl_r, pt_r: (pt_r[bi, p], 0, h,
+                                                       0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, gp, hd), lambda bi, h, p, sl_r, pt_r: (bi, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((gp, LANES), jnp.float32),
+            pltpu.VMEM((gp, LANES), jnp.float32),
+            pltpu.VMEM((gp, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, gp, hd), q.dtype),
+        interpret=interpret,
+    )(sl, pt, qg, k_pages, v_pages)
+    return out[:, :, :g, :].reshape(b, nh, hd)
+
+
+def paged_attention_decode(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, page_tables: jax.Array,
+                           seq_lens: jax.Array,
+                           softmax_scale: Optional[float] = None,
+                           use_kernel: Optional[bool] = None) -> jax.Array:
+    """Dispatching entry point: Pallas kernel on TPU, gather-dense
+    reference elsewhere (mirrors ``ops.sdpa``'s dispatch discipline)."""
+    if use_kernel is None:
+        use_kernel = _on_tpu()
+    if use_kernel:
+        try:
+            return paged_attention_pallas(q, k_pages, v_pages, page_tables,
+                                          seq_lens,
+                                          softmax_scale=softmax_scale)
+        except Exception:
+            pass
+    return paged_attention_reference(q, k_pages, v_pages, page_tables,
+                                     seq_lens, softmax_scale=softmax_scale)

@@ -1,0 +1,18 @@
+"""Serving subsystem: paged KV-cache pool + continuous-batching engine.
+
+    from hetu_tpu.serving import Engine
+
+    eng = Engine(state, cfg, num_pages=128, page_size=64, max_batch=8)
+    req = eng.add_request(prompt_ids, max_new_tokens=64)
+    outputs = eng.run()            # {req_id: generated token list}
+
+See DESIGN.md §8 for the page-size/TP-tiling rationale, the
+prefill/decode executable split, and the shape-bucket policy.
+"""
+from .engine import Engine
+from .kv_pool import PagedKVPool, TRASH_PAGE
+from .request import FINISHED, RUNNING, WAITING, Request, RequestQueue
+from .scheduler import Scheduler
+
+__all__ = ["Engine", "PagedKVPool", "TRASH_PAGE", "Request",
+           "RequestQueue", "Scheduler", "WAITING", "RUNNING", "FINISHED"]

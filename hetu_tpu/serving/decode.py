@@ -1,0 +1,185 @@
+"""Jitted serving executables: bucketed prefill + paged decode step.
+
+Prefill and decode are SEPARATE compiled programs (DESIGN.md §8): a
+prefill is one big [1, s_pad] forward whose arithmetic intensity keeps
+the MXU busy, while a decode step is a [B, 1] forward that lives or
+dies by HBM bandwidth — fusing them into one executable would force the
+decode batch to retrace whenever prefill shapes change and drag
+padding-FLOPs into every step.
+
+- ``build_prefill_fn``: dense-cache forward over the padded prompt via
+  the same :func:`~hetu_tpu.models.generate.decode_step` that
+  ``generate()`` scans (shared layer math, one source of truth), then
+  scatters the dense caches into the request's KV pages and projects
+  logits at the last TRUE token.
+- ``build_decode_fn``: single-token batched step that scatter-writes
+  each request's new k/v into its current page and attends through the
+  page table with ``ops.paged_attention``.
+
+Both are cached per shape bucket by the engine, so compile count is
+bounded by the bucket grid, not the traffic mix.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..models.generate import (_act, _lm_head, _moe_mlp, _norm_apply,
+                               _Params, _rotary_tables, decode_step)
+from ..models.gpt import GPTConfig
+from ..ops.paged_attention import paged_attention_decode
+
+
+def _params_view(cfg: GPTConfig, params) -> _Params:
+    p = _Params.__new__(_Params)
+    p.s, p.cfg = params, cfg
+    return p
+
+
+def _rope_at(x, cos_g, sin_g):
+    """Rotary embedding at per-request positions: x [B, 1, h, d],
+    cos_g/sin_g [B, d] (already position-gathered).  Same arithmetic as
+    generate._rope, which takes a shared [s, d] table — decode batches
+    have a DIFFERENT position per row, so the gather happens outside."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    c = cos_g[:, None, None, :].astype(x.dtype)
+    s = sin_g[:, None, None, :].astype(x.dtype)
+    return x * c + rot * s
+
+
+def build_prefill_fn(cfg: GPTConfig, s_pad: int, max_pages: int,
+                     page_size: int):
+    """Compile a prefill executable for prompt-length bucket ``s_pad``
+    (a multiple of ``page_size``).
+
+    fn(params, prompt [1, s_pad], true_len, pt_row [max_pages],
+       k_pages, v_pages) -> (logits [V], new k_pages, new v_pages)
+
+    Padded prompt tail tokens only influence positions >= true_len
+    (causal mask), whose KV entries are masked by ``seq_len`` until
+    decode overwrites them; padded page-table slots point at the trash
+    page, so the static per-page scatter loop never writes real pages it
+    doesn't own.
+    """
+    if s_pad % page_size != 0:
+        raise ValueError(f"prefill bucket {s_pad} not a multiple of "
+                         f"page_size {page_size}")
+    cdt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    cos, sin = (_rotary_tables(cfg, s_pad) if cfg.position == "rotary"
+                else (None, None))
+    # the power-of-two bucket can exceed the page-table width when
+    # max_pages is not itself a power of two; positions past
+    # max_pages*page_size are guaranteed padding (admission bounds real
+    # length by max_model_len), so those pages are simply not written —
+    # an unclamped pt_row[j] gather would clamp to the LAST REAL page
+    # and corrupt it with padding KV
+    n_pack = min(s_pad // page_size, max_pages)
+
+    @jax.jit
+    def run(params, prompt, true_len, pt_row, k_pages, v_pages):
+        p = _params_view(cfg, params)
+        caches = [(jnp.zeros((1, s_pad, cfg.kv_heads, cfg.head_dim), cdt),
+                   jnp.zeros((1, s_pad, cfg.kv_heads, cfg.head_dim), cdt))
+                  for _ in range(cfg.num_layers)]
+        _, cs, x = decode_step(cfg, p, prompt, caches, 0, cos, sin,
+                               return_hidden=True)
+        logits = _lm_head(p, x[0, true_len - 1][None])[0]      # [V]
+        new_k, new_v = [], []
+        for i in range(cfg.num_layers):
+            kc, vc = cs[i]
+            kp, vp = k_pages[i], v_pages[i]
+            for j in range(n_pack):
+                kp = kp.at[pt_row[j]].set(
+                    kc[0, j * page_size:(j + 1) * page_size])
+                vp = vp.at[pt_row[j]].set(
+                    vc[0, j * page_size:(j + 1) * page_size])
+            new_k.append(kp)
+            new_v.append(vp)
+        return logits, tuple(new_k), tuple(new_v)
+
+    return run
+
+
+def build_decode_fn(cfg: GPTConfig, batch: int, max_pages: int,
+                    page_size: int, use_kernel: bool = False):
+    """Compile a paged decode step for batch bucket ``batch``.
+
+    fn(params, tokens [B], pos [B], page_tables [B, max_pages],
+       k_pages, v_pages) -> (logits [B, V], new k_pages, new v_pages)
+
+    ``pos[b]`` is the KV write index for this token (== tokens already
+    committed); dummy batch slots carry pos=0 and an all-trash page
+    table, so their writes land in the trash page and their outputs are
+    discarded by the engine.  Layer math mirrors
+    ``models.generate._attn_step`` exactly, with the dense
+    update+attend swapped for page scatter + ``paged_attention``.
+    """
+    max_len = max_pages * page_size
+    cdt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    cos, sin = (_rotary_tables(cfg, max_len) if cfg.position == "rotary"
+                else (None, None))
+    c = cfg
+    hd, nh, nkv = c.head_dim, c.num_heads, c.kv_heads
+    batch_idx = jnp.arange(batch)
+
+    @jax.jit
+    def run(params, tokens, pos, page_tables, k_pages, v_pages):
+        p = _params_view(cfg, params)
+        x = p("wte.weight")[tokens][:, None].astype(cdt)       # [B, 1, H]
+        if c.position == "learned":
+            x = x + p("wpe")[pos][:, None].astype(x.dtype)
+        page_idx = page_tables[batch_idx, pos // page_size]    # [B]
+        offset = pos % page_size                               # [B]
+        seq_lens = pos + 1
+        new_k, new_v = [], []
+        for i in range(c.num_layers):
+            h = _norm_apply(c, p.layer(i, "ln_1.weight"),
+                            p.layer(i, "ln_1.bias"), x)
+            qkv = h @ p.layer(i, "attn.qkv.weight").T
+            qb = p.layer(i, "attn.qkv.bias")
+            if qb is not None:
+                qkv = qkv + qb
+            q_size, kv_size = nh * hd, nkv * hd
+            q = qkv[..., :q_size].reshape(batch, 1, nh, hd)
+            k = qkv[..., q_size:q_size + kv_size].reshape(batch, 1, nkv,
+                                                          hd)
+            v = qkv[..., q_size + kv_size:].reshape(batch, 1, nkv, hd)
+            if c.position == "rotary":
+                q = _rope_at(q, cos[pos], sin[pos])
+                k = _rope_at(k, cos[pos], sin[pos])
+            kp = k_pages[i].at[page_idx, offset].set(
+                k[:, 0].astype(cdt))
+            vp = v_pages[i].at[page_idx, offset].set(
+                v[:, 0].astype(cdt))
+            attn = paged_attention_decode(q[:, 0], kp, vp, page_tables,
+                                          seq_lens,
+                                          use_kernel=use_kernel)
+            attn = attn.reshape(batch, 1, nh * hd).astype(x.dtype)
+            out = attn @ p.layer(i, "attn.out.weight").T
+            ob = p.layer(i, "attn.out.bias")
+            if ob is not None:
+                out = out + ob
+            x = x + out
+            h = _norm_apply(c, p.layer(i, "ln_2.weight"),
+                            p.layer(i, "ln_2.bias"), x)
+            if c.is_moe_layer(i):
+                h = _moe_mlp(c, p, i, h)
+            else:
+                h = _act(c, h @ p.layer(i, "mlp.up.weight").T +
+                         (p.layer(i, "mlp.up.bias")
+                          if p.layer(i, "mlp.up.bias") is not None
+                          else 0.0))
+                h = h @ p.layer(i, "mlp.down.weight").T
+                db = p.layer(i, "mlp.down.bias")
+                if db is not None:
+                    h = h + db
+            x = x + h
+            new_k.append(kp)
+            new_v.append(vp)
+        x = _norm_apply(c, p("ln_f.weight"), p("ln_f.bias"), x)
+        logits = _lm_head(p, x[:, 0])                          # [B, V]
+        return logits, tuple(new_k), tuple(new_v)
+
+    return run

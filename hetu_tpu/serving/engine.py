@@ -1,0 +1,292 @@
+"""Continuous-batching inference engine over the paged KV pool.
+
+The serving half of the roadmap: where ``models.generate`` runs ONE
+static batch to completion, the engine runs an admission loop — every
+``step()`` it admits arrived requests (prefill, separate executable),
+packs all live requests into a shape-bucketed decode batch (paged
+attention through per-request page tables), streams each new token to
+its request, and retires/evicts under the page budget.  Late-arriving
+requests join mid-flight; short requests leave without waiting for long
+ones.
+
+Determinism contract: at temperature 0 every request's output equals a
+solo ``generate()`` run — batching, paging, admission order, and even
+preemption (recompute eviction) change WHEN a token is computed, never
+WHAT it is.  ``tests/test_serving.py`` asserts this bit-for-bit.
+
+Observability (utils/metrics.py instruments): counters
+``tokens_generated``/``prefill_tokens``/``requests_completed``/
+``preemptions``/``decode_steps``, gauges ``batch_occupancy``/
+``page_utilization``/``queue_depth``, histograms ``ttft``/``tpot``/
+``request_latency`` — with the no-op fallback when disabled.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generate import _Params
+from ..models.gpt import GPTConfig
+from ..utils.metrics import make_instrument
+from .decode import build_decode_fn, build_prefill_fn
+from .kv_pool import TRASH_PAGE, PagedKVPool
+from .request import FINISHED, RUNNING, Request, RequestQueue
+from .scheduler import Scheduler
+
+
+class Engine:
+    def __init__(self, state: Dict[str, Any], cfg: GPTConfig,
+                 num_pages: int = 64, page_size: int = 64,
+                 max_batch: int = 8, max_model_len: Optional[int] = None,
+                 mesh=None, use_kernel: bool = False,
+                 metrics: bool = True,
+                 time_fn: Optional[Callable[[], float]] = None):
+        self.cfg = cfg
+        self.params = _Params(state, cfg).s      # normalized key view
+        if max_model_len is None:
+            max_model_len = (num_pages - 1) * page_size
+            if cfg.position == "learned":
+                # never past the wpe table: an out-of-range position
+                # gather clamps silently to the last row
+                max_model_len = min(max_model_len, cfg.max_seq_len)
+        self.max_model_len = int(max_model_len)
+        self.max_pages_per_seq = -(-self.max_model_len // page_size)
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        self.pool = PagedKVPool(cfg.num_layers, num_pages, page_size,
+                                cfg.kv_heads, cfg.head_dim, dtype,
+                                mesh=mesh)
+        self.scheduler = Scheduler(self.pool, max_batch=max_batch)
+        self.use_kernel = bool(use_kernel)
+        self.queue = RequestQueue()
+        self.running: List[Request] = []
+        self.finished: Dict[int, Request] = {}
+        self._compiled: Dict[Any, Callable] = {}
+        self._time_fn = time_fn or time.monotonic
+        self._next_id = 0
+        self.steps = 0
+        m = metrics
+        self.counters = {k: make_instrument("counter", k, m) for k in
+                         ("tokens_generated", "prefill_tokens",
+                          "requests_completed", "preemptions",
+                          "decode_steps", "prefills")}
+        self.gauges = {k: make_instrument("gauge", k, m) for k in
+                       ("batch_occupancy", "page_utilization",
+                        "queue_depth")}
+        self.histograms = {k: make_instrument("histogram", k, m) for k in
+                           ("ttft", "tpot", "request_latency")}
+
+    # -- submission ----------------------------------------------------------
+
+    def add_request(self, prompt_ids: Sequence[int], max_new_tokens: int,
+                    temperature: float = 0.0, top_k: int = 0,
+                    seed: int = 0, eos_token_id: Optional[int] = None,
+                    arrival_time: Optional[float] = None,
+                    stream_cb: Optional[Callable] = None) -> Request:
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = len(prompt) + int(max_new_tokens)
+        if total > self.max_model_len:
+            raise ValueError(
+                f"prompt+max_new_tokens = {total} exceeds max_model_len "
+                f"{self.max_model_len}")
+        if self.pool.pages_for(total) > self.pool.num_usable:
+            raise ValueError(
+                f"request needs {self.pool.pages_for(total)} pages; pool "
+                f"has {self.pool.num_usable} — it could never run")
+        now = self._now()
+        req = Request(req_id=self._next_id, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature), top_k=int(top_k),
+                      seed=int(seed), eos_token_id=eos_token_id,
+                      arrival_time=now if arrival_time is None
+                      else float(arrival_time), stream_cb=stream_cb)
+        req.submit_time = max(now, req.arrival_time)
+        self._next_id += 1
+        self.queue.push(req)
+        return req
+
+    # -- loop ----------------------------------------------------------------
+
+    def _now(self) -> float:
+        return self._time_fn()
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.queue) or bool(self.running)
+
+    def step(self) -> int:
+        """One engine iteration: admit+prefill, then one decode step for
+        every live request.  Returns the number of tokens produced."""
+        produced = 0
+        now = self._now()
+        for req in self.scheduler.admit(self.queue, self.running, now):
+            produced += self._prefill(req)
+        produced += self._decode_batch()
+        self.steps += 1
+        self.gauges["batch_occupancy"].set(
+            len(self.running) / self.scheduler.max_batch)
+        self.gauges["page_utilization"].set(self.pool.utilization)
+        self.gauges["queue_depth"].set(len(self.queue))
+        return produced
+
+    def run(self, max_steps: Optional[int] = None
+            ) -> Dict[int, List[int]]:
+        """Drive until idle (or ``max_steps``); returns
+        {req_id: generated tokens} for everything finished so far."""
+        while self.has_work:
+            if max_steps is not None and self.steps >= max_steps:
+                break
+            self.step()
+        return {rid: list(r.out_tokens)
+                for rid, r in self.finished.items()}
+
+    @property
+    def compile_count(self) -> int:
+        """Distinct compiled executables — bounded by the shape-bucket
+        grid (asserted in bench/tests), not by traffic."""
+        return len(self._compiled)
+
+    # -- prefill -------------------------------------------------------------
+
+    def _get_fn(self, kind: str, bucket: int) -> Callable:
+        key = (kind, bucket)
+        fn = self._compiled.get(key)
+        if fn is None:
+            if kind == "prefill":
+                fn = build_prefill_fn(self.cfg, bucket,
+                                      self.max_pages_per_seq,
+                                      self.pool.page_size)
+            else:
+                fn = build_decode_fn(self.cfg, bucket,
+                                     self.max_pages_per_seq,
+                                     self.pool.page_size,
+                                     use_kernel=self.use_kernel)
+            self._compiled[key] = fn
+        return fn
+
+    def _pt_row(self, pages: List[int]) -> np.ndarray:
+        row = np.full(self.max_pages_per_seq, TRASH_PAGE, np.int32)
+        row[:len(pages)] = pages
+        return row
+
+    def _prefill(self, req: Request) -> int:
+        n_tok = len(req.tokens)
+        pages = self.pool.alloc(self.pool.pages_for(n_tok))
+        assert pages is not None, "admission reserved these pages"
+        req.pages = pages
+        req.peak_pages = max(req.peak_pages, len(pages))
+        s_pad = self.scheduler.prefill_bucket(n_tok)
+        fn = self._get_fn("prefill", s_pad)
+        prompt = np.zeros((1, s_pad), np.int32)
+        prompt[0, :n_tok] = req.tokens
+        logits, new_k, new_v = fn(
+            self.params, jnp.asarray(prompt), jnp.int32(n_tok),
+            jnp.asarray(self._pt_row(pages)),
+            self.pool.k_pages, self.pool.v_pages)
+        self.pool.set_pages(new_k, new_v)
+        req.pos = n_tok
+        req.state = RUNNING
+        self.running.append(req)
+        self._emit(req, np.asarray(logits))
+        now = self._now()
+        if req.first_token_time is None:
+            req.first_token_time = now
+            self.histograms["ttft"].observe(now - req.submit_time)
+        self.counters["prefill_tokens"].inc(n_tok)
+        self.counters["prefills"].inc()
+        self._maybe_finish(req)
+        return 1
+
+    # -- decode --------------------------------------------------------------
+
+    def _decode_batch(self) -> int:
+        live = [r for r in self.running if r.state == RUNNING]
+        if not live:
+            return 0
+        kept, evicted = self.scheduler.ensure_decode_pages(live)
+        for req in evicted:
+            self.running.remove(req)
+            self.queue.push(req)
+            self.counters["preemptions"].inc()
+        if not kept:
+            return 0
+        bucket = self.scheduler.decode_bucket(len(kept))
+        kept = kept[:bucket]               # surplus rides the next step
+        fn = self._get_fn("decode", bucket)
+        tokens = np.zeros(bucket, np.int32)
+        pos = np.zeros(bucket, np.int32)
+        pt = np.full((bucket, self.max_pages_per_seq), TRASH_PAGE,
+                     np.int32)
+        for i, req in enumerate(kept):
+            tokens[i] = req.tokens[-1]
+            pos[i] = req.pos
+            pt[i, :len(req.pages)] = req.pages
+        t0 = self._now()
+        logits, new_k, new_v = fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(pos),
+            jnp.asarray(pt), self.pool.k_pages, self.pool.v_pages)
+        self.pool.set_pages(new_k, new_v)
+        logits = np.asarray(logits)
+        dt = self._now() - t0
+        for i, req in enumerate(kept):
+            req.pos += 1
+            self._emit(req, logits[i])
+            self.histograms["tpot"].observe(dt)
+            self._maybe_finish(req)
+        self.counters["decode_steps"].inc()
+        return len(kept)
+
+    # -- sampling / retirement ----------------------------------------------
+
+    def _emit(self, req: Request, logits: np.ndarray) -> None:
+        """Sample the next token from fp32 logits [V] (host-side: greedy
+        argmax matches generate()'s jnp.argmax bit-for-bit; sampled mode
+        draws from a per-request, per-position RNG so replays are
+        deterministic regardless of batching)."""
+        if req.temperature == 0.0:
+            tok = int(np.argmax(logits))
+        else:
+            lg = logits.astype(np.float64) / req.temperature
+            if req.top_k > 0:
+                kth = np.sort(lg)[-req.top_k]
+                lg = np.where(lg < kth, -np.inf, lg)
+            lg = lg - lg.max()
+            probs = np.exp(lg)
+            probs /= probs.sum()
+            rng = np.random.default_rng((req.seed, len(req.tokens)))
+            tok = int(rng.choice(len(probs), p=probs))
+        req.tokens.append(tok)
+        req.out_tokens.append(tok)
+        self.counters["tokens_generated"].inc()
+        if req.stream_cb is not None:
+            req.stream_cb(req, tok)
+
+    def _maybe_finish(self, req: Request) -> None:
+        if not req.done:
+            return
+        self.pool.free(req.pages)
+        req.pages = []
+        req.state = FINISHED
+        req.finish_time = self._now()
+        if req in self.running:
+            self.running.remove(req)
+        self.finished[req.req_id] = req
+        self.counters["requests_completed"].inc()
+        self.histograms["request_latency"].observe(
+            req.finish_time - req.submit_time)
+
+    # -- observability -------------------------------------------------------
+
+    def metrics_summary(self) -> Dict[str, Any]:
+        out = {k: c.value for k, c in self.counters.items()}
+        out.update({k: g.value for k, g in self.gauges.items()})
+        for k, h in self.histograms.items():
+            out[k] = h.summary()
+        out["compile_count"] = self.compile_count
+        return out

@@ -1,0 +1,136 @@
+"""Paged KV-cache pool: preallocated page storage + free-list allocator.
+
+The dense decode cache (``models/generate.py``) holds ``[b, max_len,
+kvh, hd]`` per layer — every request pays for the *longest possible*
+sequence up front.  The pool instead preallocates ``num_pages`` fixed
+``page_size``-token pages per layer and hands them out on demand: a
+request holds ``ceil(len/page_size)`` pages, so mixed-length traffic
+shares HBM proportionally to what it actually uses (the Ragged Paged
+Attention storage layout, PAPERS.md arxiv 2604.15464).
+
+Page 0 is a reserved **trash page**: every padded page-table slot (the
+tail of a request's table, dummy batch slots) points at it, so the
+jitted prefill/decode programs can scatter-write unconditionally with
+static shapes — writes land in the trash page, reads past ``seq_len``
+are masked by the attention op.  It is never allocated.
+
+Sharding: pages are ``[num_pages, page_size, kv_heads, head_dim]`` —
+the same ``kv_heads`` axis the training stack splits across ``tp``
+(nn/parallel.py column-parallel QKV), so a pool built with a mesh
+shards pages ``P(None, None, 'tp', None)`` and the decode executable's
+per-shard pages line up with the per-shard QKV projections.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+TRASH_PAGE = 0
+
+
+class PagedKVPool:
+    """Free-list page allocator over per-layer k/v page arrays."""
+
+    def __init__(self, num_layers: int, num_pages: int, page_size: int,
+                 kv_heads: int, head_dim: int, dtype=jnp.float32,
+                 mesh=None, kv_axis: str = "tp"):
+        if num_pages < 2:
+            raise ValueError(f"num_pages must be >= 2 (page 0 is the "
+                             f"reserved trash page), got {num_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_layers = int(num_layers)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.kv_heads = int(kv_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = jnp.dtype(dtype)
+        shape = (num_pages, page_size, kv_heads, head_dim)
+        self.sharding = None
+        if mesh is not None and kv_axis in getattr(mesh, "axis_names", ()):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            tp = mesh.shape[kv_axis]
+            if kv_heads % tp == 0:
+                self.sharding = NamedSharding(
+                    mesh, P(None, None, kv_axis, None))
+
+        def make():
+            z = jnp.zeros(shape, self.dtype)
+            return jax.device_put(z, self.sharding) if self.sharding \
+                else z
+
+        self.k_pages: Tuple[jax.Array, ...] = tuple(
+            make() for _ in range(num_layers))
+        self.v_pages: Tuple[jax.Array, ...] = tuple(
+            make() for _ in range(num_layers))
+        # LIFO free list: recently-freed pages are re-issued first (their
+        # HBM is hot); page 0 reserved
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._allocated = set()
+
+    # -- allocator -----------------------------------------------------------
+
+    @property
+    def num_usable(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return len(self._allocated)
+
+    @property
+    def utilization(self) -> float:
+        return self.used_pages / self.num_usable
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` KV entries."""
+        return -(-int(n_tokens) // self.page_size)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` pages; None (no partial grant) when the pool
+        can't satisfy the request — the scheduler's eviction signal."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._allocated.update(pages)
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        for pg in pages:
+            if pg not in self._allocated:
+                raise ValueError(f"double free / foreign page {pg}")
+            self._allocated.remove(pg)
+            self._free.append(pg)
+
+    def check_invariants(self) -> None:
+        """Allocator bookkeeping invariants (asserted by tests after
+        every scheduling storm): free+allocated partition the usable
+        pages, trash page never issued."""
+        free = set(self._free)
+        assert not (free & self._allocated), "page both free and allocated"
+        assert free | self._allocated == set(range(1, self.num_pages)), \
+            "pages leaked or invented"
+        assert TRASH_PAGE not in free and TRASH_PAGE not in self._allocated
+
+    # -- accounting ----------------------------------------------------------
+
+    @property
+    def page_bytes(self) -> int:
+        """HBM bytes one page holds across k+v and all layers."""
+        per = (self.page_size * self.kv_heads * self.head_dim *
+               self.dtype.itemsize)
+        return 2 * self.num_layers * per
+
+    def set_pages(self, k_pages, v_pages) -> None:
+        """Install updated page arrays (the jitted executables return new
+        arrays; the pool is the single owner of the live version)."""
+        self.k_pages = tuple(k_pages)
+        self.v_pages = tuple(v_pages)

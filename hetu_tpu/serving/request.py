@@ -1,0 +1,93 @@
+"""Serving request lifecycle + arrival-ordered admission queue."""
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+WAITING = "waiting"
+RUNNING = "running"
+FINISHED = "finished"
+
+
+@dataclass
+class Request:
+    """One generation request flowing through the engine.
+
+    ``tokens`` accumulates prompt + generated tokens; preemption resets
+    only the KV state (``pages``/``pos``), so a re-prefill over
+    ``tokens`` resumes the sequence with an identical continuation at
+    temperature 0.
+    """
+    req_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+    eos_token_id: Optional[int] = None
+    arrival_time: float = 0.0
+    stream_cb: Optional[Callable] = None
+
+    # runtime state
+    tokens: List[int] = field(default_factory=list)
+    out_tokens: List[int] = field(default_factory=list)
+    pages: List[int] = field(default_factory=list)
+    pos: int = 0                 # KV entries committed (next write index)
+    state: str = WAITING
+    n_preemptions: int = 0
+    peak_pages: int = 0
+    submit_time: float = 0.0
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    def __post_init__(self):
+        if not self.tokens:
+            self.tokens = list(self.prompt)
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.out_tokens)
+
+    @property
+    def done(self) -> bool:
+        if self.n_generated >= self.max_new_tokens:
+            return True
+        return (self.eos_token_id is not None and self.out_tokens and
+                self.out_tokens[-1] == self.eos_token_id)
+
+
+class RequestQueue:
+    """Arrival-time-ordered waiting queue.
+
+    ``pop_ready(now)`` only releases requests whose ``arrival_time`` has
+    passed — staggered arrivals for benchmarks/tests without threads.
+    Ties break on ``req_id`` (submission order), NOT insertion order, so
+    a request pushed BACK (didn't fit / preempted) keeps its place ahead
+    of same-arrival-time peers — no overtaking, no starvation of
+    evicted work.
+    """
+
+    def __init__(self):
+        self._heap = []
+
+    def push(self, req: Request) -> None:
+        heapq.heappush(self._heap, (req.arrival_time, req.req_id, req))
+
+    def pop_ready(self, now: float) -> Optional[Request]:
+        if self._heap and self._heap[0][0] <= now:
+            return heapq.heappop(self._heap)[2]
+        return None
+
+    def next_arrival(self) -> Optional[float]:
+        return self._heap[0][0] if self._heap else None
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __bool__(self) -> bool:
+        return bool(self._heap)

@@ -1,0 +1,109 @@
+"""Continuous-batching scheduler: admission, page budget, preemption.
+
+Every engine step the scheduler (1) admits arrived requests while the
+page budget and batch-slot budget allow, and (2) guarantees every
+running request a page for its next token, preempting the
+latest-arrived request (recompute-style eviction: pages freed, sequence
+re-prefilled later from its accumulated tokens) when the pool runs dry.
+
+Shape buckets (DESIGN.md §4 discipline, §8 for serving): decode batches
+are padded to power-of-two sizes and prefill lengths to
+power-of-two page multiples, so the number of distinct compiled
+executables is bounded by ``log2(max_batch) * log2(max_pages)`` rather
+than growing with traffic.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from .kv_pool import PagedKVPool
+from .request import WAITING, Request, RequestQueue
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class Scheduler:
+    def __init__(self, pool: PagedKVPool, max_batch: int = 8):
+        self.pool = pool
+        self.max_batch = int(max_batch)
+
+    # -- shape buckets -------------------------------------------------------
+
+    def decode_bucket(self, n_live: int) -> int:
+        """Decode batch bucket: next power of two, capped at max_batch."""
+        return min(self.max_batch, _next_pow2(max(1, n_live)))
+
+    def prefill_bucket(self, n_tokens: int) -> int:
+        """Prefill length bucket: power-of-two number of pages (so the
+        dense prefill cache scatters into whole pages with static
+        slices)."""
+        ps = self.pool.page_size
+        return ps * _next_pow2(self.pool.pages_for(max(1, n_tokens)))
+
+    # -- admission -----------------------------------------------------------
+
+    def admit(self, queue: RequestQueue, running: List[Request],
+              now: float) -> List[Request]:
+        """Pop arrived requests while a batch slot AND the pages for
+        prompt+first-token fit.  Stops at the first request that doesn't
+        fit (FIFO — no small-request overtaking, keeps TTFT fair)."""
+        admitted: List[Request] = []
+        budget = self.pool.free_pages   # pages not yet claimed this step
+        while len(running) + len(admitted) < self.max_batch:
+            req = queue.pop_ready(now)
+            if req is None:
+                break
+            need = self.pool.pages_for(len(req.tokens) + 1)
+            if need > budget:
+                queue.push(req)        # original arrival order: stays first
+                break
+            budget -= need
+            admitted.append(req)
+        return admitted
+
+    # -- decode page budget --------------------------------------------------
+
+    def ensure_decode_pages(self, running: List[Request]
+                            ) -> Tuple[List[Request], List[Request]]:
+        """Give every running request a page for its next KV write,
+        evicting latest-arrived requests on exhaustion.  Returns
+        (kept, evicted); evicted requests are already reset to WAITING
+        with their pages freed."""
+        evicted: List[Request] = []
+        kept = sorted(running, key=lambda r: (r.arrival_time, r.req_id))
+        for req in list(kept):
+            if req in evicted:
+                continue
+            if len(req.pages) * self.pool.page_size >= req.pos + 1:
+                continue               # current page still has room
+            while True:
+                got = self.pool.alloc(1)
+                if got is not None:
+                    req.pages.extend(got)
+                    req.peak_pages = max(req.peak_pages, len(req.pages))
+                    break
+                victims = [r for r in kept
+                           if r not in evicted and r is not req]
+                victim = max(victims,
+                             key=lambda r: (r.arrival_time, r.req_id)) \
+                    if victims else req
+                self.preempt(victim)
+                evicted.append(victim)
+                if victim is req:
+                    break
+        return [r for r in kept if r not in evicted], evicted
+
+    def preempt(self, req: Request) -> None:
+        """Recompute-style eviction: drop KV state, keep the token
+        history — re-prefilling ``req.tokens`` reproduces the sequence
+        exactly (asserted at temperature 0 in tests)."""
+        self.pool.free(req.pages)
+        req.pages = []
+        req.pos = 0
+        req.state = WAITING
+        req.n_preemptions += 1

@@ -98,6 +98,127 @@ class Metrics:
         self.close()
 
 
+# -- serving instruments -----------------------------------------------------
+#
+# The Metrics recorder above is a step-keyed time series (training loops
+# log once per step).  Serving needs instantaneous instruments instead:
+# monotonically increasing counters (tokens out), point-in-time gauges
+# (batch occupancy, page-pool utilization), and latency distributions
+# (TTFT/TPOT percentiles).  All three share a no-op fallback so the
+# engine's hot loop pays nothing when observability is disabled.
+
+
+class Counter:
+    """Monotonically increasing count (tokens generated, preemptions)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Point-in-time value (queue depth, pool utilization)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Histogram:
+    """Latency/size distribution with exact percentiles.
+
+    Serving cares about tails over bounded windows (a few thousand
+    requests), so observations are kept raw (capped deque) and
+    percentiles computed exactly — no bucket-boundary error, no bucket
+    schema to choose per deployment.
+    """
+
+    __slots__ = ("name", "_obs", "count", "total")
+
+    def __init__(self, name: str = "", max_observations: int = 4096):
+        self.name = name
+        self._obs = deque(maxlen=int(max_observations))
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self._obs.append(v)
+        self.count += 1
+        self.total += v
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def percentile(self, p: float) -> float:
+        """Exact percentile over the retained window (p in [0, 100])."""
+        if not self._obs:
+            return 0.0
+        xs = sorted(self._obs)
+        idx = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
+        return xs[idx]
+
+    def summary(self) -> Dict[str, float]:
+        return {"count": self.count, "mean": self.mean,
+                "p50": self.percentile(50), "p90": self.percentile(90),
+                "p99": self.percentile(99)}
+
+
+class _NullInstrument:
+    """No-op stand-in for any instrument when metrics are disabled: every
+    method swallows its arguments, every read returns zero."""
+
+    name = ""
+    value = 0.0
+    count = 0
+    total = 0.0
+    mean = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+    def percentile(self, p: float) -> float:
+        return 0.0
+
+    def summary(self) -> Dict[str, float]:
+        # zeroed, same keys as Histogram.summary: consumers indexing
+        # e.g. ["p90"] must not crash when metrics are disabled
+        return {"count": 0, "mean": 0.0, "p50": 0.0, "p90": 0.0,
+                "p99": 0.0}
+
+
+NULL_INSTRUMENT = _NullInstrument()
+
+
+def make_instrument(kind: str, name: str = "", enabled: bool = True):
+    """Factory with the disabled fallback: ``make_instrument("gauge",
+    "occupancy", enabled=False)`` returns the shared no-op instrument."""
+    if not enabled:
+        return NULL_INSTRUMENT
+    cls = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}.get(
+        kind.lower())
+    if cls is None:
+        raise ValueError(f"unknown instrument kind {kind!r}")
+    return cls(name)
+
+
 def load_jsonl(path: str) -> List[Dict[str, Any]]:
     """Read back a Metrics JSONL stream."""
     out = []
