@@ -486,6 +486,41 @@ def bench_comm_microbench() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def bench_lint_graph() -> dict:
+    """The static-analysis gate as a bench target (ISSUE 3: lint-graph):
+    runs ``python -m hetu_tpu.analysis --check`` in a pinned-CPU
+    subprocess and reports pass/fail plus the analyzer's per-executable
+    collective summary.  CI tier-1 runs the same gate through the
+    ``lint_graph`` pytest marker (tests/test_analysis.py)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)       # the CLI forces its own device count
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "hetu_tpu.analysis", "--check",
+             "--json"],
+            cwd=here, env=env, capture_output=True, text=True,
+            timeout=1200)
+        lines = [l for l in proc.stdout.splitlines() if l.strip()]
+        payload = {}
+        try:
+            start = proc.stdout.index("{")
+            payload, _ = json.JSONDecoder().raw_decode(proc.stdout[start:])
+        except Exception:
+            pass
+        summary = {
+            name: {"collectives": ex.get("collectives", {}),
+                   "findings": ex.get("findings", [])}
+            for name, ex in payload.get("executables", {}).items()}
+        return {"gate_passed": proc.returncode == 0,
+                "executables": summary,
+                "tail": "" if proc.returncode == 0 else
+                        "\n".join(lines[-8:])}
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def bench_serving_microbench() -> dict:
     """Serving microbench (ISSUE 2): dense-cache ``generate()`` vs the
     paged continuous-batching engine on a GPT-2-small-proportioned model
@@ -664,7 +699,8 @@ def main():
     if len(sys.argv) > 1:
         sub = sys.argv[1]
         fns = {"serving_microbench": bench_serving_microbench,
-               "comm_microbench": bench_comm_microbench}
+               "comm_microbench": bench_comm_microbench,
+               "lint_graph": bench_lint_graph}
         if sub not in fns:
             print(json.dumps({"error": f"unknown subcommand {sub!r}; "
                                        f"have {sorted(fns)}"}))

@@ -30,6 +30,7 @@ from .ops.functional import *  # noqa: F401,F403
 from . import nn   # noqa: E402
 from . import optim  # noqa: E402
 from . import serving  # noqa: E402
+from . import analysis  # noqa: E402
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,146 @@
+"""Static analysis of lowered executables: sharding & collectives linter.
+
+Hetu's core claim is that sharding annotations (``DistributedStates`` /
+PartitionSpecs) *deterministically imply* the communication a program
+performs.  This package makes the whole lowered program checkable
+against that claim, generalizing PR 1's gradient-sync verifier to every
+registered executable (train steps, serving prefill/decode, pipeline
+stages):
+
+* **collective inventory** — :mod:`.jaxpr_walk` walks the closed jaxpr
+  of a plan and records every communication op with payload/wire bytes,
+  mesh axes, dtype, loop trip counts, and source attribution (user
+  frame + the jax name-stack tags :func:`hetu_tpu.parallel.comm.comm_tag`
+  plants at emission sites).
+* **lint rules** — :mod:`.rules` runs a rule engine over each
+  executable's context (jaxpr + graph-level facts + compiled HLO +
+  serving pool snapshots): replicated-large-param, implicit-reshard,
+  wide-collective, donation-miss, unreduced-psum-scalar,
+  trash-page-write.
+* **baseline gate** — ``python -m hetu_tpu.analysis --check`` analyzes
+  the canonical train + serving executables and fails when collective
+  counts/bytes regress past ``ANALYSIS_BASELINE.json`` or a new finding
+  appears (``--update-baseline`` re-freezes after intentional changes).
+
+Executables register themselves: ``DefineAndRunGraph.run`` registers
+every built plan, ``serving.Engine`` registers its prefill/decode
+executables (``hetu_tpu.graph.register_executable`` is the public hook
+for anything else).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..graph.graph import (ExecutableHandle, clear_executables,
+                           get_executable, iter_executables,
+                           register_executable)
+from .jaxpr_walk import (collect_collectives, compute_dtype_histogram,
+                         donation_candidates, iter_eqns,
+                         unreduced_scalar_outputs)
+from .report import (AnalysisReport, CollectiveRecord, ExecutableReport,
+                     Finding, load_baseline, save_baseline)
+from .rules import (DEFAULT_OPTIONS, RULES, AnalysisContext, ParamInfo,
+                    rule, run_rules)
+
+__all__ = [
+    "AnalysisContext", "AnalysisReport", "CollectiveRecord",
+    "ExecutableHandle", "ExecutableReport", "Finding", "ParamInfo",
+    "RULES", "DEFAULT_OPTIONS", "analyze_handle", "analyze_registered",
+    "build_context", "clear_executables", "collect_collectives",
+    "get_executable", "grad_comm_prediction", "iter_executables",
+    "register_executable", "rule", "run_rules", "verify_grad_comm",
+    "load_baseline", "save_baseline",
+]
+
+
+def build_context(handle: ExecutableHandle, compile: bool = False,
+                  options: Optional[Dict[str, Any]] = None
+                  ) -> AnalysisContext:
+    """Assemble the rule-engine context for one executable: trace the
+    plan (no execution), walk its jaxpr, and graft on the graph-level
+    facts the registration meta carries."""
+    meta = handle.meta
+    jaxpr = handle.jaxpr
+    lowered = handle.lower()
+    params = [ParamInfo(name=p["name"], shape=tuple(p["shape"]),
+                        dtype=p["dtype"], pspec=p.get("pspec"),
+                        trainable=p.get("trainable", True))
+              for p in meta.get("params", ())]
+    serving = meta.get("serving")
+    if callable(serving):
+        serving = serving()
+    ctx = AnalysisContext(
+        name=handle.name,
+        jaxpr=jaxpr,
+        lowered_text=lowered.as_text(),
+        compiled_text=handle.compiled_text() if compile else "",
+        records=collect_collectives(jaxpr),
+        params=params,
+        mesh_axes=dict(meta.get("mesh_axes", {})),
+        dp_axis=meta.get("dp_axis", "dp"),
+        args_info=lowered.args_info,
+        out_avals=jaxpr.out_avals,
+        allowed_gspmd=meta.get("allowed_gspmd"),
+        serving=serving,
+        meta=meta,
+    )
+    if options:
+        ctx.options = {**ctx.options, **options}
+    return ctx
+
+
+def analyze_handle(handle: ExecutableHandle, compile: bool = False,
+                   options: Optional[Dict[str, Any]] = None,
+                   rules: Optional[Sequence[str]] = None
+                   ) -> ExecutableReport:
+    """Analyze one executable: inventory + lint findings."""
+    ctx = build_context(handle, compile=compile, options=options)
+    rep = ExecutableReport(name=handle.name, records=ctx.records,
+                           meta={"kind": handle.meta.get("kind", "")})
+    rep.findings = run_rules(ctx, only=rules)
+    return rep
+
+
+def analyze_registered(prefix: str = "", compile: bool = False,
+                       options: Optional[Dict[str, Any]] = None,
+                       rules: Optional[Sequence[str]] = None
+                       ) -> AnalysisReport:
+    """Analyze every registered executable whose name starts with
+    ``prefix``; returns the combined :class:`AnalysisReport`."""
+    report = AnalysisReport()
+    for handle in iter_executables(prefix):
+        report.add(analyze_handle(handle, compile=compile,
+                                  options=options, rules=rules))
+    return report
+
+
+# ---------------------------------------------------------------------------
+# grad-comm predictor, folded into the general pass (PR 1 compatibility)
+# ---------------------------------------------------------------------------
+
+
+def grad_comm_prediction(handle: ExecutableHandle):
+    """``(prediction, extra)`` for a train-step handle whose plan runs
+    the explicit coalesced grad sync — the exact collective sequence the
+    lowered program must emit (``dstates.predict_update_step_collectives``
+    over the registered gradient entries)."""
+    gc = handle.meta.get("grad_comm")
+    if not gc:
+        raise ValueError(
+            f"{handle.name} has no grad-comm plan registered (implicit "
+            f"GSPMD sync, or not a train step)")
+    from ..parallel.dstates import predict_update_step_collectives
+    entries = [(name, tuple(shape), dtype)
+               for name, shape, dtype in gc["entries"]]
+    return predict_update_step_collectives(
+        entries, gc["device_num"], transport=gc["transport"],
+        bucket_mb=gc["bucket_mb"], scalar_fetches=gc["scalar_fetches"])
+
+
+def verify_grad_comm(handle: ExecutableHandle) -> None:
+    """PR 1's ``verify_grad_comm_emission`` assertion, reproduced through
+    the general pass: the lowered StableHLO of the registered train step
+    must contain exactly the predicted collective sequence."""
+    from ..parallel.dstates import verify_grad_comm_emission
+    pred, extra = grad_comm_prediction(handle)
+    verify_grad_comm_emission(handle.lower().as_text(), pred, extra=extra)

@@ -1,0 +1,6 @@
+"""``python -m hetu_tpu.analysis`` entry point (see cli.py)."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

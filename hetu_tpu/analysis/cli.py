@@ -1,0 +1,185 @@
+"""``python -m hetu_tpu.analysis`` — the lint-graph CI gate.
+
+Builds the canonical executables (a GPT-2-small-shaped train step on a
+pure-dp mesh with the explicit int8 grad sync, and the serving
+prefill/decode executables of a small continuous-batching engine — both
+scaled down so the gate runs on CPU in CI), analyzes every one, and:
+
+* ``--check`` (default): compare against ``ANALYSIS_BASELINE.json`` —
+  exit 1 when a collective count grows, payload/wire bytes grow beyond
+  ``--tolerance``, a new lint finding appears, or the grad-comm
+  emission no longer matches the DistributedStates prediction.
+* ``--update-baseline``: re-freeze the baseline after an INTENTIONAL
+  perf change (review the printed diff before committing it).
+* ``--json``: dump the full report (with per-collective records) to
+  stdout instead of the summary.
+
+The model shapes are deliberately frozen: the baseline pins exact
+collective counts, so any change to the lowering path (a new implicit
+reshard, a lost donation, a widened transport) trips the gate even when
+tests still pass numerically.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+BASELINE_DEFAULT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "ANALYSIS_BASELINE.json")
+
+
+def _force_cpu_mesh() -> None:
+    """The gate needs >= 8 devices; CPU CI gets them virtually.  Must
+    run before jax initializes a backend (import is fine, first device
+    query is not)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    if os.environ["JAX_PLATFORMS"] == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+
+def build_gate_executables():
+    """Build + register the gate's executables; returns their names.
+
+    Deterministic by construction: fixed seeds, fixed shapes, fixed
+    request schedule — the baseline pins the exact collective counts.
+    """
+    import numpy as np
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import hetu_tpu as ht
+    from hetu_tpu import optim
+    from hetu_tpu.graph.graph import DefineAndRunGraph, clear_executables
+    from hetu_tpu.models import GPTConfig, GPTLMHeadModel, llama_config
+    from hetu_tpu.parallel import create_mesh
+    from hetu_tpu.serving import Engine
+
+    clear_executables("gate_")
+    devices = jax.devices()[:8]
+
+    # -- train step: GPT-2-small-shaped (12-head/768-wide ratios scaled
+    # to CI size), dp=8, ZeRO-2, explicit int8 grad sync ---------------
+    ht.set_seed(0)
+    mesh = create_mesh({"dp": 8}, devices)
+    cfg = llama_config(vocab_size=256, hidden_size=64, num_layers=2,
+                       num_heads=4, max_seq_len=32, sp=False)
+    g = DefineAndRunGraph("gate_train")
+    g.mesh = mesh
+    with ht.graph(g):
+        ids = ht.parallel_placeholder("int32", (8, 32),
+                                      pspec=P("dp", None), name="ids")
+        labels = ht.parallel_placeholder("int32", (8, 32),
+                                         pspec=P("dp", None), name="labels")
+        model = GPTLMHeadModel(cfg)
+        loss = model(ids, labels)
+        train_op = optim.AdamOptimizer(lr=1e-2, zero=2,
+                                       grad_comm="int8").minimize(loss)
+        rng = np.random.RandomState(0)
+        IDS = rng.randint(0, 256, (8, 32)).astype(np.int32)
+        g.run(loss, [loss, train_op], {ids: IDS,
+                                       labels: np.roll(IDS, -1, axis=1)})
+        assert g._grad_comm_active, g._grad_comm_fallback
+
+    # -- serving: prefill + decode over the paged pool -----------------
+    ht.set_seed(1)
+    scfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                     num_heads=4, max_seq_len=64)
+    with ht.graph("eager", create_new=True):
+        smodel = GPTLMHeadModel(scfg)
+        smodel.logits(np.zeros((1, 4), np.int32))
+        state = {k: np.asarray(v) for k, v in
+                 smodel.state_dict().items()}
+    clock = [0.0]
+    eng = Engine(state, scfg, num_pages=16, page_size=8, max_batch=4,
+                 name="gate_serving", time_fn=lambda: clock[0])
+    eng.add_request([1, 2, 3, 4, 5], max_new_tokens=4)
+    eng.add_request([7, 8, 9], max_new_tokens=4)
+    while eng.has_work:
+        eng.step()
+        clock[0] += 1.0
+    eng.pool.check_invariants()
+    return ["gate_train/plan0"] + sorted(
+        f"gate_serving/{k}-{b}" for k, b in eng._compiled)
+
+
+def run_gate(baseline_path: str = BASELINE_DEFAULT,
+             tolerance: float = 0.1, update: bool = False,
+             as_json: bool = False, compile: bool = True,
+             out=sys.stdout) -> int:
+    """Build, analyze, gate.  Returns the process exit code."""
+    from . import (AnalysisReport, analyze_handle, get_executable,
+                   load_baseline, save_baseline, verify_grad_comm)
+
+    names = build_gate_executables()
+    report = AnalysisReport()
+    problems = []
+    for name in names:
+        handle = get_executable(name)
+        report.add(analyze_handle(handle, compile=compile))
+        if handle.meta.get("grad_comm"):
+            # PR-1 grad-comm emission assertions, via the general pass
+            try:
+                verify_grad_comm(handle)
+            except AssertionError as e:
+                problems.append(f"{name}: grad-comm emission drifted "
+                                f"from the DS prediction: {e}")
+    if as_json:
+        print(report.to_json(records=True), file=out)
+    else:
+        print(report.summary(), file=out)
+    if update:
+        save_baseline(baseline_path, report)
+        print(f"baseline written to {baseline_path}", file=out)
+        return 0
+    baseline = load_baseline(baseline_path)
+    problems += report.check_against_baseline(baseline,
+                                              tolerance=tolerance)
+    if problems:
+        print("\nLINT-GRAPH GATE FAILED:", file=out)
+        for p in problems:
+            print(f"  ! {p}", file=out)
+        print(f"\n(intentional change? review and re-freeze with "
+              f"`python -m hetu_tpu.analysis --update-baseline`)",
+              file=out)
+        return 1
+    print("\nlint-graph gate OK (baseline "
+          f"{os.path.basename(baseline_path)})", file=out)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hetu_tpu.analysis",
+        description="jaxpr/HLO sharding & collectives linter + CI gate")
+    ap.add_argument("--check", action="store_true",
+                    help="gate against the baseline (default action)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="re-freeze ANALYSIS_BASELINE.json")
+    ap.add_argument("--baseline", default=BASELINE_DEFAULT,
+                    help=f"baseline path (default {BASELINE_DEFAULT})")
+    ap.add_argument("--tolerance", type=float, default=0.1,
+                    help="relative byte-regression tolerance (default 0.1;"
+                         " collective COUNTS are always exact)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    ap.add_argument("--no-compile", action="store_true",
+                    help="skip post-SPMD compilation (disables the "
+                         "implicit-reshard rule)")
+    args = ap.parse_args(argv)
+    _force_cpu_mesh()
+    return run_gate(baseline_path=args.baseline,
+                    tolerance=args.tolerance,
+                    update=args.update_baseline,
+                    as_json=args.json,
+                    compile=not args.no_compile)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,208 @@
+"""Analysis data model: collective inventory, lint findings, baselines.
+
+The analyzer (``hetu_tpu.analysis``) walks the closed jaxpr / lowered
+StableHLO of registered executables and produces an
+:class:`AnalysisReport` — one :class:`ExecutableReport` per executable,
+each holding a **collective inventory** (every communication op the
+traced program performs, with payload/wire accounting and source
+attribution) and the **lint findings** the rule engine raised.
+
+Baselines (``ANALYSIS_BASELINE.json``) freeze the per-executable
+collective counts/bytes and the accepted findings;
+:meth:`AnalysisReport.check_against_baseline` is the CI gate — counts
+may not grow, bytes may not grow beyond a tolerance, and no finding may
+appear whose key is not already recorded.  Finding keys deliberately
+exclude source lines (they shift with unrelated edits); they are
+``executable::rule::subject`` with ``subject`` a stable slug (a param
+name, a collective kind, an argument index).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+BASELINE_VERSION = 1
+
+
+@dataclasses.dataclass
+class CollectiveRecord:
+    """One communication op in a traced program.
+
+    ``count`` folds in enclosing loop trip counts (a psum inside a
+    ``lax.scan`` of length M executes M times per step); ``payload_bytes``
+    and ``wire_bytes`` are PER EXECUTION — totals multiply by ``count``.
+    ``scope`` is the jax name-stack at the emission site (the
+    ``comm.comm_tag`` attribution tags land here); ``source`` is the user
+    frame ``file:line`` from eqn provenance.
+    """
+    kind: str                 # all_reduce | all_gather | all_to_all | ...
+    axes: Tuple[str, ...]
+    dtype: str
+    payload_bytes: int
+    wire_bytes: float
+    count: int = 1
+    scope: str = ""
+    source: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint-rule violation."""
+    rule: str
+    subject: str              # stable slug: param name, kind, arg index...
+    message: str
+    executable: str = ""
+    source: str = ""
+    severity: str = "warn"
+
+    @property
+    def key(self) -> str:
+        """Baseline identity — stable across unrelated source motion."""
+        return f"{self.executable}::{self.rule}::{self.subject}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        src = f" [{self.source}]" if self.source else ""
+        return f"{self.rule}({self.subject}): {self.message}{src}"
+
+
+@dataclasses.dataclass
+class ExecutableReport:
+    """Analysis result for one executable."""
+    name: str
+    records: List[CollectiveRecord] = dataclasses.field(default_factory=list)
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def collective_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for r in self.records:
+            out[r.kind] = out.get(r.kind, 0) + r.count
+        return out
+
+    @property
+    def total_payload_bytes(self) -> int:
+        return sum(r.payload_bytes * r.count for r in self.records)
+
+    @property
+    def total_wire_bytes(self) -> float:
+        return sum(r.wire_bytes * r.count for r in self.records)
+
+    def to_dict(self, records: bool = True) -> dict:
+        d = {"collectives": self.collective_counts(),
+             "payload_bytes": self.total_payload_bytes,
+             "wire_bytes": round(self.total_wire_bytes, 1),
+             "findings": sorted(f.key for f in self.findings)}
+        if records:
+            d["records"] = [r.to_dict() for r in self.records]
+        return d
+
+
+class AnalysisReport:
+    """Reports for a set of executables + the baseline gate."""
+
+    def __init__(self):
+        self.executables: Dict[str, ExecutableReport] = {}
+
+    def add(self, rep: ExecutableReport) -> ExecutableReport:
+        self.executables[rep.name] = rep
+        return rep
+
+    @property
+    def findings(self) -> List[Finding]:
+        return [f for rep in self.executables.values()
+                for f in rep.findings]
+
+    def to_dict(self, records: bool = False) -> dict:
+        return {"version": BASELINE_VERSION,
+                "executables": {name: rep.to_dict(records=records)
+                                for name, rep in
+                                sorted(self.executables.items())}}
+
+    def to_json(self, records: bool = False) -> str:
+        return json.dumps(self.to_dict(records=records), indent=1,
+                          sort_keys=True)
+
+    def summary(self) -> str:
+        lines = []
+        for name, rep in sorted(self.executables.items()):
+            counts = rep.collective_counts()
+            lines.append(
+                f"{name}: {sum(counts.values())} collectives {counts}, "
+                f"{rep.total_payload_bytes} payload B, "
+                f"{rep.total_wire_bytes:.0f} wire B/rank, "
+                f"{len(rep.findings)} findings")
+            for f in rep.findings:
+                lines.append(f"  - {f}")
+        return "\n".join(lines)
+
+    # -- baseline gate -------------------------------------------------------
+
+    def check_against_baseline(self, baseline: Optional[dict],
+                               tolerance: float = 0.1) -> List[str]:
+        """Regression check against a baseline dict.
+
+        Fails (returns messages) when: an executable is missing from the
+        baseline, a collective count grew, payload/wire bytes grew more
+        than ``tolerance`` (relative), or a finding key not recorded in
+        the baseline appeared.  Improvements (fewer collectives / bytes /
+        findings) pass — re-freeze them with ``--update-baseline``.
+        """
+        problems: List[str] = []
+        if not baseline:
+            return [f"no baseline for {name} (run --update-baseline)"
+                    for name in sorted(self.executables)]
+        base_exes = baseline.get("executables", {})
+        for name, rep in sorted(self.executables.items()):
+            base = base_exes.get(name)
+            if base is None:
+                problems.append(f"{name}: not in baseline "
+                                f"(run --update-baseline)")
+                continue
+            want = base.get("collectives", {})
+            got = rep.collective_counts()
+            for kind in sorted(set(want) | set(got)):
+                w, g = int(want.get(kind, 0)), int(got.get(kind, 0))
+                if g > w:
+                    problems.append(
+                        f"{name}: {kind} count regressed {w} -> {g}")
+            for field, value in (("payload_bytes", rep.total_payload_bytes),
+                                 ("wire_bytes", rep.total_wire_bytes)):
+                b = float(base.get(field, 0))
+                if value > b * (1.0 + tolerance) and value - b > 1:
+                    problems.append(
+                        f"{name}: {field} regressed {b:.0f} -> "
+                        f"{value:.0f} (> {tolerance:.0%} tolerance)")
+            known = set(base.get("findings", ()))
+            for f in rep.findings:
+                if f.key not in known:
+                    problems.append(f"{name}: new finding {f}")
+        for name in sorted(set(base_exes) - set(self.executables)):
+            problems.append(
+                f"{name}: in baseline but not analyzed (stale baseline? "
+                f"run --update-baseline)")
+        return problems
+
+
+def load_baseline(path: str) -> Optional[dict]:
+    import os
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has version {data.get('version')}, "
+            f"analyzer speaks {BASELINE_VERSION}")
+    return data
+
+
+def save_baseline(path: str, report: AnalysisReport) -> None:
+    with open(path, "w") as f:
+        f.write(report.to_json(records=False) + "\n")

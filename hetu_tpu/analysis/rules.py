@@ -1,0 +1,297 @@
+"""Lint rule engine over analyzed executables.
+
+Every rule is a function ``rule(ctx: AnalysisContext) -> List[Finding]``
+registered in :data:`RULES` via the :func:`rule` decorator; the pass
+driver (``hetu_tpu.analysis.analyze_handle``) builds one
+:class:`AnalysisContext` per executable and runs every enabled rule.
+
+Rule catalog (DESIGN.md §9 for the rationale of each):
+
+``replicated-large-param``   param above a size threshold with no
+                             sharded axis, while the mesh has shardable
+                             (non-dp) axes — accidental full replication.
+``implicit-reshard``         compiled-HLO collective counts exceed the
+                             jaxpr inventory + declared GSPMD allowance:
+                             GSPMD inserted a resharding the program's
+                             DistributedStates transitions don't predict.
+``wide-collective``          fp32/fp64 transport above a payload
+                             threshold where the surrounding compute is
+                             bf16/fp16/int8 (quantized-scale sidecars,
+                             tagged ``scales``, are exempt).
+``donation-miss``            large input buffer whose shape/dtype
+                             reappears in the outputs but is not donated.
+``unreduced-psum-scalar``    scalar result of a >1-device manual region
+                             with no cross-replica reduction on its
+                             def-chain (each rank returns its local
+                             value).
+``trash-page-write``         serving: the reserved page 0 is reachable by
+                             a real write — present in the pool
+                             free-list/allocated set, or a live decode
+                             row's page table targets it (padding rows
+                             are the only legitimate trash-page writers).
+
+Thresholds live in :data:`DEFAULT_OPTIONS` and are overridable per
+context (tests seed violations with tiny thresholds).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .jaxpr_walk import (compute_dtype_histogram, donation_candidates,
+                         unreduced_scalar_outputs)
+from .report import CollectiveRecord, Finding
+
+LOW_PRECISION = {"bfloat16", "float16", "int8", "uint8", "float8_e4m3fn",
+                 "float8_e5m2"}
+WIDE_DTYPES = {"float32", "float64"}
+
+DEFAULT_OPTIONS: Dict[str, Any] = {
+    # replicated-large-param: min bytes before replication is suspicious
+    "param_bytes_threshold": 1 << 20,
+    # wide-collective: min payload for a wide transport to matter
+    "wide_bytes_threshold": 1 << 20,
+    # donation-miss: min buffer size worth donating
+    "donation_bytes_threshold": 1 << 20,
+}
+
+
+@dataclasses.dataclass
+class ParamInfo:
+    """A trainable/stateful array the executable closes over."""
+    name: str
+    shape: Tuple[int, ...]
+    dtype: str
+    pspec: Any = None          # PartitionSpec or None
+    trainable: bool = True
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64)
+                   * np.dtype(self.dtype).itemsize)
+
+    def sharded_axes(self) -> set:
+        axes = set()
+        if self.pspec is None:
+            return axes
+        for entry in self.pspec:
+            if entry is None:
+                continue
+            for a in (entry if isinstance(entry, tuple) else (entry,)):
+                axes.add(a)
+        return axes
+
+
+@dataclasses.dataclass
+class AnalysisContext:
+    """Everything the rules may inspect for one executable."""
+    name: str
+    jaxpr: Any = None                       # ClosedJaxpr (traced plan)
+    lowered_text: str = ""                  # StableHLO (pre-partitioning)
+    compiled_text: str = ""                 # post-SPMD HLO ("" = skipped)
+    records: List[CollectiveRecord] = dataclasses.field(default_factory=list)
+    params: List[ParamInfo] = dataclasses.field(default_factory=list)
+    mesh_axes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    dp_axis: Optional[str] = "dp"           # replication intended here
+    args_info: Any = None                   # Lowered.args_info
+    out_avals: Any = None
+    # collectives GSPMD is EXPECTED to insert (kind -> count): e.g. the
+    # implicit-path gradient sync, or the scalar-loss psum of a
+    # sharded-batch eval step.  None disables implicit-reshard entirely
+    # (executable makes no prediction claim).
+    allowed_gspmd: Optional[Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
+    serving: Optional[Dict[str, Any]] = None   # pool/tap snapshot
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    options: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_OPTIONS))
+
+    def opt(self, key: str):
+        return self.options.get(key, DEFAULT_OPTIONS[key])
+
+
+RuleFn = Callable[[AnalysisContext], List[Finding]]
+RULES: Dict[str, RuleFn] = {}
+
+
+def rule(name: str):
+    def deco(fn: RuleFn) -> RuleFn:
+        fn.rule_name = name
+        RULES[name] = fn
+        return fn
+    return deco
+
+
+def run_rules(ctx: AnalysisContext,
+              only: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run (a subset of) the registered rules; findings carry the
+    executable name and are returned most-severe-first (by rule name
+    order of registration, which lists correctness rules first)."""
+    findings: List[Finding] = []
+    for name, fn in RULES.items():
+        if only is not None and name not in only:
+            continue
+        for f in fn(ctx):
+            f.executable = ctx.name
+            f.rule = name
+            findings.append(f)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+@rule("replicated-large-param")
+def _replicated_large_param(ctx: AnalysisContext) -> List[Finding]:
+    shardable = {a for a, n in ctx.mesh_axes.items()
+                 if n > 1 and a != ctx.dp_axis}
+    if not shardable:
+        return []       # pure-dp mesh: replicated-at-rest is the design
+    thr = ctx.opt("param_bytes_threshold")
+    out = []
+    for p in ctx.params:
+        if not p.trainable or p.nbytes < thr:
+            continue
+        if p.sharded_axes() & shardable:
+            continue
+        out.append(Finding(
+            rule="", subject=p.name,
+            message=f"param {p.name} {p.shape} ({p.nbytes} B) is fully "
+                    f"replicated; mesh has unused shardable axes "
+                    f"{sorted(shardable)}"))
+    return out
+
+
+@rule("implicit-reshard")
+def _implicit_reshard(ctx: AnalysisContext) -> List[Finding]:
+    if not ctx.compiled_text or ctx.allowed_gspmd is None:
+        return []
+    from ..parallel.dstates import count_hlo_collectives
+    got = count_hlo_collectives(ctx.compiled_text)
+    explicit = count_hlo_collectives(ctx.lowered_text) if ctx.lowered_text \
+        else {}
+    out = []
+    for kind in sorted(got):
+        allowed = explicit.get(kind, 0) + ctx.allowed_gspmd.get(kind, 0)
+        excess = got[kind] - allowed
+        if excess > 0:
+            out.append(Finding(
+                rule="", subject=kind,
+                message=f"compiled program emits {got[kind]} {kind} "
+                        f"collectives but only {allowed} are predicted "
+                        f"({explicit.get(kind, 0)} explicit + "
+                        f"{ctx.allowed_gspmd.get(kind, 0)} allowed): "
+                        f"{excess} GSPMD-inserted reshard(s) the sharding "
+                        f"annotations do not account for"))
+    return out
+
+
+@rule("wide-collective")
+def _wide_collective(ctx: AnalysisContext) -> List[Finding]:
+    if ctx.jaxpr is None:
+        return []
+    hist = compute_dtype_histogram(ctx.jaxpr)
+    if not hist:
+        return []
+    dominant = max(hist.items(), key=lambda kv: kv[1])[0]
+    if dominant not in LOW_PRECISION:
+        return []
+    thr = ctx.opt("wide_bytes_threshold")
+    out = []
+    for r in ctx.records:
+        if r.dtype not in WIDE_DTYPES or r.payload_bytes < thr:
+            continue
+        if "scales" in r.scope.split("/"):
+            # exact comm_tag path segment, not a substring — a user
+            # scope like "loss_rescales" must NOT be exempted
+            continue    # quantized-transport absmax sidecar: fp32 by design
+        out.append(Finding(
+            rule="", subject=f"{r.kind}:{r.dtype}",
+            message=f"{r.dtype} {r.kind} moves {r.payload_bytes} B over "
+                    f"{'/'.join(r.axes) or '?'} while the surrounding "
+                    f"compute is {dominant} — transport could be "
+                    f"narrowed (grad_comm= / bf16 cast)",
+            source=r.source))
+    return out
+
+
+@rule("donation-miss")
+def _donation_miss(ctx: AnalysisContext) -> List[Finding]:
+    if ctx.args_info is None or ctx.out_avals is None:
+        return []
+    thr = ctx.opt("donation_bytes_threshold")
+    out = []
+    for arg, nbytes in donation_candidates(ctx.args_info, ctx.out_avals,
+                                           min_bytes=thr):
+        out.append(Finding(
+            rule="", subject=f"arg{arg}",
+            message=f"input {arg} ({nbytes} B across its leaves) matches "
+                    f"output buffers but is not donated — the executable "
+                    f"holds two copies where one would do"))
+    return out
+
+
+@rule("unreduced-psum-scalar")
+def _unreduced_psum_scalar(ctx: AnalysisContext) -> List[Finding]:
+    if ctx.jaxpr is None:
+        return []
+    out = []
+    for var, scope, src in unreduced_scalar_outputs(ctx.jaxpr):
+        out.append(Finding(
+            rule="", subject=var,
+            message=f"scalar output {var} of a manual-mode region has no "
+                    f"psum/pmean on its def-chain: every rank returns its "
+                    f"OWN local value (scope {scope or '?'})",
+            source=src, severity="error"))
+    return out
+
+
+@rule("trash-page-write")
+def _trash_page_write(ctx: AnalysisContext) -> List[Finding]:
+    if ctx.serving is None:
+        return []
+    from ..serving.kv_pool import TRASH_PAGE
+    out = []
+    pool = ctx.serving.get("pool")
+    if pool is not None:
+        if TRASH_PAGE in getattr(pool, "_free", ()):
+            out.append(Finding(
+                rule="", subject="free-list", severity="error",
+                message="reserved trash page 0 is on the allocator "
+                        "free-list — a future alloc() will hand it to a "
+                        "request and real KV writes will land in the "
+                        "padding sink"))
+        if TRASH_PAGE in getattr(pool, "_allocated", ()):
+            out.append(Finding(
+                rule="", subject="allocated", severity="error",
+                message="reserved trash page 0 is marked allocated — a "
+                        "live request is scatter-writing the padding "
+                        "sink"))
+    ps = pool.page_size if pool is not None else \
+        ctx.serving.get("page_size", 1)
+    for step, rec in enumerate(ctx.serving.get("tap", ())):
+        if rec.get("kind") == "prefill":
+            if TRASH_PAGE in rec.get("pages", ()):
+                out.append(Finding(
+                    rule="", subject=f"prefill@{step}", severity="error",
+                    message=f"prefill at tap step {step} was handed page "
+                            f"0 — its prompt KV overwrites the padding "
+                            f"sink"))
+            continue
+        pt = np.asarray(rec.get("page_tables"))
+        pos = np.asarray(rec.get("pos"))
+        n_live = int(rec.get("n_live", 0))
+        for i in range(min(n_live, pt.shape[0] if pt.ndim else 0)):
+            if pt[i, int(pos[i]) // ps] == TRASH_PAGE:
+                out.append(Finding(
+                    rule="", subject=f"decode@{step}/row{i}",
+                    severity="error",
+                    message=f"decode at tap step {step}: LIVE row {i} "
+                            f"(pos {int(pos[i])}) scatter-writes page 0 "
+                            f"outside the padding path — its KV history "
+                            f"is being destroyed"))
+    return out

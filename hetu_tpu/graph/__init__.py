@@ -1,6 +1,8 @@
 from .tensor import Tensor, SymbolicDim
 from .graph import (Graph, EagerGraph, DefineAndRunGraph, DefineByRunGraph, OpNode, RunLevel,
-                    graph, run_level, get_default_graph)
+                    graph, run_level, get_default_graph,
+                    ExecutableHandle, register_executable, get_executable,
+                    iter_executables, clear_executables)
 from .ctor import (placeholder, parameter, variable, parallel_placeholder,
                    parallel_parameter, Initializer, ConstantInitializer,
                    UniformInitializer, NormalInitializer,
@@ -11,6 +13,8 @@ from .ctor import (placeholder, parameter, variable, parallel_placeholder,
 __all__ = [
     "Tensor", "SymbolicDim", "Graph", "EagerGraph", "DefineAndRunGraph", "DefineByRunGraph",
     "OpNode", "RunLevel", "graph", "run_level", "get_default_graph",
+    "ExecutableHandle", "register_executable", "get_executable",
+    "iter_executables", "clear_executables",
     "placeholder", "parameter", "variable", "parallel_placeholder",
     "parallel_parameter", "Initializer", "ConstantInitializer",
     "UniformInitializer", "NormalInitializer", "TruncatedNormalInitializer",

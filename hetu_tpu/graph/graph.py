@@ -54,6 +54,82 @@ class RunLevel(enum.Enum):
     UPDATE = "update"
 
 
+# ---------------------------------------------------------------------------
+# executable registry (static-analysis hook, hetu_tpu/analysis)
+# ---------------------------------------------------------------------------
+
+
+class ExecutableHandle:
+    """A lowerable reference to a compiled plan, registered for analysis.
+
+    Wraps a jitted function plus the abstract argument specs it was (or
+    will be) compiled for, so ``hetu_tpu.analysis`` can obtain the closed
+    jaxpr / StableHLO / compiled HLO of any executable — train steps,
+    serving prefill/decode, pipeline stages — WITHOUT running it.
+    ``meta`` carries graph-level facts the jaxpr cannot express (param
+    shardings, mesh axes, grad-comm plan, serving pool snapshot hooks).
+    """
+
+    def __init__(self, name: str, jit_fn, abstract_args: Tuple,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.jit_fn = jit_fn
+        self.abstract_args = tuple(abstract_args)
+        self.meta: Dict[str, Any] = dict(meta or {})
+        self._traced = None
+        self._lowered = None
+        self._compiled_text = None
+
+    def trace(self):
+        if self._traced is None:
+            self._traced = self.jit_fn.trace(*self.abstract_args)
+        return self._traced
+
+    @property
+    def jaxpr(self):
+        return self.trace().jaxpr
+
+    def lower(self):
+        if self._lowered is None:
+            self._lowered = self.trace().lower()
+        return self._lowered
+
+    def compiled_text(self) -> str:
+        """Post-SPMD optimized HLO text (compiles on first call)."""
+        if self._compiled_text is None:
+            self._compiled_text = self.lower().compile().as_text()
+        return self._compiled_text
+
+    def __repr__(self):
+        return f"ExecutableHandle({self.name!r})"
+
+
+_EXECUTABLE_REGISTRY: Dict[str, ExecutableHandle] = {}
+
+
+def register_executable(name: str, jit_fn, abstract_args,
+                        meta: Optional[Dict[str, Any]] = None
+                        ) -> ExecutableHandle:
+    """Register (or replace) an analyzable executable under ``name``."""
+    h = ExecutableHandle(name, jit_fn, abstract_args, meta)
+    _EXECUTABLE_REGISTRY[name] = h
+    return h
+
+
+def get_executable(name: str) -> ExecutableHandle:
+    return _EXECUTABLE_REGISTRY[name]
+
+
+def iter_executables(prefix: str = "") -> List[ExecutableHandle]:
+    return [h for n, h in sorted(_EXECUTABLE_REGISTRY.items())
+            if n.startswith(prefix)]
+
+
+def clear_executables(prefix: str = "") -> None:
+    for n in [n for n in _EXECUTABLE_REGISTRY if n.startswith(prefix)]:
+        del _EXECUTABLE_REGISTRY[n]
+
+
 class OpNode:
     """A graph node (reference ``OpDef``, ``operator.h:304``)."""
 
@@ -450,6 +526,8 @@ class DefineAndRunGraph(Graph):
         # explicit grad-comm introspection (set at plan-build time)
         self._grad_comm_active: bool = False
         self._grad_comm_fallback: Optional[str] = None
+        # plan key -> registered analysis-handle name (analysis hook)
+        self._plan_names: Dict[Tuple, str] = {}
 
     # -- shape-plan bucketing ------------------------------------------------
 
@@ -936,6 +1014,83 @@ class DefineAndRunGraph(Graph):
         jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
         return jit_step, gc_state
 
+    # -- analysis hook -------------------------------------------------------
+
+    def _register_plan_for_analysis(self, key, jit_step, gc_state,
+                                    update_node, real_fetches,
+                                    num_micro_batches) -> None:
+        """Expose this plan to the static analyzer (hetu_tpu/analysis):
+        register an ExecutableHandle with the abstract arg specs plus the
+        graph-level facts a jaxpr cannot carry — param shardings, mesh
+        axes, and (when the explicit path is active) the grad-comm plan
+        the dstates predictor can be run against."""
+        name = self._plan_names.get(key)
+        if name is not None and name in _EXECUTABLE_REGISTRY:
+            return
+        if name is None:
+            # registry membership is re-checked (not just _plan_names):
+            # after clear_executables() a cached plan must re-register
+            # under its original name on its next run, or it would
+            # silently vanish from analysis while still executing
+            name = f"{self.name}/plan{len(self._plan_names)}"
+            self._plan_names[key] = name
+        mesh_axes = {str(a): int(s) for a, s in self.mesh.shape.items()} \
+            if self.mesh is not None else {}
+        params = []
+        for t in self._var_tensors.values():
+            params.append({"name": t.name,
+                           "shape": tuple(t.concrete_shape()),
+                           "dtype": np.dtype(t.dtype.to_jnp()).name,
+                           "pspec": self._pspec_for(t),
+                           "trainable": bool(t.trainable)})
+        meta: Dict[str, Any] = {
+            "kind": "train_step" if update_node is not None else "forward",
+            "fetches": [getattr(f, "name", str(f)) for f in real_fetches],
+            "num_micro_batches": num_micro_batches,
+            "mesh_axes": mesh_axes,
+            "params": params,
+            "grad_comm_active": gc_state[0],
+            # explicit path predicts EVERY collective -> strict reshard
+            # gate; otherwise GSPMD owns the grad sync and no implicit-
+            # reshard claim is made (allowed_gspmd None disables it)
+            "allowed_gspmd": {} if gc_state[0] else None,
+        }
+        if update_node is not None:
+            opt = update_node.attrs["optimizer"]
+            meta["dp_axis"] = opt.dp_axis
+            if gc_state[0] and opt.zero in (1, 2):
+                # ZeRO-1/2 keeps optimizer state dp-sharded but params
+                # replicated at rest: GSPMD re-materializes each updated
+                # param from its sharded update — one predictable
+                # all_gather per dp-sharded state param (ROADMAP's
+                # reduce-scatter-only sync would remove these)
+                meta["allowed_gspmd"] = {"all_gather": len(opt._shardings)}
+            elif gc_state[0] and opt.zero >= 3:
+                # FSDP: params sharded at rest, forward gathers them —
+                # count depends on layer structure; no strict claim
+                meta["allowed_gspmd"] = None
+            if gc_state[0]:
+                xs = update_node.attrs["xs"]
+                entries = [(t.name, tuple(t.concrete_shape()),
+                            np.dtype(t.dtype.to_jnp()).name) for t in xs]
+                meta["grad_comm"] = {
+                    "entries": entries,
+                    "transport": opt.grad_comm,
+                    "bucket_mb": opt.bucket_mb,
+                    "device_num": mesh_axes.get(opt.dp_axis, 1),
+                    # each scalar fetch is pmean'd inside the manual
+                    # region (one explicit all_reduce apiece)
+                    "scalar_fetches": sum(
+                        1 for f in real_fetches
+                        if isinstance(f, Tensor) and len(f.shape) == 0),
+                }
+        register_executable(name, jit_step, self._abstract_pool[key], meta)
+
+    def analysis_handles(self) -> List[ExecutableHandle]:
+        """Handles of every plan this graph has registered."""
+        return [get_executable(n) for n in self._plan_names.values()
+                if n in _EXECUTABLE_REGISTRY]
+
     # -- hot switch ----------------------------------------------------------
 
     def cost_analysis(self):
@@ -1079,6 +1234,9 @@ class DefineAndRunGraph(Graph):
                 if not hasattr(a, "aval") else
                 jax.ShapeDtypeStruct(a.shape, a.dtype),
                 (var_state, opt_state, grad_accum, feeds_mb))
+        self._register_plan_for_analysis(key, jit_step, gc_state,
+                                         update_node, real_fetches,
+                                         num_micro_batches)
         fetch_vals, new_vars, new_opt, new_accum = jit_step(
             var_state, opt_state, grad_accum, feeds_mb)
 

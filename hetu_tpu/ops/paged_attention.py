@@ -88,19 +88,22 @@ def paged_attention_reference(q: jax.Array, k_pages: jax.Array,
                                        seq_lens)
     maxp = page_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else hd ** -0.5
-    # [B, maxp, ps, kvh, hd] -> [B, maxp*ps, kvh, hd]
-    k = k_pages[page_tables].reshape(b, maxp * ps, kvh, hd)
-    v = v_pages[page_tables].reshape(b, maxp * ps, kvh, hd)
-    g = nh // kvh
-    qg = q.reshape(b, kvh, g, hd).astype(jnp.float32)
-    s = jnp.einsum("bhgd,bshd->bhgs", qg,
-                   k.astype(jnp.float32)) * scale       # [B, kvh, g, S]
-    valid = (jnp.arange(maxp * ps)[None] <
-             seq_lens[:, None])[:, None, None, :]       # [B, 1, 1, S]
-    s = jnp.where(valid, s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhgs,bshd->bhgd", p, v.astype(jnp.float32))
-    return out.reshape(b, nh, hd).astype(q.dtype)
+    # named scope: the static analyzer (hetu_tpu/analysis) attributes
+    # eqns to this op through the jaxpr name stack
+    with jax.named_scope("paged_attention"):
+        # [B, maxp, ps, kvh, hd] -> [B, maxp*ps, kvh, hd]
+        k = k_pages[page_tables].reshape(b, maxp * ps, kvh, hd)
+        v = v_pages[page_tables].reshape(b, maxp * ps, kvh, hd)
+        g = nh // kvh
+        qg = q.reshape(b, kvh, g, hd).astype(jnp.float32)
+        s = jnp.einsum("bhgd,bshd->bhgs", qg,
+                       k.astype(jnp.float32)) * scale   # [B, kvh, g, S]
+        valid = (jnp.arange(maxp * ps)[None] <
+                 seq_lens[:, None])[:, None, None, :]   # [B, 1, 1, S]
+        s = jnp.where(valid, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhgs,bshd->bhgd", p, v.astype(jnp.float32))
+        return out.reshape(b, nh, hd).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -198,12 +201,13 @@ def paged_attention_pallas(q: jax.Array, k_pages: jax.Array,
             pltpu.VMEM((gp, hd), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kvh, gp, hd), q.dtype),
-        interpret=interpret,
-    )(sl, pt, qg, k_pages, v_pages)
+    with jax.named_scope("paged_attention"):
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, kvh, gp, hd), q.dtype),
+            interpret=interpret,
+        )(sl, pt, qg, k_pages, v_pages)
     return out[:, :, :g, :].reshape(b, nh, hd)
 
 

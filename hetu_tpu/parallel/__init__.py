@@ -3,6 +3,7 @@ from .dstates import (DUPLICATE, PARTIAL, NULL_HETERO_DIM,
                       DistributedStates, DistributedStatesUnion,
                       DistributedStatesHierarchy, SplitPattern,
                       deduce_comm_kind, predict_grad_comm_collectives,
+                      predict_update_step_collectives,
                       count_hlo_collectives, verify_grad_comm_emission)
 from .mesh import (AXIS_DP, AXIS_CP, AXIS_TP, AXIS_PP, AXIS_EP,
                    create_mesh, single_device_mesh, mesh_axis_size,
@@ -18,8 +19,8 @@ __all__ = [
     "DUPLICATE", "PARTIAL", "NULL_HETERO_DIM",
     "DistributedStates", "DistributedStatesUnion", "DistributedStatesHierarchy",
     "SplitPattern", "deduce_comm_kind", "dstates",
-    "predict_grad_comm_collectives", "count_hlo_collectives",
-    "verify_grad_comm_emission",
+    "predict_grad_comm_collectives", "predict_update_step_collectives",
+    "count_hlo_collectives", "verify_grad_comm_emission",
     "AXIS_DP", "AXIS_CP", "AXIS_TP", "AXIS_PP", "AXIS_EP",
     "create_mesh", "single_device_mesh", "mesh_axis_size",
     "ds_to_mesh_and_spec", "ds_to_named_sharding", "ds_from_partition_spec",

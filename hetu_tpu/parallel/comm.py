@@ -362,6 +362,7 @@ class CommRecord(NamedTuple):
     wire_bytes: float       # per-rank bytes on the wire (ring algorithm)
     dtype: str
     axis: str
+    tag: str = ""           # attribution tag (ambient comm_tag scope)
 
 
 class CommStats:
@@ -402,6 +403,7 @@ class CommStats:
 
 
 _STATS_STACK: List[CommStats] = []
+_TAG_STACK: List[str] = []
 
 
 @contextlib.contextmanager
@@ -413,6 +415,28 @@ def comm_stats():
         yield s
     finally:
         _STATS_STACK.remove(s)
+
+
+@contextlib.contextmanager
+def comm_tag(tag: str):
+    """Attribute collectives emitted inside to ``tag``.
+
+    Dual-plane tagging: the tag is (1) pushed onto the ambient stack so
+    trace-time :class:`CommRecord` s carry it, and (2) entered as a jax
+    ``named_scope`` so it lands on the eqn name-stack in the traced
+    jaxpr — the static analyzer (``hetu_tpu/analysis``) reads it back
+    from the program itself, with no side channel.
+    """
+    _TAG_STACK.append(tag)
+    try:
+        with jax.named_scope(tag):
+            yield
+    finally:
+        _TAG_STACK.pop()
+
+
+def current_comm_tag() -> str:
+    return "/".join(_TAG_STACK)
 
 
 def ring_wire_bytes(kind: str, payload_bytes: float, n: int) -> float:
@@ -434,7 +458,7 @@ def _record(kind: str, payload_bytes: int, dtype, n: int, axis: str) -> None:
         return
     rec = CommRecord(kind, int(payload_bytes),
                      ring_wire_bytes(kind, payload_bytes, n),
-                     np.dtype(dtype).name, axis)
+                     np.dtype(dtype).name, axis, current_comm_tag())
     for s in _STATS_STACK:
         s.records.append(rec)
 
@@ -550,10 +574,12 @@ def _qreduce_scatter_flat(flat: jax.Array, axis: str, op: str,
         codes, scales = _quantize_rows(rows, block)
         exc = lax.all_to_all(codes, axis, split_axis=0, concat_axis=0,
                              tiled=False, axis_index_groups=idx_groups)
-        exs = lax.all_to_all(scales, axis, split_axis=0, concat_axis=0,
-                             tiled=False, axis_index_groups=idx_groups)
         _record("all_to_all", n * chunk, jnp.int8, n, axis)
-        _record("all_to_all", n * (chunk // block) * 4, jnp.float32, n, axis)
+        with comm_tag("scales"):
+            exs = lax.all_to_all(scales, axis, split_axis=0, concat_axis=0,
+                                 tiled=False, axis_index_groups=idx_groups)
+            _record("all_to_all", n * (chunk // block) * 4, jnp.float32, n,
+                    axis)
         acc = jnp.sum(_dequantize_rows(exc, exs, block), axis=0)
     else:
         raise ValueError(f"unknown quantized transport {transport!r}")
@@ -580,10 +606,12 @@ def _qall_gather_flat(chunk_arr: jax.Array, axis: str, transport: str,
         codes, scales = _quantize_rows(chunk_arr.reshape(1, chunk), block)
         gc = lax.all_gather(codes[0], axis, tiled=False,
                             axis_index_groups=idx_groups)
-        gs = lax.all_gather(scales[0], axis, tiled=False,
-                            axis_index_groups=idx_groups)
         _record("all_gather", n * chunk, jnp.int8, n, axis)
-        _record("all_gather", n * (chunk // block) * 4, jnp.float32, n, axis)
+        with comm_tag("scales"):
+            gs = lax.all_gather(scales[0], axis, tiled=False,
+                                axis_index_groups=idx_groups)
+            _record("all_gather", n * (chunk // block) * 4, jnp.float32, n,
+                    axis)
         full = _dequantize_rows(gc, gs, block)
     else:
         raise ValueError(f"unknown quantized transport {transport!r}")
@@ -657,9 +685,10 @@ def all_reduce_coalesced(xs, axis: str, op: str = "sum",
     buckets = plan_buckets(
         [(k, np.shape(v), jnp.result_type(v)) for k, v in items], bucket_mb)
     out: Dict = {}
-    for b in buckets:
-        flat = _flatten_bucket(b, lookup)
-        red = _reduce_flat(flat, axis, op, transport, block, groups)
+    for bi, b in enumerate(buckets):
+        with comm_tag(f"grad_comm/bucket{bi}"):
+            flat = _flatten_bucket(b, lookup)
+            red = _reduce_flat(flat, axis, op, transport, block, groups)
         for k, arr in zip(b.keys, _unflatten_bucket(red, b)):
             out[k] = arr.astype(lookup[k].dtype)
     return rebuild([out[k] for k, _ in items])
@@ -695,23 +724,25 @@ def reduce_scatter_coalesced(xs, axis: str, op: str = "sum",
         [(k, np.shape(v), jnp.result_type(v)) for k, v in items], bucket_mb)
     n = axis_size(axis)
     chunks, chunk_lens = [], []
-    for b in buckets:
-        flat = _flatten_bucket(b, lookup)
-        chunk = quantized_chunk(flat.shape[0], n, block)
-        if transport == "fp32":
-            padded = jnp.pad(flat.astype(jnp.float32),
-                             (0, n * chunk - flat.shape[0]))
-            _record("reduce_scatter",
-                    padded.shape[0] * np.dtype(padded.dtype).itemsize,
-                    padded.dtype, n, axis)
-            shard = lax.psum_scatter(padded, axis, scatter_dimension=0,
-                                     tiled=True)
-            if op == "mean":
-                shard = shard / n
-            elif op != "sum":
-                raise ValueError(f"unsupported coalesced op {op!r}")
-        else:
-            shard = _qreduce_scatter_flat(flat, axis, op, transport, block)
+    for bi, b in enumerate(buckets):
+        with comm_tag(f"grad_comm/bucket{bi}"):
+            flat = _flatten_bucket(b, lookup)
+            chunk = quantized_chunk(flat.shape[0], n, block)
+            if transport == "fp32":
+                padded = jnp.pad(flat.astype(jnp.float32),
+                                 (0, n * chunk - flat.shape[0]))
+                _record("reduce_scatter",
+                        padded.shape[0] * np.dtype(padded.dtype).itemsize,
+                        padded.dtype, n, axis)
+                shard = lax.psum_scatter(padded, axis, scatter_dimension=0,
+                                         tiled=True)
+                if op == "mean":
+                    shard = shard / n
+                elif op != "sum":
+                    raise ValueError(f"unsupported coalesced op {op!r}")
+            else:
+                shard = _qreduce_scatter_flat(flat, axis, op, transport,
+                                              block)
         chunks.append(shard)
         chunk_lens.append(chunk)
     return chunks, CoalescedLayout(tuple(buckets), tuple(chunk_lens),
@@ -735,13 +766,16 @@ def all_gather_coalesced(chunks, layout: CoalescedLayout, axis: str,
             "with the per-group valid extents from layout.groups")
     n = axis_size(axis)
     out: Dict = {}
-    for shard, b, chunk in zip(chunks, layout.buckets, layout.chunks):
+    for bi, (shard, b, chunk) in enumerate(zip(chunks, layout.buckets,
+                                               layout.chunks)):
         numel = sum(b.numels)
-        if transport == "fp32":
-            _record("all_gather", n * chunk * 4, jnp.float32, n, axis)
-            full = lax.all_gather(shard, axis, tiled=True)[:numel]
-        else:
-            full = _qall_gather_flat(shard, axis, transport, block, numel)
+        with comm_tag(f"grad_comm/bucket{bi}"):
+            if transport == "fp32":
+                _record("all_gather", n * chunk * 4, jnp.float32, n, axis)
+                full = lax.all_gather(shard, axis, tiled=True)[:numel]
+            else:
+                full = _qall_gather_flat(shard, axis, transport, block,
+                                         numel)
         for k, arr in zip(b.keys, _unflatten_bucket(full, b)):
             out[k] = arr.astype(np.dtype(b.dtype))
     if layout.list_input:

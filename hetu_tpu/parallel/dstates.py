@@ -432,6 +432,25 @@ def verify_grad_comm_emission(hlo_text: str, prediction: List[dict],
             f"(kind: want/got): {bad}")
 
 
+def predict_update_step_collectives(entries, device_num: int,
+                                    transport: str = "fp32",
+                                    bucket_mb: float = 4.0,
+                                    block: Optional[int] = None,
+                                    scalar_fetches: int = 1):
+    """Step-level prediction for an explicit-grad-comm training
+    executable: the coalesced gradient-sync collectives
+    (:func:`predict_grad_comm_collectives`) plus one all_reduce (the
+    scalar pmean) per scalar fetch.  Returns ``(prediction, extra)`` in
+    exactly the form :func:`verify_grad_comm_emission` consumes, so the
+    general analysis pass (``hetu_tpu.analysis``) and direct HLO
+    assertions share one predictor."""
+    preds = predict_grad_comm_collectives(entries, device_num,
+                                          bucket_mb=bucket_mb,
+                                          transport=transport, block=block)
+    extra = {"all_reduce": int(scalar_fetches)} if scalar_fetches else {}
+    return preds, extra
+
+
 class SplitPattern:
     """Contiguous vs. non-contiguous split (distributed_states.h:139)."""
 

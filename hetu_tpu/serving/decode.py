@@ -21,6 +21,8 @@ bounded by the bucket grid, not the traffic mix.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -77,7 +79,10 @@ def build_prefill_fn(cfg: GPTConfig, s_pad: int, max_pages: int,
     # and corrupt it with padding KV
     n_pack = min(s_pad // page_size, max_pages)
 
-    @jax.jit
+    # page arrays are donated: the pool replaces them wholesale every
+    # call (Engine.set_pages), so XLA may scatter in place instead of
+    # holding live+new copies of the whole KV pool
+    @functools.partial(jax.jit, donate_argnums=(4, 5))
     def run(params, prompt, true_len, pt_row, k_pages, v_pages):
         p = _params_view(cfg, params)
         caches = [(jnp.zeros((1, s_pad, cfg.kv_heads, cfg.head_dim), cdt),
@@ -87,16 +92,17 @@ def build_prefill_fn(cfg: GPTConfig, s_pad: int, max_pages: int,
                                return_hidden=True)
         logits = _lm_head(p, x[0, true_len - 1][None])[0]      # [V]
         new_k, new_v = [], []
-        for i in range(cfg.num_layers):
-            kc, vc = cs[i]
-            kp, vp = k_pages[i], v_pages[i]
-            for j in range(n_pack):
-                kp = kp.at[pt_row[j]].set(
-                    kc[0, j * page_size:(j + 1) * page_size])
-                vp = vp.at[pt_row[j]].set(
-                    vc[0, j * page_size:(j + 1) * page_size])
-            new_k.append(kp)
-            new_v.append(vp)
+        with jax.named_scope("kv_page_scatter"):
+            for i in range(cfg.num_layers):
+                kc, vc = cs[i]
+                kp, vp = k_pages[i], v_pages[i]
+                for j in range(n_pack):
+                    kp = kp.at[pt_row[j]].set(
+                        kc[0, j * page_size:(j + 1) * page_size])
+                    vp = vp.at[pt_row[j]].set(
+                        vc[0, j * page_size:(j + 1) * page_size])
+                new_k.append(kp)
+                new_v.append(vp)
         return logits, tuple(new_k), tuple(new_v)
 
     return run
@@ -124,7 +130,7 @@ def build_decode_fn(cfg: GPTConfig, batch: int, max_pages: int,
     hd, nh, nkv = c.head_dim, c.num_heads, c.kv_heads
     batch_idx = jnp.arange(batch)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(4, 5))
     def run(params, tokens, pos, page_tables, k_pages, v_pages):
         p = _params_view(cfg, params)
         x = p("wte.weight")[tokens][:, None].astype(cdt)       # [B, 1, H]
@@ -149,10 +155,11 @@ def build_decode_fn(cfg: GPTConfig, batch: int, max_pages: int,
             if c.position == "rotary":
                 q = _rope_at(q, cos[pos], sin[pos])
                 k = _rope_at(k, cos[pos], sin[pos])
-            kp = k_pages[i].at[page_idx, offset].set(
-                k[:, 0].astype(cdt))
-            vp = v_pages[i].at[page_idx, offset].set(
-                v[:, 0].astype(cdt))
+            with jax.named_scope("kv_page_scatter"):
+                kp = k_pages[i].at[page_idx, offset].set(
+                    k[:, 0].astype(cdt))
+                vp = v_pages[i].at[page_idx, offset].set(
+                    v[:, 0].astype(cdt))
             attn = paged_attention_decode(q[:, 0], kp, vp, page_tables,
                                           seq_lens,
                                           use_kernel=use_kernel)

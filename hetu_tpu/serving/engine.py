@@ -23,8 +23,10 @@ Observability (utils/metrics.py instruments): counters
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -43,8 +45,20 @@ class Engine:
                  max_batch: int = 8, max_model_len: Optional[int] = None,
                  mesh=None, use_kernel: bool = False,
                  metrics: bool = True,
-                 time_fn: Optional[Callable[[], float]] = None):
+                 time_fn: Optional[Callable[[], float]] = None,
+                 name: str = "serving", analysis_tap: bool = True):
         self.cfg = cfg
+        self.name = name
+        # ring buffer of recent prefill/decode call shapes+page tables,
+        # consumed by the trash-page-write lint (hetu_tpu/analysis)
+        self.tap: Optional[deque] = deque(maxlen=128) if analysis_tap \
+            else None
+        # a new engine owns its analysis namespace: stale handles from a
+        # discarded same-name engine would otherwise mix dead pool
+        # snapshots into analyze_registered(name) — and pin that
+        # engine's KV pool in the process-global registry forever
+        from ..graph.graph import clear_executables
+        clear_executables(f"{self.name}/")
         self.params = _Params(state, cfg).s      # normalized key view
         if max_model_len is None:
             max_model_len = (num_pages - 1) * page_size
@@ -168,7 +182,46 @@ class Engine:
                                      self.pool.page_size,
                                      use_kernel=self.use_kernel)
             self._compiled[key] = fn
+            self._register_for_analysis(kind, bucket, fn)
         return fn
+
+    def _register_for_analysis(self, kind: str, bucket: int, fn) -> None:
+        """Expose this executable to the static analyzer
+        (hetu_tpu/analysis): abstract arg specs are fully determined by
+        the bucket, so the handle can lower without running."""
+        from ..graph.graph import register_executable
+        sds = lambda a: jax.ShapeDtypeStruct(np.shape(a),  # noqa: E731
+                                             np.asarray(a).dtype) \
+            if not hasattr(a, "aval") else jax.ShapeDtypeStruct(a.shape,
+                                                                a.dtype)
+        params = jax.tree_util.tree_map(sds, self.params)
+        pages = tuple(sds(p) for p in self.pool.k_pages)
+        maxp = self.max_pages_per_seq
+        if kind == "prefill":
+            args = (params, jax.ShapeDtypeStruct((1, bucket), np.int32),
+                    jax.ShapeDtypeStruct((), np.int32),
+                    jax.ShapeDtypeStruct((maxp,), np.int32), pages, pages)
+        else:
+            args = (params, jax.ShapeDtypeStruct((bucket,), np.int32),
+                    jax.ShapeDtypeStruct((bucket,), np.int32),
+                    jax.ShapeDtypeStruct((bucket, maxp), np.int32),
+                    pages, pages)
+        meta = {
+            "kind": f"serving_{kind}",
+            "mesh_axes": {},
+            # model weights ride in as closed-over inputs: replicated by
+            # design on the single-device path (trainable=False keeps
+            # replicated-large-param quiet; a tp-sharded pool analysis
+            # would annotate pspecs here)
+            "params": [],
+            # single-device (or fully explicit) program: NO collective
+            # may appear that the inventory doesn't list
+            "allowed_gspmd": {} if self.pool.sharding is None else None,
+            "serving": lambda: {"pool": self.pool,
+                                "page_size": self.pool.page_size,
+                                "tap": list(self.tap or ())},
+        }
+        register_executable(f"{self.name}/{kind}-{bucket}", fn, args, meta)
 
     def _pt_row(self, pages: List[int]) -> np.ndarray:
         row = np.full(self.max_pages_per_seq, TRASH_PAGE, np.int32)
@@ -182,6 +235,9 @@ class Engine:
         req.pages = pages
         req.peak_pages = max(req.peak_pages, len(pages))
         s_pad = self.scheduler.prefill_bucket(n_tok)
+        if self.tap is not None:
+            self.tap.append({"kind": "prefill", "pages": list(pages),
+                             "n_tok": n_tok})
         fn = self._get_fn("prefill", s_pad)
         prompt = np.zeros((1, s_pad), np.int32)
         prompt[0, :n_tok] = req.tokens
@@ -227,6 +283,9 @@ class Engine:
             tokens[i] = req.tokens[-1]
             pos[i] = req.pos
             pt[i, :len(req.pages)] = req.pages
+        if self.tap is not None:
+            self.tap.append({"kind": "decode", "n_live": len(kept),
+                             "pos": pos.copy(), "page_tables": pt.copy()})
         t0 = self._now()
         logits, new_k, new_v = fn(
             self.params, jnp.asarray(tokens), jnp.asarray(pos),
@@ -280,6 +339,16 @@ class Engine:
         self.counters["requests_completed"].inc()
         self.histograms["request_latency"].observe(
             req.finish_time - req.submit_time)
+
+    def unregister_analysis(self) -> None:
+        """Drop this engine's executables from the analysis registry.
+
+        Registration closes over the engine (pool snapshot hook), so a
+        long-running service that retires engines must call this (or
+        reuse the name — construction clears its own namespace) to let
+        the pool's HBM/host arrays be collected."""
+        from ..graph.graph import clear_executables
+        clear_executables(f"{self.name}/")
 
     # -- observability -------------------------------------------------------
 

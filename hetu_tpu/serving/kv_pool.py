@@ -110,6 +110,25 @@ class PagedKVPool:
             self._allocated.remove(pg)
             self._free.append(pg)
 
+    def reset(self, clear_pages: bool = False) -> None:
+        """Return the pool to its post-construction allocator state.
+
+        The rebuilt free-list must EXCLUDE the reserved trash page 0 —
+        a naive ``range(num_pages)`` rebuild would hand page 0 to the
+        next request and real KV writes would land in the padding sink
+        (every padded page-table slot points there).  Regression-tested:
+        alloc-after-reset can never return page 0.
+
+        ``clear_pages`` additionally zeroes the page storage (off by
+        default: allocator reuse does not require wiping HBM, and stale
+        KV beyond ``seq_len`` is masked by the attention op anyway).
+        """
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._allocated = set()
+        if clear_pages:
+            self.k_pages = tuple(jnp.zeros_like(p) for p in self.k_pages)
+            self.v_pages = tuple(jnp.zeros_like(p) for p in self.v_pages)
+
     def check_invariants(self) -> None:
         """Allocator bookkeeping invariants (asserted by tests after
         every scheduling storm): free+allocated partition the usable

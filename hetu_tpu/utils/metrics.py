@@ -141,21 +141,52 @@ class Histogram:
     requests), so observations are kept raw (capped deque) and
     percentiles computed exactly — no bucket-boundary error, no bucket
     schema to choose per deployment.
+
+    Optional Prometheus-style export: pass ``buckets`` (sorted upper
+    bounds) and :meth:`bucket_counts` returns cumulative
+    ``{le: count}`` with an implicit ``+Inf`` bucket.  Observations
+    above the last finite bound still count toward ``+Inf``, ``count``
+    and ``total`` — dropping the overflow tail silently under-reports
+    exactly the latencies a histogram exists to expose.
     """
 
-    __slots__ = ("name", "_obs", "count", "total")
+    __slots__ = ("name", "_obs", "count", "total", "buckets",
+                 "_bucket_counts")
 
-    def __init__(self, name: str = "", max_observations: int = 4096):
+    def __init__(self, name: str = "", max_observations: int = 4096,
+                 buckets: Optional[List[float]] = None):
         self.name = name
         self._obs = deque(maxlen=int(max_observations))
         self.count = 0
         self.total = 0.0
+        self.buckets = tuple(sorted(float(b) for b in buckets)) \
+            if buckets else ()
+        # per-bucket (non-cumulative) tallies; slot -1 is +Inf overflow
+        self._bucket_counts = [0] * (len(self.buckets) + 1)
 
     def observe(self, v: float) -> None:
         v = float(v)
         self._obs.append(v)
         self.count += 1
         self.total += v
+        for i, bound in enumerate(self.buckets):
+            if v <= bound:
+                self._bucket_counts[i] += 1
+                break
+        else:
+            # above every finite bound (or no buckets): +Inf slot, so
+            # cumulative counts always sum to self.count
+            self._bucket_counts[-1] += 1
+
+    def bucket_counts(self) -> Dict[str, int]:
+        """Cumulative Prometheus-style ``{le: count}`` incl. ``+Inf``."""
+        out: Dict[str, int] = {}
+        cum = 0
+        for bound, c in zip(self.buckets, self._bucket_counts):
+            cum += c
+            out[repr(bound)] = cum
+        out["+Inf"] = cum + self._bucket_counts[-1]
+        return out
 
     @property
     def mean(self) -> float:
@@ -196,6 +227,9 @@ class _NullInstrument:
 
     def percentile(self, p: float) -> float:
         return 0.0
+
+    def bucket_counts(self) -> Dict[str, int]:
+        return {"+Inf": 0}
 
     def summary(self) -> Dict[str, float]:
         # zeroed, same keys as Histogram.summary: consumers indexing

@@ -1,0 +1,475 @@
+"""Static-analysis pass: collective inventory, lint rules, CI gate.
+
+Each lint rule is demonstrated on a SEEDED violation (must fire exactly
+once) plus a clean control (must stay silent).  The general pass must
+also reproduce PR 1's grad-comm emission assertions unchanged: the
+registered train-step handle's lowered program contains exactly the
+collective sequence ``dstates.predict_update_step_collectives`` derives
+from the gradient set.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import hetu_tpu as ht
+from hetu_tpu import analysis, ops, optim
+from hetu_tpu.analysis import (AnalysisContext, analyze_handle,
+                               collect_collectives, run_rules)
+from hetu_tpu.graph.graph import (DefineAndRunGraph, clear_executables,
+                                  get_executable, register_executable)
+from hetu_tpu.parallel import comm, create_mesh, dstates
+from hetu_tpu.parallel.comm import shard_map
+from hetu_tpu.serving.kv_pool import PagedKVPool
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sds(shape, dtype=np.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _register(name, fn, args, **meta):
+    meta.setdefault("mesh_axes", {})
+    meta.setdefault("params", [])
+    meta.setdefault("allowed_gspmd", None)
+    clear_executables(name)
+    return register_executable(name, fn, args, meta)
+
+
+def _rules_fired(report, rule):
+    return [f for f in report.findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# collective inventory
+# ---------------------------------------------------------------------------
+
+class TestInventory:
+    def test_inventory_kinds_axes_bytes_and_tags(self, devices8):
+        mesh = create_mesh({"dp": 8}, devices8)
+
+        def f(x):
+            with comm.comm_tag("my_sync"):
+                s = jax.lax.psum(x, "dp")
+            g = jax.lax.all_gather(x, "dp", axis=0, tiled=True)
+            return s, g
+
+        jf = jax.jit(shard_map(f, mesh, (P(),), (P(), P())))
+        h = _register("t_inv/f", jf, (_sds((64,)),))
+        recs = collect_collectives(h.jaxpr)
+        assert [r.kind for r in recs] == ["all_reduce", "all_gather"]
+        ar, ag = recs
+        assert ar.axes == ("dp",) and ar.dtype == "float32"
+        assert ar.payload_bytes == 64 * 4
+        assert ar.wire_bytes == comm.ring_wire_bytes("all_reduce", 256, 8)
+        assert "my_sync" in ar.scope          # comm_tag attribution
+        assert ag.payload_bytes == 8 * 64 * 4  # gathered size
+        assert ar.source.endswith(".py:" + str(ar.source.split(":")[-1]))
+
+    def test_scan_trip_counts_multiply(self, devices8):
+        mesh = create_mesh({"dp": 8}, devices8)
+
+        def body(c, x):
+            return c + jax.lax.psum(x, "dp"), None
+
+        def f(xs):
+            c, _ = jax.lax.scan(body, jnp.zeros_like(xs[0]), xs)
+            return c
+
+        jf = jax.jit(shard_map(f, mesh, (P(),), P()))
+        h = _register("t_inv/scan", jf, (_sds((5, 16)),))
+        recs = collect_collectives(h.jaxpr)
+        assert len(recs) == 1 and recs[0].count == 5
+
+
+# ---------------------------------------------------------------------------
+# seeded rule violations (each fires exactly once)
+# ---------------------------------------------------------------------------
+
+class TestSeededViolations:
+    def test_replicated_large_param_on_train_gpt_shaped_graph(self,
+                                                              devices8):
+        """examples/train_gpt.py-shaped graph with the embedding FORCED
+        to full replication on a tp-capable mesh."""
+        from hetu_tpu.models import GPTLMHeadModel, llama_config
+        ht.set_seed(7)
+        mesh = create_mesh({"dp": 2, "tp": 4}, devices8)
+        cfg = llama_config(vocab_size=256, hidden_size=64, num_layers=1,
+                           num_heads=4, max_seq_len=16, sp=False)
+        g = DefineAndRunGraph("t_repl")
+        g.mesh = mesh
+        clear_executables("t_repl")
+        with ht.graph(g):
+            ids = ht.parallel_placeholder("int32", (4, 16),
+                                          pspec=P("dp", None), name="ids")
+            labels = ht.parallel_placeholder("int32", (4, 16),
+                                             pspec=P("dp", None),
+                                             name="labels")
+            model = GPTLMHeadModel(cfg)
+            loss = model(ids, labels)
+            # seed the violation: strip the vocab-parallel sharding
+            wte = model.transformer.wte.weight
+            wte.pspec = P(None, None)
+            train_op = optim.AdamOptimizer(lr=1e-3).minimize(loss)
+            rng = np.random.RandomState(0)
+            IDS = rng.randint(0, 256, (4, 16)).astype(np.int32)
+            g.run(loss, [loss, train_op], {ids: IDS, labels: IDS})
+        (handle,) = g.analysis_handles()
+        rep = analyze_handle(
+            handle, options={"param_bytes_threshold": 32 * 1024})
+        fired = [f for f in rep.findings
+                 if f.rule == "replicated-large-param"]
+        assert len(fired) == 1, rep.findings
+        assert fired[0].subject == wte.name
+        assert "replicated" in fired[0].message
+
+    def test_donation_miss_fires_once_and_fix_silences(self):
+        """A dropped donation on a buffer that round-trips through the
+        executable (the serving pages pattern)."""
+        def f(pages, delta):
+            return pages.at[0].add(delta)
+
+        args = (_sds((64, 256)), _sds((256,)))
+        h = _register("t_don/miss", jax.jit(f), args)
+        rep = analyze_handle(h, options={"donation_bytes_threshold": 1024})
+        fired = _rules_fired(rep, "donation-miss")
+        assert len(fired) == 1
+        assert "not donated" in fired[0].message
+        h2 = _register("t_don/fixed", jax.jit(f, donate_argnums=(0,)),
+                       args)
+        rep2 = analyze_handle(h2,
+                              options={"donation_bytes_threshold": 1024})
+        assert not _rules_fired(rep2, "donation-miss")
+        # two independent un-donated round-trip buffers -> one finding
+        # PER ARGUMENT, with distinct subjects
+        g2 = jax.jit(lambda a, b: (a * 2, b * 3))
+        h3 = _register("t_don/two", g2, (_sds((64, 256)), _sds((64, 256))))
+        rep3 = analyze_handle(h3,
+                              options={"donation_bytes_threshold": 1024})
+        fired3 = _rules_fired(rep3, "donation-miss")
+        assert len(fired3) == 2
+        assert len({f.subject for f in fired3}) == 2
+
+    def test_wide_collective_fires_once_scales_exempt(self, devices8):
+        mesh = create_mesh({"dp": 8}, devices8)
+
+        def f(x):
+            y = (x @ x).astype(jnp.float32)     # bf16 compute
+            return jax.lax.psum(y, "dp")        # fp32 transport
+
+        jf = jax.jit(shard_map(f, mesh, (P(),), P()))
+        h = _register("t_wide/f", jf, (_sds((64, 64), jnp.bfloat16),))
+        rep = analyze_handle(h, options={"wide_bytes_threshold": 1024})
+        fired = _rules_fired(rep, "wide-collective")
+        assert len(fired) == 1
+        assert "float32 all_reduce" in fired[0].message
+
+        # int8 transport's fp32 absmax sidecars are tagged "scales" and
+        # exempt: bf16 compute + quantized sync stays clean
+        def q(x):
+            y = (x @ x).astype(jnp.float32)
+            out = comm.all_reduce_coalesced({0: y}, "dp",
+                                            transport="int8")
+            return out[0]
+
+        jq = jax.jit(shard_map(q, mesh, (P(),), P()))
+        hq = _register("t_wide/q", jq, (_sds((64, 64), jnp.bfloat16),))
+        repq = analyze_handle(hq, options={"wide_bytes_threshold": 64})
+        assert not _rules_fired(repq, "wide-collective"), repq.findings
+
+        # the exemption is the exact "scales" path segment — a user
+        # scope merely CONTAINING the substring must still fire
+        def r(x):
+            y = (x @ x).astype(jnp.float32)
+            with jax.named_scope("loss_rescales"):
+                return jax.lax.psum(y, "dp")
+
+        jr = jax.jit(shard_map(r, mesh, (P(),), P()))
+        hr = _register("t_wide/r", jr, (_sds((64, 64), jnp.bfloat16),))
+        repr_ = analyze_handle(hr, options={"wide_bytes_threshold": 1024})
+        assert len(_rules_fired(repr_, "wide-collective")) == 1
+
+    def test_unreduced_psum_scalar_fires_once(self, devices8):
+        mesh = create_mesh({"dp": 8}, devices8)
+
+        def bad(x):
+            return jnp.mean(x)                  # local mean, no pmean!
+
+        jf = jax.jit(shard_map(bad, mesh, (P("dp"),), P(),
+                               check_rep=False))
+        h = _register("t_scalar/bad", jf, (_sds((16, 4)),))
+        rep = analyze_handle(h)
+        fired = _rules_fired(rep, "unreduced-psum-scalar")
+        assert len(fired) == 1
+        assert "local value" in fired[0].message
+
+        def good(x):
+            return jax.lax.pmean(jnp.mean(x), "dp")
+
+        jg = jax.jit(shard_map(good, mesh, (P("dp"),), P(),
+                               check_rep=False))
+        hg = _register("t_scalar/good", jg, (_sds((16, 4)),))
+        assert not _rules_fired(analyze_handle(hg),
+                                "unreduced-psum-scalar")
+
+    def test_implicit_reshard_fires_once(self, devices8):
+        mesh = create_mesh({"dp": 8}, devices8)
+
+        def f(x):
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P("dp", None)))
+            h = x * 2.0
+            # forces a GSPMD all-gather no DS transition predicts
+            h = jax.lax.with_sharding_constraint(
+                h, NamedSharding(mesh, P()))
+            return h.sum()
+
+        h = _register("t_resh/f", jax.jit(f), (_sds((16, 8)),),
+                      allowed_gspmd={})
+        rep = analyze_handle(h, compile=True)
+        fired = _rules_fired(rep, "implicit-reshard")
+        assert len(fired) == 1
+        assert fired[0].subject == "all_gather"
+        # same program with the reshard predicted: silent
+        h2 = _register("t_resh/ok", jax.jit(f), (_sds((16, 8)),),
+                       allowed_gspmd={"all_gather": 1})
+        assert not _rules_fired(analyze_handle(h2, compile=True),
+                                "implicit-reshard")
+
+    def test_trash_page_write_fires_once_per_seed(self):
+        # seed 1: the pre-fix reset() bug — free-list rebuilt WITH page 0
+        pool = PagedKVPool(num_layers=1, num_pages=4, page_size=8,
+                           kv_heads=1, head_dim=4)
+        pool._free = list(range(pool.num_pages - 1, -1, -1))  # includes 0
+        ctx = AnalysisContext(name="t_trash",
+                              serving={"pool": pool, "tap": []})
+        fired = [f for f in run_rules(ctx, only=["trash-page-write"])]
+        assert len(fired) == 1 and fired[0].subject == "free-list"
+
+        # seed 2: a LIVE decode row whose page table targets page 0
+        pool2 = PagedKVPool(num_layers=1, num_pages=4, page_size=8,
+                            kv_heads=1, head_dim=4)
+        tap = [{"kind": "decode", "n_live": 1,
+                "pos": np.array([4], np.int32),
+                "page_tables": np.array([[0, 0]], np.int32)}]
+        ctx2 = AnalysisContext(name="t_trash2",
+                               serving={"pool": pool2, "tap": tap})
+        fired2 = run_rules(ctx2, only=["trash-page-write"])
+        assert len(fired2) == 1 and "LIVE row 0" in fired2[0].message
+
+        # clean pool + padding-only tap: silent
+        tap_ok = [{"kind": "decode", "n_live": 1,
+                   "pos": np.array([4, 0], np.int32),
+                   "page_tables": np.array([[2, 0], [0, 0]], np.int32)}]
+        ctx3 = AnalysisContext(name="t_trash3",
+                               serving={"pool": pool2, "tap": tap_ok})
+        assert not run_rules(ctx3, only=["trash-page-write"])
+
+
+# ---------------------------------------------------------------------------
+# the general pass reproduces PR 1's grad-comm assertions
+# ---------------------------------------------------------------------------
+
+class TestGradCommThroughGeneralPass:
+    def _train(self, devices8, transport):
+        mesh = create_mesh({"dp": 8}, devices8)
+        g = DefineAndRunGraph(f"t_gc_{transport}")
+        g.mesh = mesh
+        clear_executables(g.name)
+        with ht.graph(g):
+            x = ht.parallel_placeholder("float32", (16, 8),
+                                        pspec=P("dp", None), name="x")
+            y = ht.parallel_placeholder("float32", (16, 1),
+                                        pspec=P("dp", None), name="y")
+            w = ht.parameter(np.zeros((8, 1), np.float32), name="w")
+            b = ht.parameter(np.zeros((1,), np.float32), name="b")
+            loss = ops.reduce_mean((ops.matmul(x, w) + b - y) ** 2)
+            op = optim.AdamOptimizer(lr=1e-2,
+                                     grad_comm=transport).minimize(loss)
+            rng = np.random.RandomState(0)
+            g.run(loss, [loss, op], {x: rng.randn(16, 8).astype(np.float32),
+                                     y: rng.randn(16, 1)
+                                     .astype(np.float32)})
+        assert g._grad_comm_active
+        (handle,) = g.analysis_handles()
+        return handle
+
+    @pytest.mark.parametrize("transport", ["fp32", "bf16", "int8"])
+    def test_emission_matches_prediction(self, devices8, transport):
+        handle = self._train(devices8, transport)
+        # PR 1's verify_grad_comm_emission, unchanged, via the new pass
+        analysis.verify_grad_comm(handle)
+        # and the jaxpr inventory agrees with the prediction kind-for-kind
+        pred, extra = analysis.grad_comm_prediction(handle)
+        want = dict(extra)
+        for p in pred:
+            want[p["kind"]] = want.get(p["kind"], 0) + 1
+        rep = analyze_handle(handle)
+        assert rep.collective_counts() == want
+        # gradient-sync records carry the bucket attribution tag
+        tagged = [r for r in rep.records if "grad_comm/bucket" in r.scope]
+        assert len(tagged) == len(pred)
+
+    def test_emission_drift_detected(self, devices8):
+        handle = self._train(devices8, "fp32")
+        gc = dict(handle.meta["grad_comm"])
+        gc["transport"] = "int8"     # claim a different transport
+        handle.meta["grad_comm"] = gc
+        with pytest.raises(AssertionError, match="do not match"):
+            analysis.verify_grad_comm(handle)
+
+    def test_clean_train_step_has_no_findings(self, devices8):
+        handle = self._train(devices8, "int8")
+        rep = analyze_handle(handle, compile=True)
+        assert rep.findings == [], rep.findings
+
+    def test_cached_plan_reregisters_after_registry_clear(self, devices8):
+        """clear_executables() must not make a LIVE cached plan vanish
+        from analysis forever: its next run re-registers it under the
+        original name."""
+        mesh = create_mesh({"dp": 8}, devices8)
+        g = DefineAndRunGraph("t_rereg")
+        g.mesh = mesh
+        clear_executables("t_rereg")
+        with ht.graph(g):
+            x = ht.parallel_placeholder("float32", (16, 4),
+                                        pspec=P("dp", None), name="x")
+            w = ht.parameter(np.zeros((4, 1), np.float32), name="w")
+            loss = ops.reduce_mean(ops.matmul(x, w) ** 2)
+            op = optim.SGDOptimizer(lr=0.1,
+                                    grad_comm="fp32").minimize(loss)
+            X = np.ones((16, 4), np.float32)
+            g.run(loss, [loss, op], {x: X})
+            assert [h.name for h in g.analysis_handles()] \
+                == ["t_rereg/plan0"]
+            clear_executables("t_rereg")
+            assert g.analysis_handles() == []
+            g.run(loss, [loss, op], {x: X})    # cached plan, re-executed
+            assert [h.name for h in g.analysis_handles()] \
+                == ["t_rereg/plan0"]
+
+
+# ---------------------------------------------------------------------------
+# serving executables are registered + analyzable
+# ---------------------------------------------------------------------------
+
+class TestServingAnalysis:
+    def test_engine_registers_clean_executables(self):
+        from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+        from hetu_tpu.serving import Engine
+        ht.set_seed(3)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=4, max_seq_len=64)
+        with ht.graph("eager", create_new=True):
+            model = GPTLMHeadModel(cfg)
+            model.logits(np.zeros((1, 4), np.int32))
+            state = {k: np.asarray(v) for k, v in
+                     model.state_dict().items()}
+        clear_executables("t_serve")
+        clock = [0.0]
+        eng = Engine(state, cfg, num_pages=8, page_size=8, max_batch=2,
+                     name="t_serve", time_fn=lambda: clock[0])
+        eng.add_request([1, 2, 3], max_new_tokens=3)
+        while eng.has_work:
+            eng.step()
+            clock[0] += 1.0
+        names = [h.name for h in
+                 analysis.iter_executables("t_serve")]
+        assert any("prefill" in n for n in names)
+        assert any("decode" in n for n in names)
+        report = analysis.analyze_registered("t_serve", compile=True)
+        assert report.findings == [], report.findings
+        # the page buffers are donated (donation-miss stays quiet even
+        # at a 1-byte threshold)
+        for h in analysis.iter_executables("t_serve"):
+            rep = analyze_handle(h,
+                                 options={"donation_bytes_threshold": 1})
+            assert not _rules_fired(rep, "donation-miss"), h.name
+        # inventory: single-device serving program does no communication
+        assert all(not rep.records
+                   for rep in report.executables.values())
+        # lifecycle: a new same-name engine owns the namespace (no stale
+        # dead-pool handles), and unregister empties it
+        eng2 = Engine(state, cfg, num_pages=8, page_size=8, max_batch=2,
+                      name="t_serve", time_fn=lambda: clock[0])
+        assert analysis.iter_executables("t_serve") == []
+        eng2.add_request([4, 2], max_new_tokens=2)
+        while eng2.has_work:
+            eng2.step()
+            clock[0] += 1.0
+        for h in analysis.iter_executables("t_serve"):
+            assert h.meta["serving"]()["pool"] is eng2.pool
+        eng2.unregister_analysis()
+        assert analysis.iter_executables("t_serve") == []
+
+
+# ---------------------------------------------------------------------------
+# baseline gate mechanics + the CLI (the CI lint-graph target)
+# ---------------------------------------------------------------------------
+
+class TestBaselineGate:
+    def _report(self, counts, findings=()):
+        from hetu_tpu.analysis import (AnalysisReport, CollectiveRecord,
+                                       ExecutableReport, Finding)
+        rep = AnalysisReport()
+        ex = ExecutableReport(name="exe")
+        for kind, n in counts.items():
+            for _ in range(n):
+                ex.records.append(CollectiveRecord(
+                    kind=kind, axes=("dp",), dtype="float32",
+                    payload_bytes=100, wire_bytes=175.0))
+        ex.findings = [Finding(rule=r, subject=s, message="m",
+                               executable="exe") for r, s in findings]
+        rep.add(ex)
+        return rep
+
+    def test_count_and_byte_regressions_fail(self):
+        base = self._report({"all_reduce": 1}).to_dict()
+        assert not self._report({"all_reduce": 1}) \
+            .check_against_baseline(base)
+        assert self._report({"all_reduce": 2}) \
+            .check_against_baseline(base)      # count regression
+        assert self._report({"all_reduce": 1, "all_gather": 1}) \
+            .check_against_baseline(base)      # new kind
+        # fewer collectives: pass (improvement)
+        base2 = self._report({"all_reduce": 3}).to_dict()
+        assert not self._report({"all_reduce": 2}) \
+            .check_against_baseline(base2)
+
+    def test_new_finding_fails_known_finding_passes(self):
+        base = self._report({}, findings=[("donation-miss", "arg0")]) \
+            .to_dict()
+        ok = self._report({}, findings=[("donation-miss", "arg0")])
+        assert not ok.check_against_baseline(base)
+        bad = self._report({}, findings=[("donation-miss", "arg0"),
+                                         ("wide-collective",
+                                          "all_reduce:float32")])
+        problems = bad.check_against_baseline(base)
+        assert problems and "wide-collective" in problems[0]
+
+    def test_missing_baseline_entry_fails(self):
+        rep = self._report({"all_reduce": 1})
+        assert rep.check_against_baseline(None)
+        assert rep.check_against_baseline({"executables": {}})
+
+
+@pytest.mark.lint_graph
+def test_lint_graph_gate_passes_on_clean_tree():
+    """The tier-1 CI gate: `python -m hetu_tpu.analysis --check` against
+    the checked-in ANALYSIS_BASELINE.json must pass on a clean tree."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)       # the CLI sets its own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "hetu_tpu.analysis", "--check"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, \
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "lint-graph gate OK" in proc.stdout

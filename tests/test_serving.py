@@ -81,6 +81,32 @@ def test_pool_rejects_degenerate_shapes():
         PagedKVPool(1, 1, 8, 2, 16)
 
 
+def test_pool_reset_never_reissues_trash_page():
+    """Regression: reset() must rebuild the free-list EXCLUDING the
+    reserved trash page 0 — a range(num_pages) rebuild would hand page 0
+    to the next request and real KV writes would land in the padding
+    sink.  Alloc-after-reset can never return page 0."""
+    pool = PagedKVPool(num_layers=2, num_pages=9, page_size=8,
+                       kv_heads=2, head_dim=16)
+    pool.alloc(5)
+    pool.reset()
+    assert pool.free_pages == pool.num_usable == 8
+    assert pool.used_pages == 0
+    # drain the ENTIRE pool: page 0 must never surface
+    got = pool.alloc(pool.num_usable)
+    assert got is not None and TRASH_PAGE not in got
+    assert sorted(got) == list(range(1, pool.num_pages))
+    pool.check_invariants()
+    # reset with live allocations: old handles are forgotten, page 0
+    # still reserved, invariants hold
+    pool.reset(clear_pages=True)
+    pool.check_invariants()
+    assert float(jnp.sum(jnp.abs(pool.k_pages[0]))) == 0.0
+    again = pool.alloc(pool.num_usable)
+    assert TRASH_PAGE not in again
+    pool.check_invariants()
+
+
 def test_pool_tp_sharding_spec(devices8):
     from hetu_tpu.parallel import create_mesh
     mesh = create_mesh({"tp": 2}, devices8[:2])
@@ -436,5 +462,29 @@ def test_metrics_instruments():
     n.inc(); n.observe(3.0); n.set(1.0)         # all swallow silently
     assert n.value == 0.0 and n.percentile(99) == 0.0
     assert n.summary()["p90"] == 0.0            # indexable, not {}
+    assert n.bucket_counts() == {"+Inf": 0}
     with pytest.raises(ValueError, match="unknown instrument"):
         make_instrument("summary")
+
+
+def test_histogram_buckets_count_overflow_in_inf_and_sum():
+    """Observations ABOVE the last bucket bound must still land in
+    +Inf, count and sum (dropping the overflow tail would hide exactly
+    the tail latencies a histogram exists to expose)."""
+    h = Histogram("ttft", buckets=[0.1, 1.0])
+    for v in [0.05, 0.5, 0.7, 5.0]:             # 5.0 > last bound
+        h.observe(v)
+    assert h.count == 4
+    assert h.total == pytest.approx(6.25)       # overflow in the sum
+    bc = h.bucket_counts()
+    assert bc["0.1"] == 1
+    assert bc["1.0"] == 3                       # cumulative
+    assert bc["+Inf"] == 4                      # overflow counted
+    # cumulative counts always close at the observation count
+    assert bc["+Inf"] == h.count
+    # percentiles still see the overflow observation
+    assert h.percentile(100) == 5.0
+    # bucketless histogram: everything is +Inf, count still closes
+    h2 = Histogram("tpot")
+    h2.observe(3.0)
+    assert h2.bucket_counts() == {"+Inf": 1}
