@@ -439,6 +439,21 @@ def bench_comm_microbench() -> dict:
         "            bucket_mb=4.0, transport=transport)\n"
         "        return tuple(out[i] for i in range(len(vals)))\n"
         "    return f\n"
+        # zero2_flat: the reduce-scatter-only ZeRO-2 sync (flat
+        # dp-sharded optimizer state): RS -> local elementwise update
+        # stand-in -> updated-param all-gather riding the weight dtype
+        # (tagged param_comm, so gradient wire bytes stay separable)
+        "def zero2_flat(transport):\n"
+        "    def f(*vals):\n"
+        "        g = {i: v for i, v in enumerate(vals)}\n"
+        "        chunks, layout = comm.reduce_scatter_coalesced(\n"
+        "            g, 'dp', op='mean', bucket_mb=4.0,\n"
+        "            transport=transport)\n"
+        "        chunks = [c * 0.999 for c in chunks]\n"
+        "        out = comm.all_gather_coalesced(chunks, layout, 'dp',\n"
+        "                                        tag='param_comm')\n"
+        "        return tuple(out[i] for i in range(len(vals)))\n"
+        "    return f\n"
         "def measure(fn):\n"
         "    jf = jax.jit(comm.shard_map(fn, mesh, reps, reps))\n"
         "    with comm.comm_stats() as s:\n"
@@ -450,15 +465,22 @@ def bench_comm_microbench() -> dict:
         "        out = jf(*grads)\n"
         "    jax.block_until_ready(out)\n"
         "    dt = (time.perf_counter() - t0) / 5\n"
+        "    grad_wire = sum(r.wire_bytes for r in s.records\n"
+        "                    if not r.tag.startswith('param_comm'))\n"
         "    return {'collective_calls': s.num_collectives,\n"
         "            'wire_mb_per_rank': round(s.total_wire_bytes / 2**20,\n"
         "                                      3),\n"
+        "            'grad_wire_mb_per_rank': round(grad_wire / 2**20, 3),\n"
         "            'step_time_ms': round(dt * 1e3, 2)}\n"
         "res = {'grad_tensors': len(shapes),\n"
         "       'grad_mb': round(sum(g.nbytes for g in grads) / 2**20, 2),\n"
         "       'per_tensor_fp32': measure(per_tensor)}\n"
         "for tr in ('fp32', 'bf16', 'int8'):\n"
         "    res['bucketed_' + tr] = measure(bucketed(tr))\n"
+        "    res['zero2_flat_' + tr] = measure(zero2_flat(tr))\n"
+        "    res['grad_wire_ratio_allreduce_vs_zero2flat_' + tr] = round(\n"
+        "        res['bucketed_' + tr]['grad_wire_mb_per_rank'] /\n"
+        "        res['zero2_flat_' + tr]['grad_wire_mb_per_rank'], 2)\n"
         "pt = res['per_tensor_fp32']\n"
         "q = res['bucketed_int8']\n"
         "res['calls_ratio_per_tensor_vs_int8'] = round(\n"
@@ -481,9 +503,19 @@ def bench_comm_microbench() -> dict:
         if not lines:
             return {"error": f"rc={proc.returncode}: "
                              f"{proc.stderr.strip()[-400:]}"}
-        return json.loads(lines[-1])
+        result = json.loads(lines[-1])
     except Exception as e:  # never fail the headline bench on this
         return {"error": f"{type(e).__name__}: {e}"}
+    # round-6 evidence: the zero2_flat rows (reduce-scatter-only sync)
+    # land in BENCH_r06.json next to this file
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r06.json")
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(result, fh, indent=1)
+    except Exception:
+        pass
+    return result
 
 
 def bench_lint_graph() -> dict:

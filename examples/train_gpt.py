@@ -39,6 +39,14 @@ def parse_args():
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1, help="pipeline stages")
     p.add_argument("--sp", action="store_true", help="sequence parallel")
+    p.add_argument("--grad-comm", choices=["fp32", "bf16", "int8"],
+                   default=None,
+                   help="explicit coalesced gradient sync transport "
+                        "(None keeps the implicit GSPMD per-tensor sync)")
+    p.add_argument("--flat-state", action="store_true",
+                   help="flat dp-sharded optimizer state + reduce-"
+                        "scatter-only ZeRO-2 sync (needs --grad-comm "
+                        "and --zero 1/2; half the gradient wire bytes)")
     p.add_argument("--zero", type=int, default=0, choices=[0, 1, 2, 3],
                    help="ZeRO level for optimizer state/grad/param sharding")
     p.add_argument("--ds-config", type=str, default=None,
@@ -147,7 +155,9 @@ def main():
         else:
             model = GPTLMHeadModel(cfg)
             loss = model(ids, labels)
-        train_op = optim.AdamOptimizer(lr=args.lr, zero=zero).minimize(loss)
+        train_op = optim.AdamOptimizer(
+            lr=args.lr, zero=zero, grad_comm=args.grad_comm,
+            flat_state=args.flat_state).minimize(loss)
         if args.load:
             from hetu_tpu.utils.checkpoint import load_model
             load_model(model, args.load)

@@ -134,7 +134,8 @@ def grad_comm_prediction(handle: ExecutableHandle):
                for name, shape, dtype in gc["entries"]]
     return predict_update_step_collectives(
         entries, gc["device_num"], transport=gc["transport"],
-        bucket_mb=gc["bucket_mb"], scalar_fetches=gc["scalar_fetches"])
+        bucket_mb=gc["bucket_mb"], scalar_fetches=gc["scalar_fetches"],
+        flat=gc.get("flat", False), clip=gc.get("clip", False))
 
 
 def verify_grad_comm(handle: ExecutableHandle) -> None:

@@ -65,11 +65,14 @@ def build_gate_executables():
     devices = jax.devices()[:8]
 
     # -- train step: GPT-2-small-shaped (12-head/768-wide ratios scaled
-    # to CI size), dp=8, ZeRO-2, explicit int8 grad sync ---------------
+    # to CI size), dp=8, ZeRO-2, explicit int8 grad sync over FLAT
+    # dp-sharded optimizer state (reduce-scatter-only: one RS chain +
+    # one bf16 param all-gather per bucket, ZERO grad all-gathers) -----
     ht.set_seed(0)
     mesh = create_mesh({"dp": 8}, devices)
     cfg = llama_config(vocab_size=256, hidden_size=64, num_layers=2,
-                       num_heads=4, max_seq_len=32, sp=False)
+                       num_heads=4, max_seq_len=32, sp=False,
+                       dtype="bfloat16")
     g = DefineAndRunGraph("gate_train")
     g.mesh = mesh
     with ht.graph(g):
@@ -79,8 +82,8 @@ def build_gate_executables():
                                          pspec=P("dp", None), name="labels")
         model = GPTLMHeadModel(cfg)
         loss = model(ids, labels)
-        train_op = optim.AdamOptimizer(lr=1e-2, zero=2,
-                                       grad_comm="int8").minimize(loss)
+        train_op = optim.AdamOptimizer(lr=1e-2, zero=2, grad_comm="int8",
+                                       flat_state=True).minimize(loss)
         rng = np.random.RandomState(0)
         IDS = rng.randint(0, 256, (8, 32)).astype(np.int32)
         g.run(loss, [loss, train_op], {ids: IDS,

@@ -277,8 +277,17 @@ def donation_candidates(args_info, out_avals,
         if hasattr(o, "shape"):
             key = (tuple(o.shape), np.dtype(o.dtype).name)
             out_shapes[key] = out_shapes.get(key, 0) + 1
-    by_arg: Dict[str, int] = {}
     flat, _ = jax.tree_util.tree_flatten_with_path(args_info)
+    # donated inputs claim their matching output slots FIRST: a second
+    # same-shaped input has nothing left to alias and is not a
+    # candidate (e.g. decode's tokens aliases the greedy output; pos,
+    # the same [B] int32, cannot)
+    for _path, leaf in flat:
+        if getattr(leaf, "donated", False) and hasattr(leaf, "shape"):
+            key = (tuple(leaf.shape), np.dtype(leaf.dtype).name)
+            if out_shapes.get(key, 0) > 0:
+                out_shapes[key] -= 1
+    by_arg: Dict[str, int] = {}
     for path, leaf in flat:
         if getattr(leaf, "donated", False) or not hasattr(leaf, "shape"):
             continue

@@ -29,6 +29,15 @@ Rule catalog (DESIGN.md §9 for the rationale of each):
                              free-list/allocated set, or a live decode
                              row's page table targets it (padding rows
                              are the only legitimate trash-page writers).
+``grad-allgather-under-zero2`` a ZeRO-2 train step regathers gradients:
+                             an fp32 gradient all-gather (any plan), or
+                             ANY gradient all-gather in a plan that
+                             declares the flat reduce-scatter-only sync
+                             — the regression back to the double-wire
+                             all-reduce path must fail CI.  The scale
+                             sidecars of the quantized transport
+                             (tagged ``scales``) and the updated-param
+                             gather (tagged ``param_comm``) are exempt.
 
 Thresholds live in :data:`DEFAULT_OPTIONS` and are overridable per
 context (tests seed violations with tiny thresholds).
@@ -247,6 +256,36 @@ def _unreduced_psum_scalar(ctx: AnalysisContext) -> List[Finding]:
                     f"psum/pmean on its def-chain: every rank returns its "
                     f"OWN local value (scope {scope or '?'})",
             source=src, severity="error"))
+    return out
+
+
+@rule("grad-allgather-under-zero2")
+def _grad_allgather_under_zero2(ctx: AnalysisContext) -> List[Finding]:
+    gc = (ctx.meta or {}).get("grad_comm") or {}
+    flat = bool(gc.get("flat", False))
+    # in scope: any ZeRO-2 plan, and any plan declaring the flat
+    # reduce-scatter-only contract (flat zero=1 included)
+    if int(gc.get("zero", 0)) < 2 and not flat:
+        return []
+    out = []
+    for r in ctx.records:
+        segs = r.scope.split("/")
+        if r.kind != "all_gather" or "grad_comm" not in segs \
+                or "scales" in segs:
+            continue
+        # fp32 gradient regather is always a ZeRO-2 bug; under the flat
+        # reduce-scatter-only contract ANY gradient regather is (the
+        # param gather rides the param_comm tag and stays exempt)
+        if r.dtype in WIDE_DTYPES or flat:
+            out.append(Finding(
+                rule="", subject=f"all_gather:{r.dtype}",
+                severity="error",
+                message=f"ZeRO-2 plan regathers gradients: {r.dtype} "
+                        f"all_gather of {r.payload_bytes} B in scope "
+                        f"{r.scope!r} pays the wire bytes the "
+                        f"reduce-scatter-only sync exists to save "
+                        f"(flat_state=True keeps gradients scattered)",
+                source=r.source))
     return out
 
 

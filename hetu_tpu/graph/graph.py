@@ -283,6 +283,15 @@ class Graph:
         if sharding is not None:
             val = jax.device_put(val, sharding)
         self._var_data[t.id] = val
+        # external param writes (load_model / user resets) invalidate
+        # any flat-optimizer fp32 master packed from the OLD values —
+        # flat optimizers watch this epoch and the per-tensor log
+        # (_ensure_flat_state refreshes ONLY the written params'
+        # masters, so untouched bf16 params keep their fp32 precision)
+        self._var_writes = getattr(self, "_var_writes", 0) + 1
+        if not hasattr(self, "_var_write_log"):
+            self._var_write_log = {}
+        self._var_write_log[t.id] = self._var_writes
 
     # -- sharding -----------------------------------------------------------
 
@@ -843,6 +852,7 @@ class DefineAndRunGraph(Graph):
         # LOCAL until the optimizer's bucketed collective syncs them —
         # once per step, not once per micro-batch or per parameter.
         explicit = None
+        flat_mode = False
         gc_state = (False, None)      # (active, fallback_reason) per plan
         if update_node is not None:
             opt_gc = update_node.attrs["optimizer"]
@@ -857,6 +867,14 @@ class DefineAndRunGraph(Graph):
                         .attrs["loss"])
                 gc_state = (explicit is not None,
                             None if explicit else why)
+                # reduce-scatter-only ZeRO-2: the update runs on the
+                # locally-owned flat chunk INSIDE the manual region, so
+                # the full gradient never materializes.  GRAD-level runs
+                # keep the all-reduce sync — persistent accumulation
+                # stores full (replicated) gradients.
+                flat_mode = bool(explicit is not None
+                                 and getattr(opt_gc, "flat_state", False)
+                                 and run_level == RunLevel.UPDATE)
 
         def step(var_state, opt_state, grad_accum, feeds_mb):
             scale = opt_state["_scaler"]["scale"] if scaler is not None \
@@ -955,6 +973,56 @@ class DefineAndRunGraph(Graph):
                               for v in fetch_vals]
                 return fetch_vals, acc_grads
 
+            if explicit is not None and flat_mode:
+                # flat ZeRO-2 fast path: fwd+bwd, reduce-scatter, the
+                # local-chunk optimizer update AND the param all-gather
+                # all happen inside ONE manual region — the gradients
+                # cross the wire exactly once (scattered), the updated
+                # params exactly once (weight dtype).
+                dpa = explicit["axis"]
+                opt_flat = update_node.attrs["optimizer"]
+
+                def flat_phase(vstate, fmb, fstate, gaccum):
+                    graph._manual_axes = (dpa,)
+                    try:
+                        fv, acc = compute_grads(vstate, fmb)
+                        if gaccum:
+                            # persistent GRAD-level grads arrive already
+                            # mean-synced and replicated; the dp-mean of
+                            # (local + replicated) preserves them exactly
+                            acc = {k: acc[k] + gaccum[k] for k in acc}
+                        new_vars, new_fstate = opt_flat._flat_sync_and_update(
+                            vstate, fstate, acc, update_node.attrs["xs"],
+                            dpa)
+                    finally:
+                        graph._manual_axes = ()
+                    fv = [lax.pmean(v, dpa) if v.ndim == 0 else v
+                          for v in fv]
+                    return fv, new_vars, new_fstate
+
+                from ..parallel import comm as _comm
+                fspecs = opt_flat._flat_state_pspecs(opt_state)
+                # the step counter never leaves the manual region (see
+                # _flat_sync_and_update); it increments out here where
+                # its replication is structural
+                out_fspecs = {k: v for k, v in fspecs.items()
+                              if k != "step"}
+                gac_specs = {k: PartitionSpec() for k in grad_accum}
+                flat_fn = _comm.shard_map(
+                    flat_phase, graph.mesh,
+                    in_specs=(PartitionSpec(), explicit["feed_specs"],
+                              fspecs, gac_specs),
+                    out_specs=(explicit["fetch_specs"], PartitionSpec(),
+                               out_fspecs))
+                fetch_vals, new_vars, new_opt = flat_fn(
+                    var_state, feeds_mb, opt_state, grad_accum)
+                new_opt = dict(new_opt)
+                new_opt["step"] = opt_state["step"] + 1
+                new_accum = {k: jnp.zeros_like(v)
+                             for k, v in grad_accum.items()} \
+                    if grad_accum else {}
+                return fetch_vals, new_vars, new_opt, new_accum
+
             if explicit is not None:
                 dpa = explicit["axis"]
                 opt_sync = update_node.attrs["optimizer"]
@@ -1012,13 +1080,14 @@ class DefineAndRunGraph(Graph):
             return fetch_vals, new_vars, new_opt, new_accum
 
         jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
-        return jit_step, gc_state
+        return jit_step, gc_state, flat_mode
 
     # -- analysis hook -------------------------------------------------------
 
     def _register_plan_for_analysis(self, key, jit_step, gc_state,
                                     update_node, real_fetches,
-                                    num_micro_batches) -> None:
+                                    num_micro_batches,
+                                    flat_mode: bool = False) -> None:
         """Expose this plan to the static analyzer (hetu_tpu/analysis):
         register an ExecutableHandle with the abstract arg specs plus the
         graph-level facts a jaxpr cannot carry — param shardings, mesh
@@ -1058,19 +1127,30 @@ class DefineAndRunGraph(Graph):
         if update_node is not None:
             opt = update_node.attrs["optimizer"]
             meta["dp_axis"] = opt.dp_axis
-            if gc_state[0] and opt.zero in (1, 2):
+            if gc_state[0] and flat_mode:
+                # reduce-scatter-only sync: the updated params leave the
+                # manual region fully gathered, so the per-param
+                # all-gather allowance is ZERO — any GSPMD regather is a
+                # regression the implicit-reshard rule must flag
+                meta["allowed_gspmd"] = {}
+            elif gc_state[0] and opt.zero in (1, 2):
                 # ZeRO-1/2 keeps optimizer state dp-sharded but params
                 # replicated at rest: GSPMD re-materializes each updated
                 # param from its sharded update — one predictable
-                # all_gather per dp-sharded state param (ROADMAP's
-                # reduce-scatter-only sync would remove these)
+                # all_gather per dp-sharded state param (the flat_state
+                # reduce-scatter-only sync removes these)
                 meta["allowed_gspmd"] = {"all_gather": len(opt._shardings)}
             elif gc_state[0] and opt.zero >= 3:
                 # FSDP: params sharded at rest, forward gathers them —
                 # count depends on layer structure; no strict claim
                 meta["allowed_gspmd"] = None
             if gc_state[0]:
-                xs = update_node.attrs["xs"]
+                # entries in SYNC order (optim.flat_state.sync_order —
+                # the one ordering every flat-geometry consumer shares),
+                # so bucket planning in the predictor sees exactly the
+                # runtime geometry
+                from ..optim.flat_state import sync_order
+                xs = sync_order(update_node.attrs["xs"])
                 entries = [(t.name, tuple(t.concrete_shape()),
                             np.dtype(t.dtype.to_jnp()).name) for t in xs]
                 meta["grad_comm"] = {
@@ -1078,6 +1158,9 @@ class DefineAndRunGraph(Graph):
                     "transport": opt.grad_comm,
                     "bucket_mb": opt.bucket_mb,
                     "device_num": mesh_axes.get(opt.dp_axis, 1),
+                    "zero": opt.zero,
+                    "flat": bool(flat_mode),
+                    "clip": opt.max_grad_norm is not None,
                     # each scalar fetch is pmean'd inside the manual
                     # region (one explicit all_reduce apiece)
                     "scalar_fetches": sum(
@@ -1193,7 +1276,7 @@ class DefineAndRunGraph(Graph):
             self._plan_pool[key] = self._build_executable(
                 real_fetches, feed_tensors, num_micro_batches, run_level,
                 update_node)
-        jit_step, gc_state = self._plan_pool[key]
+        jit_step, gc_state, flat_mode = self._plan_pool[key]
         # introspection tracks the plan actually EXECUTED this run, not
         # the last grad-comm-requesting build
         self._grad_comm_active, self._grad_comm_fallback = gc_state
@@ -1216,8 +1299,15 @@ class DefineAndRunGraph(Graph):
         scaler = None
         if update_node is not None:
             opt = update_node.attrs["optimizer"]
-            opt_state = dict(opt._ensure_state(
-                var_state, update_node.attrs["xs"], self))
+            if flat_mode:
+                # flat dp-sharded buffers matching the reduce-scatter
+                # geometry (optim/flat_state.py); grafts restored
+                # per-param checkpoints on the way
+                opt_state = dict(opt._ensure_flat_state(
+                    var_state, update_node.attrs["xs"], self))
+            else:
+                opt_state = dict(opt._ensure_state(
+                    var_state, update_node.attrs["xs"], self))
             scaler = update_node.attrs.get("grad_scaler")
             if scaler is not None and not scaler.enabled:
                 scaler = None
@@ -1236,7 +1326,8 @@ class DefineAndRunGraph(Graph):
                 (var_state, opt_state, grad_accum, feeds_mb))
         self._register_plan_for_analysis(key, jit_step, gc_state,
                                          update_node, real_fetches,
-                                         num_micro_batches)
+                                         num_micro_batches,
+                                         flat_mode=flat_mode)
         fetch_vals, new_vars, new_opt, new_accum = jit_step(
             var_state, opt_state, grad_accum, feeds_mb)
 

@@ -1,10 +1,11 @@
+from .flat_state import FlatStateLayout
 from .optimizer import (Optimizer, SGDOptimizer, AdamOptimizer,
                         AdamWOptimizer, AdafactorOptimizer,
                         SGD, Adam, AdamW)
 from .schedules import (constant_schedule, cosine_schedule, linear_schedule,
                         step_decay_schedule)
 
-__all__ = ["Optimizer", "SGDOptimizer", "AdamOptimizer", "AdamWOptimizer",
-           "AdafactorOptimizer", "SGD", "Adam", "AdamW",
+__all__ = ["FlatStateLayout", "Optimizer", "SGDOptimizer", "AdamOptimizer",
+           "AdamWOptimizer", "AdafactorOptimizer", "SGD", "Adam", "AdamW",
            "constant_schedule", "cosine_schedule", "linear_schedule",
            "step_decay_schedule"]

@@ -1,0 +1,125 @@
+"""Flat dp-sharded optimizer state (ZeRO-2 reduce-scatter-only sync).
+
+The reference's ZeRO path (``SplitReduceScatter`` under the ``zero`` DS
+flag, ``Communication.h:583``) syncs gradients with a single
+reduce-scatter and updates only the locally-owned shard.  Doing the same
+through the explicit coalesced grad-comm path (PR 1) needs the optimizer
+state laid out to match the *bucket chunk* geometry of
+:func:`hetu_tpu.parallel.comm.reduce_scatter_coalesced`: chunk
+boundaries do NOT align with parameter rows, so per-parameter state
+arrays cannot express "rank r owns bytes [r*chunk, (r+1)*chunk) of
+bucket b".  This module packs the per-parameter fp32 master /
+momentum / variance state into contiguous per-bucket flat buffers whose
+geometry is exactly the reduce-scatter's:
+
+* bucket planning reuses :func:`~hetu_tpu.parallel.comm.plan_buckets`
+  over the tid-sorted parameter set (same-dtype, size-capped — identical
+  inputs, identical buckets);
+* each bucket's flat buffer holds ``device_num * chunk`` fp32 elements
+  with ``chunk = quantized_chunk(numel, n, block)`` (a block multiple,
+  so int8 absmax blocks never straddle rank boundaries), zero-padded
+  past the packed parameters;
+* sharded ``P(dp)`` each rank owns a contiguous equal chunk — the very
+  shard :func:`reduce_scatter_coalesced` hands it, so the optimizer
+  update is pure local elementwise math with no regather.
+
+``index`` maps ``param key -> (bucket, offset, numel, shape)`` — the
+view used by checkpointing (per-parameter keyed safetensors entries,
+interchangeable between ``flat_state=True/False`` and across dp sizes)
+and by the static analyzer.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.comm import INT8_BLOCK, plan_buckets, quantized_chunk
+
+
+def sync_order(xs):
+    """The ONE gradient-sync ordering: ascending tensor id.  jax
+    flattens the grad dict by sorted key, so every consumer of the flat
+    geometry — layout construction, state packing, the update itself,
+    and the analyzer's registered entries — must sort exactly this way
+    or chunk boundaries disagree with the reduce-scatter shards."""
+    return sorted(xs, key=lambda t: t.id)
+
+
+class FlatStateLayout:
+    """Static geometry of a flat dp-sharded optimizer-state set."""
+
+    def __init__(self, entries: Sequence[Tuple[Any, Sequence[int], Any]],
+                 device_num: int, bucket_mb: float = 4.0,
+                 block: int = INT8_BLOCK):
+        self.entries = [(k, tuple(int(d) for d in shape),
+                         np.dtype(dt).name) for k, shape, dt in entries]
+        self.device_num = int(device_num)
+        self.block = int(block)
+        self.bucket_mb = float(bucket_mb)
+        self.buckets = tuple(plan_buckets(self.entries, bucket_mb))
+        self.chunks = tuple(
+            quantized_chunk(sum(b.numels), self.device_num, self.block)
+            for b in self.buckets)
+        # param key -> (bucket index, offset into the bucket's flat
+        # buffer, numel, original shape)
+        self.index: Dict[Any, Tuple[int, int, int, Tuple[int, ...]]] = {}
+        for bi, b in enumerate(self.buckets):
+            off = 0
+            for k, shape, numel in zip(b.keys, b.shapes, b.numels):
+                self.index[k] = (bi, off, numel, shape)
+                off += numel
+
+    @property
+    def padded_sizes(self) -> Tuple[int, ...]:
+        """Global flat length per bucket (``device_num * chunk``)."""
+        return tuple(self.device_num * c for c in self.chunks)
+
+    def same_geometry(self, other: "FlatStateLayout") -> bool:
+        return (other is not None and self.entries == other.entries
+                and self.device_num == other.device_num
+                and self.block == other.block
+                and self.bucket_mb == other.bucket_mb)
+
+    def matches(self, entries, device_num: int, bucket_mb: float = 4.0,
+                block: int = INT8_BLOCK) -> bool:
+        """Cheap geometry check against raw (normalized) entries — lets
+        the steady-state training step skip rebuilding bucket plans and
+        the param index entirely."""
+        norm = [(k, tuple(int(d) for d in shape), np.dtype(dt).name)
+                for k, shape, dt in entries]
+        return (self.entries == norm
+                and self.device_num == int(device_num)
+                and self.block == int(block)
+                and self.bucket_mb == float(bucket_mb))
+
+    def pack(self, values: Dict[Any, Any],
+             dtype=jnp.float32) -> List[jnp.ndarray]:
+        """``{key: array}`` -> per-bucket flat buffers, zero-padded to
+        ``device_num * chunk`` (padding lanes never receive gradient —
+        the reduce-scatter pads with zeros too — so they stay inert
+        through any elementwise update)."""
+        flats = []
+        for b, size in zip(self.buckets, self.padded_sizes):
+            parts = [jnp.ravel(jnp.asarray(values[k])).astype(dtype)
+                     for k in b.keys]
+            flat = jnp.concatenate(parts)
+            flats.append(jnp.pad(flat, (0, size - flat.shape[0])))
+        return flats
+
+    def unpack(self, flats: Sequence[Any],
+               dtypes: Dict[Any, Any] = None) -> Dict[Any, Any]:
+        """Per-bucket flat buffers -> ``{key: array}`` in the original
+        shapes, through the param index (padding dropped)."""
+        out: Dict[Any, Any] = {}
+        for b, flat in zip(self.buckets, flats):
+            arr = jnp.asarray(flat)
+            off = 0
+            for k, shape, numel in zip(b.keys, b.shapes, b.numels):
+                piece = arr[off:off + numel].reshape(shape)
+                if dtypes is not None and k in dtypes:
+                    piece = piece.astype(dtypes[k])
+                out[k] = piece
+                off += numel
+        return out

@@ -22,6 +22,13 @@ the XLA partitioner then emits the reduce-scatter/all-gather pairs:
   backward all-gathers are inserted by the partitioner on demand.
 
 ``zero=True`` keeps its historical meaning of level 1.
+
+``flat_state=True`` (with ``grad_comm=`` and ``zero`` 1/2) swaps the
+per-parameter state arrays for flat dp-sharded buffers matching the
+coalesced reduce-scatter geometry (optim/flat_state.py), turning the
+explicit gradient sync into the reference's reduce-scatter-only ZeRO-2
+pairing: RS -> local-chunk update -> weight-dtype param all-gather —
+half the gradient wire bytes of the all-reduce path (DESIGN.md §10).
 """
 from __future__ import annotations
 
@@ -29,6 +36,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..graph.graph import DefineAndRunGraph, Graph, OpNode, get_default_graph
 from ..graph.tensor import Tensor
@@ -39,7 +47,8 @@ class Optimizer:
                  lr=0.01, zero: int = 0, dp_axis: str = "dp",
                  max_grad_norm: Optional[float] = None,
                  grad_comm: Optional[str] = None,
-                 bucket_mb: float = 4.0):
+                 bucket_mb: float = 4.0,
+                 flat_state: bool = False):
         # lr: float, or a schedule callable step -> lr (optim.schedules)
         self.lr = lr
         self.params = list(params) if params is not None else None
@@ -67,6 +76,25 @@ class Optimizer:
         self.bucket_mb = float(bucket_mb)
         if self.bucket_mb <= 0:
             raise ValueError(f"bucket_mb must be > 0, got {bucket_mb}")
+        # flat dp-sharded optimizer state (reduce-scatter-only ZeRO-2
+        # gradient sync, reference SplitReduceScatter under zero): master
+        # fp32 params + momentum/variance packed into per-bucket flat
+        # buffers sharded P(dp) in equal per-rank chunks.  Requires the
+        # explicit grad-comm path (the chunks ARE reduce_scatter_coalesced
+        # shards) and ZeRO 1/2 semantics (params replicated at rest,
+        # state sharded).
+        self.flat_state = bool(flat_state)
+        if self.flat_state:
+            if grad_comm is None:
+                raise ValueError(
+                    "flat_state=True needs the explicit grad-comm path: "
+                    "pass grad_comm='fp32'|'bf16'|'int8'")
+            if self.zero not in (1, 2):
+                raise ValueError(
+                    f"flat_state=True implies dp-sharded state with "
+                    f"replicated params (ZeRO 1/2); got zero={self.zero}")
+        self._flat_layout = None        # FlatStateLayout when flat+active
+        self._packed_var_writes = -1    # graph._var_writes at last pack
         self._state: Dict[str, Any] = {}
         self._shardings: Dict[int, Any] = {}  # tid -> NamedSharding of states
         self._param_shardings: Dict[int, Any] = {}  # tid -> zero-3 sharding
@@ -117,6 +145,12 @@ class Optimizer:
 
     def _ensure_state(self, var_state: Dict[int, jax.Array],
                       xs: Sequence[Tensor], graph: Graph) -> Dict[str, Any]:
+        # a flat checkpoint's fp32 master copy is meaningful only to
+        # _ensure_flat_state; per-param math has no such slot, and
+        # letting it ride along (SGD's dict(opt_state) carry) would
+        # re-save a STALE master that a later flat restore prefers over
+        # the trained params — silently reverting the weights
+        self._state.pop("master", None)
         just_inited = False
         if not self._state:
             self._state = self._init_state(var_state, xs)
@@ -219,6 +253,209 @@ class Optimizer:
             grads, axis, op="mean", bucket_mb=self.bucket_mb,
             transport=self.grad_comm or "fp32")
 
+    # -- flat dp-sharded state (ZeRO-2 reduce-scatter-only sync) -------------
+    #
+    # State geometry mirrors comm.reduce_scatter_coalesced exactly
+    # (optim/flat_state.py): each rank's P(dp) shard of every flat buffer
+    # IS its reduce-scattered gradient chunk, so the update is pure local
+    # elementwise math and the only collectives per step are one
+    # reduce-scatter chain plus one param-dtype all-gather per bucket.
+
+    def _flat_slots(self):
+        """Per-param state slots packed into flat buffers (beyond the
+        fp32 master copy); subclasses that support flat_state override."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support flat_state=True")
+
+    def _flat_update(self, p, slots, g, step, lr):
+        """Elementwise update on local fp32 chunks: (master, {slot:
+        chunk}, grad, step, lr) -> (new master, {slot: new chunk})."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support flat_state=True")
+
+    def _flat_entries(self, xs: Sequence[Tensor], var_state):
+        """(key, shape, dtype) of the gradient set in SYNC order
+        (flat_state.sync_order — the one ordering every flat-geometry
+        consumer shares)."""
+        from .flat_state import sync_order
+        return [(t.id, np.shape(var_state[t.id]),
+                 np.dtype(jnp.result_type(var_state[t.id])).name)
+                for t in sync_order(xs)]
+
+    def _ensure_flat_state(self, var_state: Dict[int, jax.Array],
+                           xs: Sequence[Tensor], graph: Graph
+                           ) -> Dict[str, Any]:
+        """Build (or graft a restored checkpoint into) the flat state.
+
+        Accepts three starting points: empty (fresh init), per-parameter
+        dicts (a checkpoint written by either the flat or the per-param
+        path — checkpoints are always per-parameter keyed), or an
+        existing flat state whose geometry changed (dp resize / hot
+        switch), which is unpacked through the old index and repacked.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec
+        from .flat_state import FlatStateLayout, sync_order
+        mesh = graph.mesh
+        assert mesh is not None and self.dp_axis in mesh.axis_names, \
+            "flat_state needs a mesh with the dp axis (explicit path)"
+        slots = self._flat_slots()
+        entries = self._flat_entries(xs, var_state)
+        dp = mesh.shape[self.dp_axis]
+        st = dict(self._state)
+        is_flat = any(k.startswith("flat_") for k in st)
+        writes = getattr(graph, "_var_writes", 0)
+
+        def _written_since_pack():
+            # ONLY the params actually written since the last pack
+            # (graph._var_write_log): refreshing every master from the
+            # (possibly bf16) live values would throw away the fp32
+            # precision of untouched params
+            log = getattr(graph, "_var_write_log", {})
+            return [t for t in sync_order(xs)
+                    if log.get(t.id, -1) > self._packed_var_writes]
+
+        if is_flat and self._flat_layout is not None \
+                and self._flat_layout.matches(entries, dp,
+                                              self.bucket_mb):
+            # steady state: no bucket replanning.  But params written
+            # OUTSIDE the update loop (reset_variable / load_model)
+            # supersede their packed fp32 master slices, or the next
+            # all-gather would silently revert the external write
+            if writes != self._packed_var_writes:
+                stale = _written_since_pack()
+                if stale:
+                    lay = self._flat_layout
+                    masters = list(self._state["flat_master"])
+                    touched = set()
+                    for t in stale:
+                        bi, off, numel, _shape = lay.index[t.id]
+                        flat = jnp.asarray(masters[bi])
+                        masters[bi] = flat.at[off:off + numel].set(
+                            jnp.ravel(var_state[t.id])
+                            .astype(jnp.float32))
+                        touched.add(bi)
+                    sh_m = NamedSharding(mesh,
+                                         PartitionSpec(self.dp_axis))
+                    self._state["flat_master"] = [
+                        jax.device_put(m, sh_m) if i in touched else m
+                        for i, m in enumerate(masters)]
+                self._packed_var_writes = writes
+            return self._state
+        new_lay = FlatStateLayout(entries, dp, bucket_mb=self.bucket_mb)
+        if is_flat:
+            # geometry changed (dp size / param set): go through the
+            # per-param view and repack under the new index; params
+            # written since the last pack supersede their old master
+            old = self._flat_layout
+            per: Dict[str, Any] = {"step": st.get("step")}
+            per["master"] = old.unpack(st["flat_master"])
+            for t in _written_since_pack():
+                per["master"][t.id] = var_state[t.id]
+            for s in slots:
+                per[s] = old.unpack(st[f"flat_{s}"])
+            st = per
+        xs_sorted = sync_order(xs)
+        params = {t.id: var_state[t.id] for t in xs_sorted}
+
+        def _per_param(tree, default):
+            if not isinstance(tree, dict) or not tree:
+                return {t.id: default(t) for t in xs_sorted}
+            vals = {}
+            for t in xs_sorted:
+                arr = tree.get(t.id)
+                if arr is not None and np.shape(arr) != np.shape(
+                        var_state[t.id]):
+                    raise ValueError(
+                        f"checkpointed flat-state entry for {t.name} has "
+                        f"shape {np.shape(arr)}, param is "
+                        f"{np.shape(var_state[t.id])}")
+                vals[t.id] = arr if arr is not None else default(t)
+            return vals
+
+        zeros = lambda t: jnp.zeros(  # noqa: E731
+            np.shape(var_state[t.id]), jnp.float32)
+        # master defaults to the current (possibly bf16) param values —
+        # exactly what a flat_state=False checkpoint implies
+        master = _per_param(st.get("master"), lambda t: var_state[t.id])
+        flat: Dict[str, Any] = {
+            "step": jnp.asarray(st.get("step")
+                                if st.get("step") is not None else 0,
+                                jnp.int32),
+            "flat_master": new_lay.pack(master),
+        }
+        for s in slots:
+            flat[f"flat_{s}"] = new_lay.pack(_per_param(st.get(s), zeros))
+        sh = NamedSharding(mesh, PartitionSpec(self.dp_axis))
+        for key, bufs in flat.items():
+            if key.startswith("flat_"):
+                flat[key] = [jax.device_put(a, sh) for a in bufs]
+        self._flat_layout = new_lay
+        self._state = flat
+        self._pending_tree_state = None
+        self._packed_var_writes = writes
+        return self._state
+
+    def _flat_state_pspecs(self, opt_state: Dict[str, Any]):
+        """shard_map specs matching ``opt_state``'s structure: flat
+        buffers ride P(dp), everything else replicated."""
+        from jax.sharding import PartitionSpec
+        return {k: ([PartitionSpec(self.dp_axis)] * len(v)
+                    if k.startswith("flat_") else PartitionSpec())
+                for k, v in opt_state.items()}
+
+    def _flat_sync_and_update(self, var_state, fstate, grads,
+                              xs: Sequence[Tensor], axis: str):
+        """Reduce-scatter -> local-chunk update -> param-dtype all-gather
+        (the reference's zero pairing, Communication.h:583, without ever
+        materializing a full gradient).  Must run inside the shard_map
+        manual region; ``fstate`` leaves arrive as LOCAL chunks.
+        Returns (new param dict, new flat buffers).  The step counter is
+        NOT among the outputs: it is replicated arithmetic the caller
+        increments outside the region (a scalar leaving a manual region
+        with no reduction on its def-chain would — rightly — trip the
+        unreduced-psum-scalar lint)."""
+        from ..parallel import comm
+        from .flat_state import sync_order
+        lay = self._flat_layout
+        xs_sorted = sync_order(xs)
+        gdict = {t.id: grads[t.id] for t in xs_sorted}
+        chunks, rs_layout = comm.reduce_scatter_coalesced(
+            gdict, axis, op="mean", bucket_mb=self.bucket_mb,
+            transport=self.grad_comm or "fp32")
+        assert tuple(rs_layout.chunks) == tuple(lay.chunks), \
+            "flat-state layout drifted from the reduce-scatter geometry"
+        if self.max_grad_norm is not None:
+            # global-norm clip over the scattered chunks: local partial
+            # sums + one psum (padding lanes contribute exact zeros)
+            sq = sum(jnp.sum(jnp.square(c)) for c in chunks)
+            norm = jnp.sqrt(jax.lax.psum(sq, axis))
+            scale = jnp.minimum(1.0, self.max_grad_norm / (norm + 1e-6))
+            chunks = [c * scale for c in chunks]
+        step = fstate["step"] + 1
+        lr = self._lr_at(step)
+        slots = self._flat_slots()
+        new_master: list = []
+        new_slots: Dict[str, list] = {s: [] for s in slots}
+        for bi, g in enumerate(chunks):
+            p = fstate["flat_master"][bi]
+            cur = {s: fstate[f"flat_{s}"][bi] for s in slots}
+            p_new, cur_new = self._flat_update(p, cur, g, step, lr)
+            new_master.append(p_new)
+            for s in slots:
+                new_slots[s].append(cur_new[s])
+        # updated params ride the WEIGHT dtype across the wire (bucket
+        # dtype == param dtype), tagged param_comm — gradient bytes and
+        # parameter bytes stay separable in the accounting
+        gathered = comm.all_gather_coalesced(new_master, rs_layout, axis,
+                                             tag="param_comm")
+        new_vars = dict(var_state)
+        for t in xs_sorted:
+            new_vars[t.id] = gathered[t.id]
+        out: Dict[str, Any] = {"flat_master": new_master}
+        for s in slots:
+            out[f"flat_{s}"] = new_slots[s]
+        return new_vars, out
+
     def _c_param(self, tid: int, p):
         """ZeRO-3: keep the updated parameter dp-sharded at rest;
         ZeRO-1/2: pin it to its own (dp-replicated) spec — the param
@@ -237,7 +474,13 @@ class Optimizer:
         ``param`` (momentum, Adam m/v).  Used by cache-backed embeddings
         when a slot's occupant changes (hetu_tpu/embedding/cached.py);
         subclasses with non-standard state layouts must override."""
-        import numpy as np
+        if self._flat_layout is not None:
+            # rows of one param live at arbitrary offsets inside shared
+            # flat buffers; silently skipping would corrupt cache-backed
+            # embeddings — refuse loudly instead
+            raise NotImplementedError(
+                "reset_state_rows is not supported with flat_state=True "
+                "(cache-backed embeddings need per-param state)")
         rows = np.asarray(rows)
         if rows.size == 0:
             return
@@ -285,6 +528,9 @@ class Optimizer:
 
     def step(self, grads: Dict[int, jax.Array]) -> None:
         assert self.params is not None, "eager step needs params list"
+        assert not self.flat_state, \
+            "eager step() has no manual dp region; flat_state needs the " \
+            "graph explicit path (DefineAndRunGraph.run)"
         g = self.params[0].graph
         var_state = {p.id: g.get_tensor_value(p) for p in self.params}
         opt_state = self._ensure_state(var_state, self.params, g)
@@ -308,6 +554,16 @@ class SGDOptimizer(Optimizer):
             state["velocity"] = {t.id: jnp.zeros_like(var_state[t.id])
                                  for t in xs}
         return state
+
+    def _flat_slots(self):
+        return ("velocity",) if self.momentum != 0.0 else ()
+
+    def _flat_update(self, p, slots, g, step, lr):
+        if self.momentum == 0.0:
+            return p - lr * g, {}
+        v = self.momentum * slots["velocity"] + g
+        upd = g + self.momentum * v if self.nesterov else v
+        return p - lr * upd, {"velocity": v}
 
     def _apply_updates(self, var_state, opt_state, grads, xs):
         grads = self._clip_grads(grads, xs)
@@ -362,6 +618,24 @@ class AdamOptimizer(Optimizer):
             "v": {t.id: jnp.zeros(var_state[t.id].shape, jnp.float32)
                   for t in xs},
         }
+
+    def _flat_slots(self):
+        return ("m", "v")
+
+    def _flat_update(self, p, slots, g, step, lr):
+        # same math as _apply_updates on fp32 chunks; padding lanes have
+        # g == 0 and p == 0, so every term stays exactly 0 there
+        b1, b2 = self.beta1, self.beta2
+        if self.weight_decay and not self.decoupled_weight_decay:
+            g = g + self.weight_decay * p                      # Adam-L2
+        m = b1 * slots["m"] + (1 - b1) * g
+        v = b2 * slots["v"] + (1 - b2) * (g * g)
+        bc1 = 1.0 - b1 ** step.astype(jnp.float32)
+        bc2 = 1.0 - b2 ** step.astype(jnp.float32)
+        upd = lr * (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+        if self.weight_decay and self.decoupled_weight_decay:
+            upd = upd + lr * self.weight_decay * p
+        return p - upd, {"m": m, "v": v}
 
     def _apply_updates(self, var_state, opt_state, grads, xs):
         grads = self._clip_grads(grads, xs)

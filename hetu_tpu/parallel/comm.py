@@ -751,10 +751,21 @@ def reduce_scatter_coalesced(xs, axis: str, op: str = "sum",
 
 def all_gather_coalesced(chunks, layout: CoalescedLayout, axis: str,
                          transport: str = "fp32",
-                         block: int = INT8_BLOCK):
+                         block: int = INT8_BLOCK,
+                         tag: str = "grad_comm"):
     """Inverse of :func:`reduce_scatter_coalesced`: gather every rank's
     chunks and unflatten back to the original container (dict keyed like
-    the input mapping, or a list when the input was a sequence)."""
+    the input mapping, or a list when the input was a sequence).
+
+    The plain (non-quantized) path gathers in the BUCKET dtype, not the
+    chunk dtype: casting the fp32 chunk before the collective is
+    elementwise-identical to casting after, so a bf16 parameter set
+    crosses the wire as bf16 — the ZeRO-2 updated-param all-gather rides
+    the weight dtype instead of fp32 (half the gather bytes).  ``tag``
+    names the attribution scope: the flat-optimizer path tags its param
+    gather ``param_comm`` so byte accounting (and the
+    grad-allgather-under-zero2 lint) can tell parameter traffic from
+    gradient traffic."""
     if layout.groups is not None:
         # grouped shards are padded per-rank to the largest chunk; a
         # full-axis gather would interleave groups and padding into
@@ -769,10 +780,13 @@ def all_gather_coalesced(chunks, layout: CoalescedLayout, axis: str,
     for bi, (shard, b, chunk) in enumerate(zip(chunks, layout.buckets,
                                                layout.chunks)):
         numel = sum(b.numels)
-        with comm_tag(f"grad_comm/bucket{bi}"):
+        with comm_tag(f"{tag}/bucket{bi}"):
             if transport == "fp32":
-                _record("all_gather", n * chunk * 4, jnp.float32, n, axis)
-                full = lax.all_gather(shard, axis, tiled=True)[:numel]
+                wire_dt = np.dtype(b.dtype)
+                _record("all_gather", n * chunk * wire_dt.itemsize,
+                        wire_dt, n, axis)
+                full = lax.all_gather(shard.astype(wire_dt), axis,
+                                      tiled=True)[:numel]
             else:
                 full = _qall_gather_flat(shard, axis, transport, block,
                                          numel)

@@ -432,22 +432,78 @@ def verify_grad_comm_emission(hlo_text: str, prediction: List[dict],
             f"(kind: want/got): {bad}")
 
 
+def predict_flat_update_collectives(entries, device_num: int,
+                                    bucket_mb: float = 4.0,
+                                    transport: str = "fp32",
+                                    block: Optional[int] = None
+                                    ) -> List[dict]:
+    """Predict the collectives of one reduce-scatter-only ZeRO-2 sync
+    (flat dp-sharded optimizer state, ``Optimizer(flat_state=True)``).
+
+    Per bucket: ONE reduce-scatter chain carrying the gradients (fp32:
+    a single ``psum_scatter``; bf16/int8: the phase-1 quantized exchange
+    only — the phase-2 regather of the all-reduce path is gone) plus ONE
+    all-gather of the updated parameters riding the bucket's WEIGHT
+    dtype.  Zero gradient all-gathers, ever — exactly half the gradient
+    wire bytes of the all-reduce path at the same transport.
+    """
+    from .comm import (INT8_BLOCK, plan_buckets, quantized_chunk,
+                       ring_wire_bytes)
+    block = block or INT8_BLOCK
+    n = device_num
+    preds: List[dict] = []
+
+    def _emit(kind, payload, dtype):
+        preds.append({"kind": kind, "payload_bytes": int(payload),
+                      "wire_bytes": ring_wire_bytes(kind, payload, n),
+                      "dtype": dtype})
+
+    for b in plan_buckets(entries, bucket_mb):
+        numel = sum(b.numels)
+        chunk = quantized_chunk(numel, n, block)
+        if transport == "fp32":
+            _emit("reduce_scatter", n * chunk * 4, "float32")
+        elif transport == "bf16":
+            _emit("all_to_all", n * chunk * 2, "bfloat16")
+        elif transport == "int8":
+            _emit("all_to_all", n * chunk, "int8")
+            _emit("all_to_all", n * (chunk // block) * 4, "float32")
+        else:
+            raise ValueError(f"unknown transport {transport!r}")
+        # updated-param gather in the weight dtype (tag param_comm)
+        itemsize = np.dtype(b.dtype).itemsize
+        _emit("all_gather", n * chunk * itemsize, b.dtype)
+    return preds
+
+
 def predict_update_step_collectives(entries, device_num: int,
                                     transport: str = "fp32",
                                     bucket_mb: float = 4.0,
                                     block: Optional[int] = None,
-                                    scalar_fetches: int = 1):
+                                    scalar_fetches: int = 1,
+                                    flat: bool = False,
+                                    clip: bool = False):
     """Step-level prediction for an explicit-grad-comm training
     executable: the coalesced gradient-sync collectives
-    (:func:`predict_grad_comm_collectives`) plus one all_reduce (the
-    scalar pmean) per scalar fetch.  Returns ``(prediction, extra)`` in
+    (:func:`predict_grad_comm_collectives`, or
+    :func:`predict_flat_update_collectives` when ``flat`` — the
+    reduce-scatter-only ZeRO-2 path) plus one all_reduce (the scalar
+    pmean) per scalar fetch, plus the global-norm-clip psum when the
+    flat path clips (``clip``; the all-reduce path clips on full local
+    grads with no collective).  Returns ``(prediction, extra)`` in
     exactly the form :func:`verify_grad_comm_emission` consumes, so the
     general analysis pass (``hetu_tpu.analysis``) and direct HLO
     assertions share one predictor."""
-    preds = predict_grad_comm_collectives(entries, device_num,
-                                          bucket_mb=bucket_mb,
-                                          transport=transport, block=block)
-    extra = {"all_reduce": int(scalar_fetches)} if scalar_fetches else {}
+    if flat:
+        preds = predict_flat_update_collectives(
+            entries, device_num, bucket_mb=bucket_mb,
+            transport=transport, block=block)
+    else:
+        preds = predict_grad_comm_collectives(
+            entries, device_num, bucket_mb=bucket_mb,
+            transport=transport, block=block)
+    n_ar = int(scalar_fetches) + (1 if (flat and clip) else 0)
+    extra = {"all_reduce": n_ar} if n_ar else {}
     return preds, extra
 
 

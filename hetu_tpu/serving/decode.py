@@ -57,7 +57,13 @@ def build_prefill_fn(cfg: GPTConfig, s_pad: int, max_pages: int,
     (a multiple of ``page_size``).
 
     fn(params, prompt [1, s_pad], true_len, pt_row [max_pages],
-       k_pages, v_pages) -> (logits [V], new k_pages, new v_pages)
+       k_pages, v_pages) -> (logits [V], greedy token [], new k_pages,
+       new v_pages)
+
+    The greedy (temperature-0) argmax is folded into the jit so the
+    engine can skip the host logits round-trip entirely — the same
+    ``jnp.argmax`` ``generate()`` runs, so on-device sampling stays
+    bit-for-bit with the solo path.
 
     Padded prompt tail tokens only influence positions >= true_len
     (causal mask), whose KV entries are masked by ``seq_len`` until
@@ -81,8 +87,11 @@ def build_prefill_fn(cfg: GPTConfig, s_pad: int, max_pages: int,
 
     # page arrays are donated: the pool replaces them wholesale every
     # call (Engine.set_pages), so XLA may scatter in place instead of
-    # holding live+new copies of the whole KV pool
-    @functools.partial(jax.jit, donate_argnums=(4, 5))
+    # holding live+new copies of the whole KV pool.  true_len is donated
+    # too — the engine builds it fresh per call, and the on-device
+    # greedy token output would otherwise alias its shape/dtype and trip
+    # donation-miss
+    @functools.partial(jax.jit, donate_argnums=(2, 4, 5))
     def run(params, prompt, true_len, pt_row, k_pages, v_pages):
         p = _params_view(cfg, params)
         caches = [(jnp.zeros((1, s_pad, cfg.kv_heads, cfg.head_dim), cdt),
@@ -91,6 +100,7 @@ def build_prefill_fn(cfg: GPTConfig, s_pad: int, max_pages: int,
         _, cs, x = decode_step(cfg, p, prompt, caches, 0, cos, sin,
                                return_hidden=True)
         logits = _lm_head(p, x[0, true_len - 1][None])[0]      # [V]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         new_k, new_v = [], []
         with jax.named_scope("kv_page_scatter"):
             for i in range(cfg.num_layers):
@@ -103,7 +113,7 @@ def build_prefill_fn(cfg: GPTConfig, s_pad: int, max_pages: int,
                         vc[0, j * page_size:(j + 1) * page_size])
                 new_k.append(kp)
                 new_v.append(vp)
-        return logits, tuple(new_k), tuple(new_v)
+        return logits, greedy, tuple(new_k), tuple(new_v)
 
     return run
 
@@ -113,7 +123,13 @@ def build_decode_fn(cfg: GPTConfig, batch: int, max_pages: int,
     """Compile a paged decode step for batch bucket ``batch``.
 
     fn(params, tokens [B], pos [B], page_tables [B, max_pages],
-       k_pages, v_pages) -> (logits [B, V], new k_pages, new v_pages)
+       k_pages, v_pages) -> (logits [B, V], greedy tokens [B],
+       new k_pages, new v_pages)
+
+    The on-device greedy argmax lets the engine fetch B int32s instead
+    of a [B, V] fp32 logits matrix when every live request decodes at
+    temperature 0 — the host round-trip that dominates small-model
+    decode (ROADMAP serving item).
 
     ``pos[b]`` is the KV write index for this token (== tokens already
     committed); dummy batch slots carry pos=0 and an all-trash page
@@ -130,7 +146,11 @@ def build_decode_fn(cfg: GPTConfig, batch: int, max_pages: int,
     hd, nh, nkv = c.head_dim, c.num_heads, c.kv_heads
     batch_idx = jnp.arange(batch)
 
-    @functools.partial(jax.jit, donate_argnums=(4, 5))
+    # tokens is rebuilt by the engine every step: donating it lets XLA
+    # alias the on-device greedy-token output instead of holding a dead
+    # copy (pos, the same shape, stays un-donated — the single [B] int32
+    # output slot is already claimed)
+    @functools.partial(jax.jit, donate_argnums=(1, 4, 5))
     def run(params, tokens, pos, page_tables, k_pages, v_pages):
         p = _params_view(cfg, params)
         x = p("wte.weight")[tokens][:, None].astype(cdt)       # [B, 1, H]
@@ -187,6 +207,7 @@ def build_decode_fn(cfg: GPTConfig, batch: int, max_pages: int,
             new_v.append(vp)
         x = _norm_apply(c, p("ln_f.weight"), p("ln_f.bias"), x)
         logits = _lm_head(p, x[:, 0])                          # [B, V]
-        return logits, tuple(new_k), tuple(new_v)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return logits, greedy, tuple(new_k), tuple(new_v)
 
     return run

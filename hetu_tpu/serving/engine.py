@@ -81,6 +81,10 @@ class Engine:
         self._time_fn = time_fn or time.monotonic
         self._next_id = 0
         self.steps = 0
+        # host logits round-trips actually paid: greedy (temperature-0)
+        # traffic samples on device and only moves B int32s per step —
+        # this stays 0 unless a sampled-mode request is live
+        self.host_logit_fetches = 0
         m = metrics
         self.counters = {k: make_instrument("counter", k, m) for k in
                          ("tokens_generated", "prefill_tokens",
@@ -241,7 +245,7 @@ class Engine:
         fn = self._get_fn("prefill", s_pad)
         prompt = np.zeros((1, s_pad), np.int32)
         prompt[0, :n_tok] = req.tokens
-        logits, new_k, new_v = fn(
+        logits, greedy, new_k, new_v = fn(
             self.params, jnp.asarray(prompt), jnp.int32(n_tok),
             jnp.asarray(self._pt_row(pages)),
             self.pool.k_pages, self.pool.v_pages)
@@ -249,7 +253,11 @@ class Engine:
         req.pos = n_tok
         req.state = RUNNING
         self.running.append(req)
-        self._emit(req, np.asarray(logits))
+        if req.temperature == 0.0:
+            self._emit(req, token=int(np.asarray(greedy)))
+        else:
+            self.host_logit_fetches += 1
+            self._emit(req, logits=np.asarray(logits))
         now = self._now()
         if req.first_token_time is None:
             req.first_token_time = now
@@ -287,15 +295,24 @@ class Engine:
             self.tap.append({"kind": "decode", "n_live": len(kept),
                              "pos": pos.copy(), "page_tables": pt.copy()})
         t0 = self._now()
-        logits, new_k, new_v = fn(
+        logits, greedy, new_k, new_v = fn(
             self.params, jnp.asarray(tokens), jnp.asarray(pos),
             jnp.asarray(pt), self.pool.k_pages, self.pool.v_pages)
         self.pool.set_pages(new_k, new_v)
-        logits = np.asarray(logits)
+        # fetch the [B, V] logits only when a sampled-mode request is in
+        # the batch; all-greedy steps move B int32s instead
+        toks = np.asarray(greedy)
+        logits_host = None
+        if any(r.temperature != 0.0 for r in kept):
+            self.host_logit_fetches += 1
+            logits_host = np.asarray(logits)
         dt = self._now() - t0
         for i, req in enumerate(kept):
             req.pos += 1
-            self._emit(req, logits[i])
+            if req.temperature == 0.0:
+                self._emit(req, token=int(toks[i]))
+            else:
+                self._emit(req, logits=logits_host[i])
             self.histograms["tpot"].observe(dt)
             self._maybe_finish(req)
         self.counters["decode_steps"].inc()
@@ -303,12 +320,17 @@ class Engine:
 
     # -- sampling / retirement ----------------------------------------------
 
-    def _emit(self, req: Request, logits: np.ndarray) -> None:
-        """Sample the next token from fp32 logits [V] (host-side: greedy
-        argmax matches generate()'s jnp.argmax bit-for-bit; sampled mode
-        draws from a per-request, per-position RNG so replays are
-        deterministic regardless of batching)."""
-        if req.temperature == 0.0:
+    def _emit(self, req: Request, logits: Optional[np.ndarray] = None,
+              token: Optional[int] = None) -> None:
+        """Commit the next token: either ``token`` (already sampled on
+        device — the greedy argmax folded into the decode/prefill jit,
+        the very ``jnp.argmax`` generate() runs, so it stays bit-for-bit
+        with the solo path) or sampled host-side from fp32 ``logits``
+        [V] with a per-request, per-position RNG so replays are
+        deterministic regardless of batching."""
+        if token is not None:
+            tok = int(token)
+        elif req.temperature == 0.0:
             tok = int(np.argmax(logits))
         else:
             lg = logits.astype(np.float64) / req.temperature
@@ -358,4 +380,5 @@ class Engine:
         for k, h in self.histograms.items():
             out[k] = h.summary()
         out["compile_count"] = self.compile_count
+        out["host_logit_fetches"] = self.host_logit_fetches
         return out

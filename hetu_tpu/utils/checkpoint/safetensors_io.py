@@ -442,7 +442,32 @@ def _opt_state_items(optimizer, tid_to_name):
     # _state (a load_checkpoint after training leaves the LOADED leaves
     # in _pending_tree_state while _state still holds pre-load values)
     pending = getattr(optimizer, "_pending_tree_state", None) or {}
-    for key, tree in (optimizer._state or {}).items():
+    lay = getattr(optimizer, "_flat_layout", None)
+    state = optimizer._state or {}
+    if lay is not None and any(k.startswith("flat_") for k in state):
+        # flat dp-sharded state (optim/flat_state.py): decompose the
+        # per-bucket buffers through the param->(offset, length) index
+        # so the checkpoint stays per-parameter keyed — it loads into
+        # flat_state=True/False alike, at any dp size (the flat load
+        # path repacks under the reader's geometry).  The fp32 master
+        # copy rides as "opt.master.<name>"; per-param readers drop it
+        # at first use (_ensure_state) so a stale copy can never
+        # survive per-param training into a later flat restore.
+        for key, val in state.items():
+            if not key.startswith("flat_"):
+                yield f"opt.{key}", val, key, None
+                continue
+            slot = key[len("flat_"):]
+            # slice the LIVE buffers through the index (device-side) and
+            # fetch one parameter at a time — never materializing every
+            # flat buffer on the host at once the way an up-front
+            # _to_numpy of master+m+v would
+            per = lay.unpack(val)
+            for tid, arr in per.items():
+                name = tid_to_name.get(tid, str(tid))
+                yield f"opt.{slot}.{name}", _to_numpy(arr), slot, tid
+        return
+    for key, tree in state.items():
         if key in pending:
             continue
         if isinstance(tree, dict):

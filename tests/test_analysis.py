@@ -243,6 +243,54 @@ class TestSeededViolations:
         assert not _rules_fired(analyze_handle(h2, compile=True),
                                 "implicit-reshard")
 
+    def test_grad_allgather_under_zero2_fires_once(self):
+        """Seeded regression to the pre-flat path: a ZeRO-2 plan whose
+        records show an fp32 gradient all-gather (or, under the flat
+        reduce-scatter-only contract, ANY gradient all-gather)."""
+        from hetu_tpu.analysis import CollectiveRecord
+
+        def rec(kind, dtype, scope):
+            return CollectiveRecord(kind=kind, axes=("dp",), dtype=dtype,
+                                    payload_bytes=1 << 20,
+                                    wire_bytes=1.0, scope=scope)
+
+        ctx = AnalysisContext(
+            name="t_z2", meta={"grad_comm": {"zero": 2, "flat": True}},
+            records=[
+                rec("all_gather", "float32", "grad_comm/bucket0"),  # !!
+                rec("all_gather", "float32", "grad_comm/bucket0/scales"),
+                rec("all_gather", "bfloat16", "param_comm/bucket0"),
+                rec("reduce_scatter", "float32", "grad_comm/bucket0"),
+            ])
+        fired = run_rules(ctx, only=["grad-allgather-under-zero2"])
+        assert len(fired) == 1, fired
+        assert fired[0].subject == "all_gather:float32"
+        assert fired[0].severity == "error"
+        # flat contract: even a quantized gradient regather fires
+        ctx2 = AnalysisContext(
+            name="t_z2b", meta={"grad_comm": {"zero": 2, "flat": True}},
+            records=[rec("all_gather", "int8", "grad_comm/bucket0")])
+        assert len(run_rules(ctx2,
+                             only=["grad-allgather-under-zero2"])) == 1
+        # the legacy (non-flat) ZeRO-2 quantized path regathers in int8
+        # by design: silent
+        ctx3 = AnalysisContext(
+            name="t_z2c", meta={"grad_comm": {"zero": 2, "flat": False}},
+            records=[rec("all_gather", "int8", "grad_comm/bucket0")])
+        assert not run_rules(ctx3, only=["grad-allgather-under-zero2"])
+        # not a ZeRO-2 plan (and not flat): silent
+        ctx4 = AnalysisContext(
+            name="t_z2d", meta={"grad_comm": {"zero": 0}},
+            records=[rec("all_gather", "float32", "grad_comm/bucket0")])
+        assert not run_rules(ctx4, only=["grad-allgather-under-zero2"])
+        # a flat ZeRO-1 plan declares the same reduce-scatter-only
+        # contract: in scope despite zero < 2
+        ctx5 = AnalysisContext(
+            name="t_z2e", meta={"grad_comm": {"zero": 1, "flat": True}},
+            records=[rec("all_gather", "int8", "grad_comm/bucket0")])
+        assert len(run_rules(ctx5,
+                             only=["grad-allgather-under-zero2"])) == 1
+
     def test_trash_page_write_fires_once_per_seed(self):
         # seed 1: the pre-fix reset() bug — free-list rebuilt WITH page 0
         pool = PagedKVPool(num_layers=1, num_pages=4, page_size=8,

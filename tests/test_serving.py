@@ -436,6 +436,37 @@ def test_request_queue_arrival_order_gating():
     assert not q
 
 
+def test_greedy_sampling_on_device_skips_logits_roundtrip():
+    """Temperature-0 sampling is the jit'd jnp.argmax: an all-greedy
+    workload never fetches host logits (only B int32s), while staying
+    bit-for-bit with solo generate().  A sampled-mode request in the
+    batch forces the fetch for itself without disturbing greedy peers."""
+    cfg = GPTConfig(position="learned", norm="layernorm",
+                    activation="gelu", **CFG_KW)
+    state = _build_state(cfg, seed=21)
+    prompts = [[5, 17, 2, 9], [3, 2, 1]]
+    want = [_solo(state, cfg, pr, 6) for pr in prompts]
+
+    eng = _make_engine(state, cfg, num_pages=16, page_size=16,
+                       max_batch=4)
+    reqs = [eng.add_request(pr, 6, arrival_time=0.0) for pr in prompts]
+    _drain(eng)
+    assert eng.host_logit_fetches == 0          # argmax stayed on device
+    assert eng.metrics_summary()["host_logit_fetches"] == 0
+    for r, w in zip(reqs, want):
+        assert r.out_tokens == w
+
+    eng2 = _make_engine(state, cfg, num_pages=16, page_size=16,
+                        max_batch=4)
+    g_req = eng2.add_request(prompts[0], 6, arrival_time=0.0)
+    s_req = eng2.add_request(prompts[1], 6, temperature=1.0, seed=3,
+                             arrival_time=0.0)
+    _drain(eng2)
+    assert eng2.host_logit_fetches >= 1         # sampled row paid it
+    assert g_req.out_tokens == want[0]          # greedy peer untouched
+    assert len(s_req.out_tokens) == 6
+
+
 # ---------------------------------------------------------------------------
 # metrics instruments (satellite)
 # ---------------------------------------------------------------------------
