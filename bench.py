@@ -519,11 +519,13 @@ def bench_comm_microbench() -> dict:
 
 
 def bench_lint_graph() -> dict:
-    """The static-analysis gate as a bench target (ISSUE 3: lint-graph):
-    runs ``python -m hetu_tpu.analysis --check`` in a pinned-CPU
-    subprocess and reports pass/fail plus the analyzer's per-executable
-    collective summary.  CI tier-1 runs the same gate through the
-    ``lint_graph`` pytest marker (tests/test_analysis.py)."""
+    """The static-analysis gate as a bench target (ISSUE 3: lint-graph;
+    ISSUE 5: per-edge attribution): runs ``python -m hetu_tpu.analysis
+    --check --format json`` in a pinned-CPU subprocess and reports
+    pass/fail, the analyzer's per-executable collective summary, and the
+    per-edge coverage (explained collectives / total) per gated family.
+    CI tier-1 runs the same gate through the ``lint_graph`` pytest
+    marker (tests/test_analysis.py)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)       # the CLI forces its own device count
@@ -531,7 +533,7 @@ def bench_lint_graph() -> dict:
     try:
         proc = subprocess.run(
             [sys.executable, "-m", "hetu_tpu.analysis", "--check",
-             "--json"],
+             "--format", "json"],
             cwd=here, env=env, capture_output=True, text=True,
             timeout=1200)
         lines = [l for l in proc.stdout.splitlines() if l.strip()]
@@ -541,11 +543,21 @@ def bench_lint_graph() -> dict:
             payload, _ = json.JSONDecoder().raw_decode(proc.stdout[start:])
         except Exception:
             pass
-        summary = {
-            name: {"collectives": ex.get("collectives", {}),
-                   "findings": ex.get("findings", [])}
-            for name, ex in payload.get("executables", {}).items()}
+        summary = {}
+        for name, ex in payload.get("executables", {}).items():
+            cov = ex.get("edge_coverage") or {}
+            total = int(cov.get("total", 0))
+            pct = (100.0 * cov.get("explained", 0) / total) \
+                if total else 100.0
+            summary[name] = {
+                "collectives": ex.get("collectives", {}),
+                "gspmd_collectives": ex.get("gspmd_collectives", {}),
+                "findings": ex.get("findings", []),
+                "edge_coverage_pct": round(pct, 1),
+                "edge_coverage": cov,
+            }
         return {"gate_passed": proc.returncode == 0,
+                "exit_code": proc.returncode,
                 "executables": summary,
                 "tail": "" if proc.returncode == 0 else
                         "\n".join(lines[-8:])}

@@ -34,6 +34,8 @@ from typing import Any, Dict, List, Optional, Sequence
 from ..graph.graph import (ExecutableHandle, clear_executables,
                            get_executable, iter_executables,
                            register_executable)
+from .edges import (CommEdge, EdgeMatch, grad_comm_edges, makes_edge_claim,
+                    match_edges, predict_edges)
 from .jaxpr_walk import (collect_collectives, compute_dtype_histogram,
                          donation_candidates, iter_eqns,
                          unreduced_scalar_outputs)
@@ -43,13 +45,14 @@ from .rules import (DEFAULT_OPTIONS, RULES, AnalysisContext, ParamInfo,
                     rule, run_rules)
 
 __all__ = [
-    "AnalysisContext", "AnalysisReport", "CollectiveRecord",
-    "ExecutableHandle", "ExecutableReport", "Finding", "ParamInfo",
-    "RULES", "DEFAULT_OPTIONS", "analyze_handle", "analyze_registered",
-    "build_context", "clear_executables", "collect_collectives",
-    "get_executable", "grad_comm_prediction", "iter_executables",
-    "register_executable", "rule", "run_rules", "verify_grad_comm",
-    "load_baseline", "save_baseline",
+    "AnalysisContext", "AnalysisReport", "CollectiveRecord", "CommEdge",
+    "EdgeMatch", "ExecutableHandle", "ExecutableReport", "Finding",
+    "ParamInfo", "RULES", "DEFAULT_OPTIONS", "analyze_handle",
+    "analyze_registered", "build_context", "clear_executables",
+    "collect_collectives", "get_executable", "grad_comm_edges",
+    "grad_comm_prediction", "iter_executables", "makes_edge_claim",
+    "match_edges", "predict_edges", "register_executable", "rule",
+    "run_rules", "verify_grad_comm", "load_baseline", "save_baseline",
 ]
 
 
@@ -69,6 +72,8 @@ def build_context(handle: ExecutableHandle, compile: bool = False,
     serving = meta.get("serving")
     if callable(serving):
         serving = serving()
+    mesh_axes = dict(meta.get("mesh_axes", {}))
+    train = bool(meta.get("train", meta.get("kind") == "train_step"))
     ctx = AnalysisContext(
         name=handle.name,
         jaxpr=jaxpr,
@@ -76,13 +81,15 @@ def build_context(handle: ExecutableHandle, compile: bool = False,
         compiled_text=handle.compiled_text() if compile else "",
         records=collect_collectives(jaxpr),
         params=params,
-        mesh_axes=dict(meta.get("mesh_axes", {})),
+        mesh_axes=mesh_axes,
         dp_axis=meta.get("dp_axis", "dp"),
         args_info=lowered.args_info,
         out_avals=jaxpr.out_avals,
         allowed_gspmd=meta.get("allowed_gspmd"),
         serving=serving,
         meta=meta,
+        edges=predict_edges(meta, mesh_axes, train),
+        train=train,
     )
     if options:
         ctx.options = {**ctx.options, **options}
@@ -93,11 +100,19 @@ def analyze_handle(handle: ExecutableHandle, compile: bool = False,
                    options: Optional[Dict[str, Any]] = None,
                    rules: Optional[Sequence[str]] = None
                    ) -> ExecutableReport:
-    """Analyze one executable: inventory + lint findings."""
+    """Analyze one executable: inventory + lint findings + (for
+    edge-claiming executables) the per-edge attribution coverage."""
     ctx = build_context(handle, compile=compile, options=options)
     rep = ExecutableReport(name=handle.name, records=ctx.records,
                            meta={"kind": handle.meta.get("kind", "")})
     rep.findings = run_rules(ctx, only=rules)
+    em = ctx.edge_match()
+    if em is not None:
+        rep.meta["edge_coverage"] = em.coverage()
+        if ctx.compiled_text:
+            rep.meta["gspmd_collectives"] = dict(em.gspmd_counts)
+        rep.meta["edges"] = ctx.edges
+        rep.meta["edge_match"] = em
     return rep
 
 

@@ -1,18 +1,43 @@
 """``python -m hetu_tpu.analysis`` — the lint-graph CI gate.
 
-Builds the canonical executables (a GPT-2-small-shaped train step on a
-pure-dp mesh with the explicit int8 grad sync, and the serving
-prefill/decode executables of a small continuous-batching engine — both
-scaled down so the gate runs on CPU in CI), analyzes every one, and:
+Builds the canonical executables — five gated families, all scaled down
+so the gate runs on CPU in CI:
+
+* ``gate_train``   — GPT-2-small-shaped train step, pure-dp mesh,
+  ZeRO-2 + flat state + explicit int8 grad sync;
+* ``gate_serving`` — prefill/decode of a small continuous-batching
+  engine over the paged KV pool;
+* ``gate_tp``      — a TP/SP train graph (dp=2 x tp=4, Megatron-SP
+  layers from ``nn/parallel.py``), implicit GSPMD sync;
+* ``gate_pipe``    — a pipeline run, both ways: MPMD per-stage programs
+  (``models/gpt_mpmd.py`` on dp=2 x tp=2 submeshes) and the SPMD
+  collective-permute pipeline (``parallel/pipeline.py`` ppermute hop
+  chain inside the tick scan);
+* ``gate_moe``     — a dropless-MoE train step (``nn/moe.py`` +
+  ``ops/moe_dispatch.py`` blocked group-GEMM) with the explicit int8
+  sync.
+
+Every family registers a per-edge claim, so the per-edge attribution
+pass (``analysis/edges.py``) must explain 100% of what each program
+emits; then:
 
 * ``--check`` (default): compare against ``ANALYSIS_BASELINE.json`` —
   exit 1 when a collective count grows, payload/wire bytes grow beyond
-  ``--tolerance``, a new lint finding appears, or the grad-comm
-  emission no longer matches the DistributedStates prediction.
+  ``--tolerance``, edge coverage drops, a new lint finding appears, or
+  the grad-comm emission no longer matches the DistributedStates
+  prediction.  Exit 2 when the baseline file is missing entirely.
 * ``--update-baseline``: re-freeze the baseline after an INTENTIONAL
   perf change (review the printed diff before committing it).
-* ``--json``: dump the full report (with per-collective records) to
-  stdout instead of the summary.
+* ``--format json`` (or legacy ``--json``): dump the full report (with
+  per-collective records and edge coverage) to stdout for CI artifacts.
+* ``--explain``: after the summary, print each finding's offending
+  edge/record plus a concrete remediation hint (pspec change, donation,
+  narrower transport, capacity factor).
+
+Exit codes (stable, documented for CI): **0** clean, **1** findings or
+baseline regressions, **2** baseline missing (run ``--update-baseline``
+to create it — the missing-baseline check runs *before* the expensive
+build, so a misconfigured CI path fails fast).
 
 The model shapes are deliberately frozen: the baseline pins exact
 collective counts, so any change to the lowering path (a new implicit
@@ -52,17 +77,19 @@ def build_gate_executables():
     """
     import numpy as np
     import jax
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import Mesh, PartitionSpec as P
 
     import hetu_tpu as ht
-    from hetu_tpu import optim
-    from hetu_tpu.graph.graph import DefineAndRunGraph, clear_executables
+    from hetu_tpu import optim, ops
+    from hetu_tpu.graph.graph import (DefineAndRunGraph, clear_executables,
+                                      register_executable)
     from hetu_tpu.models import GPTConfig, GPTLMHeadModel, llama_config
     from hetu_tpu.parallel import create_mesh
     from hetu_tpu.serving import Engine
 
     clear_executables("gate_")
     devices = jax.devices()[:8]
+    names = []
 
     # -- train step: GPT-2-small-shaped (12-head/768-wide ratios scaled
     # to CI size), dp=8, ZeRO-2, explicit int8 grad sync over FLAT
@@ -89,6 +116,95 @@ def build_gate_executables():
         g.run(loss, [loss, train_op], {ids: IDS,
                                        labels: np.roll(IDS, -1, axis=1)})
         assert g._grad_comm_active, g._grad_comm_fallback
+    names.append("gate_train/plan0")
+
+    # -- TP/SP train graph: dp=2 x tp=4, Megatron-SP parallel layers,
+    # implicit GSPMD sync — every GSPMD-inserted collective must be
+    # explained by the graph's pspec edges ----------------------------
+    ht.set_seed(4)
+    tp_mesh = create_mesh({"dp": 2, "tp": 4}, devices)
+    tp_cfg = llama_config(vocab_size=256, hidden_size=64, num_layers=2,
+                          num_heads=4, max_seq_len=32, sp=True,
+                          dtype="bfloat16")
+    gt = DefineAndRunGraph("gate_tp")
+    gt.mesh = tp_mesh
+    with ht.graph(gt):
+        ids = ht.parallel_placeholder("int32", (8, 32),
+                                      pspec=P("dp", None), name="ids")
+        labels = ht.parallel_placeholder("int32", (8, 32),
+                                         pspec=P("dp", None), name="labels")
+        model = GPTLMHeadModel(tp_cfg)
+        loss = model(ids, labels)
+        train_op = optim.AdamOptimizer(lr=1e-2).minimize(loss)
+        rng = np.random.RandomState(4)
+        IDS = rng.randint(0, 256, (8, 32)).astype(np.int32)
+        gt.run(loss, [loss, train_op], {ids: IDS,
+                                        labels: np.roll(IDS, -1, axis=1)})
+    names.append("gate_tp/plan0")
+
+    # -- pipeline, MPMD: per-stage programs on dp=2 x tp=2 submeshes,
+    # declared stage edges (models/gpt_mpmd.stage_comm_edges) ---------
+    from hetu_tpu.models.gpt_mpmd import MPMDGPT
+    devs = np.array(devices).reshape(2, 2, 2)
+    pipe_cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                         num_heads=4, max_seq_len=16, dropout=0.0,
+                         activation="gelu", norm="layernorm",
+                         position="learned", sp=False)
+    mpmd = MPMDGPT(pipe_cfg, stage_layers=[[1, 1]],
+                   meshes=[[Mesh(devs[0], ("dp", "tp")),
+                            Mesh(devs[1], ("dp", "tp"))]], seed=5)
+    names += mpmd.register_analysis("gate_pipe_mpmd", batch=4, seq=16)
+
+    # -- pipeline, SPMD: the collective-permute pipeline — ppermute hop
+    # chain (M + S - 1 hops) inside the tick scan, tagged pipeline/hop
+    from hetu_tpu.parallel.pipeline import pipeline_spmd
+    pp_mesh = create_mesh({"pp": 4}, devices[:4])
+    S, d, M, B = 4, 16, 2, 8
+
+    def _stage_fn(p, v):
+        import jax.numpy as jnp
+        return jnp.tanh(v @ p["w"][0])
+
+    pp_fn = jax.jit(lambda pr, x: pipeline_spmd(_stage_fn, pr, x, M,
+                                                pp_mesh))
+    pp_params = {"w": jax.ShapeDtypeStruct((S, 1, d, d), np.float32)}
+    register_executable(
+        "gate_pipe_spmd/fwd", pp_fn,
+        (pp_params, jax.ShapeDtypeStruct((B, d), np.float32)),
+        {"kind": "forward", "mesh_axes": {"pp": 4}, "params": [],
+         "scalar_fetches": 0,
+         "pipeline": {
+             "pp_axis": "pp", "hops": M + S - 1,
+             "payload_bytes": (B // M) * d * 4,
+             "extra_edges": [
+                 {"kind": "all_reduce", "tensor": "out_collect",
+                  "producer": "last stage",
+                  "consumer": "out broadcast + aux micro-batch mean",
+                  "axes": ("pp",), "count": 2, "tag": "pipeline",
+                  "payload_bytes": B * d * 4}]}})
+    names.append("gate_pipe_spmd/fwd")
+
+    # -- dropless-MoE train step: capacity-free blocked group-GEMM
+    # (every assignment computes), explicit int8 sync -----------------
+    from hetu_tpu.nn.moe import make_moe_layer
+    ht.set_seed(6)
+    moe_mesh = create_mesh({"dp": 8}, devices)
+    gm = DefineAndRunGraph("gate_moe")
+    gm.mesh = moe_mesh
+    with ht.graph(gm):
+        x = ht.parallel_placeholder("float32", (16, 32),
+                                    pspec=P("dp", None), name="x")
+        moe = make_moe_layer(32, 64, num_experts=4, gate_type="topk",
+                             k=2, dispatch_mode="dropless", name="moe")
+        out, aux = moe(x)
+        loss = ops.reduce_mean(out ** 2) + 0.01 * aux
+        train_op = optim.AdamOptimizer(lr=1e-2, zero=1,
+                                       grad_comm="int8").minimize(loss)
+        rng = np.random.RandomState(6)
+        gm.run(loss, [loss, train_op],
+               {x: rng.randn(16, 32).astype(np.float32)})
+        assert gm._grad_comm_active, gm._grad_comm_fallback
+    names.append("gate_moe/plan0")
 
     # -- serving: prefill + decode over the paged pool -----------------
     ht.set_seed(1)
@@ -108,17 +224,52 @@ def build_gate_executables():
         eng.step()
         clock[0] += 1.0
     eng.pool.check_invariants()
-    return ["gate_train/plan0"] + sorted(
+    return names + sorted(
         f"gate_serving/{k}-{b}" for k, b in eng._compiled)
+
+
+def explain_report(report, out=sys.stdout) -> None:
+    """--explain: per finding, the offending edge/record and a concrete
+    remediation hint; per executable, the predicted edge list."""
+    for name, rep in sorted(report.executables.items()):
+        cov = rep.meta.get("edge_coverage")
+        edges = rep.meta.get("edges")
+        print(f"\n=== {name} ===", file=out)
+        if cov:
+            print(f"  edge coverage: {cov['explained']}/{cov['total']} "
+                  f"collectives explained", file=out)
+        if edges is not None:
+            print(f"  predicted edges ({len(edges)}):", file=out)
+            for e in edges:
+                print(f"    . {e.describe()}", file=out)
+        if not rep.findings:
+            print("  no findings", file=out)
+            continue
+        for f in rep.findings:
+            print(f"  ! {f}", file=out)
+            if f.hint:
+                print(f"    fix: {f.hint}", file=out)
 
 
 def run_gate(baseline_path: str = BASELINE_DEFAULT,
              tolerance: float = 0.1, update: bool = False,
              as_json: bool = False, compile: bool = True,
-             out=sys.stdout) -> int:
-    """Build, analyze, gate.  Returns the process exit code."""
+             explain: bool = False, out=sys.stdout) -> int:
+    """Build, analyze, gate.  Returns the process exit code
+    (0 clean / 1 findings / 2 baseline missing)."""
     from . import (AnalysisReport, analyze_handle, get_executable,
                    load_baseline, save_baseline, verify_grad_comm)
+
+    baseline = None
+    if not update:
+        # fail fast BEFORE the expensive build: a missing baseline is a
+        # CI configuration error, not a lint finding
+        baseline = load_baseline(baseline_path)
+        if baseline is None:
+            print(f"no baseline at {baseline_path} — run "
+                  f"`python -m hetu_tpu.analysis --update-baseline` "
+                  f"and commit the result", file=out)
+            return 2
 
     names = build_gate_executables()
     report = AnalysisReport()
@@ -137,11 +288,12 @@ def run_gate(baseline_path: str = BASELINE_DEFAULT,
         print(report.to_json(records=True), file=out)
     else:
         print(report.summary(), file=out)
+    if explain:
+        explain_report(report, out=out)
     if update:
         save_baseline(baseline_path, report)
         print(f"baseline written to {baseline_path}", file=out)
         return 0
-    baseline = load_baseline(baseline_path)
     problems += report.check_against_baseline(baseline,
                                               tolerance=tolerance)
     if problems:
@@ -160,7 +312,8 @@ def run_gate(baseline_path: str = BASELINE_DEFAULT,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m hetu_tpu.analysis",
-        description="jaxpr/HLO sharding & collectives linter + CI gate")
+        description="jaxpr/HLO sharding & collectives linter + CI gate "
+                    "(exit 0 clean / 1 findings / 2 baseline missing)")
     ap.add_argument("--check", action="store_true",
                     help="gate against the baseline (default action)")
     ap.add_argument("--update-baseline", action="store_true",
@@ -170,18 +323,28 @@ def main(argv=None) -> int:
     ap.add_argument("--tolerance", type=float, default=0.1,
                     help="relative byte-regression tolerance (default 0.1;"
                          " collective COUNTS are always exact)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    dest="fmt",
+                    help="report output format (json: full report with "
+                         "records + edge coverage, for CI artifacts)")
     ap.add_argument("--json", action="store_true",
-                    help="emit the full report as JSON")
+                    help="legacy alias for --format json")
+    ap.add_argument("--explain", action="store_true",
+                    help="print each finding's offending edge plus a "
+                         "suggested remediation (pspec change, donation,"
+                         " narrower transport, capacity factor)")
     ap.add_argument("--no-compile", action="store_true",
-                    help="skip post-SPMD compilation (disables the "
-                         "implicit-reshard rule)")
+                    help="skip post-SPMD compilation (disables GSPMD "
+                         "accounting: implicit-reshard and the "
+                         "GSPMD half of unexplained-collective)")
     args = ap.parse_args(argv)
     _force_cpu_mesh()
     return run_gate(baseline_path=args.baseline,
                     tolerance=args.tolerance,
                     update=args.update_baseline,
-                    as_json=args.json,
-                    compile=not args.no_compile)
+                    as_json=args.json or args.fmt == "json",
+                    compile=not args.no_compile,
+                    explain=args.explain)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,396 @@
+"""Per-edge communication attribution: DS transitions -> expected comms.
+
+Hetu's core contract is that ``DistributedStates`` annotations *fully
+determine* the communication a program performs — v2 deduces and inserts
+every comm op from producer -> consumer state transitions
+(``SubstituteCommOp``, ``executable_graph.cc:1006``).  This module checks
+that contract for WHOLE executables: it assembles the complete expected
+collective set from the registered producer -> consumer pspec edges
+(``dstates.deduce_pspec_transition`` over the graph's sharding
+annotations), the coalesced grad-comm plan, MoE dispatch bounds, and
+pipeline hop chains, then matches it against what the program actually
+emits.  Every emitted collective is either *explained* by a predicted
+edge or reported as ``unexplained-collective`` (rules.py) with source
+provenance.  This replaces the lowered-vs-compiled HLO diff
+(``implicit-reshard``) as the implicit-reshard detector for every
+executable that registers edges.
+
+Matching semantics (DESIGN.md §11):
+
+* **Explicit collectives** (present in the jaxpr: shard_map manual
+  regions, ppermute chains, grad-comm buckets) are matched 1:1-ish
+  against edges by *(kind, comm-tag)* — tagged edges must find their tag
+  in the record's name-stack scope; untagged records fall back to any
+  kind-compatible edge.  A record no edge explains is a finding with the
+  eqn's ``file:line`` provenance.
+* **GSPMD-inserted collectives** (compiled-HLO counts minus the lowered
+  program's explicit counts) never carry provenance — they only exist
+  after SPMD partitioning.  Per kind, the inserted count must fit the
+  *edge budget*: the sum of ``count`` over edges whose deduced kind
+  covers that collective (including autodiff duals for train steps — the
+  transpose of an all-gather is a reduce-scatter, the dual of a
+  weight-slice ``scatter`` is a gradient all-reduce), times a bounded
+  fan-out factor (one DS transition lowers to a handful of HLO ops
+  across fwd+bwd, not dozens).  Executables that still declare a strict
+  ``allowed_gspmd`` claim (the explicit grad-comm train step: zero
+  tolerated inserts) keep exact counting.
+* Exact collective *counts* stay pinned by ``ANALYSIS_BASELINE.json`` —
+  the edge pass owns *attribution and coverage*, the baseline owns
+  count regressions; together a new collective must both fit an edge
+  and re-freeze the baseline to land.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..parallel.dstates import count_hlo_collectives
+
+#: forward coverage: which emitted collective kinds one deduced edge kind
+#: explains.  GSPMD lowers generic reshards to all-to-all / all-gather /
+#: collective-permute chains depending on tiling, hence the wide rows.
+FWD_COVERS: Dict[str, Tuple[str, ...]] = {
+    "all_reduce":     ("all_reduce",),
+    "all_gather":     ("all_gather", "ppermute"),
+    "reduce_scatter": ("reduce_scatter", "ppermute"),
+    "all_to_all":     ("all_to_all", "ppermute"),
+    "ppermute":       ("ppermute",),
+    "broadcast":      ("all_reduce",),
+    "reduce":         ("all_reduce",),
+    "scatter":        (),              # a local slice: no forward comm
+    "reshard":        ("all_to_all", "all_gather", "reduce_scatter",
+                       "ppermute"),
+    "identity":       (),
+}
+
+#: additional coverage in TRAIN executables: the autodiff dual of each
+#: transition (transpose of gather is scatter-add; the dual of a
+#: weight-slice is a partial-grad reduction).
+BWD_COVERS: Dict[str, Tuple[str, ...]] = {
+    "all_reduce":     ("all_reduce",),
+    "all_gather":     ("reduce_scatter", "all_reduce"),
+    "reduce_scatter": ("all_gather",),
+    "all_to_all":     ("all_to_all",),
+    "ppermute":       ("ppermute",),
+    "broadcast":      ("all_reduce",),
+    "reduce":         ("all_reduce",),
+    "scatter":        ("all_gather", "all_reduce", "ppermute"),
+    "reshard":        ("all_to_all", "all_gather", "reduce_scatter",
+                       "all_reduce", "ppermute"),
+    "identity":       (),
+}
+
+
+@dataclasses.dataclass
+class CommEdge:
+    """One predicted producer -> consumer communication edge."""
+    kind: str                     # deduced collective ('identity' possible)
+    tensor: str = ""              # tensor / bucket the edge moves
+    producer: str = ""            # producing op / layer
+    consumer: str = ""            # consuming annotation site
+    src_spec: str = ""            # printable source pspec / DS
+    dst_spec: str = ""            # printable destination pspec / DS
+    axes: Tuple[str, ...] = ()
+    payload_bytes: int = 0
+    count: int = 1                # trip/bucket multiplier
+    tag: str = ""                 # comm_tag path expected on the record
+    origin: str = "graph"         # graph|declared|grad_comm|param_comm|
+                                  # fetch|grad_sync|moe|pipeline
+    hint: str = ""                # remediation if this edge misbehaves
+
+    def covers(self, rec_kind: str, train: bool) -> bool:
+        if rec_kind in FWD_COVERS.get(self.kind, ()):
+            return True
+        return train and rec_kind in BWD_COVERS.get(self.kind, ())
+
+    def describe(self) -> str:
+        via = f" via {self.tag!r}" if self.tag else ""
+        return (f"{self.producer or self.tensor or '?'} -> "
+                f"{self.consumer or '?'}: {self.src_spec or 'replicated'}"
+                f" -> {self.dst_spec or 'replicated'} ({self.kind}"
+                f"{via}, {self.payload_bytes} B x{self.count})")
+
+
+@dataclasses.dataclass
+class EdgeMatch:
+    """Result of matching an executable's emissions against its edges."""
+    explained: List[Tuple[Any, CommEdge]] = dataclasses.field(
+        default_factory=list)          # (CollectiveRecord, edge)
+    unexplained_records: List[Any] = dataclasses.field(default_factory=list)
+    gspmd_explained: Dict[str, Tuple[int, List[CommEdge]]] = \
+        dataclasses.field(default_factory=dict)    # kind -> (count, edges)
+    gspmd_unexplained: Dict[str, Tuple[int, int]] = \
+        dataclasses.field(default_factory=dict)    # kind -> (excess, budget)
+    gspmd_counts: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    @property
+    def total(self) -> int:
+        return (len(self.explained) + len(self.unexplained_records)
+                + sum(n for n, _ in self.gspmd_explained.values())
+                + sum(e for e, _ in self.gspmd_unexplained.values()))
+
+    @property
+    def explained_count(self) -> int:
+        return (len(self.explained)
+                + sum(n for n, _ in self.gspmd_explained.values()))
+
+    def coverage(self) -> Dict[str, int]:
+        return {"explained": self.explained_count, "total": self.total}
+
+
+# ---------------------------------------------------------------------------
+# edge assembly from registration meta
+# ---------------------------------------------------------------------------
+
+EDGE_META_KEYS = ("pspec_edges", "declared_edges", "grad_comm", "pipeline",
+                  "moe")
+
+
+def makes_edge_claim(meta: Dict[str, Any]) -> bool:
+    """Whether a registered executable predicts its communication per
+    edge (at least one edge-bearing meta key present — an empty
+    ``pspec_edges`` list IS a claim: "this program does no unpredicted
+    communication")."""
+    return any(k in meta for k in EDGE_META_KEYS)
+
+
+def _edge_from_dict(d: Dict[str, Any], origin: str) -> CommEdge:
+    return CommEdge(
+        kind=d.get("kind", "reshard"),
+        tensor=str(d.get("tensor", "")),
+        producer=str(d.get("producer", "")),
+        consumer=str(d.get("consumer", "")),
+        src_spec=str(d.get("src_spec", "")),
+        dst_spec=str(d.get("dst_spec", "")),
+        axes=tuple(d.get("axes", ())),
+        payload_bytes=int(d.get("payload_bytes", 0)),
+        count=int(d.get("count", 1)),
+        tag=str(d.get("tag", "")),
+        origin=str(d.get("origin", origin)),
+        hint=str(d.get("hint", "")))
+
+
+def grad_comm_edges(gc: Dict[str, Any]) -> List[CommEdge]:
+    """Edges for the explicit coalesced gradient sync: one edge per
+    predicted collective of ``dstates.predict_update_step_collectives``,
+    tagged the way ``comm.py`` tags the emission sites (``grad_comm`` /
+    ``scales`` sidecars / the flat path's ``param_comm`` regather)."""
+    from ..parallel.dstates import predict_update_step_collectives
+    entries = [(name, tuple(shape), dtype)
+               for name, shape, dtype in gc["entries"]]
+    flat = bool(gc.get("flat", False))
+    transport = gc["transport"]
+    preds, extra = predict_update_step_collectives(
+        entries, gc["device_num"], transport=transport,
+        bucket_mb=gc["bucket_mb"], scalar_fetches=gc["scalar_fetches"],
+        flat=flat, clip=gc.get("clip", False))
+    edges: List[CommEdge] = []
+    for p in preds:
+        quantized = transport in ("bf16", "int8")
+        if flat and p["kind"] == "all_gather":
+            tag, origin = "param_comm", "param_comm"
+            desc = "updated params regathered in the weight dtype"
+        elif quantized and p["dtype"] == "float32":
+            tag, origin = "scales", "grad_comm"
+            desc = "quantized-transport absmax sidecar (fp32 by design)"
+        else:
+            tag, origin = "grad_comm", "grad_comm"
+            desc = f"bucketed {transport} gradient sync"
+        edges.append(CommEdge(
+            kind=p["kind"], tensor="grad_bucket", producer="optimizer",
+            consumer=desc, src_spec="partial(dp)" if origin == "grad_comm"
+            else "P(dp)", dst_spec="P(dp)" if p["kind"] != "all_gather"
+            else "replicated", axes=(gc.get("dp_axis", "dp"),),
+            payload_bytes=int(p["payload_bytes"]), tag=tag, origin=origin))
+    for kind, n in (extra or {}).items():
+        edges.append(CommEdge(
+            kind=kind, tensor="scalar_fetch", producer="loss/clip",
+            consumer="pmean of scalar fetches + flat global-norm clip",
+            src_spec="partial(dp)", dst_spec="replicated",
+            axes=(gc.get("dp_axis", "dp"),), payload_bytes=4, count=n,
+            origin="fetch"))
+    return edges
+
+
+def predict_edges(meta: Dict[str, Any], mesh_axes: Dict[str, int],
+                  train: bool) -> Optional[List[CommEdge]]:
+    """The complete expected collective set of one registered
+    executable, or None when it makes no edge claim."""
+    if not makes_edge_claim(meta):
+        return None
+    edges: List[CommEdge] = []
+    for d in meta.get("pspec_edges") or ():
+        edges.append(_edge_from_dict(d, "graph"))
+    for d in meta.get("declared_edges") or ():
+        edges.append(_edge_from_dict(d, "declared"))
+    if meta.get("grad_comm"):
+        edges.extend(grad_comm_edges(meta["grad_comm"]))
+    else:
+        # scalar fetches of a sharded program are reduced to replicated
+        # at the fetch boundary (partial -> duplicate: all_reduce)
+        n_scalar = int(meta.get("scalar_fetches", 0) or 0)
+        multi = any(int(s) > 1 for s in mesh_axes.values())
+        if n_scalar and multi:
+            edges.append(CommEdge(
+                kind="all_reduce", tensor="scalar_fetch",
+                producer="loss", consumer="fetch boundary",
+                src_spec="partial", dst_spec="replicated",
+                axes=tuple(mesh_axes), payload_bytes=4, count=n_scalar,
+                origin="fetch"))
+        if train and multi:
+            # implicit GSPMD grad sync: params replicated over dp,
+            # batch sharded -> per-param partial grads psum over dp
+            n_params = sum(1 for p in meta.get("params", ())
+                           if p.get("trainable", True)) or 1
+            dpa = meta.get("dp_axis", "dp")
+            edges.append(CommEdge(
+                kind="all_reduce", tensor="gradients",
+                producer="backward", consumer="implicit GSPMD grad sync",
+                src_spec=f"partial({dpa})", dst_spec="replicated",
+                axes=(dpa,), count=n_params, origin="grad_sync",
+                hint="switch to the explicit path (grad_comm=) for "
+                     "coalesced, narrowable gradient collectives"))
+    for m in meta.get("moe") or ():
+        if m.get("ep_axis"):
+            itemsize = np.dtype(m.get("dtype", "float32")).itemsize
+            payload = int(m.get("num_experts", 1)) \
+                * int(m.get("capacity") or 1) \
+                * int(m.get("embed_dim", 1)) * itemsize
+            ep = str(m["ep_axis"])
+            name = m.get("name", "moe")
+            for which in ("dispatch", "combine"):
+                edges.append(CommEdge(
+                    kind="reshard", tensor=f"{name}.{which}",
+                    producer="moe gate",
+                    consumer=f"expert-parallel {which} all-to-all",
+                    src_spec="P(dp)", dst_spec=f"P({ep})",
+                    axes=(ep,), payload_bytes=payload, origin="moe",
+                    hint="bytes bounded by capacity_factor "
+                         f"{m.get('capacity_factor')}"))
+            # the combine einsum contracts the ep-sharded expert dim:
+            # its output is partial over ep (DS: partial -> duplicate =
+            # all_reduce) whenever tokens are not co-sharded on ep
+            edges.append(CommEdge(
+                kind="all_reduce", tensor=f"{name}.combine_reduce",
+                producer="combine einsum",
+                consumer="partial-over-ep expert outputs",
+                src_spec=f"partial({ep})", dst_spec="replicated",
+                axes=(ep,), payload_bytes=payload, count=2,
+                origin="moe"))
+    pl = meta.get("pipeline")
+    if pl:
+        hops = int(pl.get("hops", 0) or 0)
+        if hops:
+            edges.append(CommEdge(
+                kind="ppermute", tensor="stage_boundary",
+                producer="pipeline tick", consumer="next stage",
+                src_spec=f"P({pl.get('pp_axis', 'pp')})@stage s",
+                dst_spec="stage s+1",
+                axes=(str(pl.get("pp_axis", "pp")),),
+                payload_bytes=int(pl.get("payload_bytes", 0)),
+                count=hops, tag="pipeline", origin="pipeline"))
+        for d in pl.get("extra_edges") or ():
+            edges.append(_edge_from_dict(d, "pipeline"))
+    return edges
+
+
+# ---------------------------------------------------------------------------
+# matching
+# ---------------------------------------------------------------------------
+
+
+def _scope_segments(scope: str) -> List[str]:
+    return [s for s in scope.split("/") if s]
+
+
+def _tag_in_scope(tag: str, scope: str) -> bool:
+    """Edge tag segments appear in the record's name-stack path, in
+    order (``grad_comm`` matches ``.../grad_comm/bucket0/...``)."""
+    if not tag:
+        return False
+    want = _scope_segments(tag)
+    got = _scope_segments(scope)
+    i = 0
+    for seg in got:
+        if i < len(want) and seg == want[i]:
+            i += 1
+    return i == len(want)
+
+
+def match_edges(records, lowered_text: str, compiled_text: str,
+                edges: List[CommEdge], train: bool,
+                allowed_gspmd: Optional[Dict[str, int]] = None,
+                budget_factor: int = 4) -> EdgeMatch:
+    """Match an executable's emitted collectives against its predicted
+    edge set (module docstring for the semantics)."""
+    m = EdgeMatch()
+
+    # -- explicit records (jaxpr inventory) ---------------------------------
+    tagged = [e for e in edges if e.tag]
+    untagged = [e for e in edges if not e.tag]
+    # each edge may explain at most `count` records: an unbounded
+    # kind-only match would let one edge absorb every rogue collective
+    # of that kind and never fire
+    used: Dict[int, int] = {}
+
+    def _claim(e: CommEdge) -> bool:
+        if used.get(id(e), 0) >= e.count:
+            return False
+        used[id(e)] = used.get(id(e), 0) + 1
+        return True
+
+    def _pick(pool, rec, need_tag):
+        # exact-kind edges first, broad covers (reshard, autodiff
+        # duals) second — a greedy first-fit on the broad edge could
+        # starve a later record whose only cover it was
+        for exact in (True, False):
+            for e in pool:
+                if (e.kind == rec.kind) != exact:
+                    continue
+                if not e.covers(rec.kind, train):
+                    continue
+                if need_tag and not _tag_in_scope(e.tag, rec.scope):
+                    continue
+                if _claim(e):
+                    return e
+        return None
+
+    for rec in records:
+        edge = _pick(tagged, rec, need_tag=True)       # 1: tag + kind
+        if edge is None:                               # 2: untagged
+            edge = _pick(untagged, rec, need_tag=False)
+        # NO third tier: a tagged edge must find its tag in the
+        # record's scope — letting it absorb arbitrary same-kind
+        # records would make the explicit-record half of
+        # unexplained-collective vacuous (a rogue untagged ppermute in
+        # a pipeline program must fire, not ride the hop edge)
+        if edge is not None:
+            m.explained.append((rec, edge))
+        else:
+            m.unexplained_records.append(rec)
+
+    # -- GSPMD-inserted collectives (post-partitioning only) ----------------
+    if compiled_text:
+        got = count_hlo_collectives(compiled_text, include_ppermute=True)
+        explicit = count_hlo_collectives(lowered_text,
+                                         include_ppermute=True) \
+            if lowered_text else {}
+        m.gspmd_counts = {k: v - explicit.get(k, 0)
+                          for k, v in got.items() if v - explicit.get(k, 0)
+                          > 0}
+        for kind, excess in sorted(m.gspmd_counts.items()):
+            if allowed_gspmd is not None:
+                # strict declared claim (explicit grad-comm train steps:
+                # zero tolerated inserts) — exact, as implicit-reshard was
+                budget = int(allowed_gspmd.get(kind, 0))
+                covering = []
+            else:
+                covering = [e for e in edges if e.covers(kind, train)]
+                budget = budget_factor * sum(e.count for e in covering)
+            if excess <= budget:
+                m.gspmd_explained[kind] = (excess, covering)
+            else:
+                m.gspmd_unexplained[kind] = (excess, budget)
+    return m

@@ -84,17 +84,24 @@ def _axis_names(params: dict) -> Tuple[str, ...]:
     return tuple(str(a) for a in ax)
 
 
+def _name_stack_of(eqn) -> str:
+    """The eqn's jax name-stack alone (no traceback walk)."""
+    si = getattr(eqn, "source_info", None)
+    if si is None:
+        return ""
+    try:
+        return str(si.name_stack)
+    except Exception:
+        return ""
+
+
 def _source_of(eqn) -> Tuple[str, str]:
     """(scope, file:line) from eqn provenance."""
-    scope = ""
+    scope = _name_stack_of(eqn)
     src = ""
     si = getattr(eqn, "source_info", None)
     if si is None:
         return scope, src
-    try:
-        scope = str(si.name_stack)
-    except Exception:
-        pass
     try:
         from jax._src import source_info_util as siu
         fr = siu.user_frame(si)
@@ -107,17 +114,26 @@ def _source_of(eqn) -> Tuple[str, str]:
 
 
 def iter_eqns(jaxpr, _trip: int = 1, _axis_sizes: Optional[Dict[str, int]]
-              = None) -> Iterator[Tuple[Any, int, Dict[str, int]]]:
-    """Yield ``(eqn, trip_count, axis_sizes)`` over the whole jaxpr tree.
+              = None, _scope: str = ""
+              ) -> Iterator[Tuple[Any, int, Dict[str, int], str]]:
+    """Yield ``(eqn, trip_count, axis_sizes, scope_prefix)`` over the
+    whole jaxpr tree.
 
     ``trip_count`` multiplies enclosing ``scan``/``while`` iterations
     (unbounded whiles count as 1 with the loop noted by the caller via
     the eqn itself); ``axis_sizes`` maps manual mesh axes in scope to
     their sizes, resolved from enclosing ``shard_map`` meshes.
+
+    ``scope_prefix`` carries the name-stack of the enclosing *container*
+    eqns: jax traces scan/pjit/cond bodies in a fresh name-stack frame,
+    so a ``comm_tag`` entered AROUND a ``lax.scan`` lands on the scan
+    eqn but NOT on the collectives inside its body — without the prefix
+    a pipeline loop's ppermutes would show up untagged.  Callers join
+    ``scope_prefix`` with the eqn's own name-stack for full attribution.
     """
     axis_sizes = dict(_axis_sizes or {})
     for eqn in _as_jaxpr(jaxpr).eqns:
-        yield eqn, _trip, axis_sizes
+        yield eqn, _trip, axis_sizes, _scope
         sub_trip = _trip
         sub_axes = axis_sizes
         if eqn.primitive.name == "scan":
@@ -131,14 +147,29 @@ def iter_eqns(jaxpr, _trip: int = 1, _axis_sizes: Optional[Dict[str, int]]
                     zip(getattr(mesh, "axis_names", ()), shape)
                 for name, size in items:
                     sub_axes[str(name)] = int(size)
-        for sub in _sub_jaxprs(eqn):
-            yield from iter_eqns(sub, sub_trip, sub_axes)
+        subs = list(_sub_jaxprs(eqn))
+        if subs:
+            # scope computed only for container eqns (name-stack read,
+            # no traceback walk) — per-eqn cost would dominate the walk
+            sub_scope = _join_scope(_scope, _name_stack_of(eqn))
+            for sub in subs:
+                yield from iter_eqns(sub, sub_trip, sub_axes, sub_scope)
+
+
+def _join_scope(prefix: str, scope: str) -> str:
+    """Compose an enclosing container's scope with an inner name-stack
+    (skipping duplication when the inner stack already carries it)."""
+    if not prefix:
+        return scope
+    if not scope or scope == prefix or scope.startswith(prefix + "/"):
+        return scope or prefix
+    return f"{prefix}/{scope}"
 
 
 def collect_collectives(jaxpr) -> List[CollectiveRecord]:
     """The collective inventory of a closed jaxpr (see module doc)."""
     records: List[CollectiveRecord] = []
-    for eqn, trip, axis_sizes in iter_eqns(jaxpr):
+    for eqn, trip, axis_sizes, prefix in iter_eqns(jaxpr):
         kind = COLLECTIVE_PRIMS.get(eqn.primitive.name)
         if kind is None:
             continue
@@ -162,6 +193,11 @@ def collect_collectives(jaxpr) -> List[CollectiveRecord]:
                 dtype = np.dtype(v.aval.dtype).name
                 break
         scope, src = _source_of(eqn)
+        # container-scope propagation: a comm_tag entered around the
+        # enclosing scan/pjit lands on the container eqn, not the body
+        # eqns — join it in so loop collectives keep their attribution
+        # (ppermute hop chains inside the pipeline tick scan).
+        scope = _join_scope(prefix, scope)
         try:
             wire = ring_wire_bytes(kind, payload, n)
         except ValueError:
@@ -175,7 +211,7 @@ def collect_collectives(jaxpr) -> List[CollectiveRecord]:
 def compute_dtype_histogram(jaxpr) -> Dict[str, int]:
     """dtype name -> count of FLOP-dominant eqns producing it."""
     out: Dict[str, int] = {}
-    for eqn, trip, _ in iter_eqns(jaxpr):
+    for eqn, trip, _, _prefix in iter_eqns(jaxpr):
         if eqn.primitive.name in COMPUTE_PRIMS and eqn.outvars:
             aval = getattr(eqn.outvars[0], "aval", None)
             if aval is not None and hasattr(aval, "dtype"):
@@ -206,7 +242,7 @@ def unreduced_scalar_outputs(jaxpr) -> List[Tuple[str, str, str]]:
     inside them (conservative: no false positives from merged carries).
     """
     offenders: List[Tuple[str, str, str]] = []
-    for eqn, _trip, axis_sizes in iter_eqns(jaxpr):
+    for eqn, _trip, axis_sizes, _prefix in iter_eqns(jaxpr):
         if eqn.primitive.name != "shard_map":
             continue
         mesh = eqn.params.get("mesh")

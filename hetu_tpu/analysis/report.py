@@ -58,6 +58,10 @@ class Finding:
     executable: str = ""
     source: str = ""
     severity: str = "warn"
+    # suggested remediation, printed by the CLI's --explain mode (a pspec
+    # change, a donation, a narrower transport, a capacity factor...).
+    # NOT part of the baseline key: hints may improve without re-freezing.
+    hint: str = ""
 
     @property
     def key(self) -> str:
@@ -99,6 +103,14 @@ class ExecutableReport:
              "payload_bytes": self.total_payload_bytes,
              "wire_bytes": round(self.total_wire_bytes, 1),
              "findings": sorted(f.key for f in self.findings)}
+        # per-edge attribution results (present when the executable
+        # registers an edge claim): coverage is gated (may not drop),
+        # GSPMD-inserted counts are gated like explicit counts (may not
+        # grow) — the edge pass explains them, the baseline pins them.
+        if "edge_coverage" in self.meta:
+            d["edge_coverage"] = dict(self.meta["edge_coverage"])
+        if "gspmd_collectives" in self.meta:
+            d["gspmd_collectives"] = dict(self.meta["gspmd_collectives"])
         if records:
             d["records"] = [r.to_dict() for r in self.records]
         return d
@@ -133,11 +145,18 @@ class AnalysisReport:
         lines = []
         for name, rep in sorted(self.executables.items()):
             counts = rep.collective_counts()
+            cov = rep.meta.get("edge_coverage")
+            cov_s = ""
+            if cov:
+                pct = 100.0 * cov["explained"] / cov["total"] \
+                    if cov["total"] else 100.0
+                cov_s = (f", edges explain {cov['explained']}/"
+                         f"{cov['total']} ({pct:.0f}%)")
             lines.append(
                 f"{name}: {sum(counts.values())} collectives {counts}, "
                 f"{rep.total_payload_bytes} payload B, "
                 f"{rep.total_wire_bytes:.0f} wire B/rank, "
-                f"{len(rep.findings)} findings")
+                f"{len(rep.findings)} findings{cov_s}")
             for f in rep.findings:
                 lines.append(f"  - {f}")
         return "\n".join(lines)
@@ -172,6 +191,45 @@ class AnalysisReport:
                 if g > w:
                     problems.append(
                         f"{name}: {kind} count regressed {w} -> {g}")
+            # GSPMD-inserted counts (edge pass): may not grow either —
+            # a new implicit reshard must re-freeze the baseline even
+            # when a generous edge budget would absorb it.  A report
+            # that LOST its GSPMD accounting (edge claim dropped, or
+            # analysis ran uncompiled) fails too: silently stopping to
+            # measure is the regression class this gate exists for.
+            want_g = base.get("gspmd_collectives", {})
+            got_g = rep.meta.get("gspmd_collectives")
+            if "gspmd_collectives" in base:
+                if got_g is None:
+                    problems.append(
+                        f"{name}: baseline records GSPMD accounting but "
+                        f"the report has none (edge claim lost, or "
+                        f"--no-compile?)")
+                else:
+                    for kind in sorted(set(want_g) | set(got_g)):
+                        w = int(want_g.get(kind, 0))
+                        g = int(got_g.get(kind, 0))
+                        if g > w:
+                            problems.append(
+                                f"{name}: GSPMD-inserted {kind} "
+                                f"regressed {w} -> {g}")
+            # edge coverage may not drop below the frozen ratio, and an
+            # executable may not silently stop making its edge claim
+            want_c = base.get("edge_coverage")
+            got_c = rep.meta.get("edge_coverage")
+            if want_c and got_c is None:
+                problems.append(
+                    f"{name}: baseline records edge coverage "
+                    f"{want_c['explained']}/{want_c['total']} but the "
+                    f"executable no longer makes an edge claim")
+            elif want_c and got_c:
+                w_un = int(want_c["total"]) - int(want_c["explained"])
+                g_un = int(got_c["total"]) - int(got_c["explained"])
+                if g_un > w_un:
+                    problems.append(
+                        f"{name}: unexplained collectives regressed "
+                        f"{w_un} -> {g_un} (edge coverage "
+                        f"{got_c['explained']}/{got_c['total']})")
             for field, value in (("payload_bytes", rep.total_payload_bytes),
                                  ("wire_bytes", rep.total_wire_bytes)):
                 b = float(base.get(field, 0))

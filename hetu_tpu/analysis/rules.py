@@ -38,6 +38,19 @@ Rule catalog (DESIGN.md §9 for the rationale of each):
                              sidecars of the quantized transport
                              (tagged ``scales``) and the updated-param
                              gather (tagged ``param_comm``) are exempt.
+``unexplained-collective``   an emitted collective the per-edge
+                             DS-transition attribution (analysis/edges)
+                             cannot explain: an explicit record no
+                             predicted edge covers, or GSPMD-inserted
+                             collectives beyond the edge budget /
+                             declared allowance.  Replaces
+                             ``implicit-reshard`` for every executable
+                             that registers edges.
+``moe-capacity-overprovision`` MoE dispatch payload exceeds what the
+                             layer's capacity factor predicts — the
+                             dispatch/combine all-to-alls move more
+                             bytes than the routing math requires
+                             (dropless mode is exempt: no capacity).
 
 Thresholds live in :data:`DEFAULT_OPTIONS` and are overridable per
 context (tests seed violations with tiny thresholds).
@@ -64,6 +77,14 @@ DEFAULT_OPTIONS: Dict[str, Any] = {
     "wide_bytes_threshold": 1 << 20,
     # donation-miss: min buffer size worth donating
     "donation_bytes_threshold": 1 << 20,
+    # unexplained-collective: how many GSPMD-inserted HLO collectives
+    # ONE predicted DS-transition edge may lower to (fwd + bwd
+    # transpose + a couple of partitioner splits).  Counts stay pinned
+    # exactly by the baseline; this bounds attribution, not growth.
+    "gspmd_budget_factor": 4,
+    # moe-capacity-overprovision: tolerated payload slack over the
+    # capacity-factor prediction (1.0 = exact)
+    "moe_capacity_slack": 1.0,
 }
 
 
@@ -114,11 +135,33 @@ class AnalysisContext:
         default_factory=dict)
     serving: Optional[Dict[str, Any]] = None   # pool/tap snapshot
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # predicted DS-transition edges (analysis/edges.predict_edges);
+    # None = the executable makes no per-edge claim
+    edges: Optional[List[Any]] = None
+    # whether this executable differentiates (enables autodiff-dual
+    # matching in the edge pass) — set once by build_context so the
+    # edge predictor and the matcher share one definition
+    train: bool = False
     options: Dict[str, Any] = dataclasses.field(
         default_factory=lambda: dict(DEFAULT_OPTIONS))
+    _edge_match: Any = dataclasses.field(default=None, repr=False)
 
     def opt(self, key: str):
         return self.options.get(key, DEFAULT_OPTIONS[key])
+
+    def edge_match(self):
+        """Match emitted collectives against the predicted edge set
+        (cached; ``None`` when the executable makes no edge claim)."""
+        if self.edges is None:
+            return None
+        if self._edge_match is None:
+            from .edges import match_edges
+            self._edge_match = match_edges(
+                self.records, self.lowered_text, self.compiled_text,
+                self.edges, train=self.train,
+                allowed_gspmd=self.allowed_gspmd,
+                budget_factor=int(self.opt("gspmd_budget_factor")))
+        return self._edge_match
 
 
 RuleFn = Callable[[AnalysisContext], List[Finding]]
@@ -171,12 +214,20 @@ def _replicated_large_param(ctx: AnalysisContext) -> List[Finding]:
             rule="", subject=p.name,
             message=f"param {p.name} {p.shape} ({p.nbytes} B) is fully "
                     f"replicated; mesh has unused shardable axes "
-                    f"{sorted(shardable)}"))
+                    f"{sorted(shardable)}",
+            hint=f"shard it: pspec=P({sorted(shardable)[0]!r}, ...) on "
+                 f"its largest dim (vocab/feature), or mark "
+                 f"trainable=False if it is a frozen table"))
     return out
 
 
 @rule("implicit-reshard")
 def _implicit_reshard(ctx: AnalysisContext) -> List[Finding]:
+    if ctx.edges is not None:
+        # the per-edge attribution pass owns GSPMD accounting for
+        # edge-claiming executables (unexplained-collective below) —
+        # including the strict allowed_gspmd claim when one is declared
+        return []
     if not ctx.compiled_text or ctx.allowed_gspmd is None:
         return []
     from ..parallel.dstates import count_hlo_collectives
@@ -195,7 +246,10 @@ def _implicit_reshard(ctx: AnalysisContext) -> List[Finding]:
                         f"({explicit.get(kind, 0)} explicit + "
                         f"{ctx.allowed_gspmd.get(kind, 0)} allowed): "
                         f"{excess} GSPMD-inserted reshard(s) the sharding "
-                        f"annotations do not account for"))
+                        f"annotations do not account for",
+                hint="register pspec edges for this executable so the "
+                     "per-edge pass can attribute the reshard, or align "
+                     "the producer/consumer pspecs that force it"))
     return out
 
 
@@ -224,7 +278,10 @@ def _wide_collective(ctx: AnalysisContext) -> List[Finding]:
                     f"{'/'.join(r.axes) or '?'} while the surrounding "
                     f"compute is {dominant} — transport could be "
                     f"narrowed (grad_comm= / bf16 cast)",
-            source=r.source))
+            source=r.source,
+            hint=f"narrow the transport: Optimizer(grad_comm='bf16'|"
+                 f"'int8') for gradient syncs, or cast to {dominant} "
+                 f"before the collective and back after"))
     return out
 
 
@@ -240,7 +297,9 @@ def _donation_miss(ctx: AnalysisContext) -> List[Finding]:
             rule="", subject=f"arg{arg}",
             message=f"input {arg} ({nbytes} B across its leaves) matches "
                     f"output buffers but is not donated — the executable "
-                    f"holds two copies where one would do"))
+                    f"holds two copies where one would do",
+            hint=f"donate it: jax.jit(fn, donate_argnums=(...)) for "
+                 f"input {arg} — XLA reuses the buffer in place"))
     return out
 
 
@@ -255,7 +314,9 @@ def _unreduced_psum_scalar(ctx: AnalysisContext) -> List[Finding]:
             message=f"scalar output {var} of a manual-mode region has no "
                     f"psum/pmean on its def-chain: every rank returns its "
                     f"OWN local value (scope {scope or '?'})",
-            source=src, severity="error"))
+            source=src, severity="error",
+            hint="reduce it before returning: jax.lax.pmean(x, axis) "
+                 "for means, lax.psum for sums"))
     return out
 
 
@@ -285,7 +346,93 @@ def _grad_allgather_under_zero2(ctx: AnalysisContext) -> List[Finding]:
                         f"{r.scope!r} pays the wire bytes the "
                         f"reduce-scatter-only sync exists to save "
                         f"(flat_state=True keeps gradients scattered)",
-                source=r.source))
+                source=r.source,
+                hint="keep gradients scattered: Optimizer("
+                     "flat_state=True) updates the locally-owned flat "
+                     "chunk and regathers PARAMS (weight dtype, tag "
+                     "param_comm), never gradients"))
+    return out
+
+
+@rule("unexplained-collective")
+def _unexplained_collective(ctx: AnalysisContext) -> List[Finding]:
+    """Per-edge attribution (analysis/edges): every emitted collective
+    must be explained by a predicted DS-transition edge."""
+    em = ctx.edge_match()
+    if em is None:
+        return []
+    out: List[Finding] = []
+    for r in em.unexplained_records:
+        segs = [s for s in r.scope.split("/") if s]
+        slug = segs[-1] if segs else "untagged"
+        out.append(Finding(
+            rule="", subject=f"{r.kind}:{slug}",
+            message=f"{r.dtype} {r.kind} over "
+                    f"{'/'.join(r.axes) or '?'} ({r.payload_bytes} B "
+                    f"x{r.count}, scope {r.scope or 'untagged'}) is not "
+                    f"predicted by any DS-transition edge — the program "
+                    f"communicates outside its sharding contract",
+            source=r.source,
+            hint="predict it: annotate the producer with sharded(...) "
+                 "so the edge pass sees the transition, or wrap the "
+                 "emission in comm.comm_tag(...) matching a declared "
+                 "edge; if the collective is wrong, fix the producer/"
+                 "consumer pspecs so the transition disappears"))
+    for kind, (excess, budget) in sorted(em.gspmd_unexplained.items()):
+        near = [e.describe() for e in (ctx.edges or [])
+                if e.kind != "identity"][:3]
+        near_s = ("; nearest declared edges: " + " | ".join(near)) \
+            if near else "; no edge predicts this kind at all"
+        out.append(Finding(
+            rule="", subject=f"gspmd:{kind}",
+            message=f"GSPMD inserted {excess} {kind} collective(s) "
+                    f"beyond the {budget} the predicted edges allow"
+                    f"{near_s}",
+            hint="a producer -> consumer pspec disagreement the "
+                 "annotations do not account for: align the stale "
+                 "pspec (or declare the edge) so the reshard is "
+                 "predicted — or remove the mid-graph constraint that "
+                 "forces it"))
+    return out
+
+
+@rule("moe-capacity-overprovision")
+def _moe_capacity_overprovision(ctx: AnalysisContext) -> List[Finding]:
+    """MoE dispatch payload must not exceed the capacity-factor
+    prediction: the dispatch/combine all-to-alls are the widest
+    collectives on an ICI-bound mesh, and an over-provisioned capacity
+    moves (and zero-pads) bytes the routing math never fills."""
+    from ..ops.moe_dispatch import capacity_tokens
+    out: List[Finding] = []
+    slack = float(ctx.opt("moe_capacity_slack"))
+    for m in (ctx.meta or {}).get("moe") or ():
+        if m.get("dispatch_mode") == "dropless":
+            continue    # capacity-free: every assignment computes, no pad
+        try:
+            pred = capacity_tokens(int(m["tokens"]),
+                                   int(m["num_experts"]),
+                                   int(m.get("k", 1)),
+                                   float(m["capacity_factor"]))
+        except (KeyError, ValueError, TypeError):
+            continue
+        actual = int(m.get("capacity", pred))
+        if actual <= pred * slack:
+            continue
+        itemsize = np.dtype(m.get("dtype", "float32")).itemsize
+        per_cap = int(m["num_experts"]) * int(m.get("embed_dim", 1)) \
+            * itemsize
+        out.append(Finding(
+            rule="", subject=m.get("name", "moe"),
+            message=f"MoE layer {m.get('name', '?')} dispatches with "
+                    f"capacity {actual} tokens/expert but "
+                    f"capacity_factor {m['capacity_factor']} predicts "
+                    f"{pred}: each dispatch/combine all-to-all moves "
+                    f"{(actual - pred) * per_cap} zero-padded bytes "
+                    f"per step",
+            hint=f"size capacity from capacity_tokens(T, E, k, cf) "
+                 f"(= {pred} here), lower capacity_factor, or switch "
+                 f"to dispatch_mode='dropless' (capacity-free blocked "
+                 f"group-GEMM, no padding at all)"))
     return out
 
 

@@ -171,6 +171,9 @@ class Graph:
         # grad-comm path): pspec sharding constraints referencing manual
         # axes are illegal inside the region and are skipped there
         self._manual_axes: Tuple[str, ...] = ()
+        # MoE layers built in this graph record their dispatch bounds
+        # here (nn/moe.py) for the analyzer's capacity accounting
+        self._moe_meta: List[Dict[str, Any]] = []
 
     # -- construction -------------------------------------------------------
 
@@ -208,7 +211,12 @@ class Graph:
                     d.set(16)
         in_structs = [jax.ShapeDtypeStruct(t.concrete_shape(), t.dtype.to_jnp())
                       for t in in_tensors]
-        out_struct = jax.eval_shape(lambda *xs: impl(*xs, **attrs), *in_structs)
+        # underscore attrs are node metadata, not impl kwargs (same
+        # filtering _eval_targets applies at trace time)
+        call_attrs = {k: v for k, v in attrs.items()
+                      if not k.startswith("_")}
+        out_struct = jax.eval_shape(lambda *xs: impl(*xs, **call_attrs),
+                                    *in_structs)
         flat_outs, treedef = jax.tree_util.tree_flatten(out_struct)
         outputs = []
         for i, s in enumerate(flat_outs):
@@ -1084,6 +1092,75 @@ class DefineAndRunGraph(Graph):
 
     # -- analysis hook -------------------------------------------------------
 
+    def _collect_pspec_edges(self) -> List[Dict[str, Any]]:
+        """Producer -> consumer pspec edges of this graph, for the
+        per-edge attribution pass (hetu_tpu/analysis/edges).
+
+        Every tensor carrying a pspec annotation is a constraint site
+        (``_eval_targets`` applies ``with_sharding_constraint`` there);
+        the edge runs from its nearest *annotated* dataflow ancestor to
+        it, and ``dstates.deduce_pspec_transition`` names the collective
+        GSPMD will insert for the transition.  Identity edges (the
+        annotation merely restates the inherited layout) are dropped.
+        """
+        edges: List[Dict[str, Any]] = []
+        if self.mesh is None:
+            return edges
+        mesh_axes = {str(a): int(s) for a, s in self.mesh.shape.items()}
+        if max(mesh_axes.values(), default=1) <= 1:
+            return edges
+        from ..parallel.dstates import _spec_pairs, deduce_pspec_transition
+
+        def _ancestor(t, limit: int = 128):
+            """Nearest annotated tensor on the main dataflow chain."""
+            for _ in range(limit):
+                node = t.producer
+                if node is None or not node.inputs:
+                    return None
+                t = node.inputs[0]
+                if self._pspec_for(t) is not None:
+                    return t
+            return None
+
+        for node in self.ops:
+            for out in node.outputs:
+                dst_spec = self._pspec_for(out)
+                if dst_spec is None or node.op_type in ("variable",
+                                                        "placeholder"):
+                    continue    # leaf annotations constrain inputs only
+                src_t = _ancestor(out)
+                src_spec = self._pspec_for(src_t) \
+                    if src_t is not None else None
+                try:
+                    src_shape = tuple(src_t.concrete_shape()) \
+                        if src_t is not None else tuple(out.concrete_shape())
+                    dst_shape = tuple(out.concrete_shape())
+                    kind = deduce_pspec_transition(
+                        src_spec, src_shape, dst_spec, dst_shape,
+                        mesh_axes)
+                except (ValueError, TypeError):
+                    continue
+                if kind == "identity":
+                    continue
+                nbytes = int(np.prod(dst_shape, dtype=np.int64)
+                             * np.dtype(out.dtype.to_jnp()).itemsize)
+                # the axes the transition MOVES (placement changed) —
+                # spectator axes keep their dim and never communicate
+                changed = {a for _d, a in
+                           _spec_pairs(src_spec) ^ _spec_pairs(dst_spec)}
+                edges.append({
+                    "kind": kind,
+                    "tensor": out.name,
+                    "producer": src_t.name if src_t is not None
+                    else node.inputs[0].name if node.inputs else "",
+                    "consumer": node.attrs.get("_edge_tag") or node.name,
+                    "src_spec": str(src_spec),
+                    "dst_spec": str(dst_spec),
+                    "axes": tuple(sorted(changed)),
+                    "payload_bytes": nbytes,
+                })
+        return edges
+
     def _register_plan_for_analysis(self, key, jit_step, gc_state,
                                     update_node, real_fetches,
                                     num_micro_batches,
@@ -1123,6 +1200,15 @@ class DefineAndRunGraph(Graph):
             # gate; otherwise GSPMD owns the grad sync and no implicit-
             # reshard claim is made (allowed_gspmd None disables it)
             "allowed_gspmd": {} if gc_state[0] else None,
+            # per-edge attribution (analysis/edges): the graph's
+            # producer -> consumer pspec transitions, plus the facts the
+            # edge synthesizers need (scalar fetch reductions, MoE
+            # dispatch bounds)
+            "pspec_edges": self._collect_pspec_edges(),
+            "scalar_fetches": sum(
+                1 for f in real_fetches
+                if isinstance(f, Tensor) and len(f.shape) == 0),
+            "moe": [dict(m) for m in getattr(self, "_moe_meta", ())],
         }
         if update_node is not None:
             opt = update_node.attrs["optimizer"]
@@ -1155,6 +1241,7 @@ class DefineAndRunGraph(Graph):
                             np.dtype(t.dtype.to_jnp()).name) for t in xs]
                 meta["grad_comm"] = {
                     "entries": entries,
+                    "dp_axis": opt.dp_axis,
                     "transport": opt.grad_comm,
                     "bucket_mb": opt.bucket_mb,
                     "device_num": mesh_axes.get(opt.dp_axis, 1),
@@ -1163,9 +1250,7 @@ class DefineAndRunGraph(Graph):
                     "clip": opt.max_grad_norm is not None,
                     # each scalar fetch is pmean'd inside the manual
                     # region (one explicit all_reduce apiece)
-                    "scalar_fetches": sum(
-                        1 for f in real_fetches
-                        if isinstance(f, Tensor) and len(f.shape) == 0),
+                    "scalar_fetches": meta["scalar_fetches"],
                 }
         register_executable(name, jit_step, self._abstract_pool[key], meta)
 

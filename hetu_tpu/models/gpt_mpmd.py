@@ -189,6 +189,63 @@ BLOCK_SPECS = {
 }
 
 
+def stage_comm_edges(cfg: GPTConfig, lrange: Sequence[int], first: bool,
+                     last: bool, batch: int, seq: int,
+                     mesh_axes: Dict[str, int]) -> List[Dict[str, Any]]:
+    """Declared DS-transition edges of one MPMD stage program, for the
+    analyzer's per-edge attribution (``hetu_tpu/analysis/edges``).
+
+    ``block_apply`` plants its sharding constraints below the graph
+    layer (raw ``lax.with_sharding_constraint``), so the stage declares
+    the same boundary list here — one edge per ``_wsc`` site, deduced
+    exactly as the graph-level walk would: the tp-sharded qkv/mlp_up
+    projections are local slices (``scatter``), the attn_out/mlp_down
+    contractions leave tp-partial sums (``all_reduce``), the LM head
+    re-slices the logits over tp and its log-softmax reduces them.
+    """
+    tp = int(mesh_axes.get("tp", 1))
+    if tp <= 1:
+        return []
+    c = cfg
+    act = batch * seq * c.hidden_size * 4
+    edges: List[Dict[str, Any]] = []
+
+    def e(kind, tensor, src, dst, payload):
+        edges.append({"kind": kind, "tensor": tensor,
+                      "producer": tensor, "consumer": f"{tensor}.wsc",
+                      "src_spec": src, "dst_spec": dst, "axes": ("tp",),
+                      "payload_bytes": int(payload)})
+
+    for li in lrange:
+        qkv_bytes = batch * seq * (c.num_heads + 2 * c.kv_heads) \
+            * c.head_dim * 4
+        e("scatter", f"layer{li}.qkv", "P(dp)", "P(dp,None,tp)",
+          qkv_bytes)
+        # q/k/v head split: [b,s,o] tp on the fused projection dim ->
+        # [b,s,nh,hd] tp on the head dim — a genuine reshard (GSPMD
+        # lowers the GQA repeat + head regrouping to collective-permutes
+        # when nh/kvh tilings disagree)
+        e("reshard", f"layer{li}.attn_heads", "P(dp,None,tp)",
+          "P(dp,None,tp,None)", qkv_bytes)
+        e("all_reduce", f"layer{li}.attn_out", "partial(tp)",
+          "P(dp,None,None)", act)
+        mult = 2 if c.activation == "swiglu" else 1
+        e("scatter", f"layer{li}.mlp_up", "P(dp)", "P(dp,None,tp)",
+          batch * seq * mult * c.ffn_size * 4)
+        e("all_reduce", f"layer{li}.mlp_down", "partial(tp)",
+          "P(dp,None,None)", act)
+    if first:
+        # vocab-sharded wte lookup: masked local gather + psum over tp
+        e("all_reduce", "wte_lookup", "P(tp,None) table",
+          "P(dp,None,None)", act)
+    if last:
+        e("scatter", "logits", "P(dp)", "P(dp,None,tp)",
+          batch * seq * c.vocab_size * 4)
+        e("all_reduce", "log_softmax", "partial(tp)", "replicated",
+          batch * seq * 4)
+    return edges
+
+
 # ---------------------------------------------------------------------------
 # stage builders
 
@@ -351,6 +408,76 @@ class MPMDGPT:
                 x = block_apply(cfg, params[f"layer{li}"], x, key, mesh)
             return x
         return fwd
+
+    # -- static analysis -----------------------------------------------------
+
+    def register_analysis(self, name: str, batch: int, seq: int
+                          ) -> List[str]:
+        """Register every stage program with the static analyzer
+        (``python -m hetu_tpu.analysis``), declaring each stage's
+        DS-transition edges (:func:`stage_comm_edges`) so the per-edge
+        pass can explain the tp collectives GSPMD inserts inside stage
+        programs.  Returns the registered executable names."""
+        from ..parallel.pipeline_mpmd import register_stage_executables
+        cfg = self.cfg
+        ranges: List[List[int]] = []
+        lo = 0
+        for n in self.stage_layers[0]:
+            ranges.append(list(range(lo, lo + n)))
+            lo += n
+
+        def _sds(tree):
+            return jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(np.shape(a),
+                                               np.asarray(a).dtype)
+                if not hasattr(a, "aval") else
+                jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+        rng_sds = _sds(jax.random.PRNGKey(0))
+
+        def stage_args(p, s, stage):
+            params_sds = _sds(stage.params)
+            if s == 0:
+                x_sds = jax.ShapeDtypeStruct((batch, seq), np.int32)
+            else:
+                x_sds = jax.ShapeDtypeStruct(
+                    (batch, seq, cfg.hidden_size), np.float32)
+            if stage.is_last:
+                y_sds = jax.ShapeDtypeStruct((batch, seq), np.int32)
+                return (params_sds, x_sds, y_sds, rng_sds)
+            return (params_sds, x_sds, rng_sds)
+
+        def stage_meta(p, s, stage):
+            mesh_axes = {str(a): int(sz)
+                         for a, sz in stage.mesh.shape.items()} \
+                if stage.mesh is not None else {}
+            params = []
+            for ename, sub in stage.params.items():
+                leaves = sub.items() if isinstance(sub, dict) \
+                    else [("", sub)]
+                for lname, leaf in leaves:
+                    spec = BLOCK_SPECS.get(lname) \
+                        if ename.startswith("layer") \
+                        else self._entry_spec(ename)
+                    params.append({
+                        "name": f"{ename}.{lname}" if lname else ename,
+                        "shape": tuple(np.shape(leaf)),
+                        "dtype": str(np.asarray(leaf).dtype)
+                        if not hasattr(leaf, "dtype")
+                        else np.dtype(leaf.dtype).name,
+                        "pspec": spec})
+            first, last = s == 0, stage.is_last
+            return {
+                "params": params,
+                "declared_edges": stage_comm_edges(
+                    cfg, ranges[s], first, last, batch, seq, mesh_axes),
+                "pipeline": {"hops": 0,
+                             "boundary_bytes": batch * seq
+                             * cfg.hidden_size * 4},
+            }
+
+        return register_stage_executables(self.runtime, name,
+                                          stage_args, stage_meta)
 
     # -- training ------------------------------------------------------------
 

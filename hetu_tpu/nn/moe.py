@@ -32,6 +32,7 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -39,6 +40,7 @@ from .. import ops
 from ..graph.ctor import (ConstantInitializer, Initializer,
                           NormalInitializer, XavierNormalInitializer,
                           parallel_parameter)
+from ..ops.moe_dispatch import capacity_tokens
 from .module import Module
 from .parallel import sharded
 
@@ -94,7 +96,7 @@ def topk_gating_impl(logits, k, capacity_factor):
     Returns (l_aux, combine [T,E,C], dispatch [T,E,C])."""
     T, E = logits.shape
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    capacity = k * math.ceil(T / E * capacity_factor)
+    capacity = capacity_tokens(T, E, k, capacity_factor)
     _, topk_idx = lax.top_k(gates, k)                             # [T, k]
     masks, gate_vals, l_aux = [], [], 0.0
     for i in range(k):
@@ -113,7 +115,7 @@ def ktop1_gating_impl(logits, k, capacity_factor):
     Ep = E // k
     proto = jax.nn.softmax(
         logits.astype(jnp.float32).reshape(T, k, Ep), axis=-1)    # [T,k,Ep]
-    capacity = k * math.ceil(T / E * capacity_factor)
+    capacity = capacity_tokens(T, E, k, capacity_factor)
     masks, gate_vals, l_aux = [], [], 0.0
     for i in range(k):
         g = proto[:, i, :]                                        # [T, Ep]
@@ -130,7 +132,7 @@ def hash_gating_impl(indices, num_experts, capacity_factor):
     """Static hash routing (HashGate.py hashgating): expert id is given
     per token (e.g. ``token_id % E``); gate weight is 1."""
     T = indices.shape[0]
-    capacity = math.ceil(T / num_experts * capacity_factor)
+    capacity = capacity_tokens(T, num_experts, 1, capacity_factor)
     m = jax.nn.one_hot(indices, num_experts, dtype=jnp.float32)
     dispatch, combine = _dispatch_combine([m], [jnp.ones((T,), jnp.float32)],
                                           capacity)
@@ -153,7 +155,7 @@ def sam_gating_impl(logits, k, capacity_factor, num_groups):
                                 dtype=jnp.float32)                # [T, G]
     # top-k inside the chosen group
     local = jnp.einsum("tge,tg->te", grouped, group_mask)         # [T, Eg]
-    capacity = k * math.ceil(T / E * capacity_factor)
+    capacity = capacity_tokens(T, E, k, capacity_factor)
     _, topk_local = lax.top_k(local, k)
     base = top_group * Eg
     masks, gate_vals, l_aux = [], [], 0.0
@@ -186,7 +188,7 @@ def balance_gating_impl(scores, capacity_factor, n_iters=10):
     logp = lax.fori_loop(0, n_iters, body, logp)
     idx = jnp.argmax(logp, axis=-1)
     m = jax.nn.one_hot(idx, E, dtype=jnp.float32)
-    capacity = math.ceil(T / E * capacity_factor)
+    capacity = capacity_tokens(T, E, 1, capacity_factor)
     gv = jax.nn.sigmoid(jnp.sum(s * m, axis=1))
     dispatch, combine = _dispatch_combine([m], [gv], capacity)
     return jnp.zeros((), jnp.float32), combine, dispatch
@@ -402,12 +404,48 @@ class MoELayer(Module):
         self.ep_axis, self.dp_axis = ep_axis, dp_axis
         self.dispatch_mode = dispatch_mode
 
+    def _record_analysis_meta(self, xt, capacity: Optional[int],
+                              payload=None) -> None:
+        """Expose this layer's dispatch bounds to the static analyzer
+        (graph meta ``moe``): the capacity-factor prediction bounds the
+        EP dispatch/combine all-to-all payload, and the
+        ``moe-capacity-overprovision`` rule flags dispatch tensors sized
+        beyond it (dropless mode carries no capacity and is exempt)."""
+        from ..graph.graph import get_default_graph
+        g = get_default_graph()
+        if not hasattr(g, "_moe_meta"):
+            return
+        try:
+            T, d = (int(s) for s in xt.concrete_shape())
+        except (TypeError, ValueError):
+            return
+        gate = self.gate
+        g._moe_meta.append({
+            "name": getattr(self.experts.w1, "name", "moe"),
+            "tokens": T,
+            "embed_dim": d,
+            "num_experts": self.experts.num_experts,
+            "k": getattr(gate, "k", 1),
+            "capacity_factor": getattr(gate, "capacity_factor", 1.0)
+            if getattr(gate, "training", True)
+            else getattr(gate, "eval_capacity_factor", 1.0),
+            "capacity": capacity,
+            "dispatch_mode": self.dispatch_mode,
+            "ep_axis": self.ep_axis,
+            # the all-to-all moves the DISPATCHED tensor, whose dtype
+            # is the einsum promotion of (fp32 gate masks, xt) — not
+            # the layer weight dtype
+            "dtype": np.dtype((payload if payload is not None
+                               else xt).dtype.to_jnp()).name,
+        })
+
     def forward(self, x, token_ids=None):
         """x: [..., d] -> (out [..., d], l_aux)."""
         orig_shape = x.shape
         d = orig_shape[-1]
         xt = ops.reshape(x, (-1, d))                              # [T, d]
         if self.dispatch_mode == "dropless":
+            self._record_analysis_meta(xt, capacity=None)
             k, act = self.gate.k, self.experts.activation
             out, l_aux = ops.functional._op(
                 "moe_dropless",
@@ -419,7 +457,10 @@ class MoELayer(Module):
                 num_outputs=2)
             if self.dp_axis:
                 out = sharded(out, P(self.dp_axis, None))
-            out = ops.reshape(out, orig_shape)
+            # batch-agnostic unflatten: under the explicit grad-comm
+            # manual region the leading (dp-sharded) dim is LOCAL, so
+            # the captured global batch size must not be baked in
+            out = ops.reshape(out, (-1, *orig_shape[1:]))
             return out, l_aux
         if isinstance(self.gate, HashGate):
             if token_ids is None:
@@ -428,6 +469,8 @@ class MoELayer(Module):
         else:
             l_aux, combine, dispatch = self.gate(xt)
         dispatched = ops.einsum("tec,td->ecd", dispatch, xt)      # [E, C, d]
+        self._record_analysis_meta(xt, capacity=int(dispatch.shape[-1]),
+                                   payload=dispatched)
         if self.ep_axis:
             dispatched = sharded(dispatched, P(self.ep_axis, None, None))
         eout = self.experts(dispatched)                           # [E, C, d]
@@ -436,7 +479,7 @@ class MoELayer(Module):
         out = ops.einsum("tec,ecd->td", combine, eout)            # [T, d]
         if self.dp_axis:
             out = sharded(out, P(self.dp_axis, None))
-        out = ops.reshape(out, orig_shape)
+        out = ops.reshape(out, (-1, *orig_shape[1:]))
         return out, l_aux
 
 

@@ -31,14 +31,20 @@ from ..parallel.dstates import (DUPLICATE, NULL_HETERO_DIM, DistributedStates,
 from .module import Module
 
 
-def sharded(t, pspec):
+def sharded(t, pspec, tag: Optional[str] = None):
     """Annotate an activation with a sharding constraint.
 
     Returns a NEW tensor (identity op) carrying the annotation, so other
     consumers of ``t`` keep their own layout — annotating in place would
     silently reshard every consumer.
+
+    ``tag`` names the boundary for the static analyzer's per-edge
+    attribution (``--explain`` prints it as the edge's consumer site:
+    "tp_row_reduce", "sp_gather", ...); purely provenance, no effect on
+    lowering.
     """
-    out = ops.functional._op("sharding_constraint", lambda x: x, [t])
+    out = ops.functional._op("sharding_constraint", lambda x: x, [t],
+                             attrs={"_edge_tag": tag} if tag else None)
     out.pspec = pspec
     return out
 
@@ -51,10 +57,12 @@ def _norm_out_spec(out, sp, dp_axis, tp_axis, seq_axis):
     if sp:
         seq_entry = (seq_axis, tp_axis) if seq_axis else tp_axis
         return sharded(out, P(dp_axis, seq_entry,
-                              *([None] * (out.ndim - 2))))
+                              *([None] * (out.ndim - 2))),
+                       tag="sp_norm_scatter")
     if seq_axis:
         return sharded(out, P(dp_axis, seq_axis,
-                              *([None] * (out.ndim - 2))))
+                              *([None] * (out.ndim - 2))),
+                       tag="cp_seq_split")
     return out
 
 
@@ -98,7 +106,9 @@ class ColumnParallelLinear(Module):
         spec.append(None if self.gather_output else self.tp_axis)
         if self.seq_axis and out.ndim >= 3:
             spec[1] = self.seq_axis
-        return sharded(out, P(*spec))
+        return sharded(out, P(*spec),
+                       tag="tp_col_gather" if self.gather_output
+                       else "tp_col_split")
 
 
 class RowParallelLinear(Module):
@@ -138,7 +148,7 @@ class RowParallelLinear(Module):
         in_spec = [self.dp_axis] + [None] * (x.ndim - 2) + [self.tp_axis]
         if self.seq_axis and x.ndim >= 3:
             in_spec[1] = self.seq_axis
-        x = sharded(x, P(*in_spec))
+        x = sharded(x, P(*in_spec), tag="tp_row_input")
         out = ops.linear(x, self.weight, None, trans_b=True)
         if self.sp:
             # reduce-scatter onto sequence shards (dim 1 of [b, s, h]);
@@ -150,7 +160,9 @@ class RowParallelLinear(Module):
             out_spec = [self.dp_axis] + [None] * (out.ndim - 1)
             if self.seq_axis and out.ndim >= 3:
                 out_spec[1] = self.seq_axis
-        out = sharded(out, P(*out_spec))
+        out = sharded(out, P(*out_spec),
+                      tag="sp_row_scatter" if self.sp
+                      else "tp_row_reduce")
         if self.bias is not None:
             out = sharded(out + self.bias, P(*out_spec))
         return out
@@ -174,7 +186,7 @@ class ParallelEmbedding(Module):
     def forward(self, ids):
         out = ops.embedding_lookup(self.weight, ids)
         spec = [self.dp_axis] + [None] * (out.ndim - 2) + [self.tp_axis]
-        return sharded(out, P(*spec))
+        return sharded(out, P(*spec), tag="tp_embed_split")
 
 
 class VocabParallelEmbedding(Module):
@@ -205,7 +217,7 @@ class VocabParallelEmbedding(Module):
         spec = [self.dp_axis] + [None] * (out.ndim - 1)
         if self.seq_axis and out.ndim >= 3:
             spec[1] = self.seq_axis
-        return sharded(out, P(*spec))
+        return sharded(out, P(*spec), tag="vocab_embed_reduce")
 
 
 class ParallelLayerNorm(Module):
@@ -269,7 +281,7 @@ def vocab_parallel_cross_entropy(logits, target, dp_axis: str = "dp",
     spec = [dp_axis] + [None] * (logits.ndim - 2) + [tp_axis]
     if seq_axis and logits.ndim >= 3:
         spec[1] = seq_axis
-    logits = sharded(logits, P(*spec))
+    logits = sharded(logits, P(*spec), tag="vocab_ce_shard")
     loss = ops.softmax_cross_entropy(logits, target, reduction=reduction,
                                      ignore_index=ignore_index)
     return loss

@@ -19,10 +19,22 @@ and the training MoE layer's ``dispatch_mode="dropless"``
 """
 from __future__ import annotations
 
+import math
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+
+
+def capacity_tokens(num_tokens: int, num_experts: int, k: int,
+                    capacity_factor: float) -> int:
+    """Tokens-per-expert capacity the GShard routing math requires:
+    ``k * ceil(T/E * cf)``.  Single source of truth shared by the gating
+    impls (nn/moe.py) and the analyzer's ``moe-capacity-overprovision``
+    rule — a dispatch tensor sized beyond this moves zero-padded bytes
+    through the EP all-to-alls."""
+    return int(k) * math.ceil(num_tokens / num_experts
+                              * float(capacity_factor))
 
 
 def pick_block_size(n_assign: int, num_experts: int) -> int:

@@ -450,6 +450,11 @@ def ring_wire_bytes(kind: str, payload_bytes: float, n: int) -> float:
         return 2.0 * payload_bytes * frac
     if kind in ("reduce_scatter", "all_gather", "all_to_all"):
         return payload_bytes * frac
+    if kind == "ppermute":
+        # one hop: every rank sends its full local payload once; a
+        # K-hop chain (pipeline ticks, ring attention) is K records (or
+        # one record with count=K), so totals come out as hops x payload
+        return float(payload_bytes)
     raise ValueError(f"unknown collective kind {kind!r}")
 
 

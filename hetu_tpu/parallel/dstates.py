@@ -341,6 +341,138 @@ def deduce_comm_kind(src: DistributedStates, dst: DistributedStates) -> str:
     return "reshard"  # generic (BatchedISendIRecv in the reference)
 
 
+# -- pspec edges: PartitionSpec -> DS, and per-edge comm deduction ------------
+#
+# The per-edge attribution pass (hetu_tpu/analysis/edges.py) predicts the
+# complete expected collective set of an executable from its
+# producer -> consumer pspec transitions.  PartitionSpecs are the lowered
+# form of DistributedStates here (GSPMD meshes instead of ordered device
+# groups), so an edge between two annotations maps back into DS space and
+# the reference's comm-op deduction (`deduce_comm_kind` above) names the
+# collective GSPMD will insert for it.
+
+
+def _ds_from_splits(device_num: int,
+                    splits: Dict[int, int]) -> DistributedStates:
+    """Assemble a DS from per-dim split counts over ``device_num``
+    devices, leftover factor as duplicate(-1), with POSITIVES-FIRST
+    order (duplicate least significant): a gathered / scattered dim
+    then trades places with the duplicate factor exactly as
+    ``check_combine`` expects, so allgather/scatter/reducescatter
+    deduction works on pspec-derived states (the canonical sorted order
+    would put -1 first and spuriously fail the order check)."""
+    states = dict(splits)
+    split_total = 1
+    for v in states.values():
+        split_total *= v
+    states[DUPLICATE] = device_num // split_total
+    order = sorted(k for k, v in states.items() if k >= 0 and v > 1)
+    if states[DUPLICATE] > 1:
+        order.append(DUPLICATE)
+    return DistributedStates(device_num, states, order)
+
+
+def pspec_to_ds(pspec, ndim: int, mesh_axes: Dict[str, int]
+                ) -> DistributedStates:
+    """Lower a ``PartitionSpec`` over a named mesh into a
+    :class:`DistributedStates`: each sharded tensor dim becomes a split
+    dim with the product of its mesh-axis sizes, the leftover device
+    factor becomes duplicate(-1).  ``pspec=None`` means fully replicated
+    (GSPMD's default for unannotated values)."""
+    device_num = 1
+    for s in mesh_axes.values():
+        device_num *= int(s)
+    splits: Dict[int, int] = {}
+    if pspec is not None:
+        for d, entry in enumerate(pspec):
+            if entry is None:
+                continue
+            ents = entry if isinstance(entry, tuple) else (entry,)
+            split = 1
+            for a in ents:
+                if a is not None:
+                    split *= int(mesh_axes.get(a, 1))
+            if split > 1:
+                if d >= ndim:
+                    raise ValueError(
+                        f"pspec {pspec} has more sharded entries than "
+                        f"tensor dims ({ndim})")
+                splits[d] = splits.get(d, 1) * split
+    return _ds_from_splits(device_num, splits)
+
+
+def _spec_pairs(pspec) -> set:
+    """{(dim, axis)} placements of a PartitionSpec (None -> empty)."""
+    pairs = set()
+    if pspec is None:
+        return pairs
+    for d, entry in enumerate(pspec):
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            if a is not None:
+                pairs.add((d, str(a)))
+    return pairs
+
+
+def deduce_pspec_transition(src_spec, src_shape: Sequence[int],
+                            dst_spec, dst_shape: Sequence[int],
+                            mesh_axes: Dict[str, int]) -> str:
+    """Collective kind implied by a producer -> consumer pspec edge.
+
+    Same-shape edges are pure layout transitions: lower both specs to DS
+    and run the reference deduction (:func:`deduce_comm_kind`).  When the
+    op between the two annotations changes the shape (a matmul, an
+    einsum dispatch, an embedding lookup) there is no dim correspondence,
+    so the edge is classified by how the mesh-axis placements moved:
+
+    * axes *lost* entirely (sharded input contracted away) — the result
+      is partial over those axes: ``all_reduce``;
+    * axes *gained* (a sharded weight splits the output) — a local
+      slice: ``scatter`` (no forward comm; its autodiff dual is not);
+    * placements *moved* or mixed — a generic ``reshard`` (GSPMD lowers
+      these to all-to-all / all-gather / collective-permute chains).
+    """
+    src_pairs, dst_pairs = _spec_pairs(src_spec), _spec_pairs(dst_spec)
+    live = {a for a, s in mesh_axes.items() if int(s) > 1}
+    src_pairs = {(d, a) for d, a in src_pairs if a in live}
+    dst_pairs = {(d, a) for d, a in dst_pairs if a in live}
+    if src_pairs == dst_pairs:
+        return "identity"
+    if tuple(src_shape) == tuple(dst_shape):
+        # project onto the CHANGED mesh axes only: axes that keep their
+        # dim placement are spectators (their device subgroups never
+        # communicate), and the DS predicates are all-or-nothing over
+        # the device group, so the deduction runs on the subgroup the
+        # transition actually moves data across.
+        moved = {a for _d, a in src_pairs ^ dst_pairs}
+        n_sub = 1
+        for a in moved:
+            n_sub *= int(mesh_axes[a])
+
+        def _sub_ds(pairs):
+            splits: Dict[int, int] = {}
+            for d, a in pairs:
+                if a in moved:
+                    splits[d] = splits.get(d, 1) * int(mesh_axes[a])
+            return _ds_from_splits(n_sub, splits)
+
+        try:
+            return deduce_comm_kind(_sub_ds(src_pairs),
+                                    _sub_ds(dst_pairs))
+        except ValueError:
+            pass
+    src_axes = {a for _, a in src_pairs}
+    dst_axes = {a for _, a in dst_pairs}
+    lost = src_axes - dst_axes
+    gained = dst_axes - src_axes
+    if lost and not gained:
+        return "all_reduce"    # contraction over the sharded dim: partial
+    if gained and not lost:
+        return "scatter"       # sharded weight slices the output locally
+    return "reshard"
+
+
 # -- coalesced gradient-comm predictions -------------------------------------
 #
 # The comm-op deduction above predicts WHICH collective converts one DS into
@@ -394,13 +526,23 @@ def predict_grad_comm_collectives(entries, device_num: int,
     return preds
 
 
-def count_hlo_collectives(hlo_text: str) -> Dict[str, int]:
+def count_hlo_collectives(hlo_text: str,
+                          include_ppermute: bool = False
+                          ) -> Dict[str, int]:
     """Count collective ops in lowered StableHLO / HLO text.
 
     Handles ``stablehlo.all_reduce``, classic ``all-reduce(``, and the
     async pair spelling after XLA's latency-hiding scheduler
     (``all-reduce-start(`` — the matching ``-done`` is not counted, so
-    each async collective still counts once)."""
+    each async collective still counts once).
+
+    ``include_ppermute`` adds ``collective-permute`` to the tally.  It
+    is opt-in (the per-edge attribution pass uses it) because the
+    legacy exact-count consumers — ``verify_grad_comm_emission`` and
+    the declared ``allowed_gspmd`` diff — have no way to predict
+    permutes, and a legitimate ppermute chain (ring attention, the
+    SPMD pipeline) must not start tripping them.
+    """
     import re
     pats = {
         "all_reduce": r"stablehlo\.all_reduce|all-reduce(?:-start)?\(",
@@ -409,6 +551,9 @@ def count_hlo_collectives(hlo_text: str) -> Dict[str, int]:
         "reduce_scatter":
             r"stablehlo\.reduce_scatter|reduce-scatter(?:-start)?\(",
     }
+    if include_ppermute:
+        pats["ppermute"] = (r"stablehlo\.collective_permute|"
+                            r"collective-permute(?:-start)?\(")
     return {k: len(re.findall(p, hlo_text)) for k, p in pats.items()}
 
 

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .comm import comm_tag
+
 
 def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], Any],
                   stage_params: Any,
@@ -99,8 +101,11 @@ def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], Any],
             out_buf = lax.dynamic_update_index_in_dim(
                 out_buf, jnp.where(valid, y, cur), safe_idx, 0)
             # hop to the next stage (reference P2P send/recv at stage
-            # boundaries); XLA overlaps this with the next tick's compute
-            send = lax.ppermute(y, pp_axis, fwd_perm)
+            # boundaries); XLA overlaps this with the next tick's compute.
+            # comm_tag so the analyzer attributes the scan-body ppermute
+            # chain to the pipeline (M + S - 1 hops x activation bytes)
+            with comm_tag("pipeline/hop"):
+                send = lax.ppermute(y, pp_axis, fwd_perm)
             return (send, out_buf, aux_sum), None
 
         init_recv = jnp.zeros((mb_size, *x_mb_local.shape[2:]),
@@ -115,8 +120,9 @@ def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], Any],
         # out_buf is only valid on the last stage; broadcast it so the
         # (replicated) out_specs is truthful
         mask = (stage == S - 1).astype(out_buf.dtype)
-        return lax.psum(out_buf * mask, pp_axis), \
-            lax.psum(aux_sum, pp_axis) / M
+        with comm_tag("pipeline/collect"):
+            return lax.psum(out_buf * mask, pp_axis), \
+                lax.psum(aux_sum, pp_axis) / M
 
     from .comm import shard_map
     fn = shard_map(

@@ -332,6 +332,57 @@ class MPMDPipelineRuntime:
 
 
 # ---------------------------------------------------------------------------
+# static-analysis registration
+
+
+def register_stage_executables(runtime: "MPMDPipelineRuntime", name: str,
+                               stage_args, stage_meta=None) -> List[str]:
+    """Register every stage program of an MPMD pipeline with the static
+    analyzer (``hetu_tpu.analysis``): last stages register their fused
+    loss+grads program (``step_last``, a train executable), the others
+    their forward.
+
+    ``stage_args(p, s, stage) -> tuple`` returns the abstract argument
+    specs (ShapeDtypeStructs) the stage's jit is traced with;
+    ``stage_meta(p, s, stage) -> dict`` optionally supplies extra
+    registration meta (declared DS-transition edges, pipeline hop info,
+    param pspecs) merged over the defaults.  Returns the registered
+    names (``{name}/pipe{p}-stage{s}``).
+    """
+    from ..graph.graph import clear_executables, register_executable
+    clear_executables(name)
+    names: List[str] = []
+    S = runtime.num_stages
+    for p, pipe in enumerate(runtime.pipes):
+        for s, stage in enumerate(pipe):
+            mesh_axes = {str(a): int(sz)
+                         for a, sz in stage.mesh.shape.items()} \
+                if stage.mesh is not None else {}
+            meta: Dict[str, Any] = {
+                "kind": "pipeline_stage",
+                "train": bool(stage.is_last),
+                "mesh_axes": mesh_axes,
+                "params": [],
+                "scalar_fetches": 1 if stage.is_last else 0,
+                # stage boundaries move via jax.device_put between
+                # submeshes (the reference's kP2PStream), not via
+                # in-program collectives — hops live in the controller
+                "pipeline": {"num_stages": S, "stage": s, "hops": 0},
+            }
+            if stage_meta is not None:
+                extra = stage_meta(p, s, stage) or {}
+                pl = {**meta["pipeline"], **(extra.pop("pipeline", {}))}
+                meta.update(extra)
+                meta["pipeline"] = pl
+            fn = stage.step_last if stage.is_last else stage.fwd_jit
+            ex_name = f"{name}/pipe{p}-stage{s}"
+            register_executable(ex_name, fn, stage_args(p, s, stage),
+                                meta)
+            names.append(ex_name)
+    return names
+
+
+# ---------------------------------------------------------------------------
 # cross-pipeline (hetero-DP) grad reduction
 
 

@@ -221,10 +221,17 @@ class Engine:
             # single-device (or fully explicit) program: NO collective
             # may appear that the inventory doesn't list
             "allowed_gspmd": {} if self.pool.sharding is None else None,
+            "scalar_fetches": 0,
             "serving": lambda: {"pool": self.pool,
                                 "page_size": self.pool.page_size,
                                 "tap": list(self.tap or ())},
         }
+        if self.pool.sharding is None:
+            # per-edge claim: the single-device serving path predicts
+            # ZERO comm edges — any emitted collective is unexplained
+            # by construction (a tp-sharded pool would declare its
+            # attention/head reduction edges here instead)
+            meta["pspec_edges"] = []
         register_executable(f"{self.name}/{kind}-{bucket}", fn, args, meta)
 
     def _pt_row(self, pages: List[int]) -> np.ndarray:

@@ -511,13 +511,35 @@ class TestBaselineGate:
 @pytest.mark.lint_graph
 def test_lint_graph_gate_passes_on_clean_tree():
     """The tier-1 CI gate: `python -m hetu_tpu.analysis --check` against
-    the checked-in ANALYSIS_BASELINE.json must pass on a clean tree."""
+    the checked-in ANALYSIS_BASELINE.json must pass on a clean tree —
+    now over all five gated executable families (dp/ZeRO-2 flat train,
+    serving prefill/decode, TP/SP, pipeline MPMD+SPMD, dropless MoE),
+    with the per-edge pass explaining 100% of emitted collectives.
+
+    One subprocess exercises the whole CLI surface: --format json (CI
+    artifact), --explain (hint mode), exit code 0.
+    """
+    import json as _json
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)       # the CLI sets its own device count
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
-        [sys.executable, "-m", "hetu_tpu.analysis", "--check"],
+        [sys.executable, "-m", "hetu_tpu.analysis", "--check",
+         "--format", "json", "--explain"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, \
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
     assert "lint-graph gate OK" in proc.stdout
+    payload, _ = _json.JSONDecoder().raw_decode(
+        proc.stdout[proc.stdout.index("{"):])
+    exes = payload["executables"]
+    for family in ("gate_train", "gate_serving", "gate_tp", "gate_pipe",
+                   "gate_moe"):
+        assert any(n.startswith(family) for n in exes), sorted(exes)
+    for name, ex in exes.items():
+        cov = ex["edge_coverage"]
+        assert cov["explained"] == cov["total"], (name, cov)
+        assert ex["findings"] == [], (name, ex["findings"])
+    # --explain printed the per-executable edge sections after the JSON
+    assert "predicted edges" in proc.stdout
+    assert "=== gate_tp/plan0 ===" in proc.stdout

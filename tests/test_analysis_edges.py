@@ -1,0 +1,547 @@
+"""Per-edge DS-transition attribution (ISSUE 5).
+
+The edge pass must (a) deduce the right collective for every
+producer -> consumer pspec transition, (b) explain 100% of what the
+gated executable families emit (TP/SP, pipeline, MoE, grad-comm,
+serving), and (c) fire ``unexplained-collective`` exactly once per
+seeded violation: a stale pspec edge, an over-provisioned MoE capacity,
+an untagged scan collective.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import hetu_tpu as ht
+from hetu_tpu import analysis, ops, optim
+from hetu_tpu.analysis import analyze_handle, collect_collectives
+from hetu_tpu.analysis.edges import CommEdge, match_edges
+from hetu_tpu.graph.graph import (DefineAndRunGraph, clear_executables,
+                                  register_executable)
+from hetu_tpu.parallel import comm, create_mesh, dstates
+from hetu_tpu.parallel.comm import comm_tag, shard_map
+from hetu_tpu.parallel.dstates import deduce_pspec_transition, pspec_to_ds
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sds(shape, dtype=np.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _register(name, fn, args, **meta):
+    meta.setdefault("mesh_axes", {})
+    meta.setdefault("params", [])
+    meta.setdefault("allowed_gspmd", None)
+    clear_executables(name)
+    return register_executable(name, fn, args, meta)
+
+
+def _fired(rep, rule):
+    return [f for f in rep.findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# pspec -> DS lowering + per-edge comm deduction
+# ---------------------------------------------------------------------------
+
+class TestPspecTransitions:
+    MA = {"dp": 2, "tp": 4}
+
+    def test_pspec_to_ds(self):
+        ds = pspec_to_ds(P("tp", None), 2, self.MA)
+        assert ds.device_num == 8
+        assert ds.get_dim(0) == 4 and ds.get_dim(dstates.DUPLICATE) == 2
+        repl = pspec_to_ds(None, 3, self.MA)
+        assert repl.check_pure_duplicate()
+        with pytest.raises(ValueError):
+            pspec_to_ds(P("dp", "tp"), 1, self.MA)   # more entries than dims
+
+    @pytest.mark.parametrize("src,ss,dst,ds_,want", [
+        # same shape: true DS transitions via deduce_comm_kind
+        (P("dp", None, "tp"), (4, 16, 64), P("dp", None, None),
+         (4, 16, 64), "all_gather"),
+        (P("dp", None, None), (4, 16, 64), P("dp", None, "tp"),
+         (4, 16, 64), "scatter"),
+        (P("dp", "tp", None), (4, 16, 64), P("dp", None, "tp"),
+         (4, 16, 64), "reshard"),
+        (None, (256, 64), P("tp", None), (256, 64), "scatter"),
+        (P("tp", None), (256, 64), P(None, None), (256, 64), "all_gather"),
+        # shape changed: mesh-axis movement heuristics
+        (P("dp", None, "tp"), (4, 16, 64), P("dp", None, None),
+         (4, 16, 32), "all_reduce"),                 # contracted away
+        (P("dp", ("tp",), None), (4, 16, 64), P("dp", None, "tp"),
+         (4, 16, 256), "reshard"),                   # SP colp boundary
+        (P("dp", None, "tp"), (4, 16, 256), P("dp", ("tp",), None),
+         (4, 16, 64), "reshard"),                    # SP rowp boundary
+        (P("dp", None), (8, 64), P("dp", None, None), (8, 4, 16),
+         "identity"),                                # batch flow
+        (P("dp", None), (8, 64), P("dp", None), (8, 64), "identity"),
+    ])
+    def test_deduction_matrix(self, src, ss, dst, ds_, want):
+        assert deduce_pspec_transition(src, ss, dst, ds_, self.MA) == want
+
+    def test_dead_axes_are_spectators(self):
+        # axes of size 1 never communicate: same transition, degenerate tp
+        assert deduce_pspec_transition(
+            P("dp", None, "tp"), (4, 16, 64), P("dp", None, None),
+            (4, 16, 64), {"dp": 8, "tp": 1}) == "identity"
+
+
+# ---------------------------------------------------------------------------
+# ppermute accounting + scan scope propagation (satellites)
+# ---------------------------------------------------------------------------
+
+class TestPpermuteAndScanTags:
+    def test_ppermute_wire_bytes_per_hop(self, devices8):
+        assert comm.ring_wire_bytes("ppermute", 1024, 8) == 1024.0
+        assert comm.ring_wire_bytes("ppermute", 1024, 1) == 0.0
+
+    def test_pipeline_hop_chain_counted_and_tagged(self, devices8):
+        """parallel/pipeline.py: the tick-scan ppermute chain keeps its
+        pipeline/hop tag and counts hops x payload."""
+        from hetu_tpu.parallel.pipeline import pipeline_spmd
+        mesh = create_mesh({"pp": 4}, devices8[:4])
+        S, d, M, B = 4, 16, 2, 8
+
+        def stage_fn(p, v):
+            return jnp.tanh(v @ p["w"][0])
+
+        fn = jax.jit(lambda pr, x: pipeline_spmd(stage_fn, pr, x, M, mesh))
+        h = _register("t_pphop/fwd", fn,
+                      ({"w": _sds((S, 1, d, d))}, _sds((B, d))))
+        recs = collect_collectives(h.jaxpr)
+        pp = [r for r in recs if r.kind == "ppermute"]
+        assert len(pp) == 1
+        (hop,) = pp
+        assert hop.count == M + S - 1                 # fill + drain hops
+        assert hop.payload_bytes == (B // M) * d * 4  # one mb activation
+        assert hop.wire_bytes == hop.payload_bytes    # per hop
+        assert "pipeline/hop" in hop.scope
+        ars = [r for r in recs if r.kind == "all_reduce"]
+        assert len(ars) == 2
+        assert all("pipeline/collect" in r.scope for r in ars)
+
+    def test_outer_comm_tag_propagates_into_scan_body(self, devices8):
+        """A comm_tag entered AROUND a lax.scan lands on the scan eqn
+        only; the walk must join it onto body collectives so pipeline
+        loops keep their attribution."""
+        mesh = create_mesh({"dp": 8}, devices8)
+
+        def f(xs):
+            def body(c, x):
+                return c + lax.psum(x, "dp"), None
+            with comm_tag("outer_sync"):
+                c, _ = lax.scan(body, jnp.zeros_like(xs[0]), xs)
+            return c
+
+        jf = jax.jit(shard_map(f, mesh, (P(),), P()))
+        h = _register("t_scantag/f", jf, (_sds((5, 16)),))
+        (rec,) = collect_collectives(h.jaxpr)
+        assert rec.count == 5
+        assert "outer_sync" in rec.scope
+
+    def test_untagged_scan_collective_fires_unexplained_once(self,
+                                                             devices8):
+        """Seeded violation: a scan-body ppermute with no comm_tag and
+        no pipeline edge — one unexplained-collective with provenance."""
+        mesh = create_mesh({"pp": 4}, devices8[:4])
+        perm = [(i, (i + 1) % 4) for i in range(4)]
+
+        def f(xs):
+            def body(c, x):
+                return c + lax.ppermute(x, "pp", perm), None
+            c, _ = lax.scan(body, jnp.zeros_like(xs[0]), xs)
+            return c
+
+        jf = jax.jit(shard_map(f, mesh, (P(),), P(), check_rep=False))
+        h = _register("t_scanuntag/f", jf, (_sds((3, 16)),),
+                      pspec_edges=[])          # edge claim: no comm at all
+        rep = analyze_handle(h)
+        fired = _fired(rep, "unexplained-collective")
+        assert len(fired) == 1, rep.findings
+        assert fired[0].subject == "ppermute:untagged"
+        assert fired[0].source, "record provenance must carry file:line"
+        assert "comm_tag" in fired[0].hint
+        # same loop with the tag + a declared pipeline edge: silent
+        def g(xs):
+            def body(c, x):
+                with comm_tag("pipeline/hop"):
+                    return c + lax.ppermute(x, "pp", perm), None
+            c, _ = lax.scan(body, jnp.zeros_like(xs[0]), xs)
+            return c
+
+        jg = jax.jit(shard_map(g, mesh, (P(),), P(), check_rep=False))
+        hg = _register("t_scanuntag/ok", jg, (_sds((3, 16)),),
+                       pipeline={"pp_axis": "pp", "hops": 3,
+                                 "payload_bytes": 16 * 4})
+        assert not _fired(analyze_handle(hg), "unexplained-collective")
+        # a TAGGED edge must NOT absorb an untagged record of the same
+        # kind: the rogue loop fires even when a pipeline edge exists
+        hr = _register("t_scanuntag/rogue", jf, (_sds((3, 16)),),
+                       pipeline={"pp_axis": "pp", "hops": 3,
+                                 "payload_bytes": 16 * 4})
+        fired_r = _fired(analyze_handle(hr), "unexplained-collective")
+        assert len(fired_r) == 1, fired_r
+
+
+# ---------------------------------------------------------------------------
+# TP/SP + stale-pspec seeding (tentpole)
+# ---------------------------------------------------------------------------
+
+class TestTPEdgeAttribution:
+    def _tp_train(self, devices8, name="t_tpedge"):
+        from hetu_tpu.models import GPTLMHeadModel, llama_config
+        ht.set_seed(11)
+        mesh = create_mesh({"dp": 2, "tp": 4}, devices8)
+        cfg = llama_config(vocab_size=128, hidden_size=32, num_layers=1,
+                           num_heads=4, max_seq_len=16, sp=True,
+                           dtype="bfloat16")
+        g = DefineAndRunGraph(name)
+        g.mesh = mesh
+        clear_executables(name)
+        with ht.graph(g):
+            ids = ht.parallel_placeholder("int32", (4, 16),
+                                          pspec=P("dp", None), name="ids")
+            labels = ht.parallel_placeholder("int32", (4, 16),
+                                             pspec=P("dp", None),
+                                             name="labels")
+            model = GPTLMHeadModel(cfg)
+            loss = model(ids, labels)
+            op = optim.AdamOptimizer(lr=1e-3).minimize(loss)
+            rng = np.random.RandomState(0)
+            IDS = rng.randint(0, 128, (4, 16)).astype(np.int32)
+            g.run(loss, [loss, op], {ids: IDS, labels: IDS})
+        (handle,) = g.analysis_handles()
+        return handle
+
+    def test_tp_sp_graph_fully_explained(self, devices8):
+        handle = self._tp_train(devices8)
+        edges = handle.meta["pspec_edges"]
+        assert edges, "TP graph must yield pspec edges"
+        kinds = {e["kind"] for e in edges}
+        assert "all_reduce" in kinds          # row-parallel partials
+        rep = analyze_handle(handle, compile=True)
+        assert rep.findings == [], rep.findings
+        cov = rep.meta["edge_coverage"]
+        assert cov["total"] > 0 and cov["explained"] == cov["total"]
+        # GSPMD inserted real collectives and every one is attributed
+        assert sum(rep.meta["gspmd_collectives"].values()) > 0
+
+    def test_stale_pspec_edge_fires_unexplained_with_provenance(
+            self, devices8):
+        """Seeded violation: the graph's edges went stale (annotations
+        dropped after registration) — the emitted reshard has no
+        covering edge and must surface with the GSPMD counts."""
+        mesh = create_mesh({"dp": 8}, devices8)
+
+        def f(x):
+            x = lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P("dp", None)))
+            h = x * 2.0
+            # the smuggled constraint: a mid-graph gather no edge knows
+            h = lax.with_sharding_constraint(h, NamedSharding(mesh, P()))
+            return h.sum()
+
+        # healthy: the edge is declared -> silent
+        ok = _register(
+            "t_stale/ok", jax.jit(f), (_sds((16, 8)),),
+            mesh_axes={"dp": 8},
+            pspec_edges=[{"kind": "all_gather", "tensor": "h",
+                          "src_spec": "P(dp,None)", "dst_spec": "P()",
+                          "axes": ("dp",), "payload_bytes": 16 * 8 * 4}])
+        assert not _fired(analyze_handle(ok, compile=True),
+                          "unexplained-collective")
+        # stale: same program, the annotation/edge is gone
+        stale = _register("t_stale/bad", jax.jit(f), (_sds((16, 8)),),
+                          mesh_axes={"dp": 8}, pspec_edges=[])
+        rep = analyze_handle(stale, compile=True)
+        fired = _fired(rep, "unexplained-collective")
+        assert len(fired) == 1, rep.findings
+        assert fired[0].subject == "gspmd:all_gather"
+        assert "no edge predicts this kind" in fired[0].message
+        assert "pspec" in fired[0].hint
+
+    def test_stale_tp_boundary_graph_edge(self, devices8):
+        """TP-boundary-shaped graph: registration computes the row-
+        parallel all_reduce edge; wiping it (stale pspec) surfaces the
+        psum as unexplained."""
+        from hetu_tpu.nn.parallel import RowParallelLinear
+        ht.set_seed(12)
+        mesh = create_mesh({"dp": 2, "tp": 4}, devices8)
+        g = DefineAndRunGraph("t_tpstale")
+        g.mesh = mesh
+        clear_executables("t_tpstale")
+        with ht.graph(g):
+            x = ht.parallel_placeholder("float32", (4, 8, 16),
+                                        pspec=P("dp", None, None),
+                                        name="x")
+            layer = RowParallelLinear(16, 32, bias=False, name="row")
+            y = layer(x)
+            loss = ops.reduce_mean(y ** 2)
+            g.run([loss], feed_dict={
+                x: np.random.RandomState(0).randn(4, 8, 16)
+                .astype(np.float32)})
+        (h,) = g.analysis_handles()
+        assert any(e["kind"] == "all_reduce"
+                   for e in h.meta["pspec_edges"])
+        assert not _fired(analyze_handle(h, compile=True),
+                          "unexplained-collective")
+        h.meta["pspec_edges"] = []          # the annotations went stale
+        h.meta["scalar_fetches"] = 0
+        rep = analyze_handle(h, compile=True)
+        fired = _fired(rep, "unexplained-collective")
+        assert len(fired) == 1, rep.findings
+        assert fired[0].subject == "gspmd:all_reduce"
+
+
+# ---------------------------------------------------------------------------
+# grad-comm records match their tagged edges 1:1
+# ---------------------------------------------------------------------------
+
+class TestGradCommEdges:
+    def test_flat_int8_records_all_matched_by_tag(self, devices8):
+        mesh = create_mesh({"dp": 8}, devices8)
+        g = DefineAndRunGraph("t_gce")
+        g.mesh = mesh
+        clear_executables("t_gce")
+        with ht.graph(g):
+            x = ht.parallel_placeholder("float32", (16, 8),
+                                        pspec=P("dp", None), name="x")
+            y = ht.parallel_placeholder("float32", (16, 1),
+                                        pspec=P("dp", None), name="y")
+            w = ht.parameter(np.zeros((8, 1), np.float32), name="w")
+            loss = ops.reduce_mean((ops.matmul(x, w) - y) ** 2)
+            op = optim.AdamOptimizer(lr=1e-2, zero=2, grad_comm="int8",
+                                     flat_state=True).minimize(loss)
+            rng = np.random.RandomState(0)
+            g.run(loss, [loss, op],
+                  {x: rng.randn(16, 8).astype(np.float32),
+                   y: rng.randn(16, 1).astype(np.float32)})
+        (h,) = g.analysis_handles()
+        rep = analyze_handle(h, compile=True)
+        assert rep.findings == [], rep.findings
+        cov = rep.meta["edge_coverage"]
+        assert cov["explained"] == cov["total"] > 0
+        em = rep.meta["edge_match"]
+        # every explicit record found a TAGGED edge except the untagged
+        # scalar pmean (fetch-origin edge)
+        origins = {e.origin for _r, e in em.explained}
+        assert "grad_comm" in origins and "param_comm" in origins
+        for rec, edge in em.explained:
+            if edge.origin == "param_comm":
+                assert "param_comm" in rec.scope
+
+
+# ---------------------------------------------------------------------------
+# MoE: capacity rule + dropless/EP families
+# ---------------------------------------------------------------------------
+
+class TestMoECapacity:
+    def _meta(self, capacity, mode="capacity"):
+        return {"moe": [{"name": "moe.l0", "tokens": 64, "embed_dim": 32,
+                         "num_experts": 8, "k": 2, "capacity_factor": 1.0,
+                         "capacity": capacity, "dispatch_mode": mode,
+                         "ep_axis": "ep", "dtype": "float32"}]}
+
+    def test_capacity_tokens_helper(self):
+        from hetu_tpu.ops.moe_dispatch import capacity_tokens
+        assert capacity_tokens(64, 8, 2, 1.0) == 16
+        assert capacity_tokens(64, 8, 2, 1.25) == 20
+        assert capacity_tokens(10, 3, 1, 1.0) == 4    # ceil
+
+    def test_overprovision_fires_exactly_once(self):
+        from hetu_tpu.analysis import AnalysisContext, run_rules
+        # predicted capacity 16; dispatch built with 48 -> 3x the bytes
+        ctx = AnalysisContext(name="t_moe", meta=self._meta(48))
+        fired = run_rules(ctx, only=["moe-capacity-overprovision"])
+        assert len(fired) == 1, fired
+        assert fired[0].subject == "moe.l0"
+        assert "zero-padded" in fired[0].message
+        assert "dropless" in fired[0].hint
+        # exact capacity: silent
+        ctx2 = AnalysisContext(name="t_moe2", meta=self._meta(16))
+        assert not run_rules(ctx2, only=["moe-capacity-overprovision"])
+        # dropless mode: exempt even with nonsense capacity
+        ctx3 = AnalysisContext(name="t_moe3",
+                               meta=self._meta(999, mode="dropless"))
+        assert not run_rules(ctx3, only=["moe-capacity-overprovision"])
+
+    def test_ep_capacity_moe_fully_explained(self, devices8):
+        from hetu_tpu.nn.moe import make_moe_layer
+        ht.set_seed(13)
+        mesh = create_mesh({"ep": 8}, devices8)
+        g = DefineAndRunGraph("t_moe_ep")
+        g.mesh = mesh
+        clear_executables("t_moe_ep")
+        with ht.graph(g):
+            x = ht.parallel_placeholder("float32", (16, 32),
+                                        pspec=P(None, None), name="x")
+            moe = make_moe_layer(32, 64, num_experts=8, gate_type="topk",
+                                 k=2, capacity_factor=1.25, ep_axis="ep",
+                                 name="moe_ep")
+            out, aux = moe(x)
+            loss = ops.reduce_mean(out ** 2) + 0.01 * aux
+            g.run([loss], feed_dict={
+                x: np.random.RandomState(1).randn(16, 32)
+                .astype(np.float32)})
+        (h,) = g.analysis_handles()
+        (m,) = h.meta["moe"]
+        from hetu_tpu.ops.moe_dispatch import capacity_tokens
+        assert m["capacity"] == capacity_tokens(16, 8, 2, 1.25)
+        rep = analyze_handle(h, compile=True)
+        assert rep.findings == [], rep.findings
+        cov = rep.meta["edge_coverage"]
+        assert cov["explained"] == cov["total"] > 0
+
+    def test_dropless_moe_trains_under_explicit_sync(self, devices8):
+        """Satellite of the gate family: dropless MoE + explicit int8
+        sync runs in the manual-dp region and explains everything."""
+        from hetu_tpu.nn.moe import make_moe_layer
+        ht.set_seed(14)
+        mesh = create_mesh({"dp": 8}, devices8)
+        g = DefineAndRunGraph("t_moe_flat")
+        g.mesh = mesh
+        clear_executables("t_moe_flat")
+        with ht.graph(g):
+            x = ht.parallel_placeholder("float32", (16, 32),
+                                        pspec=P("dp", None), name="x")
+            moe = make_moe_layer(32, 64, num_experts=4, gate_type="topk",
+                                 k=2, dispatch_mode="dropless",
+                                 name="moe")
+            out, aux = moe(x)
+            loss = ops.reduce_mean(out ** 2) + 0.01 * aux
+            op = optim.AdamOptimizer(lr=1e-2, zero=1,
+                                     grad_comm="int8").minimize(loss)
+            g.run(loss, [loss, op],
+                  {x: np.random.RandomState(2).randn(16, 32)
+                   .astype(np.float32)})
+            assert g._grad_comm_active, g._grad_comm_fallback
+        (h,) = g.analysis_handles()
+        (m,) = h.meta["moe"]
+        assert m["dispatch_mode"] == "dropless" and m["capacity"] is None
+        rep = analyze_handle(h, compile=True)
+        assert rep.findings == [], rep.findings
+        cov = rep.meta["edge_coverage"]
+        assert cov["explained"] == cov["total"] > 0
+
+
+# ---------------------------------------------------------------------------
+# MPMD pipeline stages under the lint (gpt_mpmd-shaped)
+# ---------------------------------------------------------------------------
+
+class TestMPMDPipelineLint:
+    def test_stage_programs_fully_explained(self, devices8):
+        from hetu_tpu.models.gpt import GPTConfig
+        from hetu_tpu.models.gpt_mpmd import MPMDGPT
+        devs = np.array(devices8).reshape(2, 2, 2)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=4, max_seq_len=16, dropout=0.0,
+                        activation="gelu", norm="layernorm",
+                        position="learned", sp=False)
+        m = MPMDGPT(cfg, stage_layers=[[1, 1]],
+                    meshes=[[Mesh(devs[0], ("dp", "tp")),
+                             Mesh(devs[1], ("dp", "tp"))]], seed=3)
+        names = m.register_analysis("t_mpmd", batch=4, seq=16)
+        assert len(names) == 2
+        last = analysis.get_executable(names[-1])
+        assert last.meta["train"]             # fused loss+grads program
+        assert last.meta["declared_edges"]
+        for n in names:
+            rep = analyze_handle(analysis.get_executable(n),
+                                 compile=True)
+            assert rep.findings == [], (n, rep.findings)
+            cov = rep.meta["edge_coverage"]
+            assert cov["explained"] == cov["total"] > 0, (n, cov)
+
+
+# ---------------------------------------------------------------------------
+# baseline gate mechanics for the new fields + CLI exit codes
+# ---------------------------------------------------------------------------
+
+class TestEdgeBaselineGate:
+    def _rep(self, coverage=None, gspmd=None):
+        from hetu_tpu.analysis import AnalysisReport, ExecutableReport
+        rep = AnalysisReport()
+        ex = ExecutableReport(name="exe")
+        if coverage is not None:
+            ex.meta["edge_coverage"] = coverage
+        if gspmd is not None:
+            ex.meta["gspmd_collectives"] = gspmd
+        rep.add(ex)
+        return rep
+
+    def test_gspmd_count_regression_fails(self):
+        base = self._rep(gspmd={"all_gather": 2}).to_dict()
+        assert not self._rep(gspmd={"all_gather": 2}) \
+            .check_against_baseline(base)
+        probs = self._rep(gspmd={"all_gather": 3}) \
+            .check_against_baseline(base)
+        assert probs and "GSPMD-inserted all_gather" in probs[0]
+        # improvement passes
+        assert not self._rep(gspmd={"all_gather": 1}) \
+            .check_against_baseline(base)
+
+    def test_coverage_drop_fails(self):
+        base = self._rep(coverage={"explained": 5, "total": 5}).to_dict()
+        assert not self._rep(coverage={"explained": 5, "total": 5}) \
+            .check_against_baseline(base)
+        probs = self._rep(coverage={"explained": 4, "total": 5}) \
+            .check_against_baseline(base)
+        assert probs and "unexplained collectives regressed" in probs[0]
+
+    def test_cli_exit_2_on_missing_baseline_before_build(self, tmp_path):
+        """Exit code 2, and FAST: the check runs before the expensive
+        executable build."""
+        import io
+        from hetu_tpu.analysis.cli import run_gate
+        buf = io.StringIO()
+        rc = run_gate(baseline_path=str(tmp_path / "nope.json"),
+                      out=buf)
+        assert rc == 2
+        assert "--update-baseline" in buf.getvalue()
+
+
+class TestMatchSemantics:
+    def test_tagged_edge_requires_tag_untagged_falls_back(self):
+        from hetu_tpu.analysis import CollectiveRecord
+        rec_tagged = CollectiveRecord(
+            kind="all_gather", axes=("dp",), dtype="bfloat16",
+            payload_bytes=1024, wire_bytes=1.0,
+            scope="param_comm/bucket0")
+        rec_plain = CollectiveRecord(
+            kind="all_reduce", axes=("dp",), dtype="float32",
+            payload_bytes=4, wire_bytes=1.0, scope="")
+        edges = [CommEdge(kind="all_gather", tag="param_comm"),
+                 CommEdge(kind="all_reduce", origin="fetch")]
+        m = match_edges([rec_tagged, rec_plain], "", "", edges,
+                        train=True)
+        assert not m.unexplained_records
+        by_rec = {id(r): e for r, e in m.explained}
+        assert by_rec[id(rec_tagged)].tag == "param_comm"
+        assert by_rec[id(rec_plain)].origin == "fetch"
+        # a record whose kind no edge covers stays unexplained
+        rec_odd = CollectiveRecord(
+            kind="all_to_all", axes=("dp",), dtype="int8",
+            payload_bytes=8, wire_bytes=1.0, scope="")
+        m2 = match_edges([rec_odd], "", "", edges, train=True)
+        assert m2.unexplained_records == [rec_odd]
+
+    def test_strict_allowed_gspmd_claim_stays_exact(self):
+        """An executable with allowed_gspmd={} (the flat train step)
+        keeps zero-tolerance GSPMD accounting even with generous
+        edges."""
+        lowered = ""
+        compiled = "all-gather(x) all-gather(y)"
+        edges = [CommEdge(kind="all_gather", count=10)]
+        strict = match_edges([], lowered, compiled, edges, train=True,
+                             allowed_gspmd={})
+        assert strict.gspmd_unexplained.get("all_gather") == (2, 0)
+        loose = match_edges([], lowered, compiled, edges, train=True,
+                            allowed_gspmd=None)
+        assert "all_gather" in loose.gspmd_explained
