@@ -566,20 +566,27 @@ def bench_lint_graph() -> dict:
 
 
 def bench_serving_microbench() -> dict:
-    """Serving microbench (ISSUE 2): dense-cache ``generate()`` vs the
-    paged continuous-batching engine on a GPT-2-small-proportioned model
-    with mixed-length prompts (64/512/1024 + short traffic).
+    """Serving microbench v2 (ISSUE 6): dense-cache ``generate()`` vs
+    the UNIFIED ragged prefill+decode engine on a GPT-2-small-
+    proportioned model with mixed-length prompts (64/512/1024 + short
+    traffic).
 
-    Reports per-request KV HBM bytes HELD (dense: every request pays the
-    padded ``[B, max_len]`` cache; paged: ``peak_pages * page_bytes``),
-    tokens/s for both paths, and the engine's compiled-executable count
-    (must stay <= the shape-bucket grid).  The KV accounting is analytic
-    from shapes — valid off-hardware; wall times on CPU are a relative
-    sanity signal only.  Layer count/width are scaled down
+    v2 reports, per path, BOTH a cold trace (includes XLA compile — what
+    the v1 numbers measured) and a steady-state trace (compile
+    amortized — what a long-running service sees), plus the unified
+    engine's executable-call count, compile count (must be <= 2: the
+    unified step + optional warmup — the old bucket grid compiled
+    O(prefill buckets x batch buckets)), per-request KV HBM bytes held,
+    and the per-stage TTFT/TBT latency histograms
+    (``utils/metrics.py`` Prometheus buckets).  The KV accounting is
+    analytic from shapes — valid off-hardware; wall times on CPU are a
+    relative signal only.  Layer count/width are scaled down
     (HETU_TPU_SERVE_BENCH_{HIDDEN,LAYERS} to override) so the CPU run
-    finishes in seconds; the footprint ratio is width-independent.
+    finishes in seconds.
 
-    Writes BENCH_SERVING.json next to this file and returns the dict.
+    Writes BENCH_SERVING.json next to this file (keeping the previous
+    bucketed-engine numbers under a ``v1`` key for the trajectory) and
+    returns the dict.
     """
     code = (
         "import os, sys, json, time\n"
@@ -612,6 +619,7 @@ def bench_serving_microbench() -> dict:
         "    state[f'h{i}.mlp.down.weight'] = w(H, f)\n"
         "lens = [64, 64, 512, 64, 1024, 64]\n"
         "new = 32\n"
+        "n_tok = len(lens) * new\n"
         "prompts = [rng.randint(1, V, size=n).tolist() for n in lens]\n"
         "kv_itemsize = 4\n"
         "\n"
@@ -621,52 +629,84 @@ def bench_serving_microbench() -> dict:
         "for i, p in enumerate(prompts):\n"
         "    batch[i, :len(p)] = p\n"
         "t0 = time.perf_counter()\n"
-        "out = np.asarray(generate(state, cfg, batch, new))\n"
-        "dense_wall = time.perf_counter() - t0\n"
-        "dense_tokens = len(lens) * new\n"
+        "np.asarray(generate(state, cfg, batch, new))\n"
+        "dense_cold = time.perf_counter() - t0\n"
+        "# steady state = best of 3 (kills 2-core scheduler noise; same\n"
+        "# treatment for both paths)\n"
+        "dense_warm = float('inf')\n"
+        "for _ in range(3):\n"
+        "    t0 = time.perf_counter()\n"
+        "    np.asarray(generate(state, cfg, batch, new))\n"
+        "    dense_warm = min(dense_warm, time.perf_counter() - t0)\n"
         "dense_bytes_per_req = 2 * L * (smax + new) * NKV * hd * kv_itemsize\n"
         "\n"
-        "# -- paged engine: continuous batching over the page pool --\n"
+        "# -- unified engine: ONE ragged prefill+decode executable --\n"
         "eng = Engine(state, cfg, num_pages=24, page_size=128,\n"
-        "             max_batch=8)\n"
+        "             max_batch=8, max_model_len=smax + new,\n"
+        "             chunk_size=128, prefill_rows=2)\n"
         "t0 = time.perf_counter()\n"
         "reqs = [eng.add_request(p, new, arrival_time=0.0)\n"
         "        for p in prompts]\n"
         "eng.run()\n"
-        "paged_wall = time.perf_counter() - t0\n"
-        "paged_tokens = sum(r.n_generated for r in reqs)\n"
+        "cold_wall = time.perf_counter() - t0\n"
         "paged_bytes = [r.peak_pages * eng.pool.page_bytes for r in reqs]\n"
-        "m = eng.metrics_summary()\n"
-        "pre_b = sorted(k[1] for k in eng._compiled if k[0] == 'prefill')\n"
-        "dec_b = sorted(k[1] for k in eng._compiled if k[0] == 'decode')\n"
+        "mc = eng.metrics_summary()        # COLD-trace metrics (incl.\n"
+        "                                  # compile -- what v1 measured)\n"
+        "# steady state: same trace on the warm executable, fresh\n"
+        "# metrics, best of 3 (same treatment as dense)\n"
+        "warm_wall = float('inf')\n"
+        "for _ in range(3):\n"
+        "    eng.reset_metrics()\n"
+        "    t0 = time.perf_counter()\n"
+        "    reqs = [eng.add_request(p, new, arrival_time=0.0)\n"
+        "            for p in prompts]\n"
+        "    eng.run()\n"
+        "    warm_wall = min(warm_wall, time.perf_counter() - t0)\n"
+        "m = eng.metrics_summary()         # STEADY metrics (last replay)\n"
         "res = {\n"
         "  'model': {'hidden': H, 'layers': L, 'heads': NH,\n"
         "            'kv_heads': NKV, 'vocab': V},\n"
         "  'prompt_lens': lens, 'max_new_tokens': new,\n"
         "  'page_size': eng.pool.page_size,\n"
-        "  'dense': {'tokens_per_sec': round(dense_tokens / dense_wall, 1),\n"
-        "            'wall_s': round(dense_wall, 2),\n"
+        "  'chunk_size': eng.scheduler.chunk,\n"
+        "  'prefill_rows': eng.scheduler.prefill_rows,\n"
+        "  'token_budget': eng.scheduler.token_budget,\n"
+        "  'dense': {'tokens_per_sec': round(n_tok / dense_cold, 1),\n"
+        "            'tokens_per_sec_steady': round(n_tok / dense_warm, 1),\n"
+        "            'wall_s': round(dense_cold, 2),\n"
+        "            'wall_s_steady': round(dense_warm, 2),\n"
         "            'kv_bytes_per_req': dense_bytes_per_req,\n"
         "            'recompiles': 1},\n"
-        "  'paged': {'tokens_per_sec': round(paged_tokens / paged_wall, 1),\n"
-        "            'wall_s': round(paged_wall, 2),\n"
-        "            'kv_bytes_per_req_mean': int(np.mean(paged_bytes)),\n"
-        "            'kv_bytes_per_req': paged_bytes,\n"
-        "            'recompiles': int(m['compile_count']),\n"
-        "            'prefill_buckets': pre_b, 'decode_buckets': dec_b,\n"
-        "            'decode_steps': int(m['decode_steps']),\n"
-        "            'preemptions': int(m['preemptions']),\n"
-        "            'ttft_p90_ms': round(m['ttft']['p90'] * 1e3, 1)},\n"
+        "  'unified': {\n"
+        "    # cold = first trace incl. XLA compile (the v1-comparable\n"
+        "    # numbers); steady = best-of-3 warm replay of the same trace\n"
+        "    'cold': {'tokens_per_sec': round(n_tok / cold_wall, 1),\n"
+        "             'wall_s': round(cold_wall, 2),\n"
+        "             'ttft_p90_ms': round(mc['ttft']['p90'] * 1e3, 1),\n"
+        "             'executable_calls': int(mc['executable_calls']),\n"
+        "             'preemptions': int(mc['preemptions'])},\n"
+        "    'steady': {'tokens_per_sec': round(n_tok / warm_wall, 1),\n"
+        "               'wall_s': round(warm_wall, 2),\n"
+        "               'ttft_p90_ms': round(m['ttft']['p90'] * 1e3, 1),\n"
+        "               'tbt_p50_ms': round(m['tbt']['p50'] * 1e3, 1),\n"
+        "               'tbt_p90_ms': round(m['tbt']['p90'] * 1e3, 1),\n"
+        "               'ttft_buckets': m['ttft_buckets'],\n"
+        "               'tbt_buckets': m['tbt_buckets'],\n"
+        "               'executable_calls': int(m['executable_calls']),\n"
+        "               'decode_steps': int(m['decode_steps']),\n"
+        "               'prefill_chunks': int(m['prefill_chunks'])},\n"
+        "    'kv_bytes_per_req_mean': int(np.mean(paged_bytes)),\n"
+        "    'kv_bytes_per_req': paged_bytes,\n"
+        "    'compile_count': int(m['compile_count']),\n"
+        "    'host_logit_fetches': int(m['host_logit_fetches'])},\n"
         "}\n"
         "res['kv_bytes_ratio_dense_vs_paged'] = round(\n"
         "    dense_bytes_per_req / np.mean(paged_bytes), 2)\n"
-        "# bound from the THEORETICAL bucket grid (pow2 batch sizes up\n"
-        "# to max_batch, pow2 page counts up to max_pages_per_seq) --\n"
-        "# not from the observed cache, which would be a tautology\n"
-        "grid_bound = (int(np.log2(8)) + 1 +\n"
-        "              int(np.ceil(np.log2(eng.max_pages_per_seq))) + 1)\n"
-        "res['recompile_bound_bucket_grid'] = grid_bound\n"
-        "res['recompiles_bounded'] = m['compile_count'] <= grid_bound\n"
+        "res['steady_speedup_vs_dense'] = round(\n"
+        "    dense_warm / warm_wall, 2)\n"
+        "# the contract the CI guard pins: ONE executable (+ optional\n"
+        "# warmup) over the whole mixed trace -- vs the v1 bucket grid\n"
+        "res['compile_count_ok'] = m['compile_count'] <= 2\n"
         "print(json.dumps(res))\n"
     )
     env = dict(os.environ)
@@ -685,6 +725,18 @@ def bench_serving_microbench() -> dict:
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_SERVING.json")
     try:
+        prev = {}
+        try:
+            with open(out_path) as fh:
+                prev = json.load(fh)
+        except Exception:
+            pass
+        # keep the bucketed-engine trajectory: the first refreeze nests
+        # the old numbers under "v1"; later refreezes carry it forward
+        if "v1" in prev:
+            result["v1"] = prev["v1"]
+        elif "paged" in prev:
+            result["v1"] = prev
         with open(out_path, "w") as fh:
             json.dump(result, fh, indent=1)
     except Exception:

@@ -48,7 +48,7 @@ def serve_demo(state, cfg, args):
         prompt = [int(period[(phase + j) % 4]) for j in range(plen)]
         reqs.append(eng.add_request(
             prompt, max_new_tokens=int(rng.randint(6, 14)),
-            temperature=args.temperature,
+            temperature=args.temperature, top_p=args.top_p, seed=i,
             arrival_time=time.monotonic() + i * args.serve_stagger))
     eng.run()
     wall = time.monotonic() - t0
@@ -71,9 +71,10 @@ def serve_demo(state, cfg, args):
     m = eng.metrics_summary()
     print(f"served {n} requests / {total_new} tokens in {wall:.2f}s "
           f"({total_new / wall:.1f} tok/s aggregate)")
-    print(f"engine: {int(m['decode_steps'])} decode steps, "
+    print(f"engine: {int(m['executable_calls'])} unified-step calls, "
           f"{int(m['preemptions'])} preemptions, "
-          f"{int(m['compile_count'])} compiled executables, "
+          f"{int(m['compile_count'])} compiled executable(s), "
+          f"{int(m['host_logit_fetches'])} host logit fetches, "
           f"ttft p90 {m['ttft']['p90'] * 1e3:.1f} ms")
     if args.temperature == 0.0:
         print("self-check OK: every served request matches its solo "
@@ -86,6 +87,8 @@ def main():
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--ckpt", type=str, default="")
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-p", type=float, default=0.0,
+                    help="nucleus sampling mass (on-device; 0 disables)")
     ap.add_argument("--serve", action="store_true",
                     help="after training, push staggered requests "
                          "through the continuous-batching engine")

@@ -4,7 +4,7 @@ Hetu's core claim is that sharding annotations (``DistributedStates`` /
 PartitionSpecs) *deterministically imply* the communication a program
 performs.  This package makes the whole lowered program checkable
 against that claim, generalizing PR 1's gradient-sync verifier to every
-registered executable (train steps, serving prefill/decode, pipeline
+registered executable (train steps, the unified serving step, pipeline
 stages):
 
 * **collective inventory** — :mod:`.jaxpr_walk` walks the closed jaxpr

@@ -5,8 +5,9 @@ so the gate runs on CPU in CI:
 
 * ``gate_train``   — GPT-2-small-shaped train step, pure-dp mesh,
   ZeRO-2 + flat state + explicit int8 grad sync;
-* ``gate_serving`` — prefill/decode of a small continuous-batching
-  engine over the paged KV pool;
+* ``gate_serving`` — the unified ragged prefill+decode step of a small
+  continuous-batching engine over the paged KV pool (ONE executable;
+  the v1 bucketed prefill/decode grid is gone);
 * ``gate_tp``      — a TP/SP train graph (dp=2 x tp=4, Megatron-SP
   layers from ``nn/parallel.py``), implicit GSPMD sync;
 * ``gate_pipe``    — a pipeline run, both ways: MPMD per-stage programs
@@ -206,7 +207,7 @@ def build_gate_executables():
         assert gm._grad_comm_active, gm._grad_comm_fallback
     names.append("gate_moe/plan0")
 
-    # -- serving: prefill + decode over the paged pool -----------------
+    # -- serving: ONE unified ragged prefill+decode executable ---------
     ht.set_seed(1)
     scfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
                      num_heads=4, max_seq_len=64)
@@ -217,15 +218,16 @@ def build_gate_executables():
                  smodel.state_dict().items()}
     clock = [0.0]
     eng = Engine(state, scfg, num_pages=16, page_size=8, max_batch=4,
-                 name="gate_serving", time_fn=lambda: clock[0])
+                 chunk_size=4, name="gate_serving",
+                 time_fn=lambda: clock[0])
     eng.add_request([1, 2, 3, 4, 5], max_new_tokens=4)
     eng.add_request([7, 8, 9], max_new_tokens=4)
     while eng.has_work:
         eng.step()
         clock[0] += 1.0
     eng.pool.check_invariants()
-    return names + sorted(
-        f"gate_serving/{k}-{b}" for k, b in eng._compiled)
+    assert eng.compile_count == 1, "the bucket grid came back"
+    return names + sorted(f"gate_serving/{k}" for k in eng._compiled)
 
 
 def explain_report(report, out=sys.stdout) -> None:

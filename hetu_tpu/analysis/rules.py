@@ -460,6 +460,24 @@ def _trash_page_write(ctx: AnalysisContext) -> List[Finding]:
     ps = pool.page_size if pool is not None else \
         ctx.serving.get("page_size", 1)
     for step, rec in enumerate(ctx.serving.get("tap", ())):
+        if rec.get("kind") == "unified":
+            # ragged packed step: each live row writes q_len tokens at
+            # positions [pos, pos + q_len) through its page table — none
+            # of those slots may resolve to the trash page
+            pt = np.asarray(rec.get("page_tables"))
+            for row, pos, qlen in rec.get("rows", ()):
+                for t in range(int(qlen)):
+                    if pt[int(row), (int(pos) + t) // ps] == TRASH_PAGE:
+                        out.append(Finding(
+                            rule="", subject=f"unified@{step}/row{row}",
+                            severity="error",
+                            message=f"unified step at tap step {step}: "
+                                    f"LIVE row {row} (pos {int(pos) + t})"
+                                    f" scatter-writes page 0 outside the"
+                                    f" padding path — its KV history is "
+                                    f"being destroyed"))
+                        break
+            continue
         if rec.get("kind") == "prefill":
             if TRASH_PAGE in rec.get("pages", ()):
                 out.append(Finding(

@@ -1,0 +1,281 @@
+"""Ragged paged attention: mixed prefill chunks + decode in ONE kernel.
+
+The serving engine's v1 split (``ops/paged_attention.py`` decode kernel
++ a dense bucketed prefill) pays a compile-grid tax: every prompt-length
+bucket and every decode-batch bucket is its own executable, and each
+admitted request runs its own prefill call.  This op collapses the two
+phases into one program over a **ragged batch** — the Ragged Paged
+Attention recipe (PAPERS.md, arxiv 2604.15464):
+
+- the query side is a flat token axis ``q [T, nh, hd]`` holding every
+  scheduled token this step: prefill *chunks* (Sarathi-style slices of a
+  long prompt) and decode tokens side by side;
+- raggedness is described by four per-sequence int32 arrays that ride
+  in as **scalar prefetch** on TPU:
+
+  ===============  =======================================================
+  ``q_lens   [S]``  query tokens this step (0 = padding row)
+  ``cu_q   [S+1]``  cumulative query offsets: row i owns
+                    ``q[cu_q[i] : cu_q[i] + q_lens[i]]``
+  ``page_tables``   ``[S, maxp]`` physical KV page ids (padding slots
+                    point at the reserved trash page)
+  ``ctx_lens [S]``  total KV length *including* this step's tokens
+  ===============  =======================================================
+
+- a decode row is simply the degenerate ``q_lens[i] == 1`` case — no
+  separate code path, no separate executable;
+- causal masking is *within* each row's query span: query j of row i
+  sits at absolute position ``ctx_lens[i] - q_lens[i] + j`` and attends
+  every KV position at or before it.
+
+Two implementations with the same contract:
+
+- ``ragged_paged_attention_reference`` — per-row gather of the page
+  table into a contiguous ``[maxp*ps, kvh, hd]`` view + masked dense
+  attention over a static ``max_q``-wide query window (CPU oracle).
+- ``ragged_paged_attention_pallas`` — Pallas TPU kernel, grid
+  ``(kvh, S, maxp)`` with pages innermost.  The k/v BlockSpec index
+  maps read the prefetched page table (one physical-page DMA per grid
+  step), ``pl.when`` skips pages past ``ctx_lens`` and whole padding
+  rows, and the online-softmax state is carried in VMEM scratch.  The
+  query window is loaded with a dynamic ``pl.ds`` slice at ``cu_q[i]``
+  and the output window is committed read-modify-write so ragged row
+  boundaries never clobber a neighbour.  Runs in interpret mode off-TPU.
+
+``max_q`` (the static query-window bound) is the scheduler's prefill
+chunk size: every row owns at most ``max_q`` query tokens.  Inputs are
+padded by ``max_q`` rows internally so the window slide never reads out
+of bounds.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .paged_attention import (DEFAULT_MASK_VALUE, LANES, SUBLANES, _on_tpu)
+
+
+def _check_ragged_shapes(q, k_pages, v_pages, q_lens, cu_q, page_tables,
+                         ctx_lens, max_q):
+    t, nh, hd = q.shape
+    p_, ps, kvh, hd2 = k_pages.shape
+    if v_pages.shape != k_pages.shape:
+        raise ValueError(f"k_pages {k_pages.shape} != v_pages "
+                         f"{v_pages.shape}")
+    if hd != hd2:
+        raise ValueError(f"head_dim mismatch: q {hd} vs pages {hd2}")
+    if nh % kvh != 0:
+        raise ValueError(f"num_heads {nh} not divisible by kv_heads {kvh}")
+    s = q_lens.shape[0]
+    if cu_q.shape != (s + 1,):
+        raise ValueError(f"cu_q must be [S+1]={s + 1}, got {cu_q.shape}")
+    if page_tables.ndim != 2 or page_tables.shape[0] != s:
+        raise ValueError(f"page_tables must be [S, maxp], got "
+                         f"{page_tables.shape}")
+    if ctx_lens.shape != (s,):
+        raise ValueError(f"ctx_lens must be [S], got {ctx_lens.shape}")
+    if not 1 <= int(max_q):
+        raise ValueError(f"max_q must be >= 1, got {max_q}")
+    return t, nh, hd, ps, kvh, s
+
+
+# ---------------------------------------------------------------------------
+# reference path (CPU / oracle)
+# ---------------------------------------------------------------------------
+
+def ragged_paged_attention_reference(q: jax.Array, k_pages: jax.Array,
+                                     v_pages: jax.Array, q_lens: jax.Array,
+                                     cu_q: jax.Array,
+                                     page_tables: jax.Array,
+                                     ctx_lens: jax.Array, *, max_q: int,
+                                     softmax_scale: Optional[float] = None
+                                     ) -> jax.Array:
+    """Dense oracle for the ragged contract: per row, gather its pages
+    in position order and run masked fp32 attention over a static
+    ``max_q`` query window at ``cu_q[i]``.  Returns ``[T, nh, hd]``;
+    rows' padding windows never leak into neighbouring rows (masked
+    read-modify-write, mirroring the kernel)."""
+    t, nh, hd, ps, kvh, s = _check_ragged_shapes(
+        q, k_pages, v_pages, q_lens, cu_q, page_tables, ctx_lens, max_q)
+    maxp = page_tables.shape[1]
+    g = nh // kvh
+    scale = softmax_scale if softmax_scale is not None else hd ** -0.5
+    kk = maxp * ps
+    kv_pos = jnp.arange(kk)
+    qp = jnp.pad(q, ((0, max_q), (0, 0), (0, 0)))
+    out = jnp.zeros_like(qp)
+    with jax.named_scope("ragged_paged_attention"):
+        for i in range(s):
+            start, qlen, ctx = cu_q[i], q_lens[i], ctx_lens[i]
+            qi = lax.dynamic_slice(qp, (start, 0, 0), (max_q, nh, hd))
+            qg = qi.reshape(max_q, kvh, g, hd).astype(jnp.float32)
+            k = k_pages[page_tables[i]].reshape(kk, kvh, hd)
+            v = v_pages[page_tables[i]].reshape(kk, kvh, hd)
+            sc = jnp.einsum("qhgd,khd->qhgk", qg,
+                            k.astype(jnp.float32)) * scale
+            qpos = (ctx - qlen) + jnp.arange(max_q)       # absolute pos
+            valid = kv_pos[None, :] <= qpos[:, None]      # causal in-row
+            sc = jnp.where(valid[:, None, None, :], sc,
+                           DEFAULT_MASK_VALUE)
+            pr = jax.nn.softmax(sc, axis=-1)
+            o = jnp.einsum("qhgk,khd->qhgd", pr,
+                           v.astype(jnp.float32))
+            o = o.reshape(max_q, nh, hd).astype(q.dtype)
+            rowv = jnp.arange(max_q) < qlen
+            cur = lax.dynamic_slice(out, (start, 0, 0), (max_q, nh, hd))
+            out = lax.dynamic_update_slice(
+                out, jnp.where(rowv[:, None, None], o, cur),
+                (start, 0, 0))
+    return out[:t]
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+def _ragged_kernel(ql_ref, cu_ref, pt_ref, cl_ref,    # scalar prefetch
+                   q_ref, k_ref, v_ref,               # inputs
+                   o_ref,                             # output
+                   m_scr, l_scr, acc_scr,             # scratch
+                   *, scale: float, ps: int, maxp: int, max_q: int,
+                   gp: int):
+    i = pl.program_id(1)
+    p = pl.program_id(2)
+    qlen = ql_ref[i]
+    start = cu_ref[i]
+    ctx = cl_ref[i]
+    mqg = max_q * gp
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, DEFAULT_MASK_VALUE)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(jnp.logical_and(qlen > 0, p * ps < ctx))
+    def _page():
+        q = q_ref[pl.ds(start, max_q), 0].astype(jnp.float32)
+        q2 = q.reshape(mqg, q.shape[-1])               # [max_q*gp, hd]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)      # [ps, hd]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        row_q = lax.broadcasted_iota(jnp.int32, (mqg, ps), 0) // gp
+        cols = p * ps + lax.broadcasted_iota(jnp.int32, (mqg, ps), 1)
+        qpos = (ctx - qlen) + row_q                    # absolute position
+        s = jnp.where(cols <= qpos, s, DEFAULT_MASK_VALUE)
+        m_prev = m_scr[:, 0]                           # [mqg]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)
+        pexp = jnp.exp(s - m_cur[:, None])             # [mqg, ps]
+        l_cur = l_scr[:, 0] * alpha + jnp.sum(pexp, axis=1)
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + lax.dot_general(
+            pexp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_cur[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_cur[:, None], l_scr.shape)
+
+    @pl.when(p == maxp - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)                # empty rows -> 0
+        o = (acc_scr[...] / l[:, None]).reshape(max_q, gp,
+                                                acc_scr.shape[-1])
+        # ragged row boundaries are not block-aligned: commit the window
+        # read-modify-write so the padded tail of this row's window never
+        # clobbers the next row's (already- or not-yet-written) tokens
+        prev = o_ref[pl.ds(start, max_q), 0]
+        rowv = lax.broadcasted_iota(jnp.int32, (max_q, 1, 1), 0) < qlen
+        o_ref[pl.ds(start, max_q), 0] = jnp.where(
+            rowv, o.astype(o_ref.dtype), prev)
+
+
+def ragged_paged_attention_pallas(q: jax.Array, k_pages: jax.Array,
+                                  v_pages: jax.Array, q_lens: jax.Array,
+                                  cu_q: jax.Array, page_tables: jax.Array,
+                                  ctx_lens: jax.Array, *, max_q: int,
+                                  softmax_scale: Optional[float] = None,
+                                  interpret: Optional[bool] = None
+                                  ) -> jax.Array:
+    """Pallas ragged paged attention (same contract as the reference).
+
+    Grid is ``(kvh, S, maxp)`` with pages innermost (sequential on TPU);
+    the query/output windows live in a full-token-axis VMEM block while
+    k/v index maps read the prefetched page table so each grid step DMAs
+    exactly one physical page — pages past ``ctx_lens[i]`` and whole
+    padding rows are skipped with ``pl.when``.
+    """
+    t, nh, hd, ps, kvh, s = _check_ragged_shapes(
+        q, k_pages, v_pages, q_lens, cu_q, page_tables, ctx_lens, max_q)
+    maxp = page_tables.shape[1]
+    scale = softmax_scale if softmax_scale is not None else hd ** -0.5
+    if interpret is None:
+        interpret = not _on_tpu()
+    g = nh // kvh
+    gp = max(SUBLANES, ((g + SUBLANES - 1) // SUBLANES) * SUBLANES)
+    t_pad = t + max_q                       # window slide never OOB
+    qg = q.reshape(t, kvh, g, hd)
+    qg = jnp.pad(qg, ((0, max_q), (0, 0), (0, gp - g), (0, 0)))
+    kernel = functools.partial(_ragged_kernel, scale=float(scale), ps=ps,
+                               maxp=maxp, max_q=int(max_q), gp=gp)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(kvh, s, maxp),
+        in_specs=[
+            pl.BlockSpec((t_pad, 1, gp, hd),
+                         lambda h, i, p, ql, cu, pt, cl: (0, h, 0, 0)),
+            pl.BlockSpec((1, ps, 1, hd),
+                         lambda h, i, p, ql, cu, pt, cl: (pt[i, p], 0, h,
+                                                          0)),
+            pl.BlockSpec((1, ps, 1, hd),
+                         lambda h, i, p, ql, cu, pt, cl: (pt[i, p], 0, h,
+                                                          0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (t_pad, 1, gp, hd),
+            lambda h, i, p, ql, cu, pt, cl: (0, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((max_q * gp, LANES), jnp.float32),
+            pltpu.VMEM((max_q * gp, LANES), jnp.float32),
+            pltpu.VMEM((max_q * gp, hd), jnp.float32),
+        ],
+    )
+    with jax.named_scope("ragged_paged_attention"):
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((t_pad, kvh, gp, hd), q.dtype),
+            interpret=interpret,
+        )(q_lens.astype(jnp.int32), cu_q.astype(jnp.int32),
+          page_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
+          qg, k_pages, v_pages)
+    return out[:t, :, :g, :].reshape(t, nh, hd)
+
+
+def ragged_paged_attention(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, q_lens: jax.Array,
+                           cu_q: jax.Array, page_tables: jax.Array,
+                           ctx_lens: jax.Array, *, max_q: int,
+                           softmax_scale: Optional[float] = None,
+                           use_kernel: Optional[bool] = None) -> jax.Array:
+    """Dispatching entry point: Pallas kernel on TPU, gather-dense
+    reference elsewhere (``ops.sdpa`` / ``paged_attention_decode``
+    dispatch discipline)."""
+    if use_kernel is None:
+        use_kernel = _on_tpu()
+    if use_kernel:
+        try:
+            return ragged_paged_attention_pallas(
+                q, k_pages, v_pages, q_lens, cu_q, page_tables, ctx_lens,
+                max_q=max_q, softmax_scale=softmax_scale)
+        except Exception:
+            pass
+    return ragged_paged_attention_reference(
+        q, k_pages, v_pages, q_lens, cu_q, page_tables, ctx_lens,
+        max_q=max_q, softmax_scale=softmax_scale)

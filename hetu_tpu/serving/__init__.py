@@ -1,13 +1,17 @@
-"""Serving subsystem: paged KV-cache pool + continuous-batching engine.
+"""Serving subsystem: paged KV-cache pool + continuous-batching engine
+driving ONE unified ragged prefill+decode executable.
 
     from hetu_tpu.serving import Engine
 
-    eng = Engine(state, cfg, num_pages=128, page_size=64, max_batch=8)
-    req = eng.add_request(prompt_ids, max_new_tokens=64)
+    eng = Engine(state, cfg, num_pages=128, page_size=64, max_batch=8,
+                 chunk_size=64, prefill_rows=1)
+    req = eng.add_request(prompt_ids, max_new_tokens=64,
+                          temperature=0.8, top_p=0.95, seed=7)
     outputs = eng.run()            # {req_id: generated token list}
 
-See DESIGN.md §8 for the page-size/TP-tiling rationale, the
-prefill/decode executable split, and the shape-bucket policy.
+See DESIGN.md §8 for the page-size/TP-tiling rationale and §12 for the
+unified ragged step (token-budget packing, chunked prefill, on-device
+temperature/top-k/top-p sampling, the one-executable compile contract).
 """
 from .engine import Engine
 from .kv_pool import PagedKVPool, TRASH_PAGE

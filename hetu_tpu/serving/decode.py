@@ -1,23 +1,37 @@
-"""Jitted serving executables: bucketed prefill + paged decode step.
+"""The unified serving executable: one jit for ragged prefill + decode.
 
-Prefill and decode are SEPARATE compiled programs (DESIGN.md §8): a
-prefill is one big [1, s_pad] forward whose arithmetic intensity keeps
-the MXU busy, while a decode step is a [B, 1] forward that lives or
-dies by HBM bandwidth — fusing them into one executable would force the
-decode batch to retrace whenever prefill shapes change and drag
-padding-FLOPs into every step.
+v1 (PR 2) compiled a GRID of programs — one bucketed prefill executable
+per power-of-two prompt length, one decode executable per power-of-two
+batch size — and ran every admitted request's prefill as its own call.
+That bounded compiles logarithmically but still paid
+O(prefill buckets x batch buckets) compiles and serialized prefills,
+which is exactly where the v1 bench lost (15.5 tok/s paged vs 25.6
+dense, TTFT p90 6.3 s, BENCH_SERVING.json v1).
 
-- ``build_prefill_fn``: dense-cache forward over the padded prompt via
-  the same :func:`~hetu_tpu.models.generate.decode_step` that
-  ``generate()`` scans (shared layer math, one source of truth), then
-  scatters the dense caches into the request's KV pages and projects
-  logits at the last TRUE token.
-- ``build_decode_fn``: single-token batched step that scatter-writes
-  each request's new k/v into its current page and attends through the
-  page table with ``ops.paged_attention``.
+``build_unified_step_fn`` replaces the whole grid with ONE executable
+over a fixed-shape **ragged token batch** (DESIGN.md §12):
 
-Both are cached per shape bucket by the engine, so compile count is
-bounded by the bucket grid, not the traffic mix.
+- the token axis ``[T]`` = ``max_seqs`` single-token slots (decode — the
+  degenerate 1-query-token case) followed by ``prefill_rows`` chunk
+  slots of ``chunk_size`` tokens each (Sarathi-style prefill chunks);
+- raggedness is described per row by ``(q_lens, cu_q, page_tables,
+  ctx_lens)`` — the same scalar arrays the
+  :mod:`~hetu_tpu.ops.ragged_paged_attention` kernel prefetches;
+- every layer runs the projections/MLP over the WHOLE token axis (one
+  MXU-shaped matmul for mixed prefill+decode, the core RPA win),
+  scatter-writes each token's k/v into its page at ``(token_page,
+  token_off)`` (padding tokens land in the trash page), and attends
+  raggedly: the Pallas kernel on TPU, or — off TPU — a split dense
+  fallback whose decode half IS ``paged_attention_reference`` (the
+  bit-for-bit-proven v1 decode math) and whose chunk half is the same
+  gather+masked-dense attention with a causal in-row mask;
+- sampling is ON DEVICE for every mode: greedy argmax (bit-for-bit the
+  ``jnp.argmax`` solo ``generate()`` runs), or temperature / top-k /
+  top-p (nucleus) from a per-row params vector, keyed by
+  ``fold_in(PRNGKey(seed), ctx_len)`` so a request's sample at token
+  position ``n`` is identical regardless of batching, chunking or
+  preemption.  The engine fetches ``[rows]`` int32 — never a ``[B, V]``
+  logits matrix (``host_logit_fetches`` stays 0 on mixed traffic).
 """
 from __future__ import annotations
 
@@ -25,12 +39,13 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..models.generate import (_act, _lm_head, _moe_mlp, _norm_apply,
-                               _Params, _rotary_tables, decode_step)
+                               _Params, _rotary_tables)
 from ..models.gpt import GPTConfig
-from ..ops.paged_attention import paged_attention_decode
-
+from ..ops.paged_attention import paged_attention_reference
+from ..ops.ragged_paged_attention import ragged_paged_attention_pallas
 
 def _params_view(cfg: GPTConfig, params) -> _Params:
     p = _Params.__new__(_Params)
@@ -38,176 +53,269 @@ def _params_view(cfg: GPTConfig, params) -> _Params:
     return p
 
 
-def _rope_at(x, cos_g, sin_g):
-    """Rotary embedding at per-request positions: x [B, 1, h, d],
-    cos_g/sin_g [B, d] (already position-gathered).  Same arithmetic as
-    generate._rope, which takes a shared [s, d] table — decode batches
-    have a DIFFERENT position per row, so the gather happens outside."""
+def _rope_tok(x, cos_g, sin_g):
+    """Rotary embedding at per-token positions: x [T, h, d], cos_g/sin_g
+    [T, d] (already position-gathered).  Same arithmetic as
+    ``generate._rope`` — the flat token axis just has a DIFFERENT
+    position per row, so the table gather happens outside."""
     half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
     rot = jnp.concatenate([-x2, x1], axis=-1)
-    c = cos_g[:, None, None, :].astype(x.dtype)
-    s = sin_g[:, None, None, :].astype(x.dtype)
+    c = cos_g[:, None, :].astype(x.dtype)
+    s = sin_g[:, None, :].astype(x.dtype)
     return x * c + rot * s
 
 
-def build_prefill_fn(cfg: GPTConfig, s_pad: int, max_pages: int,
-                     page_size: int):
-    """Compile a prefill executable for prompt-length bucket ``s_pad``
-    (a multiple of ``page_size``).
+def _split_ragged_attention(cfg: GPTConfig, q, kp, vp, q_lens,
+                            page_tables, ctx_lens, max_seqs: int,
+                            prefill_rows: int, chunk: int):
+    """Off-TPU ragged attention over the structured serving layout.
 
-    fn(params, prompt [1, s_pad], true_len, pt_row [max_pages],
-       k_pages, v_pages) -> (logits [V], greedy token [], new k_pages,
-       new v_pages)
-
-    The greedy (temperature-0) argmax is folded into the jit so the
-    engine can skip the host logits round-trip entirely — the same
-    ``jnp.argmax`` ``generate()`` runs, so on-device sampling stays
-    bit-for-bit with the solo path.
-
-    Padded prompt tail tokens only influence positions >= true_len
-    (causal mask), whose KV entries are masked by ``seq_len`` until
-    decode overwrites them; padded page-table slots point at the trash
-    page, so the static per-page scatter loop never writes real pages it
-    doesn't own.
-    """
-    if s_pad % page_size != 0:
-        raise ValueError(f"prefill bucket {s_pad} not a multiple of "
-                         f"page_size {page_size}")
-    cdt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-    cos, sin = (_rotary_tables(cfg, s_pad) if cfg.position == "rotary"
-                else (None, None))
-    # the power-of-two bucket can exceed the page-table width when
-    # max_pages is not itself a power of two; positions past
-    # max_pages*page_size are guaranteed padding (admission bounds real
-    # length by max_model_len), so those pages are simply not written —
-    # an unclamped pt_row[j] gather would clamp to the LAST REAL page
-    # and corrupt it with padding KV
-    n_pack = min(s_pad // page_size, max_pages)
-
-    # page arrays are donated: the pool replaces them wholesale every
-    # call (Engine.set_pages), so XLA may scatter in place instead of
-    # holding live+new copies of the whole KV pool.  true_len is donated
-    # too — the engine builds it fresh per call, and the on-device
-    # greedy token output would otherwise alias its shape/dtype and trip
-    # donation-miss
-    @functools.partial(jax.jit, donate_argnums=(2, 4, 5))
-    def run(params, prompt, true_len, pt_row, k_pages, v_pages):
-        p = _params_view(cfg, params)
-        caches = [(jnp.zeros((1, s_pad, cfg.kv_heads, cfg.head_dim), cdt),
-                   jnp.zeros((1, s_pad, cfg.kv_heads, cfg.head_dim), cdt))
-                  for _ in range(cfg.num_layers)]
-        _, cs, x = decode_step(cfg, p, prompt, caches, 0, cos, sin,
-                               return_hidden=True)
-        logits = _lm_head(p, x[0, true_len - 1][None])[0]      # [V]
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        new_k, new_v = [], []
-        with jax.named_scope("kv_page_scatter"):
-            for i in range(cfg.num_layers):
-                kc, vc = cs[i]
-                kp, vp = k_pages[i], v_pages[i]
-                for j in range(n_pack):
-                    kp = kp.at[pt_row[j]].set(
-                        kc[0, j * page_size:(j + 1) * page_size])
-                    vp = vp.at[pt_row[j]].set(
-                        vc[0, j * page_size:(j + 1) * page_size])
-                new_k.append(kp)
-                new_v.append(vp)
-        return logits, greedy, tuple(new_k), tuple(new_v)
-
-    return run
-
-
-def build_decode_fn(cfg: GPTConfig, batch: int, max_pages: int,
-                    page_size: int, use_kernel: bool = False):
-    """Compile a paged decode step for batch bucket ``batch``.
-
-    fn(params, tokens [B], pos [B], page_tables [B, max_pages],
-       k_pages, v_pages) -> (logits [B, V], greedy tokens [B],
-       new k_pages, new v_pages)
-
-    The on-device greedy argmax lets the engine fetch B int32s instead
-    of a [B, V] fp32 logits matrix when every live request decodes at
-    temperature 0 — the host round-trip that dominates small-model
-    decode (ROADMAP serving item).
-
-    ``pos[b]`` is the KV write index for this token (== tokens already
-    committed); dummy batch slots carry pos=0 and an all-trash page
-    table, so their writes land in the trash page and their outputs are
-    discarded by the engine.  Layer math mirrors
-    ``models.generate._attn_step`` exactly, with the dense
-    update+attend swapped for page scatter + ``paged_attention``.
-    """
-    max_len = max_pages * page_size
-    cdt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-    cos, sin = (_rotary_tables(cfg, max_len) if cfg.position == "rotary"
-                else (None, None))
+    The flat batch's FIRST ``max_seqs`` tokens are the single-token
+    decode slots: they run through :func:`paged_attention_reference` —
+    literally the v1 decode math, so temperature-0 decode stays
+    bit-for-bit with solo ``generate()``.  Each chunk slot then runs
+    gather+masked-dense attention over its own page table with the
+    causal in-row mask (query j at absolute position
+    ``ctx - q_len + j``).  Padding decode slots attend one trash-page
+    slot (``max(ctx, 1)``) and padding chunk rows attend trash pages —
+    finite junk, never NaN, discarded by the engine."""
     c = cfg
-    hd, nh, nkv = c.head_dim, c.num_heads, c.kv_heads
-    batch_idx = jnp.arange(batch)
+    hd, nh, kvh = c.head_dim, c.num_heads, c.kv_heads
+    g = nh // kvh
+    maxp = page_tables.shape[1]
+    ps = kp.shape[1]
+    scale = hd ** -0.5
+    # decode slots: [S] one-token rows (v1 math, bitwise-proven)
+    outs = [paged_attention_reference(
+        q[:max_seqs], kp, vp, page_tables[:max_seqs],
+        jnp.maximum(ctx_lens[:max_seqs], 1))]
+    # power-of-two page-window levels: a chunk whose context spans n
+    # pages attends only the first level >= n pages of its table.  The
+    # dropped tail slots are exactly the ones the causal mask would zero
+    # (trailing exact-zero softmax terms — removing them is the same
+    # width-invariance the decode path already relies on, so chunk
+    # numerics stay bit-for-bit with the full-width form).  Level 0 is
+    # the idle slot: decode-only steps skip the chunk region entirely —
+    # the CPU analogue of the Pallas kernel's pl.when page skipping.
+    levels = [0]
+    n = 1
+    while n < maxp:
+        levels.append(n)
+        n *= 2
+    levels.append(maxp)
+    levels_arr = jnp.asarray(levels, jnp.int32)
 
-    # tokens is rebuilt by the engine every step: donating it lets XLA
-    # alias the on-device greedy-token output instead of holding a dead
-    # copy (pos, the same shape, stays un-donated — the single [B] int32
-    # output slot is already claimed)
-    @functools.partial(jax.jit, donate_argnums=(1, 4, 5))
-    def run(params, tokens, pos, page_tables, k_pages, v_pages):
-        p = _params_view(cfg, params)
-        x = p("wte.weight")[tokens][:, None].astype(cdt)       # [B, 1, H]
+    def make_chunk_attn(npages):
+        if npages == 0:
+            return lambda qc, pt_row, ctx, qlen: jnp.zeros(
+                (chunk, nh, hd), q.dtype)
+
+        # near-twin of ops.ragged_paged_attention_reference's per-row
+        # body, but NOT shared on purpose: this path masks with -inf
+        # (exact-zero softmax terms — the bit-for-bit-vs-solo contract),
+        # while the ops reference mirrors the kernel's finite
+        # DEFAULT_MASK_VALUE for interpret-mode parity
+        def attn(qc, pt_row, ctx, qlen):
+            width = npages * ps
+            qg = qc.reshape(chunk, kvh, g, hd).astype(jnp.float32)
+            k = kp[pt_row[:npages]].reshape(width, kvh, hd)
+            v = vp[pt_row[:npages]].reshape(width, kvh, hd)
+            s = jnp.einsum("qhgd,khd->qhgk", qg,
+                           k.astype(jnp.float32)) * scale
+            qpos = (ctx - qlen) + jnp.arange(chunk)
+            valid = jnp.arange(width)[None, :] <= qpos[:, None]
+            s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
+            pr = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("qhgk,khd->qhgd", pr, v.astype(jnp.float32))
+            return o.reshape(chunk, nh, hd).astype(q.dtype)
+
+        return attn
+
+    branches = [make_chunk_attn(npages) for npages in levels]
+    for r in range(prefill_rows):
+        row = max_seqs + r
+        qc = q[max_seqs + r * chunk: max_seqs + (r + 1) * chunk]
+        need = -(-ctx_lens[row] // ps)              # pages ctx spans
+        lvl = jnp.searchsorted(levels_arr, need)
+        lvl = jnp.where(q_lens[row] > 0, lvl, 0)    # idle -> level 0
+        outs.append(lax.switch(lvl, branches, qc, page_tables[row],
+                               ctx_lens[row], q_lens[row]))
+    return jnp.concatenate(outs, axis=0)
+
+
+def _sample_row(logits, temp, top_p, top_k, seed, ctx):
+    """On-device next-token choice for one row, fp32 logits [V].
+
+    Greedy rows take the jit'd argmax (the very ``jnp.argmax`` solo
+    ``generate()`` runs — bit-for-bit at temperature 0).  Sampled rows
+    draw from temperature-scaled logits with optional top-k truncation
+    and top-p (nucleus) truncation, keyed by ``(seed, ctx)`` — ``ctx``
+    equals the sampled token's index in the sequence, so replays are
+    deterministic regardless of batching/chunking/preemption."""
+    v = logits.shape[0]
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), ctx)
+    lg = logits / jnp.where(temp > 0, temp, 1.0)
+    order = jnp.argsort(-lg)
+    lg_s = lg[order]                                 # descending
+    probs = jax.nn.softmax(lg_s)
+    csum = jnp.cumsum(probs)
+    idxs = jnp.arange(v)
+    # nucleus: drop tokens once the mass BEFORE them reaches top_p (the
+    # smallest prefix whose mass >= top_p always survives; the argmax
+    # token is never cut)
+    cut = (csum - probs > top_p) & (top_p > 0.0) & (top_p < 1.0)
+    cut = cut | ((idxs >= top_k) & (top_k > 0))
+    samp = order[jax.random.categorical(
+        key, jnp.where(cut, -jnp.inf, lg_s))].astype(jnp.int32)
+    return jnp.where(temp == 0.0, greedy, samp)
+
+
+def build_unified_step_fn(cfg: GPTConfig, max_seqs: int, chunk: int,
+                          prefill_rows: int, max_pages: int,
+                          page_size: int, use_kernel: bool = False):
+    """Compile THE serving executable: one ragged prefill+decode step.
+
+    Token-axis layout (static)::
+
+        [0 .. max_seqs)                    decode slots, 1 token each
+        [max_seqs .. max_seqs + R*chunk)   R = prefill_rows chunk slots
+
+    fn(params,
+       tokens [T] i32, token_pos [T] i32,
+       token_page [T] i32, token_off [T] i32,   # KV write plan (trash
+                                                # page for padding)
+       q_lens [rows] i32, cu_q [rows+1] i32,
+       page_tables [rows, max_pages] i32, ctx_lens [rows] i32,
+       temps [rows] f32, top_ps [rows] f32,
+       top_ks [rows] i32, seeds [rows] i32,
+       k_pages, v_pages)
+      -> (next_tokens [rows] i32, new k_pages, new v_pages)
+
+    where ``rows = max_seqs + prefill_rows`` and ``T = max_seqs +
+    prefill_rows * chunk``.  Every row gets a next-token sample at its
+    LAST query token; the engine commits it only when the row reached
+    the end of its accumulated sequence (``pos + q_len == len(tokens)``
+    — i.e. the final prefill chunk or a decode step).  ALL shapes are
+    fixed: the engine compiles this exactly once.
+    """
+    if prefill_rows < 1:
+        raise ValueError(f"prefill_rows must be >= 1, got {prefill_rows}")
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    c = cfg
+    t_tokens = max_seqs + prefill_rows * chunk
+    n_rows = max_seqs + prefill_rows
+    max_len = max_pages * page_size
+    cdt = jnp.bfloat16 if c.dtype == "bfloat16" else jnp.float32
+    cos, sin = (_rotary_tables(c, max_len) if c.position == "rotary"
+                else (None, None))
+    hd, nh, nkv = c.head_dim, c.num_heads, c.kv_heads
+
+    def region_map(f, h, q_lens, f_chunk=None):
+        """Apply a row-wise map ``f`` per region: unconditionally over
+        the decode slots, under ``lax.cond`` per chunk slot — an idle
+        chunk slot (no prompt in flight) contributes zeros without
+        paying its ``[chunk, ...]`` matmul.  Row-wise means per-token
+        results are unchanged by the split (bit-for-bit).  ``f_chunk``
+        overrides ``f`` for the chunk slots (MoE keeps v1's per-phase
+        expert paths: dense per-token mix for decode, dispatched
+        group-GEMM for prefill chunks)."""
+        fc = f_chunk or f
+        parts = [f(h[:max_seqs])]
+        for r in range(prefill_rows):
+            sl = h[max_seqs + r * chunk: max_seqs + (r + 1) * chunk]
+            zero = jax.eval_shape(fc, sl)
+            parts.append(lax.cond(
+                q_lens[max_seqs + r] > 0, fc,
+                lambda s, z=zero: jnp.zeros(z.shape, z.dtype), sl))
+        return jnp.concatenate(parts, axis=0)
+
+    # pages are donated (the pool replaces them wholesale every call, so
+    # XLA scatters in place); seeds is donated so the [rows] int32
+    # next-token output can alias it instead of tripping donation-miss
+    @functools.partial(jax.jit, donate_argnums=(12, 13, 14))
+    def run(params, tokens, token_pos, token_page, token_off, q_lens,
+            cu_q, page_tables, ctx_lens, temps, top_ps, top_ks, seeds,
+            k_pages, v_pages):
+        p = _params_view(c, params)
+        x = p("wte.weight")[tokens].astype(cdt)            # [T, H]
         if c.position == "learned":
-            x = x + p("wpe")[pos][:, None].astype(x.dtype)
-        page_idx = page_tables[batch_idx, pos // page_size]    # [B]
-        offset = pos % page_size                               # [B]
-        seq_lens = pos + 1
+            x = x + p("wpe")[token_pos].astype(x.dtype)
         new_k, new_v = [], []
         for i in range(c.num_layers):
             h = _norm_apply(c, p.layer(i, "ln_1.weight"),
                             p.layer(i, "ln_1.bias"), x)
-            qkv = h @ p.layer(i, "attn.qkv.weight").T
-            qb = p.layer(i, "attn.qkv.bias")
-            if qb is not None:
-                qkv = qkv + qb
+
+            def qkv_proj(hh, i=i):
+                out = hh @ p.layer(i, "attn.qkv.weight").T
+                qb = p.layer(i, "attn.qkv.bias")
+                return out + qb if qb is not None else out
+
+            qkv = region_map(qkv_proj, h, q_lens)
             q_size, kv_size = nh * hd, nkv * hd
-            q = qkv[..., :q_size].reshape(batch, 1, nh, hd)
-            k = qkv[..., q_size:q_size + kv_size].reshape(batch, 1, nkv,
+            q = qkv[..., :q_size].reshape(t_tokens, nh, hd)
+            k = qkv[..., q_size:q_size + kv_size].reshape(t_tokens, nkv,
                                                           hd)
-            v = qkv[..., q_size + kv_size:].reshape(batch, 1, nkv, hd)
+            v = qkv[..., q_size + kv_size:].reshape(t_tokens, nkv, hd)
             if c.position == "rotary":
-                q = _rope_at(q, cos[pos], sin[pos])
-                k = _rope_at(k, cos[pos], sin[pos])
+                q = _rope_tok(q, cos[token_pos], sin[token_pos])
+                k = _rope_tok(k, cos[token_pos], sin[token_pos])
             with jax.named_scope("kv_page_scatter"):
-                kp = k_pages[i].at[page_idx, offset].set(
-                    k[:, 0].astype(cdt))
-                vp = v_pages[i].at[page_idx, offset].set(
-                    v[:, 0].astype(cdt))
-            attn = paged_attention_decode(q[:, 0], kp, vp, page_tables,
-                                          seq_lens,
-                                          use_kernel=use_kernel)
-            attn = attn.reshape(batch, 1, nh * hd).astype(x.dtype)
-            out = attn @ p.layer(i, "attn.out.weight").T
-            ob = p.layer(i, "attn.out.bias")
-            if ob is not None:
-                out = out + ob
-            x = x + out
+                kp = k_pages[i].at[token_page, token_off].set(
+                    k.astype(cdt))
+                vp = v_pages[i].at[token_page, token_off].set(
+                    v.astype(cdt))
+            if use_kernel:
+                attn = ragged_paged_attention_pallas(
+                    q, kp, vp, q_lens, cu_q, page_tables, ctx_lens,
+                    max_q=chunk)
+            else:
+                attn = _split_ragged_attention(
+                    c, q, kp, vp, q_lens, page_tables, ctx_lens,
+                    max_seqs, prefill_rows, chunk)
+            attn = attn.reshape(t_tokens, nh * hd).astype(x.dtype)
+
+            def out_proj(aa, i=i):
+                out = aa @ p.layer(i, "attn.out.weight").T
+                ob = p.layer(i, "attn.out.bias")
+                return out + ob if ob is not None else out
+
+            x = x + region_map(out_proj, attn, q_lens)
             h = _norm_apply(c, p.layer(i, "ln_2.weight"),
                             p.layer(i, "ln_2.bias"), x)
             if c.is_moe_layer(i):
-                h = _moe_mlp(c, p, i, h)
+                # decode slots: [T', 1, H] -> s=1 dense per-token mix
+                # (v1 decode path); chunk slots: [1, C, H] -> dispatched
+                # blocked group-GEMM (v1 prefill path) — both exactly
+                # equivalent, each matching its v1 phase
+                mlp = lambda hh, i=i: _moe_mlp(c, p, i,  # noqa: E731
+                                               hh[:, None, :])[:, 0]
+                mlp_chunk = lambda hh, i=i: _moe_mlp(c, p, i,  # noqa: E731
+                                                     hh[None])[0]
             else:
-                h = _act(c, h @ p.layer(i, "mlp.up.weight").T +
-                         (p.layer(i, "mlp.up.bias")
-                          if p.layer(i, "mlp.up.bias") is not None
-                          else 0.0))
-                h = h @ p.layer(i, "mlp.down.weight").T
-                db = p.layer(i, "mlp.down.bias")
-                if db is not None:
-                    h = h + db
-            x = x + h
+                mlp_chunk = None
+
+                def mlp(hh, i=i):
+                    hh = _act(c, hh @ p.layer(i, "mlp.up.weight").T +
+                              (p.layer(i, "mlp.up.bias")
+                               if p.layer(i, "mlp.up.bias") is not None
+                               else 0.0))
+                    hh = hh @ p.layer(i, "mlp.down.weight").T
+                    db = p.layer(i, "mlp.down.bias")
+                    return hh + db if db is not None else hh
+
+            x = x + region_map(mlp, h, q_lens, f_chunk=mlp_chunk)
             new_k.append(kp)
             new_v.append(vp)
         x = _norm_apply(c, p("ln_f.weight"), p("ln_f.bias"), x)
-        logits = _lm_head(p, x[:, 0])                          # [B, V]
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return logits, greedy, tuple(new_k), tuple(new_v)
+        # per-row last TRUE query token -> [rows, V] fp32 logits
+        last = jnp.clip(cu_q[:n_rows] + jnp.maximum(q_lens, 1) - 1, 0,
+                        t_tokens - 1)
+        logits = _lm_head(p, x[last])
+        next_tokens = jax.vmap(_sample_row)(logits, temps, top_ps,
+                                            top_ks, seeds, ctx_lens)
+        return next_tokens, tuple(new_k), tuple(new_v)
 
     return run

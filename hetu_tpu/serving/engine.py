@@ -2,29 +2,39 @@
 
 The serving half of the roadmap: where ``models.generate`` runs ONE
 static batch to completion, the engine runs an admission loop — every
-``step()`` it admits arrived requests (prefill, separate executable),
-packs all live requests into a shape-bucketed decode batch (paged
-attention through per-request page tables), streams each new token to
-its request, and retires/evicts under the page budget.  Late-arriving
-requests join mid-flight; short requests leave without waiting for long
-ones.
+``step()`` it admits arrived requests, packs ALL live work (prefill
+chunks + decode tokens) into one ragged token batch, runs the single
+**unified executable** (``serving/decode.build_unified_step_fn``), and
+streams each emitted token to its request, retiring/evicting under the
+page budget.  Late-arriving requests join mid-flight; short requests
+leave without waiting for long ones; long prompts prefill in
+``chunk_size`` slices so they never stall running decodes.
+
+One executable, compiled once (DESIGN.md §12): there is no prefill
+bucket grid and no per-batch-size decode program — ``compile_count``
+is 1 regardless of traffic, asserted by the CI recompile guard.
 
 Determinism contract: at temperature 0 every request's output equals a
-solo ``generate()`` run — batching, paging, admission order, and even
-preemption (recompute eviction) change WHEN a token is computed, never
-WHAT it is.  ``tests/test_serving.py`` asserts this bit-for-bit.
+solo ``generate()`` run — batching, paging, chunked prefill, admission
+order, and even preemption (recompute eviction) change WHEN a token is
+computed, never WHAT it is.  Sampled modes (temperature / top-k /
+top-p) run ON DEVICE keyed by ``(seed, position)``, so replays are
+deterministic too and the engine only ever fetches ``[rows]`` int32 —
+``host_logit_fetches`` stays 0 on any traffic mix.
 
 Observability (utils/metrics.py instruments): counters
 ``tokens_generated``/``prefill_tokens``/``requests_completed``/
-``preemptions``/``decode_steps``, gauges ``batch_occupancy``/
-``page_utilization``/``queue_depth``, histograms ``ttft``/``tpot``/
-``request_latency`` — with the no-op fallback when disabled.
+``preemptions``/``decode_steps``/``prefill_chunks``/``step_calls``,
+gauges ``batch_occupancy``/``page_utilization``/``queue_depth``,
+histograms ``ttft``/``tbt``/``tpot``/``request_latency`` (ttft/tbt are
+Prometheus-bucketed for per-stage latency dashboards) — with the no-op
+fallback when disabled.
 """
 from __future__ import annotations
 
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,23 +43,30 @@ import numpy as np
 from ..models.generate import _Params
 from ..models.gpt import GPTConfig
 from ..utils.metrics import make_instrument
-from .decode import build_decode_fn, build_prefill_fn
+from .decode import build_unified_step_fn
 from .kv_pool import TRASH_PAGE, PagedKVPool
 from .request import FINISHED, RUNNING, Request, RequestQueue
 from .scheduler import Scheduler
+
+# default Prometheus-style latency bounds (seconds) for ttft/tbt; tests
+# and benches with a synthetic clock pass their own
+DEFAULT_LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                           10.0)
 
 
 class Engine:
     def __init__(self, state: Dict[str, Any], cfg: GPTConfig,
                  num_pages: int = 64, page_size: int = 64,
                  max_batch: int = 8, max_model_len: Optional[int] = None,
+                 chunk_size: Optional[int] = 64, prefill_rows: int = 1,
                  mesh=None, use_kernel: bool = False,
                  metrics: bool = True,
+                 latency_buckets: Optional[Sequence[float]] = None,
                  time_fn: Optional[Callable[[], float]] = None,
                  name: str = "serving", analysis_tap: bool = True):
         self.cfg = cfg
         self.name = name
-        # ring buffer of recent prefill/decode call shapes+page tables,
+        # ring buffer of recent packed-step layouts (rows + page tables),
         # consumed by the trash-page-write lint (hetu_tpu/analysis)
         self.tap: Optional[deque] = deque(maxlen=128) if analysis_tap \
             else None
@@ -72,35 +89,66 @@ class Engine:
         self.pool = PagedKVPool(cfg.num_layers, num_pages, page_size,
                                 cfg.kv_heads, cfg.head_dim, dtype,
                                 mesh=mesh)
-        self.scheduler = Scheduler(self.pool, max_batch=max_batch)
+        # chunk_size=None: whole-prompt chunks (bounded by what a
+        # sequence can ever hold) — the "infinite chunk" configuration
+        chunk = self.max_model_len if chunk_size is None \
+            else min(int(chunk_size), self.max_model_len)
+        self.scheduler = Scheduler(self.pool, max_batch=max_batch,
+                                   chunk=chunk,
+                                   prefill_rows=prefill_rows)
         self.use_kernel = bool(use_kernel)
         self.queue = RequestQueue()
         self.running: List[Request] = []
         self.finished: Dict[int, Request] = {}
-        self._compiled: Dict[Any, Callable] = {}
         self._time_fn = time_fn or time.monotonic
         self._next_id = 0
         self.steps = 0
-        # host logits round-trips actually paid: greedy (temperature-0)
-        # traffic samples on device and only moves B int32s per step —
-        # this stays 0 unless a sampled-mode request is live
+        self._calls = 0
+        # host logits round-trips actually paid: sampling (greedy AND
+        # temperature/top-k/top-p) runs on device and moves [rows]
+        # int32s per step — this stays 0 on every traffic mix
         self.host_logit_fetches = 0
         m = metrics
         self.counters = {k: make_instrument("counter", k, m) for k in
                          ("tokens_generated", "prefill_tokens",
                           "requests_completed", "preemptions",
-                          "decode_steps", "prefills")}
+                          "decode_steps", "prefill_chunks",
+                          "step_calls")}
         self.gauges = {k: make_instrument("gauge", k, m) for k in
                        ("batch_occupancy", "page_utilization",
                         "queue_depth")}
-        self.histograms = {k: make_instrument("histogram", k, m) for k in
-                           ("ttft", "tpot", "request_latency")}
+        lb = list(latency_buckets if latency_buckets is not None
+                  else DEFAULT_LATENCY_BUCKETS)
+        self.histograms = {
+            "ttft": make_instrument("histogram", "ttft", m, buckets=lb),
+            "tbt": make_instrument("histogram", "tbt", m, buckets=lb),
+            "tpot": make_instrument("histogram", "tpot", m),
+            "request_latency": make_instrument("histogram",
+                                               "request_latency", m),
+        }
+        # THE executable: fixed (max_seqs, chunk, prefill_rows) shapes,
+        # compiled exactly once — no bucket grid, no per-request prefill
+        self._compiled: Dict[str, Callable] = {
+            "unified": build_unified_step_fn(
+                cfg, self.scheduler.max_batch, self.scheduler.chunk,
+                self.scheduler.prefill_rows, self.max_pages_per_seq,
+                page_size, use_kernel=self.use_kernel)}
+        # static packed-layout constants
+        s, r, ck = (self.scheduler.max_batch, self.scheduler.prefill_rows,
+                    self.scheduler.chunk)
+        self.n_rows = s + r
+        self.n_tokens = s + r * ck
+        cu = np.concatenate([np.arange(s, dtype=np.int32),
+                             s + ck * np.arange(r + 1, dtype=np.int32)])
+        self._cu_q = cu                       # [rows + 1], layout-fixed
+        self._register_for_analysis()
 
     # -- submission ----------------------------------------------------------
 
     def add_request(self, prompt_ids: Sequence[int], max_new_tokens: int,
                     temperature: float = 0.0, top_k: int = 0,
-                    seed: int = 0, eos_token_id: Optional[int] = None,
+                    top_p: float = 0.0, seed: int = 0,
+                    eos_token_id: Optional[int] = None,
                     arrival_time: Optional[float] = None,
                     stream_cb: Optional[Callable] = None) -> Request:
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
@@ -121,7 +169,8 @@ class Engine:
         req = Request(req_id=self._next_id, prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), top_k=int(top_k),
-                      seed=int(seed), eos_token_id=eos_token_id,
+                      top_p=float(top_p), seed=int(seed),
+                      eos_token_id=eos_token_id,
                       arrival_time=now if arrival_time is None
                       else float(arrival_time), stream_cb=stream_cb)
         req.submit_time = max(now, req.arrival_time)
@@ -139,13 +188,20 @@ class Engine:
         return bool(self.queue) or bool(self.running)
 
     def step(self) -> int:
-        """One engine iteration: admit+prefill, then one decode step for
-        every live request.  Returns the number of tokens produced."""
-        produced = 0
+        """One engine iteration: admit, pack prefill chunks + decodes
+        into ONE ragged batch, run the unified executable.  Returns the
+        number of tokens emitted."""
         now = self._now()
         for req in self.scheduler.admit(self.queue, self.running, now):
-            produced += self._prefill(req)
-        produced += self._decode_batch()
+            self._start(req)
+        live = [r for r in self.running if r.state == RUNNING]
+        kept, evicted = self.scheduler.ensure_decode_pages(live)
+        for req in evicted:
+            self.running.remove(req)
+            self.queue.push(req)
+            self.counters["preemptions"].inc()
+        rows = self.scheduler.pack(kept)
+        produced = self._run_unified(rows) if rows else 0
         self.steps += 1
         self.gauges["batch_occupancy"].set(
             len(self.running) / self.scheduler.max_batch)
@@ -166,189 +222,135 @@ class Engine:
 
     @property
     def compile_count(self) -> int:
-        """Distinct compiled executables — bounded by the shape-bucket
-        grid (asserted in bench/tests), not by traffic."""
-        return len(self._compiled)
+        """Compiled program count, read from the REAL jit cache when the
+        runtime exposes it — a silent retrace (shape/dtype/weak-type
+        drift in the packed arrays) shows up here and trips the CI
+        recompile guard, which a structural ``len(_compiled)`` never
+        could.  Falls back to one per built executable."""
+        n = 0
+        for fn in self._compiled.values():
+            try:
+                n += int(fn._cache_size())
+            except Exception:
+                n += 1
+        return n
 
-    # -- prefill -------------------------------------------------------------
+    @property
+    def executable_calls(self) -> int:
+        """Unified-step invocations — engine state (a plain counter), so
+        it stays correct under ``metrics=False``."""
+        return self._calls
 
-    def _get_fn(self, kind: str, bucket: int) -> Callable:
-        key = (kind, bucket)
-        fn = self._compiled.get(key)
-        if fn is None:
-            if kind == "prefill":
-                fn = build_prefill_fn(self.cfg, bucket,
-                                      self.max_pages_per_seq,
-                                      self.pool.page_size)
-            else:
-                fn = build_decode_fn(self.cfg, bucket,
-                                     self.max_pages_per_seq,
-                                     self.pool.page_size,
-                                     use_kernel=self.use_kernel)
-            self._compiled[key] = fn
-            self._register_for_analysis(kind, bucket, fn)
-        return fn
+    # -- admission / lifecycle -----------------------------------------------
 
-    def _register_for_analysis(self, kind: str, bucket: int, fn) -> None:
-        """Expose this executable to the static analyzer
-        (hetu_tpu/analysis): abstract arg specs are fully determined by
-        the bucket, so the handle can lower without running."""
-        from ..graph.graph import register_executable
-        sds = lambda a: jax.ShapeDtypeStruct(np.shape(a),  # noqa: E731
-                                             np.asarray(a).dtype) \
-            if not hasattr(a, "aval") else jax.ShapeDtypeStruct(a.shape,
-                                                                a.dtype)
-        params = jax.tree_util.tree_map(sds, self.params)
-        pages = tuple(sds(p) for p in self.pool.k_pages)
-        maxp = self.max_pages_per_seq
-        if kind == "prefill":
-            args = (params, jax.ShapeDtypeStruct((1, bucket), np.int32),
-                    jax.ShapeDtypeStruct((), np.int32),
-                    jax.ShapeDtypeStruct((maxp,), np.int32), pages, pages)
-        else:
-            args = (params, jax.ShapeDtypeStruct((bucket,), np.int32),
-                    jax.ShapeDtypeStruct((bucket,), np.int32),
-                    jax.ShapeDtypeStruct((bucket, maxp), np.int32),
-                    pages, pages)
-        meta = {
-            "kind": f"serving_{kind}",
-            "mesh_axes": {},
-            # model weights ride in as closed-over inputs: replicated by
-            # design on the single-device path (trainable=False keeps
-            # replicated-large-param quiet; a tp-sharded pool analysis
-            # would annotate pspecs here)
-            "params": [],
-            # single-device (or fully explicit) program: NO collective
-            # may appear that the inventory doesn't list
-            "allowed_gspmd": {} if self.pool.sharding is None else None,
-            "scalar_fetches": 0,
-            "serving": lambda: {"pool": self.pool,
-                                "page_size": self.pool.page_size,
-                                "tap": list(self.tap or ())},
-        }
-        if self.pool.sharding is None:
-            # per-edge claim: the single-device serving path predicts
-            # ZERO comm edges — any emitted collective is unexplained
-            # by construction (a tp-sharded pool would declare its
-            # attention/head reduction edges here instead)
-            meta["pspec_edges"] = []
-        register_executable(f"{self.name}/{kind}-{bucket}", fn, args, meta)
-
-    def _pt_row(self, pages: List[int]) -> np.ndarray:
-        row = np.full(self.max_pages_per_seq, TRASH_PAGE, np.int32)
-        row[:len(pages)] = pages
-        return row
-
-    def _prefill(self, req: Request) -> int:
-        n_tok = len(req.tokens)
-        pages = self.pool.alloc(self.pool.pages_for(n_tok))
+    def _start(self, req: Request) -> None:
+        """Move an admitted request to RUNNING: grant the pages its
+        accumulated tokens need (whole prompt — or whole history after a
+        preemption).  Prefill itself is chunked over subsequent packed
+        steps; there is no prefill call here."""
+        pages = self.pool.alloc(self.pool.pages_for(len(req.tokens)))
         assert pages is not None, "admission reserved these pages"
         req.pages = pages
         req.peak_pages = max(req.peak_pages, len(pages))
-        s_pad = self.scheduler.prefill_bucket(n_tok)
-        if self.tap is not None:
-            self.tap.append({"kind": "prefill", "pages": list(pages),
-                             "n_tok": n_tok})
-        fn = self._get_fn("prefill", s_pad)
-        prompt = np.zeros((1, s_pad), np.int32)
-        prompt[0, :n_tok] = req.tokens
-        logits, greedy, new_k, new_v = fn(
-            self.params, jnp.asarray(prompt), jnp.int32(n_tok),
-            jnp.asarray(self._pt_row(pages)),
-            self.pool.k_pages, self.pool.v_pages)
-        self.pool.set_pages(new_k, new_v)
-        req.pos = n_tok
         req.state = RUNNING
         self.running.append(req)
-        if req.temperature == 0.0:
-            self._emit(req, token=int(np.asarray(greedy)))
-        else:
-            self.host_logit_fetches += 1
-            self._emit(req, logits=np.asarray(logits))
-        now = self._now()
-        if req.first_token_time is None:
-            req.first_token_time = now
-            self.histograms["ttft"].observe(now - req.submit_time)
-        self.counters["prefill_tokens"].inc(n_tok)
-        self.counters["prefills"].inc()
-        self._maybe_finish(req)
-        return 1
 
-    # -- decode --------------------------------------------------------------
+    # -- the unified step ----------------------------------------------------
 
-    def _decode_batch(self) -> int:
-        live = [r for r in self.running if r.state == RUNNING]
-        if not live:
-            return 0
-        kept, evicted = self.scheduler.ensure_decode_pages(live)
-        for req in evicted:
-            self.running.remove(req)
-            self.queue.push(req)
-            self.counters["preemptions"].inc()
-        if not kept:
-            return 0
-        bucket = self.scheduler.decode_bucket(len(kept))
-        kept = kept[:bucket]               # surplus rides the next step
-        fn = self._get_fn("decode", bucket)
-        tokens = np.zeros(bucket, np.int32)
-        pos = np.zeros(bucket, np.int32)
-        pt = np.full((bucket, self.max_pages_per_seq), TRASH_PAGE,
-                     np.int32)
-        for i, req in enumerate(kept):
-            tokens[i] = req.tokens[-1]
-            pos[i] = req.pos
-            pt[i, :len(req.pages)] = req.pages
+    def _pack_arrays(self, rows: List[Tuple[Request, int, int]]):
+        """Host-side marshalling of the packed step: flat token arrays +
+        per-row ragged descriptors + per-row sampling params."""
+        t, nr = self.n_tokens, self.n_rows
+        ps = self.pool.page_size
+        tokens = np.zeros(t, np.int32)
+        token_pos = np.zeros(t, np.int32)
+        token_page = np.full(t, TRASH_PAGE, np.int32)
+        token_off = np.zeros(t, np.int32)
+        q_lens = np.zeros(nr, np.int32)
+        page_tables = np.full((nr, self.max_pages_per_seq), TRASH_PAGE,
+                              np.int32)
+        ctx_lens = np.zeros(nr, np.int32)
+        temps = np.zeros(nr, np.float32)
+        top_ps = np.zeros(nr, np.float32)
+        top_ks = np.zeros(nr, np.int32)
+        seeds = np.zeros(nr, np.int32)
+        for req, qlen, row in rows:
+            start = int(self._cu_q[row])
+            pos = np.arange(req.pos, req.pos + qlen)
+            tokens[start:start + qlen] = req.tokens[req.pos:req.pos + qlen]
+            token_pos[start:start + qlen] = pos
+            pages = np.asarray(req.pages, np.int32)
+            token_page[start:start + qlen] = pages[pos // ps]
+            token_off[start:start + qlen] = pos % ps
+            q_lens[row] = qlen
+            page_tables[row, :len(req.pages)] = req.pages
+            ctx_lens[row] = req.pos + qlen
+            temps[row] = req.temperature
+            top_ps[row] = req.top_p
+            top_ks[row] = req.top_k
+            seeds[row] = req.seed
+        return (tokens, token_pos, token_page, token_off, q_lens,
+                page_tables, ctx_lens, temps, top_ps, top_ks, seeds)
+
+    def _run_unified(self, rows: List[Tuple[Request, int, int]]) -> int:
+        (tokens, token_pos, token_page, token_off, q_lens, page_tables,
+         ctx_lens, temps, top_ps, top_ks, seeds) = self._pack_arrays(rows)
         if self.tap is not None:
-            self.tap.append({"kind": "decode", "n_live": len(kept),
-                             "pos": pos.copy(), "page_tables": pt.copy()})
+            self.tap.append({
+                "kind": "unified",
+                "rows": [(row, req.pos, qlen) for req, qlen, row in rows],
+                "page_tables": page_tables.copy()})
         t0 = self._now()
-        logits, greedy, new_k, new_v = fn(
-            self.params, jnp.asarray(tokens), jnp.asarray(pos),
-            jnp.asarray(pt), self.pool.k_pages, self.pool.v_pages)
+        next_tokens, new_k, new_v = self._compiled["unified"](
+            self.params, jnp.asarray(tokens), jnp.asarray(token_pos),
+            jnp.asarray(token_page), jnp.asarray(token_off),
+            jnp.asarray(q_lens), jnp.asarray(self._cu_q),
+            jnp.asarray(page_tables), jnp.asarray(ctx_lens),
+            jnp.asarray(temps), jnp.asarray(top_ps),
+            jnp.asarray(top_ks), jnp.asarray(seeds),
+            self.pool.k_pages, self.pool.v_pages)
         self.pool.set_pages(new_k, new_v)
-        # fetch the [B, V] logits only when a sampled-mode request is in
-        # the batch; all-greedy steps move B int32s instead
-        toks = np.asarray(greedy)
-        logits_host = None
-        if any(r.temperature != 0.0 for r in kept):
-            self.host_logit_fetches += 1
-            logits_host = np.asarray(logits)
+        toks = np.asarray(next_tokens)          # [rows] int32, ever
         dt = self._now() - t0
-        for i, req in enumerate(kept):
-            req.pos += 1
-            if req.temperature == 0.0:
-                self._emit(req, token=int(toks[i]))
-            else:
-                self._emit(req, logits=logits_host[i])
-            self.histograms["tpot"].observe(dt)
-            self._maybe_finish(req)
-        self.counters["decode_steps"].inc()
-        return len(kept)
+        self._calls += 1
+        self.counters["step_calls"].inc()
+        # classify by SLOT, not q_len: a chunk_size=1 prefill chunk is
+        # still a prefill chunk
+        s = self.scheduler.max_batch
+        n_decode = sum(1 for _, _, row in rows if row < s)
+        n_chunk = sum(1 for _, _, row in rows if row >= s)
+        if n_decode:
+            self.counters["decode_steps"].inc()
+        self.counters["prefill_chunks"].inc(n_chunk)
+        produced = 0
+        for req, qlen, row in rows:
+            pre = max(0, min(qlen, req.prompt_len - req.pos))
+            if pre:
+                self.counters["prefill_tokens"].inc(pre)
+            req.pos += qlen
+            if req.pos == len(req.tokens):      # row reached its tip:
+                self._emit(req, int(toks[row]))  # commit the sample
+                produced += 1
+                now = self._now()
+                if req.first_token_time is None:
+                    req.first_token_time = now
+                    self.histograms["ttft"].observe(now - req.submit_time)
+                else:
+                    self.histograms["tbt"].observe(
+                        now - (req.last_token_time or now))
+                    self.histograms["tpot"].observe(dt)
+                req.last_token_time = now
+                self._maybe_finish(req)
+        return produced
 
     # -- sampling / retirement ----------------------------------------------
 
-    def _emit(self, req: Request, logits: Optional[np.ndarray] = None,
-              token: Optional[int] = None) -> None:
-        """Commit the next token: either ``token`` (already sampled on
-        device — the greedy argmax folded into the decode/prefill jit,
-        the very ``jnp.argmax`` generate() runs, so it stays bit-for-bit
-        with the solo path) or sampled host-side from fp32 ``logits``
-        [V] with a per-request, per-position RNG so replays are
-        deterministic regardless of batching."""
-        if token is not None:
-            tok = int(token)
-        elif req.temperature == 0.0:
-            tok = int(np.argmax(logits))
-        else:
-            lg = logits.astype(np.float64) / req.temperature
-            if req.top_k > 0:
-                kth = np.sort(lg)[-req.top_k]
-                lg = np.where(lg < kth, -np.inf, lg)
-            lg = lg - lg.max()
-            probs = np.exp(lg)
-            probs /= probs.sum()
-            rng = np.random.default_rng((req.seed, len(req.tokens)))
-            tok = int(rng.choice(len(probs), p=probs))
+    def _emit(self, req: Request, token: int) -> None:
+        """Commit the next token — ALWAYS sampled on device by the
+        unified executable (greedy argmax bit-for-bit with solo
+        ``generate()``; temperature/top-k/top-p keyed by
+        ``(seed, position)`` for batching-independent replays)."""
+        tok = int(token)
         req.tokens.append(tok)
         req.out_tokens.append(tok)
         self.counters["tokens_generated"].inc()
@@ -369,6 +371,51 @@ class Engine:
         self.histograms["request_latency"].observe(
             req.finish_time - req.submit_time)
 
+    # -- analysis ------------------------------------------------------------
+
+    def _register_for_analysis(self) -> None:
+        """Expose the unified executable to the static analyzer
+        (hetu_tpu/analysis): abstract arg specs are fully determined by
+        the engine's fixed layout, so the handle can lower without
+        running."""
+        from ..graph.graph import register_executable
+        sds = lambda a: jax.ShapeDtypeStruct(np.shape(a),  # noqa: E731
+                                             np.asarray(a).dtype) \
+            if not hasattr(a, "aval") else jax.ShapeDtypeStruct(a.shape,
+                                                                a.dtype)
+        params = jax.tree_util.tree_map(sds, self.params)
+        pages = tuple(sds(p) for p in self.pool.k_pages)
+        t, nr, maxp = self.n_tokens, self.n_rows, self.max_pages_per_seq
+        i32 = lambda *s: jax.ShapeDtypeStruct(s, np.int32)  # noqa: E731
+        f32 = lambda *s: jax.ShapeDtypeStruct(s, np.float32)  # noqa: E731
+        args = (params, i32(t), i32(t), i32(t), i32(t), i32(nr),
+                i32(nr + 1), i32(nr, maxp), i32(nr), f32(nr), f32(nr),
+                i32(nr), i32(nr), pages, pages)
+        meta = {
+            "kind": "serving_unified",
+            "mesh_axes": {},
+            # model weights ride in as closed-over inputs: replicated by
+            # design on the single-device path (trainable=False keeps
+            # replicated-large-param quiet; a tp-sharded pool analysis
+            # would annotate pspecs here)
+            "params": [],
+            # single-device (or fully explicit) program: NO collective
+            # may appear that the inventory doesn't list
+            "allowed_gspmd": {} if self.pool.sharding is None else None,
+            "scalar_fetches": 0,
+            "serving": lambda: {"pool": self.pool,
+                                "page_size": self.pool.page_size,
+                                "tap": list(self.tap or ())},
+        }
+        if self.pool.sharding is None:
+            # per-edge claim: the single-device serving path predicts
+            # ZERO comm edges — any emitted collective is unexplained
+            # by construction (a tp-sharded pool would declare its
+            # attention/head reduction edges here instead)
+            meta["pspec_edges"] = []
+        register_executable(f"{self.name}/unified",
+                            self._compiled["unified"], args, meta)
+
     def unregister_analysis(self) -> None:
         """Drop this engine's executables from the analysis registry.
 
@@ -381,11 +428,33 @@ class Engine:
 
     # -- observability -------------------------------------------------------
 
+    def reset_metrics(self) -> None:
+        """Zero every counter/gauge/histogram AND the step counter (the
+        compiled executable and all request state stay) — lets a bench
+        separate the compile-bearing first trace from steady-state
+        serving.  ``steps`` and ``executable_calls`` reset too, so
+        ``run(max_steps=...)`` and the call count describe the trace
+        since the reset, not the engine's lifetime (``compile_count``
+        deliberately does NOT reset — compiles are lifetime state)."""
+        self.steps = 0
+        self._calls = 0
+        for d in (self.counters, self.gauges, self.histograms):
+            for k, inst in list(d.items()):
+                if inst.__class__.__name__ == "_NullInstrument":
+                    continue
+                kw = {"buckets": list(inst.buckets)} \
+                    if getattr(inst, "buckets", None) else {}
+                d[k] = make_instrument(inst.__class__.__name__.lower(),
+                                       k, True, **kw)
+
     def metrics_summary(self) -> Dict[str, Any]:
         out = {k: c.value for k, c in self.counters.items()}
         out.update({k: g.value for k, g in self.gauges.items()})
         for k, h in self.histograms.items():
             out[k] = h.summary()
+        out["ttft_buckets"] = self.histograms["ttft"].bucket_counts()
+        out["tbt_buckets"] = self.histograms["tbt"].bucket_counts()
         out["compile_count"] = self.compile_count
+        out["executable_calls"] = self.executable_calls
         out["host_logit_fetches"] = self.host_logit_fetches
         return out

@@ -24,6 +24,7 @@ class Request:
     max_new_tokens: int
     temperature: float = 0.0
     top_k: int = 0
+    top_p: float = 0.0           # 0 (or >= 1) disables nucleus cut
     seed: int = 0
     eos_token_id: Optional[int] = None
     arrival_time: float = 0.0
@@ -39,6 +40,7 @@ class Request:
     peak_pages: int = 0
     submit_time: float = 0.0
     first_token_time: Optional[float] = None
+    last_token_time: Optional[float] = None
     finish_time: Optional[float] = None
 
     def __post_init__(self):

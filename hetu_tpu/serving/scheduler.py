@@ -1,55 +1,60 @@
-"""Continuous-batching scheduler: admission, page budget, preemption.
+"""Continuous-batching scheduler: admission, token-budget packing,
+page budget, preemption.
 
 Every engine step the scheduler (1) admits arrived requests while the
-page budget and batch-slot budget allow, and (2) guarantees every
-running request a page for its next token, preempting the
-latest-arrived request (recompute-style eviction: pages freed, sequence
-re-prefilled later from its accumulated tokens) when the pool runs dry.
+page budget and sequence-slot budget allow, (2) guarantees every
+running request a page for its next KV write (preempting the
+latest-arrived request — recompute-style eviction — when the pool runs
+dry), and (3) **packs** the step's ragged token batch for the single
+unified executable (DESIGN.md §12):
 
-Shape buckets (DESIGN.md §4 discipline, §8 for serving): decode batches
-are padded to power-of-two sizes and prefill lengths to
-power-of-two page multiples, so the number of distinct compiled
-executables is bounded by ``log2(max_batch) * log2(max_pages)`` rather
-than growing with traffic.
+- every request one token from emitting (``remaining == 1`` — a decode,
+  or the 1-token tail of a chunked prefill: the degenerate case) takes a
+  single-token slot.  There are ``max_batch`` of them and at most
+  ``max_batch`` live requests, so **every decode advances every step**
+  — a long prompt arrival can never stall running decodes;
+- remaining budget goes to prefill chunks: the earliest-arrived
+  requests still mid-prompt each get one ``chunk`` slot
+  (``prefill_rows`` of them per step), Sarathi-style.  A prompt longer
+  than ``chunk`` prefills over several steps, interleaved with decodes
+  in the SAME executable call.
+
+There are no shape buckets and no per-request prefill executables: the
+packed batch always has the same ``max_batch + prefill_rows * chunk``
+token shape, so the engine compiles exactly one program no matter the
+traffic mix.
 """
 from __future__ import annotations
 
 from typing import List, Tuple
 
 from .kv_pool import PagedKVPool
-from .request import WAITING, Request, RequestQueue
-
-
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+from .request import RUNNING, WAITING, Request, RequestQueue
 
 
 class Scheduler:
-    def __init__(self, pool: PagedKVPool, max_batch: int = 8):
+    def __init__(self, pool: PagedKVPool, max_batch: int = 8,
+                 chunk: int = 64, prefill_rows: int = 1):
+        if prefill_rows < 1:
+            raise ValueError(f"prefill_rows must be >= 1, got "
+                             f"{prefill_rows}")
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
         self.pool = pool
         self.max_batch = int(max_batch)
+        self.chunk = int(chunk)
+        self.prefill_rows = int(prefill_rows)
 
-    # -- shape buckets -------------------------------------------------------
-
-    def decode_bucket(self, n_live: int) -> int:
-        """Decode batch bucket: next power of two, capped at max_batch."""
-        return min(self.max_batch, _next_pow2(max(1, n_live)))
-
-    def prefill_bucket(self, n_tokens: int) -> int:
-        """Prefill length bucket: power-of-two number of pages (so the
-        dense prefill cache scatters into whole pages with static
-        slices)."""
-        ps = self.pool.page_size
-        return ps * _next_pow2(self.pool.pages_for(max(1, n_tokens)))
+    @property
+    def token_budget(self) -> int:
+        """Tokens one packed step can carry (the executable's T)."""
+        return self.max_batch + self.prefill_rows * self.chunk
 
     # -- admission -----------------------------------------------------------
 
     def admit(self, queue: RequestQueue, running: List[Request],
               now: float) -> List[Request]:
-        """Pop arrived requests while a batch slot AND the pages for
+        """Pop arrived requests while a sequence slot AND the pages for
         prompt+first-token fit.  Stops at the first request that doesn't
         fit (FIFO — no small-request overtaking, keeps TTFT fair)."""
         admitted: List[Request] = []
@@ -66,6 +71,36 @@ class Scheduler:
             admitted.append(req)
         return admitted
 
+    # -- token-budget packing ------------------------------------------------
+
+    def pack(self, running: List[Request]
+             ) -> List[Tuple[Request, int, int]]:
+        """Assign the step's rows: ``[(request, q_len, row_index)]``.
+
+        Single-token rows (``remaining == 1``) fill slots
+        ``[0, max_batch)``; mid-prompt requests fill chunk slots
+        ``[max_batch, max_batch + prefill_rows)`` in arrival order with
+        ``q_len = min(remaining, chunk)``.  Requests beyond the chunk
+        slots simply wait — they are still RUNNING and keep their pages,
+        they just don't ride this step."""
+        live = sorted((r for r in running if r.state == RUNNING),
+                      key=lambda r: (r.arrival_time, r.req_id))
+        rows: List[Tuple[Request, int, int]] = []
+        slot = 0
+        chunk_row = 0
+        for r in live:
+            remaining = len(r.tokens) - r.pos
+            if remaining == 1 and slot < self.max_batch:
+                rows.append((r, 1, slot))
+                slot += 1
+        for r in live:
+            remaining = len(r.tokens) - r.pos
+            if remaining > 1 and chunk_row < self.prefill_rows:
+                rows.append((r, min(remaining, self.chunk),
+                             self.max_batch + chunk_row))
+                chunk_row += 1
+        return rows
+
     # -- decode page budget --------------------------------------------------
 
     def ensure_decode_pages(self, running: List[Request]
@@ -73,7 +108,9 @@ class Scheduler:
         """Give every running request a page for its next KV write,
         evicting latest-arrived requests on exhaustion.  Returns
         (kept, evicted); evicted requests are already reset to WAITING
-        with their pages freed."""
+        with their pages freed.  Mid-prefill requests were granted their
+        whole prompt's pages at admission, so only emitted-token growth
+        allocates here."""
         evicted: List[Request] = []
         kept = sorted(running, key=lambda r: (r.arrival_time, r.req_id))
         for req in list(kept):
@@ -100,8 +137,9 @@ class Scheduler:
 
     def preempt(self, req: Request) -> None:
         """Recompute-style eviction: drop KV state, keep the token
-        history — re-prefilling ``req.tokens`` reproduces the sequence
-        exactly (asserted at temperature 0 in tests)."""
+        history — re-prefilling ``req.tokens`` (chunked like any other
+        prompt) reproduces the sequence exactly (asserted at
+        temperature 0 in tests)."""
         self.pool.free(req.pages)
         req.pages = []
         req.pos = 0

@@ -241,16 +241,20 @@ class _NullInstrument:
 NULL_INSTRUMENT = _NullInstrument()
 
 
-def make_instrument(kind: str, name: str = "", enabled: bool = True):
+def make_instrument(kind: str, name: str = "", enabled: bool = True,
+                    **kwargs):
     """Factory with the disabled fallback: ``make_instrument("gauge",
-    "occupancy", enabled=False)`` returns the shared no-op instrument."""
+    "occupancy", enabled=False)`` returns the shared no-op instrument.
+    Extra kwargs flow to the instrument constructor (e.g.
+    ``make_instrument("histogram", "ttft", buckets=[0.1, 1.0])`` for
+    Prometheus-style bucketed latency histograms)."""
     if not enabled:
         return NULL_INSTRUMENT
     cls = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}.get(
         kind.lower())
     if cls is None:
         raise ValueError(f"unknown instrument kind {kind!r}")
-    return cls(name)
+    return cls(name, **kwargs)
 
 
 def load_jsonl(path: str) -> List[Dict[str, Any]]:

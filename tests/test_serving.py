@@ -299,25 +299,24 @@ def test_engine_streaming_and_eos():
     assert streamed == req.out_tokens           # every token streamed
 
 
-def test_engine_compile_cache_bounded_by_buckets():
+def test_engine_single_unified_executable():
     """Requests with assorted prompt lengths and a fluctuating live set
-    must compile at most one executable per (kind, bucket)."""
+    run through ONE compiled executable — the unified ragged
+    prefill+decode step.  There is no bucket grid to grow."""
     cfg = GPTConfig(position="learned", norm="layernorm",
                     activation="gelu", **CFG_KW)
     state = _build_state(cfg, seed=4)
     eng = _make_engine(state, cfg, num_pages=32, page_size=8,
-                       max_batch=4)
+                       max_batch=4, chunk_size=8)
     rng = np.random.RandomState(0)
     for i in range(7):
         pr = [int(t) for t in rng.randint(1, 90, size=rng.randint(2, 14))]
         eng.add_request(pr, 6, arrival_time=float(i))
     _drain(eng)
-    prefill_buckets = {k for k in eng._compiled if k[0] == "prefill"}
-    decode_buckets = {k for k in eng._compiled if k[0] == "decode"}
-    assert eng.compile_count == len(prefill_buckets) + len(decode_buckets)
-    # power-of-two bucketing bounds each family logarithmically
-    assert len(prefill_buckets) <= 3            # 8/16/32-token buckets
-    assert len(decode_buckets) <= 3             # 1/2/4 batch buckets
+    assert eng.compile_count == 1
+    assert set(eng._compiled) == {"unified"}
+    assert eng.executable_calls == eng.metrics_summary()["step_calls"]
+    assert eng.executable_calls >= 1
 
 
 def test_engine_metrics_advance_and_disable():
@@ -369,12 +368,13 @@ def test_admission_respects_step_page_budget():
         assert r.out_tokens == w
 
 
-def test_prefill_bucket_exceeding_page_table_width():
+def test_prompt_filling_entire_page_table():
     """A request filling its entire (non-power-of-two-wide) page table:
-    the prefill bucket rounds up past the table, and the scatter loop
-    must NOT write the phantom pages (regression: the clamped
-    pt_row[j] gather silently overwrote the last real page with
-    padding KV)."""
+    chunked prefill must scatter exactly the real tokens' KV (v1
+    regression: the bucketed prefill's clamped pt_row[j] gather
+    silently overwrote the last real page with padding KV — the
+    per-token write plan makes phantom pages impossible by
+    construction, but the full-table scenario stays covered)."""
     cfg = GPTConfig(position="rotary", norm="rmsnorm",
                     activation="silu", num_kv_heads=2, **CFG_KW)
     state = _build_state(cfg, seed=14)
@@ -436,11 +436,11 @@ def test_request_queue_arrival_order_gating():
     assert not q
 
 
-def test_greedy_sampling_on_device_skips_logits_roundtrip():
-    """Temperature-0 sampling is the jit'd jnp.argmax: an all-greedy
-    workload never fetches host logits (only B int32s), while staying
-    bit-for-bit with solo generate().  A sampled-mode request in the
-    batch forces the fetch for itself without disturbing greedy peers."""
+def test_sampling_on_device_skips_logits_roundtrip():
+    """ALL sampling modes run inside the unified executable: an
+    all-greedy workload AND a mixed greedy/temperature batch both fetch
+    only [rows] int32s — host_logit_fetches stays 0 — while greedy rows
+    remain bit-for-bit with solo generate()."""
     cfg = GPTConfig(position="learned", norm="layernorm",
                     activation="gelu", **CFG_KW)
     state = _build_state(cfg, seed=21)
@@ -462,7 +462,7 @@ def test_greedy_sampling_on_device_skips_logits_roundtrip():
     s_req = eng2.add_request(prompts[1], 6, temperature=1.0, seed=3,
                              arrival_time=0.0)
     _drain(eng2)
-    assert eng2.host_logit_fetches >= 1         # sampled row paid it
+    assert eng2.host_logit_fetches == 0         # sampled row too
     assert g_req.out_tokens == want[0]          # greedy peer untouched
     assert len(s_req.out_tokens) == 6
 
