@@ -38,7 +38,8 @@ def serve_demo(state, cfg, args):
 
     rng = np.random.RandomState(0)
     period = np.array([3, 7, 1, 12], np.int32)
-    eng = Engine(state, cfg, num_pages=64, page_size=8, max_batch=8)
+    eng = Engine(state, cfg, num_pages=64, page_size=8, max_batch=8,
+                 prefix_cache=not args.no_prefix_cache)
     n = args.serve_requests
     t0 = time.monotonic()
     reqs = []
@@ -76,6 +77,14 @@ def serve_demo(state, cfg, args):
           f"{int(m['compile_count'])} compiled executable(s), "
           f"{int(m['host_logit_fetches'])} host logit fetches, "
           f"ttft p90 {m['ttft']['p90'] * 1e3:.1f} ms")
+    if not args.no_prefix_cache:
+        print(f"prefix cache: hit rate "
+              f"{m['prefix_cache_hit_rate']:.2f} "
+              f"({int(m['prefix_cache_hits'])} hits / "
+              f"{int(m['prefix_cache_misses'])} misses), "
+              f"{int(m['prefix_cache_tokens_saved'])} prefill tokens "
+              f"saved, {int(m['prefix_cache_evictions'])} evictions, "
+              f"{int(m['prefix_cache_pages'])} pages cached")
     if args.temperature == 0.0:
         print("self-check OK: every served request matches its solo "
               "generate() run bit-for-bit")
@@ -95,6 +104,9 @@ def main():
     ap.add_argument("--serve-requests", type=int, default=6)
     ap.add_argument("--serve-stagger", type=float, default=0.05,
                     help="arrival spacing in seconds")
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable copy-on-write prefix caching "
+                         "(DESIGN.md §13; on by default)")
     args = ap.parse_args()
     ckpt = args.ckpt or os.path.join(tempfile.mkdtemp(), "gpt")
 

@@ -225,7 +225,7 @@ def build_gate_executables():
     while eng.has_work:
         eng.step()
         clock[0] += 1.0
-    eng.pool.check_invariants()
+    eng.pool.check_invariants(force=True)
     assert eng.compile_count == 1, "the bucket grid came back"
     return names + sorted(f"gate_serving/{k}" for k in eng._compiled)
 

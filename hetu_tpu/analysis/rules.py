@@ -29,6 +29,12 @@ Rule catalog (DESIGN.md §9 for the rationale of each):
                              free-list/allocated set, or a live decode
                              row's page table targets it (padding rows
                              are the only legitimate trash-page writers).
+``cow-page-write``           serving: a unified-step KV write plan entry
+                             targets a CACHED page — read-only by the
+                             CoW contract whatever its sharer count
+                             (the index serves it to future lookups);
+                             writing it corrupts a shared KV history
+                             (trash page exempt: padding's sink).
 ``grad-allgather-under-zero2`` a ZeRO-2 train step regathers gradients:
                              an fp32 gradient all-gather (any plan), or
                              ANY gradient all-gather in a plan that
@@ -433,6 +439,54 @@ def _moe_capacity_overprovision(ctx: AnalysisContext) -> List[Finding]:
                  f"(= {pred} here), lower capacity_factor, or switch "
                  f"to dispatch_mode='dropless' (capacity-free blocked "
                  f"group-GEMM, no padding at all)"))
+    return out
+
+
+@rule("cow-page-write")
+def _cow_page_write(ctx: AnalysisContext) -> List[Finding]:
+    """Copy-on-write contract over the paged pool: prefix-cache pages
+    are read-only, so no live row's KV write plan may resolve to ANY
+    cached page.  The engine snapshots cached-page refcounts into every
+    unified tap record (membership alone proves the page is read-only:
+    refcount 1 = cached with zero live sharers — the index still serves
+    it to future lookups); a violation means a request's scatter is
+    destroying KV history the cache (and possibly other live requests,
+    refcount > 1) will read."""
+    if ctx.serving is None:
+        return []
+    from ..serving.kv_pool import TRASH_PAGE
+    pool = ctx.serving.get("pool")
+    ps = pool.page_size if pool is not None else \
+        ctx.serving.get("page_size", 1)
+    out: List[Finding] = []
+    for step, rec in enumerate(ctx.serving.get("tap", ())):
+        if rec.get("kind") != "unified":
+            continue
+        refs = rec.get("refcounts")
+        if not refs:
+            continue
+        pt = np.asarray(rec.get("page_tables"))
+        for row, pos, qlen in rec.get("rows", ()):
+            for t in range(int(qlen)):
+                pg = int(pt[int(row), (int(pos) + t) // ps])
+                if pg != TRASH_PAGE and pg in refs:
+                    out.append(Finding(
+                        rule="", subject=f"unified@{step}/row{row}",
+                        severity="error",
+                        message=f"unified step at tap step {step}: row "
+                                f"{row}'s KV write plan (pos "
+                                f"{int(pos) + t}) targets page {pg} "
+                                f"with refcount {int(refs[pg])} — a "
+                                f"read-only prefix-cache page; the "
+                                f"write corrupts KV history the cache "
+                                f"(and any live sharer) reads",
+                        hint="copy-on-write: start the request's write "
+                             "cursor at the cached boundary (pos = "
+                             "shared_pages * page_size) and allocate a "
+                             "fresh page for the first partial/"
+                             "divergent page — shared pages may only "
+                             "ever be READ"))
+                    break
     return out
 
 

@@ -9,14 +9,19 @@ driving ONE unified ragged prefill+decode executable.
                           temperature=0.8, top_p=0.95, seed=7)
     outputs = eng.run()            # {req_id: generated token list}
 
-See DESIGN.md §8 for the page-size/TP-tiling rationale and §12 for the
+See DESIGN.md §8 for the page-size/TP-tiling rationale, §12 for the
 unified ragged step (token-budget packing, chunked prefill, on-device
-temperature/top-k/top-p sampling, the one-executable compile contract).
+temperature/top-k/top-p sampling, the one-executable compile contract),
+and §13 for copy-on-write prefix caching (chained page hashing,
+refcounted read-only pages, LRU eviction — on by default, disable with
+``Engine(..., prefix_cache=False)``).
 """
 from .engine import Engine
 from .kv_pool import PagedKVPool, TRASH_PAGE
+from .prefix_cache import CacheEntry, PrefixCache
 from .request import FINISHED, RUNNING, WAITING, Request, RequestQueue
 from .scheduler import Scheduler
 
-__all__ = ["Engine", "PagedKVPool", "TRASH_PAGE", "Request",
-           "RequestQueue", "Scheduler", "WAITING", "RUNNING", "FINISHED"]
+__all__ = ["Engine", "PagedKVPool", "TRASH_PAGE", "PrefixCache",
+           "CacheEntry", "Request", "RequestQueue", "Scheduler",
+           "WAITING", "RUNNING", "FINISHED"]

@@ -22,13 +22,25 @@ top-p) run ON DEVICE keyed by ``(seed, position)``, so replays are
 deterministic too and the engine only ever fetches ``[rows]`` int32 —
 ``host_logit_fetches`` stays 0 on any traffic mix.
 
+Prefix reuse (``serving/prefix_cache.py``, on by default): finished
+requests' fully-written pages enter a chained-hash index; a new request
+whose page-aligned token prefix is cached attaches those pages
+read-only (copy-on-write — its KV write plan starts past them) and
+prefills only the uncached suffix.  When the pool runs dry, an LRU
+sweep over refcount-0 cached pages reclaims space BEFORE recompute
+preemption.  Cache-hit and cache-cold runs are bit-for-bit identical
+at temperature 0: the kernel reads identical page contents either way.
+
 Observability (utils/metrics.py instruments): counters
 ``tokens_generated``/``prefill_tokens``/``requests_completed``/
-``preemptions``/``decode_steps``/``prefill_chunks``/``step_calls``,
+``preemptions``/``decode_steps``/``prefill_chunks``/``step_calls``/
+``prefix_cache_hits``/``prefix_cache_misses``/
+``prefix_cache_tokens_saved``/``prefix_cache_evictions``,
 gauges ``batch_occupancy``/``page_utilization``/``queue_depth``,
 histograms ``ttft``/``tbt``/``tpot``/``request_latency`` (ttft/tbt are
 Prometheus-bucketed for per-stage latency dashboards) — with the no-op
-fallback when disabled.
+fallback when disabled.  ``metrics_summary()`` adds the derived
+``prefix_cache_hit_rate`` and the live ``prefix_cache_pages`` count.
 """
 from __future__ import annotations
 
@@ -45,6 +57,7 @@ from ..models.gpt import GPTConfig
 from ..utils.metrics import make_instrument
 from .decode import build_unified_step_fn
 from .kv_pool import TRASH_PAGE, PagedKVPool
+from .prefix_cache import PrefixCache
 from .request import FINISHED, RUNNING, Request, RequestQueue
 from .scheduler import Scheduler
 
@@ -63,7 +76,8 @@ class Engine:
                  metrics: bool = True,
                  latency_buckets: Optional[Sequence[float]] = None,
                  time_fn: Optional[Callable[[], float]] = None,
-                 name: str = "serving", analysis_tap: bool = True):
+                 name: str = "serving", analysis_tap: bool = True,
+                 prefix_cache: bool = True, debug: bool = False):
         self.cfg = cfg
         self.name = name
         # ring buffer of recent packed-step layouts (rows + page tables),
@@ -86,16 +100,25 @@ class Engine:
         self.max_model_len = int(max_model_len)
         self.max_pages_per_seq = -(-self.max_model_len // page_size)
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        self.debug = bool(debug)
         self.pool = PagedKVPool(cfg.num_layers, num_pages, page_size,
                                 cfg.kv_heads, cfg.head_dim, dtype,
-                                mesh=mesh)
+                                mesh=mesh, debug=debug)
+        # copy-on-write prefix reuse: finished requests' full pages are
+        # indexed by chained token hash; _start attaches the longest
+        # cached prefix so prefill skips straight to the cached boundary
+        self.prefix_cache: Optional[PrefixCache] = \
+            PrefixCache(self.pool) if prefix_cache else None
+        if self.prefix_cache is not None:
+            self.pool.set_reclaim(self._reclaim_cached_pages)
         # chunk_size=None: whole-prompt chunks (bounded by what a
         # sequence can ever hold) — the "infinite chunk" configuration
         chunk = self.max_model_len if chunk_size is None \
             else min(int(chunk_size), self.max_model_len)
         self.scheduler = Scheduler(self.pool, max_batch=max_batch,
                                    chunk=chunk,
-                                   prefill_rows=prefill_rows)
+                                   prefill_rows=prefill_rows,
+                                   prefix_cache=self.prefix_cache)
         self.use_kernel = bool(use_kernel)
         self.queue = RequestQueue()
         self.running: List[Request] = []
@@ -113,7 +136,14 @@ class Engine:
                          ("tokens_generated", "prefill_tokens",
                           "requests_completed", "preemptions",
                           "decode_steps", "prefill_chunks",
-                          "step_calls")}
+                          "step_calls",
+                          # prefix cache: hits/misses count request
+                          # starts with/without a cached prefix;
+                          # tokens_saved = prefill tokens skipped;
+                          # evictions = cached pages LRU-reclaimed
+                          "prefix_cache_hits", "prefix_cache_misses",
+                          "prefix_cache_tokens_saved",
+                          "prefix_cache_evictions")}
         self.gauges = {k: make_instrument("gauge", k, m) for k in
                        ("batch_occupancy", "page_utilization",
                         "queue_depth")}
@@ -202,6 +232,10 @@ class Engine:
             self.counters["preemptions"].inc()
         rows = self.scheduler.pack(kept)
         produced = self._run_unified(rows) if rows else 0
+        if self.debug:
+            self.pool.check_invariants()
+            if self.prefix_cache is not None:
+                self.prefix_cache.check_invariants()
         self.steps += 1
         self.gauges["batch_occupancy"].set(
             len(self.running) / self.scheduler.max_batch)
@@ -243,15 +277,57 @@ class Engine:
 
     # -- admission / lifecycle -----------------------------------------------
 
+    def _reclaim_cached_pages(self, n: int) -> int:
+        """The pool's reclaim hook: LRU-sweep refcount-0 cached pages
+        when the free list runs dry — BEFORE the scheduler falls back to
+        recompute preemption."""
+        freed = self.prefix_cache.evict(n)
+        if freed:
+            self.counters["prefix_cache_evictions"].inc(freed)
+        return freed
+
     def _start(self, req: Request) -> None:
-        """Move an admitted request to RUNNING: grant the pages its
-        accumulated tokens need (whole prompt — or whole history after a
-        preemption).  Prefill itself is chunked over subsequent packed
-        steps; there is no prefill call here."""
-        pages = self.pool.alloc(self.pool.pages_for(len(req.tokens)))
-        assert pages is not None, "admission reserved these pages"
-        req.pages = pages
-        req.peak_pages = max(req.peak_pages, len(pages))
+        """Move an admitted request to RUNNING: attach the longest
+        cached prefix (copy-on-write — the shared pages enter the page
+        table read-only and ``pos`` starts at the cached boundary, so
+        the KV write plan and the token budget only ever see the
+        uncached suffix), then grant the pages the rest of its
+        accumulated tokens need.  Prefill itself is chunked over
+        subsequent packed steps; there is no prefill call here."""
+        looked_up = self.prefix_cache is not None and req.pos == 0 \
+            and not req.pages
+        if looked_up:
+            entries = self.prefix_cache.acquire(req)
+            if entries:
+                req.pages = [e.page for e in entries]
+                req.shared_pages = len(entries)
+                req.pos = len(entries) * self.pool.page_size
+                req.cached_tokens = req.pos
+        need = self.pool.pages_for(len(req.tokens)) - len(req.pages)
+        pages = self.pool.alloc(need)
+        if pages is None:
+            # admission over-committed (another _start this step evicted
+            # a cached page the budget counted on): roll back and retry
+            # next step — never crash the loop on a page race.  Counters
+            # deliberately untouched: the retried start is the SAME
+            # logical start, not a second hit/miss
+            if self.prefix_cache is not None and req.shared_pages:
+                self.prefix_cache.release(req)
+            req.pages = []
+            req.shared_pages = 0
+            req.cached_tokens = 0
+            req.pos = 0
+            self.queue.push(req)
+            return
+        if looked_up:
+            if req.shared_pages:
+                self.counters["prefix_cache_hits"].inc()
+                self.counters["prefix_cache_tokens_saved"].inc(
+                    req.cached_tokens)
+            else:
+                self.counters["prefix_cache_misses"].inc()
+        req.pages = req.pages + pages
+        req.peak_pages = max(req.peak_pages, len(req.pages))
         req.state = RUNNING
         self.running.append(req)
 
@@ -299,7 +375,13 @@ class Engine:
             self.tap.append({
                 "kind": "unified",
                 "rows": [(row, req.pos, qlen) for req, qlen, row in rows],
-                "page_tables": page_tables.copy()})
+                "page_tables": page_tables.copy(),
+                # refcount snapshot of the read-only cached pages: the
+                # cow-page-write lint flags any live row whose write
+                # plan targets a page in this snapshot (membership =
+                # cached = read-only, whatever the sharer count)
+                "refcounts": {int(pg): self.pool.refcount(pg)
+                              for pg in self.pool._cached}})
         t0 = self._now()
         next_tokens, new_k, new_v = self._compiled["unified"](
             self.params, jnp.asarray(tokens), jnp.asarray(token_pos),
@@ -360,7 +442,13 @@ class Engine:
     def _maybe_finish(self, req: Request) -> None:
         if not req.done:
             return
-        self.pool.free(req.pages)
+        if self.prefix_cache is not None:
+            # fully-written pages enter the cache index (refcount 0,
+            # LRU-evictable); duplicates and the partial tail are freed;
+            # shared references released
+            self.prefix_cache.on_finish(req)
+        else:
+            self.pool.free(req.pages)
         req.pages = []
         req.state = FINISHED
         req.finish_time = self._now()
@@ -457,4 +545,10 @@ class Engine:
         out["compile_count"] = self.compile_count
         out["executable_calls"] = self.executable_calls
         out["host_logit_fetches"] = self.host_logit_fetches
+        # prefix cache: request-level hit rate since the last
+        # reset_metrics (warm a shared header, reset, replay: 1.0)
+        hits = self.counters["prefix_cache_hits"].value
+        miss = self.counters["prefix_cache_misses"].value
+        out["prefix_cache_hit_rate"] = hits / max(hits + miss, 1.0)
+        out["prefix_cache_pages"] = self.pool.cached_pages
         return out

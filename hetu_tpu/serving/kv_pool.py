@@ -19,10 +19,18 @@ the same ``kv_heads`` axis the training stack splits across ``tp``
 (nn/parallel.py column-parallel QKV), so a pool built with a mesh
 shards pages ``P(None, None, 'tp', None)`` and the decode executable's
 per-shard pages line up with the per-shard QKV projections.
+
+Pages live in one of THREE states (``serving/prefix_cache.py`` adds
+the third): **free** (on the free list), **allocated** (owned by
+exactly one request, writable), or **cached** (owned by the prefix
+cache, READ-ONLY, refcounted by live sharers; refcount 0 = evictable).
+``alloc`` consults an optional reclaim hook — the prefix cache's LRU
+sweep — before failing, so cached pages are transparently recycled
+ahead of the scheduler's recompute-preemption fallback.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,7 +43,7 @@ class PagedKVPool:
 
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
                  kv_heads: int, head_dim: int, dtype=jnp.float32,
-                 mesh=None, kv_axis: str = "tp"):
+                 mesh=None, kv_axis: str = "tp", debug: bool = False):
         if num_pages < 2:
             raise ValueError(f"num_pages must be >= 2 (page 0 is the "
                              f"reserved trash page), got {num_pages}")
@@ -69,6 +77,15 @@ class PagedKVPool:
         # HBM is hot); page 0 reserved
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
         self._allocated = set()
+        # read-only pages owned by the prefix cache: page -> live sharers
+        # (refcount() reports 1 + sharers; 0 sharers = LRU-evictable)
+        self._cached: Dict[int, int] = {}
+        # invoked by alloc() when the free list can't cover a request:
+        # fn(n_short) reclaims up to n_short cached pages (LRU sweep)
+        self._reclaim: Optional[Callable[[int], int]] = None
+        # O(num_pages) invariant rebuilds are opt-in: tests/engines set
+        # debug=True (or pass force=) — bench/production paths skip them
+        self.debug = bool(debug)
 
     # -- allocator -----------------------------------------------------------
 
@@ -94,9 +111,14 @@ class PagedKVPool:
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """Allocate ``n`` pages; None (no partial grant) when the pool
-        can't satisfy the request — the scheduler's eviction signal."""
+        can't satisfy the request — the scheduler's eviction signal.
+        When a reclaim hook is installed (the prefix cache's LRU sweep),
+        a dry free list triggers it BEFORE giving up: cached refcount-0
+        pages are recycled ahead of recompute preemption."""
         if n < 0:
             raise ValueError(f"alloc({n})")
+        if n > len(self._free) and self._reclaim is not None:
+            self._reclaim(n - len(self._free))
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
@@ -109,6 +131,57 @@ class PagedKVPool:
                 raise ValueError(f"double free / foreign page {pg}")
             self._allocated.remove(pg)
             self._free.append(pg)
+
+    # -- cached (read-only, refcounted) pages --------------------------------
+
+    def set_reclaim(self, fn: Optional[Callable[[int], int]]) -> None:
+        """Install the cache's LRU sweep: ``fn(n)`` frees up to ``n``
+        refcount-0 cached pages; ``alloc`` calls it before failing."""
+        self._reclaim = fn
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._cached)
+
+    def refcount(self, pg: int) -> int:
+        """0 = free, 1 = exclusively owned (allocated, or cached with no
+        sharer), 1+n = cached and shared by n live requests.  A KV write
+        plan may only ever target refcount-1 ALLOCATED pages — the
+        ``cow-page-write`` analysis rule audits exactly this."""
+        if pg in self._cached:
+            return 1 + self._cached[pg]
+        return 1 if pg in self._allocated else 0
+
+    def cache_page(self, pg: int) -> None:
+        """allocated -> cached (refcount 0): the finishing request hands
+        the fully-written page to the prefix cache, read-only from here."""
+        if pg not in self._allocated:
+            raise ValueError(f"cannot cache non-allocated page {pg}")
+        self._allocated.remove(pg)
+        self._cached[pg] = 0
+
+    def share_page(self, pg: int) -> None:
+        """A live request attached this cached page to its page table."""
+        if pg not in self._cached:
+            raise ValueError(f"cannot share non-cached page {pg}")
+        self._cached[pg] += 1
+
+    def unshare_page(self, pg: int) -> None:
+        if self._cached.get(pg, 0) < 1:
+            raise ValueError(f"unshare of page {pg} with no sharers")
+        self._cached[pg] -= 1
+
+    def uncache_page(self, pg: int) -> None:
+        """cached (refcount 0) -> free: the cache evicted the entry; the
+        index entry must already be gone so no lookup can hand the page
+        out again after it becomes writable."""
+        if pg not in self._cached:
+            raise ValueError(f"cannot uncache non-cached page {pg}")
+        if self._cached[pg] != 0:
+            raise ValueError(f"evicting cached page {pg} with "
+                             f"{self._cached[pg]} live sharers")
+        del self._cached[pg]
+        self._free.append(pg)
 
     def reset(self, clear_pages: bool = False) -> None:
         """Return the pool to its post-construction allocator state.
@@ -125,19 +198,34 @@ class PagedKVPool:
         """
         self._free = list(range(self.num_pages - 1, 0, -1))
         self._allocated = set()
+        self._cached = {}
         if clear_pages:
             self.k_pages = tuple(jnp.zeros_like(p) for p in self.k_pages)
             self.v_pages = tuple(jnp.zeros_like(p) for p in self.v_pages)
 
-    def check_invariants(self) -> None:
-        """Allocator bookkeeping invariants (asserted by tests after
-        every scheduling storm): free+allocated partition the usable
-        pages, trash page never issued."""
+    def check_invariants(self, force: bool = False) -> None:
+        """Allocator bookkeeping invariants: free/allocated/cached
+        PARTITION the usable pages (pairwise disjoint, nothing leaked or
+        invented), trash page never issued, cached refcounts
+        non-negative.  Rebuilding the sets is O(num_pages), so the check
+        is OPT-IN: a no-op unless the pool was built with ``debug=True``
+        (tests, debug engines) or ``force=True`` is passed — bench and
+        production paths skip it on every scheduling storm."""
+        if not (self.debug or force):
+            return
         free = set(self._free)
+        cached = set(self._cached)
+        assert len(free) == len(self._free), "free list holds duplicates"
         assert not (free & self._allocated), "page both free and allocated"
-        assert free | self._allocated == set(range(1, self.num_pages)), \
-            "pages leaked or invented"
+        assert not (free & cached), "page both free and cached"
+        assert not (self._allocated & cached), \
+            "page both allocated and cached"
+        assert free | self._allocated | cached \
+            == set(range(1, self.num_pages)), "pages leaked or invented"
         assert TRASH_PAGE not in free and TRASH_PAGE not in self._allocated
+        assert TRASH_PAGE not in cached, "trash page entered the cache"
+        assert all(rc >= 0 for rc in self._cached.values()), \
+            "negative cached-page refcount"
 
     # -- accounting ----------------------------------------------------------
 

@@ -1,0 +1,258 @@
+"""Copy-on-write prefix caching over the paged KV pool.
+
+Under multi-user traffic most requests share a prefix — a system
+prompt, a few-shot header, a conversation so far — and the engine used
+to recompute that prefill for every arrival.  The paged pool already
+stores KV page-granularly and the unified step already consumes an
+arbitrary per-request page table, so cached pages can enter a new
+request's table with ZERO kernel changes; this module adds the index
+that makes the reuse safe.
+
+**Chained page hashing** (vLLM/SGLang style).  A full page of KV at
+page index ``i`` is determined by exactly ``tokens[0 : (i+1)*page_size]``
+(causality: position ``j``'s K/V depends only on tokens ``<= j``).  The
+index therefore keys each cached page by ``(parent_entry_id,
+page_tokens)`` — the parent link chains the whole prefix into the key,
+so equal keys imply equal full token prefixes (Python's tuple hash does
+the chaining; the match is exact, never probabilistic).  Lookups walk
+the chain page by page and stop at the first divergence: the longest
+cached page-aligned prefix.
+
+**Copy-on-write rules.**  Cached pages are READ-ONLY.  A request that
+attaches a cached prefix starts its KV cursor (``pos``) at the cached
+boundary, so its per-token KV write plan only ever targets freshly
+allocated pages — the first partial or divergent page is always a new
+allocation, never a shared one.  The pool tracks a refcount per cached
+page (``1 +`` live sharers); the ``cow-page-write`` analysis rule
+audits the engine's write-plan tap and fails CI if any live row writes
+a cached page at all — refcount 1 (no sharers) is still read-only,
+because the index serves the page to future lookups.
+
+**Lookup cap.**  A request's match is capped at
+``(len(tokens) - 1) // page_size`` pages: at least one token always
+remains uncached, because the engine must still run the final prompt
+position through the model to sample the first new token.  Caching is
+page-aligned-only on purpose — a partial-page hit would need the tail
+of the page recomputed into a *different* physical page, and stitching
+two half-pages is exactly the kind of layout change that breaks the
+bit-for-bit contract.  Full-page reuse reads identical page contents
+through the identical kernel, so temperature-0 outputs are unchanged.
+
+**Insertion** happens when a request FINISHES: every fully-written page
+(``(i+1)*page_size <= pos``, generated tokens included — they extend
+the token prefix like any other) moves from the request's ownership
+into the index at refcount 0; pages whose content is already cached
+are freed as duplicates; the partial tail page is freed.
+
+**Eviction** is LRU over refcount-0 entries, leaves first.  Any
+request sharing a child page also shares its parents, so
+``refcount(parent) >= refcount(child)`` — a refcount-0 entry's whole
+subtree is refcount-0 and leaf-first order can always reach it.  The
+pool calls :meth:`evict` through its reclaim hook when the free list
+runs dry, so cache reclamation happens BEFORE the scheduler falls back
+to recompute preemption; a page is removed from the index before it
+re-enters the free list, so the index never references a writable page.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .kv_pool import PagedKVPool
+
+ROOT = -1                       # parent id of a first-page entry
+
+
+@dataclass
+class CacheEntry:
+    """One cached read-only page: a node in the prefix tree."""
+    eid: int                    # unique entry id (the chain link)
+    parent: int                 # parent entry id, ROOT for page 0
+    tokens: Tuple[int, ...]     # this page's token content
+    page: int                   # physical page in the pool
+    depth: int                  # page index within its prefix
+    last_use: int = 0           # LRU clock (monotonic ticks)
+    refs: int = 0               # live requests sharing this page
+    children: int = 0           # child entries extending this prefix
+
+
+class PrefixCache:
+    """Refcounted index of read-only cached pages in a PagedKVPool."""
+
+    def __init__(self, pool: PagedKVPool):
+        self.pool = pool
+        self.page_size = pool.page_size
+        self._index: Dict[Tuple[int, Tuple[int, ...]], CacheEntry] = {}
+        self._by_id: Dict[int, CacheEntry] = {}
+        # req_id -> the entries it holds references on
+        self._attached: Dict[int, List[CacheEntry]] = {}
+        self._next_id = 0
+        self._tick = 0
+
+    # -- introspection -------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    @property
+    def evictable_pages(self) -> int:
+        """Pages an eviction sweep could reclaim right now.  Exactly the
+        refcount-0 entries: a refcount-0 entry's subtree is refcount-0
+        too (sharers of a child share its parents), so leaf-first
+        eviction reaches every one of them."""
+        return sum(1 for e in self._index.values() if e.refs == 0)
+
+    # -- lookup / attach -----------------------------------------------------
+
+    def _max_match_pages(self, tokens: Sequence[int]) -> int:
+        # at least one token must stay uncached: the engine still has to
+        # run the last prompt position to sample the first new token
+        return max(0, len(tokens) - 1) // self.page_size
+
+    def match(self, tokens: Sequence[int]) -> List[CacheEntry]:
+        """Longest chain of cached full pages covering ``tokens`` —
+        NO side effects (admission accounting peeks with this)."""
+        ps = self.page_size
+        out: List[CacheEntry] = []
+        parent = ROOT
+        for i in range(self._max_match_pages(tokens)):
+            e = self._index.get((parent, tuple(tokens[i * ps:(i + 1) * ps])))
+            if e is None:
+                break
+            out.append(e)
+            parent = e.eid
+        return out
+
+    def acquire(self, req) -> List[CacheEntry]:
+        """Attach the longest cached prefix to ``req``: refcount every
+        matched page (they become unevictable) and touch the LRU clock.
+        The caller points the request's page table at ``entry.page`` and
+        starts ``pos`` at the cached boundary."""
+        entries = self.match(req.tokens)
+        if not entries:
+            return entries
+        self._tick += 1
+        for e in entries:
+            e.refs += 1
+            e.last_use = self._tick
+            self.pool.share_page(e.page)
+        self._attached[req.req_id] = entries
+        return entries
+
+    def release(self, req) -> int:
+        """Drop ``req``'s shared references (preemption, admission
+        rollback, or the tail of :meth:`on_finish`)."""
+        entries = self._attached.pop(req.req_id, [])
+        for e in entries:
+            e.refs -= 1
+            self.pool.unshare_page(e.page)
+        return len(entries)
+
+    # -- insertion (request finish) ------------------------------------------
+
+    def on_finish(self, req) -> Tuple[int, int]:
+        """Retire a finished request's pages through the cache: insert
+        every fully-written owned page, free duplicates and the partial
+        tail, release shared references.  Returns
+        ``(pages_inserted, pages_freed)``."""
+        ps = self.page_size
+        shared = self._attached.get(req.req_id, [])
+        # pages fully written by the request (pos = next write index)
+        full = min(len(req.pages), req.pos // ps)
+        parent = shared[-1].eid if shared else ROOT
+        inserted = 0
+        for i in range(len(shared), full):
+            key = (parent, tuple(req.tokens[i * ps:(i + 1) * ps]))
+            page = req.pages[i]
+            have = self._index.get(key)
+            if have is not None:
+                # identical content already cached: ours is a duplicate
+                self.pool.free([page])
+                parent = have.eid
+                continue
+            self.pool.cache_page(page)
+            self._tick += 1
+            e = CacheEntry(eid=self._next_id, parent=parent,
+                           tokens=key[1], page=page, depth=i,
+                           last_use=self._tick)
+            self._next_id += 1
+            self._index[key] = e
+            self._by_id[e.eid] = e
+            if parent != ROOT:
+                self._by_id[parent].children += 1
+            parent = e.eid
+            inserted += 1
+        tail = req.pages[full:]
+        if tail:
+            self.pool.free(tail)
+        self.release(req)
+        freed = (full - len(shared) - inserted) + len(tail)
+        req.pages = []
+        req.shared_pages = 0
+        return inserted, freed
+
+    # -- eviction ------------------------------------------------------------
+
+    def evict(self, n: int) -> int:
+        """Reclaim up to ``n`` pages: LRU refcount-0 leaves first (each
+        removal may expose its parent as the next leaf).  O(entries) per
+        page — pools are tens-to-hundreds of pages, and this only runs
+        when the free list is already dry."""
+        freed = 0
+        while freed < n:
+            cands = [e for e in self._index.values()
+                     if e.refs == 0 and e.children == 0]
+            if not cands:
+                break
+            victim = min(cands, key=lambda e: (e.last_use, e.eid))
+            self._remove(victim)
+            freed += 1
+        return freed
+
+    def _remove(self, e: CacheEntry) -> None:
+        del self._index[(e.parent, e.tokens)]
+        del self._by_id[e.eid]
+        if e.parent != ROOT:
+            self._by_id[e.parent].children -= 1
+        self.pool.uncache_page(e.page)
+
+    def clear(self) -> None:
+        """Evict everything evictable (attached entries survive — live
+        requests still read their pages)."""
+        self.evict(len(self._index))
+
+    # -- invariants ----------------------------------------------------------
+
+    def check_invariants(self, force: bool = False) -> None:
+        """Cache-side bookkeeping invariants (the pool partition has its
+        own in ``PagedKVPool.check_invariants``).  Opt-in like the
+        pool's: runs only under ``pool.debug`` or ``force``."""
+        if not (self.pool.debug or force):
+            return
+        assert len(self._index) == len(self._by_id)
+        per_page_refs: Dict[int, int] = {}
+        children: Dict[int, int] = {}
+        for e in self._index.values():
+            assert self._by_id[e.eid] is e
+            assert e.refs >= 0, f"negative refcount on entry {e.eid}"
+            per_page_refs[e.page] = e.refs
+            if e.parent != ROOT:
+                parent = self._by_id.get(e.parent)
+                assert parent is not None, \
+                    f"entry {e.eid} orphaned: parent {e.parent} evicted"
+                assert parent.depth == e.depth - 1
+                assert parent.refs >= e.refs, \
+                    "child page outlives its parent's sharers"
+                children[e.parent] = children.get(e.parent, 0) + 1
+        for e in self._index.values():
+            assert e.children == children.get(e.eid, 0)
+        # the pool's cached partition and the index agree page-for-page
+        assert per_page_refs == dict(self.pool._cached), \
+            "cache index and pool cached-page partition diverged"
+        attached_refs: Dict[int, int] = {}
+        for entries in self._attached.values():
+            for e in entries:
+                attached_refs[e.eid] = attached_refs.get(e.eid, 0) + 1
+        for e in self._index.values():
+            assert e.refs == attached_refs.get(e.eid, 0), \
+                f"entry {e.eid} refcount {e.refs} != attached references"

@@ -34,6 +34,12 @@ class Request:
     tokens: List[int] = field(default_factory=list)
     out_tokens: List[int] = field(default_factory=list)
     pages: List[int] = field(default_factory=list)
+    # the first shared_pages entries of ``pages`` are READ-ONLY prefix-
+    # cache pages (refcounted, never in the KV write plan); the rest are
+    # exclusively owned.  cached_tokens = prefill tokens skipped via the
+    # cache on the most recent start (metrics / tests).
+    shared_pages: int = 0
+    cached_tokens: int = 0
     pos: int = 0                 # KV entries committed (next write index)
     state: str = WAITING
     n_preemptions: int = 0

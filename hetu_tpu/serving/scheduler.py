@@ -34,7 +34,8 @@ from .request import RUNNING, WAITING, Request, RequestQueue
 
 class Scheduler:
     def __init__(self, pool: PagedKVPool, max_batch: int = 8,
-                 chunk: int = 64, prefill_rows: int = 1):
+                 chunk: int = 64, prefill_rows: int = 1,
+                 prefix_cache=None):
         if prefill_rows < 1:
             raise ValueError(f"prefill_rows must be >= 1, got "
                              f"{prefill_rows}")
@@ -44,6 +45,11 @@ class Scheduler:
         self.max_batch = int(max_batch)
         self.chunk = int(chunk)
         self.prefill_rows = int(prefill_rows)
+        # optional serving.prefix_cache.PrefixCache: admission charges
+        # only the UNCACHED suffix against the page budget (and counts
+        # refcount-0 cached pages as reclaimable), preemption releases
+        # shared pages instead of freeing them
+        self.cache = prefix_cache
 
     @property
     def token_budget(self) -> int:
@@ -56,14 +62,30 @@ class Scheduler:
               now: float) -> List[Request]:
         """Pop arrived requests while a sequence slot AND the pages for
         prompt+first-token fit.  Stops at the first request that doesn't
-        fit (FIFO — no small-request overtaking, keeps TTFT fair)."""
+        fit (FIFO — no small-request overtaking, keeps TTFT fair).
+
+        With a prefix cache, a candidate is charged only its UNCACHED
+        suffix: matched pages come for free, and refcount-0 cached pages
+        count as reclaimable budget (the pool's reclaim hook evicts them
+        on demand at ``_start``) — except the matched ones themselves,
+        which this admission is about to pin."""
         admitted: List[Request] = []
-        budget = self.pool.free_pages   # pages not yet claimed this step
+        # free pages + LRU-reclaimable cached pages not yet claimed
+        budget = self.pool.free_pages
+        if self.cache is not None:
+            budget += self.cache.evictable_pages
+        pinned = set()
         while len(running) + len(admitted) < self.max_batch:
             req = queue.pop_ready(now)
             if req is None:
                 break
             need = self.pool.pages_for(len(req.tokens) + 1)
+            if self.cache is not None:
+                for e in self.cache.match(req.tokens):
+                    need -= 1          # cached page: nothing to allocate
+                    if e.refs == 0 and e.eid not in pinned:
+                        budget -= 1    # ...but it is no longer evictable
+                        pinned.add(e.eid)
             if need > budget:
                 queue.push(req)        # original arrival order: stays first
                 break
@@ -139,9 +161,16 @@ class Scheduler:
         """Recompute-style eviction: drop KV state, keep the token
         history — re-prefilling ``req.tokens`` (chunked like any other
         prompt) reproduces the sequence exactly (asserted at
-        temperature 0 in tests)."""
-        self.pool.free(req.pages)
+        temperature 0 in tests).  Shared prefix-cache pages are
+        RELEASED (refcount drop), never freed — other requests and the
+        cache index still hold them; only exclusively-owned pages return
+        to the free list."""
+        self.pool.free(req.pages[req.shared_pages:])
+        if self.cache is not None and req.shared_pages:
+            self.cache.release(req)
         req.pages = []
+        req.shared_pages = 0
+        req.cached_tokens = 0
         req.pos = 0
         req.state = WAITING
         req.n_preemptions += 1

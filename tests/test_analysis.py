@@ -320,6 +320,63 @@ class TestSeededViolations:
                                serving={"pool": pool2, "tap": tap_ok})
         assert not run_rules(ctx3, only=["trash-page-write"])
 
+    def test_cow_page_write_fires_once_per_seed(self):
+        """Copy-on-write contract: a unified-step tap record whose KV
+        write plan targets a CACHED page (in the refcount snapshot —
+        read-only whatever the sharer count) fires exactly once per
+        offending row; writes to exclusively-owned pages, READS of
+        cached pages, and trash-page padding stay silent."""
+        pool = PagedKVPool(num_layers=1, num_pages=8, page_size=8,
+                           kv_heads=1, head_dim=4)
+        # seeded violation: row 0 writes tokens at pos 8..11 -> page-
+        # table slot 1 -> page 2, which the snapshot says is shared
+        # (refcount 2 = cache + one live sharer).  Four tokens hit it;
+        # the rule reports the ROW once, not four findings.
+        tap = [{"kind": "unified", "rows": [(0, 8, 4)],
+                "page_tables": np.array([[3, 2, 0]], np.int32),
+                "refcounts": {2: 2}}]
+        ctx = AnalysisContext(name="t_cow",
+                              serving={"pool": pool, "tap": tap})
+        fired = run_rules(ctx, only=["cow-page-write"])
+        assert len(fired) == 1
+        assert "page 2" in fired[0].message
+        assert "refcount 2" in fired[0].message
+        assert fired[0].hint and "copy-on-write" in fired[0].hint
+
+        # a cached page with ZERO live sharers (refcount 1) is still
+        # read-only — the index serves it to future lookups
+        tap_rc1 = [{"kind": "unified", "rows": [(0, 8, 4)],
+                    "page_tables": np.array([[3, 2, 0]], np.int32),
+                    "refcounts": {2: 1}}]
+        ctx_rc1 = AnalysisContext(name="t_cow1",
+                                  serving={"pool": pool, "tap": tap_rc1})
+        assert len(run_rules(ctx_rc1, only=["cow-page-write"])) == 1
+
+        # clean: the write cursor starts PAST the shared page (pos 8
+        # writes page-table slot 1 = page 3, exclusively owned — never
+        # in the cached-page snapshot); page 2 is only READ
+        tap_ok = [{"kind": "unified", "rows": [(0, 8, 4)],
+                   "page_tables": np.array([[2, 3, 0]], np.int32),
+                   "refcounts": {2: 2}}]
+        ctx2 = AnalysisContext(name="t_cow2",
+                               serving={"pool": pool, "tap": tap_ok})
+        assert not run_rules(ctx2, only=["cow-page-write"])
+
+        # trash-page padding is exempt even at refcount > 1
+        tap_pad = [{"kind": "unified", "rows": [(0, 0, 2)],
+                    "page_tables": np.array([[0, 0, 0]], np.int32),
+                    "refcounts": {0: 5}}]
+        ctx3 = AnalysisContext(name="t_cow3",
+                               serving={"pool": pool, "tap": tap_pad})
+        assert not run_rules(ctx3, only=["cow-page-write"])
+
+        # records without a refcount snapshot (cache off) are skipped
+        tap_off = [{"kind": "unified", "rows": [(0, 8, 4)],
+                    "page_tables": np.array([[3, 2, 0]], np.int32)}]
+        ctx4 = AnalysisContext(name="t_cow4",
+                               serving={"pool": pool, "tap": tap_off})
+        assert not run_rules(ctx4, only=["cow-page-write"])
+
 
 # ---------------------------------------------------------------------------
 # the general pass reproduces PR 1's grad-comm assertions

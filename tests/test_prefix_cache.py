@@ -1,0 +1,620 @@
+"""Copy-on-write prefix caching over the paged KV pool (ISSUE 7).
+
+Three layers of coverage:
+
+- **pool partition** — the third page state (cached, read-only,
+  refcounted) added to ``PagedKVPool``: legal/illegal transitions, the
+  extended ``check_invariants`` partition, the reclaim hook;
+- **index mechanics** — chained page hashing (``PrefixCache``):
+  longest-prefix match, insertion with dedup, LRU leaf-first eviction,
+  refcounts pinning pages against eviction — plus a randomized
+  alloc/free/share/evict fuzz trace asserting the invariants at every
+  step (no page is ever simultaneously free, allocated, and cached;
+  refcounts return to zero);
+- **engine contract** — temperature-0 outputs bit-for-bit identical
+  between cache-hit and cache-cold runs (late arrivals, eviction
+  pressure, and preemption asserted), hit rate 100% for identical
+  page-aligned prefixes with ``compile_count <= 2``, prefill charged
+  only the uncached suffix, LRU reclaim firing BEFORE recompute
+  preemption, and ``reset_metrics`` zeroing the cache counters.
+"""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.models.generate import generate
+from hetu_tpu.serving import Engine, PagedKVPool, PrefixCache, Request
+
+CFG_KW = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+              max_seq_len=64, sp=False, dropout=0.0)
+
+
+def _build_state(cfg, seed=3):
+    ht.set_seed(seed)
+    with ht.graph("eager", create_new=True):
+        model = GPTLMHeadModel(cfg)
+        model.logits(np.zeros((1, 4), np.int32))
+        state = {k: np.asarray(v) for k, v in model.state_dict().items()}
+    return state
+
+
+def _solo(state, cfg, prompt, n_new):
+    return np.asarray(generate(state, cfg,
+                               np.asarray([prompt], np.int32), n_new,
+                               temperature=0.0))[0, len(prompt):].tolist()
+
+
+def _make_engine(state, cfg, **kw):
+    clock = [0.0]
+    kw.setdefault("time_fn", lambda: clock[0])
+    kw.setdefault("debug", True)        # invariant checks on in tests
+    eng = Engine(state, cfg, **kw)
+    eng._test_clock = clock
+    return eng
+
+
+def _drain(eng):
+    while eng.has_work:
+        eng.step()
+        eng._test_clock[0] += 1.0
+
+
+def _pool(num_pages=10, page_size=4):
+    return PagedKVPool(num_layers=1, num_pages=num_pages,
+                       page_size=page_size, kv_heads=1, head_dim=4,
+                       debug=True)
+
+
+def _finished_req(pool, cache, rid, tokens, n_written=None):
+    """Drive a fake request through alloc -> write -> on_finish so its
+    full pages land in the index (no model involved)."""
+    n = len(tokens) if n_written is None else n_written
+    req = Request(req_id=rid, prompt=list(tokens), max_new_tokens=1)
+    req.pages = pool.alloc(pool.pages_for(len(tokens)))
+    assert req.pages is not None
+    req.pos = n
+    cache.on_finish(req)
+    pool.check_invariants()
+    cache.check_invariants()
+    return req
+
+
+# ---------------------------------------------------------------------------
+# pool: the cached (read-only, refcounted) page state
+# ---------------------------------------------------------------------------
+
+class TestPoolCachedState:
+    def test_transitions_and_refcounts(self):
+        pool = _pool()
+        (pg,) = pool.alloc(1)
+        assert pool.refcount(pg) == 1           # exclusively owned
+        pool.cache_page(pg)
+        assert pool.refcount(pg) == 1           # cached, no sharers
+        assert pool.cached_pages == 1 and pool.used_pages == 0
+        pool.share_page(pg)
+        pool.share_page(pg)
+        assert pool.refcount(pg) == 3
+        with pytest.raises(ValueError):          # still shared: not free
+            pool.uncache_page(pg)
+        pool.unshare_page(pg)
+        pool.unshare_page(pg)
+        pool.uncache_page(pg)
+        assert pool.refcount(pg) == 0 and pg in pool._free
+        pool.check_invariants()
+
+    def test_illegal_transitions_raise(self):
+        pool = _pool()
+        (pg,) = pool.alloc(1)
+        with pytest.raises(ValueError):          # not cached yet
+            pool.share_page(pg)
+        with pytest.raises(ValueError):
+            pool.unshare_page(pg)
+        with pytest.raises(ValueError):
+            pool.uncache_page(pg)
+        pool.cache_page(pg)
+        with pytest.raises(ValueError):          # already cached
+            pool.cache_page(pg)
+        free_pg = pool._free[-1]
+        with pytest.raises(ValueError):          # free page can't cache
+            pool.cache_page(free_pg)
+
+    def test_invariants_catch_partition_violations(self):
+        pool = _pool()
+        (pg,) = pool.alloc(1)
+        pool.cache_page(pg)
+        pool._free.append(pg)                   # corrupt: free AND cached
+        with pytest.raises(AssertionError):
+            pool.check_invariants()
+        pool = _pool()
+        (pg,) = pool.alloc(1)
+        pool._cached[pg] = 0                    # allocated AND cached
+        with pytest.raises(AssertionError):
+            pool.check_invariants()
+
+    def test_invariants_opt_in(self):
+        """The O(num_pages) rebuild is skipped unless debug/force — a
+        corrupted non-debug pool only trips under force=True."""
+        pool = PagedKVPool(num_layers=1, num_pages=6, page_size=4,
+                           kv_heads=1, head_dim=4)   # debug=False
+        (pg,) = pool.alloc(1)
+        pool._free.append(pg)                   # free AND allocated
+        pool.check_invariants()                 # no-op: opt-in
+        with pytest.raises(AssertionError):
+            pool.check_invariants(force=True)
+
+    def test_reclaim_hook_runs_before_alloc_fails(self):
+        pool = _pool(num_pages=5)
+        pages = pool.alloc(4)                   # pool now dry
+        for pg in pages:
+            pool.cache_page(pg)
+        calls = []
+
+        def reclaim(n):
+            calls.append(n)
+            for pg in pages[:n]:
+                pool.uncache_page(pg)
+            return n
+
+        pool.set_reclaim(reclaim)
+        got = pool.alloc(2)
+        assert calls == [2] and got is not None and len(got) == 2
+        pool.check_invariants()
+
+    def test_reset_clears_cached_partition(self):
+        pool = _pool()
+        (pg,) = pool.alloc(1)
+        pool.cache_page(pg)
+        pool.reset()
+        assert pool.cached_pages == 0
+        assert pool.free_pages == pool.num_usable
+        pool.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# index mechanics: chained hash, dedup, LRU eviction
+# ---------------------------------------------------------------------------
+
+class TestPrefixCacheIndex:
+    def test_match_walks_chain_and_stops_at_divergence(self):
+        pool = _pool(page_size=4)
+        cache = PrefixCache(pool)
+        _finished_req(pool, cache, 0, list(range(12)))  # pages 0-3,4-7,8-11
+        assert len(cache) == 3
+        # full match capped at (len-1)//ps: the last token stays uncached
+        assert len(cache.match(list(range(12)))) == 2
+        assert len(cache.match(list(range(13)))) == 3
+        # divergence mid-chain stops the walk
+        toks = list(range(8)) + [99, 99, 99, 99, 0]
+        assert len(cache.match(toks)) == 2
+        toks = [99] + list(range(1, 13))
+        assert cache.match(toks) == []
+        # sub-page prompts can never match
+        assert cache.match(list(range(4))) == []
+
+    def test_chained_key_rejects_same_page_different_prefix(self):
+        """Two sequences sharing page-1 CONTENT but differing in page 0
+        must not collide: the parent link chains the whole prefix into
+        the key."""
+        pool = _pool(page_size=4)
+        cache = PrefixCache(pool)
+        a = [1, 2, 3, 4, 5, 6, 7, 8, 0]
+        b = [9, 9, 9, 9, 5, 6, 7, 8, 0]        # same 2nd page tokens
+        _finished_req(pool, cache, 0, a)
+        assert len(cache.match(b)) == 0        # page 0 diverges: no hit
+        _finished_req(pool, cache, 1, b)
+        assert len(cache) == 4                 # both [5,6,7,8] pages live
+        assert len(cache.match(a)) == 2
+        assert len(cache.match(b)) == 2
+
+    def test_on_finish_dedups_against_existing_entries(self):
+        pool = _pool(page_size=4)
+        cache = PrefixCache(pool)
+        toks = list(range(9))
+        _finished_req(pool, cache, 0, toks)
+        free_before = pool.free_pages
+        _finished_req(pool, cache, 1, toks)    # identical content
+        assert len(cache) == 2                 # nothing new inserted
+        assert pool.free_pages == free_before  # duplicates+tail freed
+        assert pool.cached_pages == 2
+
+    def test_partial_tail_page_is_freed_not_cached(self):
+        pool = _pool(page_size=4)
+        cache = PrefixCache(pool)
+        # 6 written tokens on 2 pages: page 1 only half full
+        _finished_req(pool, cache, 0, list(range(6)))
+        assert len(cache) == 1 and pool.cached_pages == 1
+
+    def test_acquire_release_pins_against_eviction(self):
+        pool = _pool(page_size=4)
+        cache = PrefixCache(pool)
+        _finished_req(pool, cache, 0, list(range(9)))
+        req = Request(req_id=1, prompt=list(range(9)), max_new_tokens=1)
+        entries = cache.acquire(req)
+        assert [e.depth for e in entries] == [0, 1]
+        assert all(e.refs == 1 for e in entries)
+        assert cache.evictable_pages == 0
+        assert cache.evict(5) == 0             # everything pinned
+        cache.release(req)
+        assert cache.evictable_pages == 2
+        assert cache.evict(5) == 2
+        assert pool.cached_pages == 0
+        assert pool.free_pages == pool.num_usable
+        pool.check_invariants()
+        cache.check_invariants()
+
+    def test_lru_evicts_leaf_first_oldest_first(self):
+        pool = _pool(num_pages=12, page_size=4)
+        cache = PrefixCache(pool)
+        a = list(range(9))                     # chain A: 2 pages
+        b = [50, 51, 52, 53, 0]                # chain B: 1 page
+        _finished_req(pool, cache, 0, a)
+        _finished_req(pool, cache, 1, b)
+        # touch chain A: B becomes the LRU entry
+        req = Request(req_id=2, prompt=a, max_new_tokens=1)
+        cache.acquire(req)
+        cache.release(req)
+        assert cache.evict(1) == 1
+        assert cache.match(b) == []            # B went first
+        assert len(cache.match(a)) == 2
+        # evicting A removes the LEAF (depth 1) before its parent
+        assert cache.evict(1) == 1
+        assert len(cache.match(a)) == 1
+        cache.check_invariants()
+
+    def test_preempted_rerun_releases_then_reacquires(self):
+        pool = _pool(page_size=4)
+        cache = PrefixCache(pool)
+        _finished_req(pool, cache, 0, list(range(9)))
+        req = Request(req_id=1, prompt=list(range(9)), max_new_tokens=1)
+        e1 = cache.acquire(req)
+        cache.release(req)                      # preemption path
+        assert all(e.refs == 0 for e in e1)
+        e2 = cache.acquire(req)                 # re-start re-pins
+        assert [e.eid for e in e1] == [e.eid for e in e2]
+        assert all(e.refs == 1 for e in e2)
+        cache.release(req)
+        cache.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# randomized fuzz: pool + cache bookkeeping under an adversarial trace
+# ---------------------------------------------------------------------------
+
+def test_fuzz_alloc_free_share_evict_invariants_hold():
+    """Randomized alloc/free/finish(share-into-cache)/acquire/release/
+    evict trace over PagedKVPool + PrefixCache.  After EVERY operation
+    the partition invariants hold (no page simultaneously free,
+    allocated, and cached); at the end all refcounts return to zero and
+    every page returns to the free list."""
+    rng = np.random.RandomState(7)
+    pool = _pool(num_pages=17, page_size=4)
+    cache = PrefixCache(pool)
+    pool.set_reclaim(cache.evict)
+    live = {}                                  # rid -> Request (allocated)
+    holders = {}                               # rid -> Request (acquired)
+    next_rid = 0
+    for step in range(400):
+        op = rng.randint(5)
+        if op == 0:                            # start a request
+            n_tok = int(rng.randint(1, 14))
+            toks = [int(t) for t in rng.randint(0, 6, size=n_tok)]
+            req = Request(req_id=next_rid, prompt=toks, max_new_tokens=1)
+            next_rid += 1
+            entries = cache.acquire(req)
+            if entries:
+                req.pages = [e.page for e in entries]
+                req.shared_pages = len(entries)
+                req.pos = len(entries) * pool.page_size
+            got = pool.alloc(pool.pages_for(n_tok) - len(req.pages))
+            if got is None:                    # rollback, like Engine._start
+                cache.release(req)
+            else:
+                req.pages = req.pages + got
+                live[req.req_id] = req
+        elif op == 1 and live:                 # finish: insert into cache
+            rid = list(live)[rng.randint(len(live))]
+            req = live.pop(rid)
+            req.pos = int(rng.randint(req.pos,
+                                      len(req.pages) * pool.page_size + 1))
+            cache.on_finish(req)
+        elif op == 2 and live:                 # preempt: free + release
+            rid = list(live)[rng.randint(len(live))]
+            req = live.pop(rid)
+            pool.free(req.pages[req.shared_pages:])
+            cache.release(req)
+            req.pages = []
+            req.shared_pages = 0
+        elif op == 3:                          # reader acquires a prefix
+            n_tok = int(rng.randint(1, 14))
+            toks = [int(t) for t in rng.randint(0, 6, size=n_tok)]
+            req = Request(req_id=next_rid, prompt=toks, max_new_tokens=1)
+            next_rid += 1
+            if cache.acquire(req):
+                holders[req.req_id] = req
+        elif op == 4:
+            if holders and rng.randint(2):     # reader leaves
+                rid = list(holders)[rng.randint(len(holders))]
+                cache.release(holders.pop(rid))
+            else:
+                cache.evict(int(rng.randint(1, 4)))
+        pool.check_invariants()
+        cache.check_invariants()
+        # the three states partition: implied by check_invariants, but
+        # assert the headline property explicitly
+        free = set(pool._free)
+        assert not (free & pool._allocated & set(pool._cached))
+    for req in list(live.values()):
+        pool.free(req.pages[req.shared_pages:])
+        cache.release(req)
+    for req in list(holders.values()):
+        cache.release(req)
+    assert cache.evictable_pages == len(cache)  # all refs back to zero
+    cache.clear()
+    assert len(cache) == 0 and pool.cached_pages == 0
+    assert pool.free_pages == pool.num_usable
+    pool.check_invariants()
+    cache.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# engine contract: bit-for-bit reuse, hit rate, eviction-before-preemption
+# ---------------------------------------------------------------------------
+
+class TestEnginePrefixReuse:
+    def test_cache_hit_bit_for_bit_vs_cold_and_solo(self):
+        """Identical prompt set through (a) a cold engine with the cache
+        disabled and (b) a warm engine serving everything off cached
+        pages: outputs match each other AND solo generate() exactly."""
+        cfg = GPTConfig(position="rotary", norm="rmsnorm",
+                        activation="swiglu", **CFG_KW)
+        state = _build_state(cfg, seed=7)
+        rng = np.random.RandomState(2)
+        header = [int(t) for t in rng.randint(1, 90, size=16)]
+        prompts = [header + [int(t) for t in rng.randint(1, 90, size=n)]
+                   for n in (3, 7, 5)]
+        want = [_solo(state, cfg, pr, 6) for pr in prompts]
+        cold = _make_engine(state, cfg, num_pages=24, page_size=8,
+                            max_batch=4, chunk_size=8, prefix_cache=False)
+        cold_reqs = [cold.add_request(p, 6, arrival_time=0.0)
+                     for p in prompts]
+        _drain(cold)
+        assert cold.metrics_summary()["prefix_cache_hits"] == 0
+        warm = _make_engine(state, cfg, num_pages=24, page_size=8,
+                            max_batch=4, chunk_size=8)
+        warm.add_request(prompts[0], 6, arrival_time=0.0)
+        _drain(warm)
+        assert warm.pool.cached_pages > 0
+        reqs = [warm.add_request(p, 6, arrival_time=warm._test_clock[0])
+                for p in prompts]
+        _drain(warm)
+        for r, c, w in zip(reqs, cold_reqs, want):
+            assert r.out_tokens == w
+            assert c.out_tokens == w
+        assert all(r.cached_tokens >= 16 for r in reqs)
+        assert warm.compile_count == 1
+
+    def test_identical_page_aligned_prefix_hit_rate_100(self):
+        """The CI pin: replaying identical prompts whose length spans
+        full pages hits the cache on EVERY request (hit rate 1.0) and
+        the engine still compiles at most 2 executables."""
+        cfg = GPTConfig(position="learned", norm="layernorm",
+                        activation="gelu", **CFG_KW)
+        state = _build_state(cfg, seed=11)
+        rng = np.random.RandomState(3)
+        prompts = [[int(t) for t in rng.randint(1, 90, size=n)]
+                   for n in (16, 24, 17)]       # > page_size each
+        eng = _make_engine(state, cfg, num_pages=32, page_size=8,
+                           max_batch=4, chunk_size=8)
+        reqs = [eng.add_request(p, 4, arrival_time=0.0) for p in prompts]
+        _drain(eng)
+        want = [list(r.out_tokens) for r in reqs]
+        eng.reset_metrics()
+        replay = [eng.add_request(p, 4, arrival_time=eng._test_clock[0])
+                  for p in prompts]
+        _drain(eng)
+        m = eng.metrics_summary()
+        assert m["prefix_cache_hit_rate"] == 1.0
+        assert m["prefix_cache_misses"] == 0
+        # every full prompt page is reused: (len-1)//ps pages per prompt
+        saved = sum((len(p) - 1) // 8 * 8 for p in prompts)
+        assert m["prefix_cache_tokens_saved"] == saved
+        assert m["compile_count"] <= 2 and eng.compile_count == 1
+        for r, w in zip(replay, want):
+            assert r.out_tokens == w
+
+    def test_prefill_charged_only_for_uncached_suffix(self):
+        """The scheduler starts prefill chunks at the cached boundary:
+        the replay's prefill_tokens counter covers ONLY the uncached
+        suffix, and the whole replay takes fewer executable calls."""
+        cfg = GPTConfig(position="rotary", norm="rmsnorm",
+                        activation="silu", **CFG_KW)
+        state = _build_state(cfg, seed=9)
+        rng = np.random.RandomState(4)
+        prompt = [int(t) for t in rng.randint(1, 90, size=33)]
+        eng = _make_engine(state, cfg, num_pages=24, page_size=8,
+                           max_batch=2, chunk_size=8)
+        eng.add_request(prompt, 4, arrival_time=0.0)
+        _drain(eng)
+        cold_m = eng.metrics_summary()
+        assert cold_m["prefill_tokens"] == 33
+        eng.reset_metrics()
+        req = eng.add_request(prompt, 4, arrival_time=eng._test_clock[0])
+        _drain(eng)
+        m = eng.metrics_summary()
+        # 33 tokens = 4 full pages + 1; the 4 full pages come cached
+        assert req.cached_tokens == 32
+        assert m["prefill_tokens"] == 33 - 32
+        assert m["executable_calls"] < cold_m["executable_calls"]
+        assert req.out_tokens == _solo(state, cfg, prompt, 4)
+
+    def test_lru_reclaim_fires_before_recompute_preemption(self):
+        """A full cache and a page-hungry arrival: the pool's reclaim
+        hook LRU-evicts cached pages and the request runs WITHOUT any
+        recompute preemption."""
+        cfg = GPTConfig(position="learned", norm="layernorm",
+                        activation="gelu", **CFG_KW)
+        state = _build_state(cfg, seed=5)
+        rng = np.random.RandomState(6)
+        eng = _make_engine(state, cfg, num_pages=7, page_size=8,
+                           max_batch=2, chunk_size=8)
+        # fill the cache: two disjoint requests retire their pages in
+        for n in (16, 17):
+            pr = [int(t) for t in rng.randint(1, 90, size=n)]
+            eng.add_request(pr, 3, arrival_time=eng._test_clock[0])
+            _drain(eng)
+        assert eng.pool.cached_pages >= 4
+        assert eng.pool.free_pages < 5
+        big = [int(t) for t in rng.randint(1, 90, size=30)]
+        req = eng.add_request(big, 4, arrival_time=eng._test_clock[0])
+        _drain(eng)
+        m = eng.metrics_summary()
+        assert m["prefix_cache_evictions"] >= 1
+        assert m["preemptions"] == 0
+        assert req.out_tokens == _solo(state, cfg, big, 4)
+
+    def test_bit_for_bit_under_late_arrival_eviction_and_preemption(self):
+        """The hard determinism case with the cache ON: small pool
+        (forces BOTH cache eviction and recompute preemption), shared
+        headers, late arrivals — every output still matches its solo
+        run, and preempted requests re-attach through the cache."""
+        cfg = GPTConfig(position="rotary", norm="rmsnorm",
+                        activation="swiglu", **CFG_KW)
+        state = _build_state(cfg, seed=13)
+        rng = np.random.RandomState(8)
+        header = [int(t) for t in rng.randint(1, 90, size=8)]
+        prompts = [header + [int(t) for t in rng.randint(1, 90, size=n)]
+                   for n in (9, 2, 13, 5)]
+        want = [_solo(state, cfg, pr, 8) for pr in prompts]
+        eng = _make_engine(state, cfg, num_pages=7, page_size=8,
+                           max_batch=3, chunk_size=4)
+        # warm the header into the cache, then hit it with a late-
+        # arriving burst that overflows the 6-page pool
+        eng.add_request(header + prompts[0][8:10], 2, arrival_time=0.0)
+        _drain(eng)
+        reqs = [eng.add_request(pr, 8,
+                                arrival_time=eng._test_clock[0] + i)
+                for i, pr in enumerate(prompts)]
+        _drain(eng)
+        m = eng.metrics_summary()
+        assert m["preemptions"] >= 1, \
+            "trace should exercise preemption; shrink the pool if not"
+        assert m["prefix_cache_evictions"] >= 1, \
+            "trace should exercise cache eviction"
+        assert m["prefix_cache_hits"] >= 1
+        for r, w in zip(reqs, want):
+            assert r.out_tokens == w
+        assert eng.pool.used_pages == 0
+        assert eng.compile_count == 1
+
+    def test_reset_metrics_zeroes_cache_counters(self):
+        cfg = GPTConfig(position="learned", norm="layernorm",
+                        activation="gelu", **CFG_KW)
+        state = _build_state(cfg, seed=2)
+        rng = np.random.RandomState(1)
+        prompt = [int(t) for t in rng.randint(1, 90, size=17)]
+        eng = _make_engine(state, cfg, num_pages=16, page_size=8,
+                           max_batch=2, chunk_size=8)
+        eng.add_request(prompt, 3, arrival_time=0.0)
+        _drain(eng)
+        eng.add_request(prompt, 3, arrival_time=eng._test_clock[0])
+        _drain(eng)
+        m = eng.metrics_summary()
+        assert m["prefix_cache_hits"] == 1
+        assert m["prefix_cache_misses"] == 1
+        assert m["prefix_cache_tokens_saved"] == 16
+        eng.reset_metrics()
+        m = eng.metrics_summary()
+        for k in ("prefix_cache_hits", "prefix_cache_misses",
+                  "prefix_cache_tokens_saved", "prefix_cache_evictions"):
+            assert m[k] == 0, k
+        assert m["prefix_cache_hit_rate"] == 0.0
+        # live state is NOT metrics: cached pages survive the reset
+        assert m["prefix_cache_pages"] == eng.pool.cached_pages > 0
+
+    def test_write_plan_never_targets_shared_pages(self):
+        """CoW at the tap level: across a whole shared-header trace, no
+        live row's KV write plan resolves to ANY cached page — the same
+        property the ``cow-page-write`` analysis rule audits."""
+        from hetu_tpu.serving.kv_pool import TRASH_PAGE
+        cfg = GPTConfig(position="rotary", norm="rmsnorm",
+                        activation="silu", **CFG_KW)
+        state = _build_state(cfg, seed=17)
+        rng = np.random.RandomState(9)
+        header = [int(t) for t in rng.randint(1, 90, size=16)]
+        eng = _make_engine(state, cfg, num_pages=16, page_size=8,
+                           max_batch=4, chunk_size=8)
+        # warm the shared header, then a concurrent burst: three
+        # requests write their tails while all READ the cached pages
+        eng.add_request(header + [44], 4, arrival_time=0.0)
+        _drain(eng)
+        for i in range(3):
+            tail = [int(t) for t in rng.randint(1, 90, size=3 + i)]
+            eng.add_request(header + tail, 4,
+                            arrival_time=eng._test_clock[0])
+        _drain(eng)
+        assert eng.metrics_summary()["prefix_cache_hits"] >= 3
+        ps = eng.pool.page_size
+        checked = 0
+        for rec in eng.tap:
+            refs = rec["refcounts"]
+            pt = np.asarray(rec["page_tables"])
+            for row, pos, qlen in rec["rows"]:
+                for t in range(int(qlen)):
+                    pg = int(pt[int(row), (int(pos) + t) // ps])
+                    if pg != TRASH_PAGE:
+                        assert pg not in refs, \
+                            f"write plan hit cached page {pg}"
+                        checked += 1
+        assert checked > 0
+
+    def test_start_rollback_does_not_double_count(self):
+        """When _start's residual alloc fails (page race after another
+        start's eviction), the request is rolled back and retried — the
+        retry is the SAME logical start, so hit/miss/tokens_saved
+        counters must not count it twice."""
+        cfg = GPTConfig(position="learned", norm="layernorm",
+                        activation="gelu", **CFG_KW)
+        state = _build_state(cfg, seed=8)
+        rng = np.random.RandomState(5)
+        prompt = [int(t) for t in rng.randint(1, 90, size=17)]
+        eng = _make_engine(state, cfg, num_pages=8, page_size=8,
+                           max_batch=2, chunk_size=8)
+        eng.add_request(prompt, 3, arrival_time=0.0)
+        _drain(eng)
+        eng.reset_metrics()
+        # pin every free page so the residual alloc must fail: the
+        # cached prefix gets acquired, then rolled back
+        hold = eng.pool.alloc(eng.pool.free_pages)
+        req = eng.add_request(prompt, 3,
+                              arrival_time=eng._test_clock[0])
+        eng.queue.pop_ready(eng._test_clock[0])
+        eng._start(req)
+        m = eng.metrics_summary()
+        assert req.state != "running" and req.pos == 0
+        assert req.shared_pages == 0 and len(eng.queue) == 1
+        assert m["prefix_cache_hits"] == 0
+        assert m["prefix_cache_misses"] == 0
+        assert m["prefix_cache_tokens_saved"] == 0
+        eng.pool.free(hold)                 # race resolves: retry runs
+        _drain(eng)
+        m = eng.metrics_summary()
+        assert m["prefix_cache_hits"] == 1
+        assert m["prefix_cache_tokens_saved"] == 16
+        assert req.out_tokens == _solo(state, cfg, prompt, 3)
+
+    def test_cache_disabled_engine_unchanged(self):
+        """prefix_cache=False keeps the PR 6 behavior: no cache object,
+        no cached pages, pool drains back to fully free."""
+        cfg = GPTConfig(position="learned", norm="layernorm",
+                        activation="gelu", **CFG_KW)
+        state = _build_state(cfg, seed=4)
+        eng = _make_engine(state, cfg, num_pages=8, page_size=8,
+                           max_batch=2, prefix_cache=False)
+        assert eng.prefix_cache is None
+        r = eng.add_request([5, 17, 2, 9, 1, 3, 4, 8, 11], 4,
+                            arrival_time=0.0)
+        _drain(eng)
+        assert eng.pool.cached_pages == 0
+        assert eng.pool.free_pages == eng.pool.num_usable
+        assert r.out_tokens == _solo(state, cfg, list(r.prompt), 4)

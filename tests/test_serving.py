@@ -37,6 +37,7 @@ def _solo(state, cfg, prompt, n_new):
 def _make_engine(state, cfg, **kw):
     clock = [0.0]
     kw.setdefault("time_fn", lambda: clock[0])
+    kw.setdefault("debug", True)        # invariant checks on in tests
     eng = Engine(state, cfg, **kw)
     eng._test_clock = clock
     return eng
@@ -56,7 +57,7 @@ def _drain(eng, check=True):
 
 def test_pool_alloc_free_invariants():
     pool = PagedKVPool(num_layers=2, num_pages=9, page_size=8,
-                       kv_heads=2, head_dim=16)
+                       kv_heads=2, head_dim=16, debug=True)
     assert pool.num_usable == 8 and pool.free_pages == 8
     a = pool.alloc(3)
     b = pool.alloc(4)
@@ -87,7 +88,7 @@ def test_pool_reset_never_reissues_trash_page():
     to the next request and real KV writes would land in the padding
     sink.  Alloc-after-reset can never return page 0."""
     pool = PagedKVPool(num_layers=2, num_pages=9, page_size=8,
-                       kv_heads=2, head_dim=16)
+                       kv_heads=2, head_dim=16, debug=True)
     pool.alloc(5)
     pool.reset()
     assert pool.free_pages == pool.num_usable == 8

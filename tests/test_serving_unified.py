@@ -50,6 +50,7 @@ def _solo(state, cfg, prompt, n_new):
 def _make_engine(state, cfg, **kw):
     clock = [0.0]
     kw.setdefault("time_fn", lambda: clock[0])
+    kw.setdefault("debug", True)        # invariant checks on in tests
     eng = Engine(state, cfg, **kw)
     eng._test_clock = clock
     return eng
