@@ -565,6 +565,65 @@ def bench_lint_graph() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def bench_mem_lint() -> dict:
+    """The static peak-HBM model as a bench target (ISSUE 8): runs the
+    analysis gate in a pinned-CPU subprocess and reports, per gated
+    executable, the predicted peak bytes, the per-kind breakdown, and
+    the delta against XLA's own ``compiled.memory_analysis()`` totals —
+    the evidence trail that the planner's memory numbers track what the
+    compiler actually allocates.  Writes BENCH_MEM.json next to this
+    file."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)       # the CLI forces its own device count
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "hetu_tpu.analysis", "--check",
+             "--format", "json"],
+            cwd=here, env=env, capture_output=True, text=True,
+            timeout=1200)
+        payload = {}
+        try:
+            start = proc.stdout.index("{")
+            payload, _ = json.JSONDecoder().raw_decode(proc.stdout[start:])
+        except Exception:
+            pass
+        rows = {}
+        deltas = []
+        for name, ex in payload.get("executables", {}).items():
+            mem = ex.get("memory")
+            if not mem:
+                rows[name] = {"error": "no memory accounting"}
+                continue
+            row = {
+                "predicted_peak_bytes": int(mem["peak_bytes"]),
+                "by_kind": mem.get("by_kind", {}),
+                "xla_total_bytes": mem.get("xla_total_bytes"),
+                "xla_delta_pct": mem.get("xla_delta_pct"),
+            }
+            if mem.get("xla_delta_pct") is not None:
+                deltas.append(abs(float(mem["xla_delta_pct"])))
+            rows[name] = row
+        result = {
+            "gate_passed": proc.returncode == 0,
+            "exit_code": proc.returncode,
+            "executables": rows,
+            # headline: the worst absolute cross-check delta over all
+            # gate families (the gate bounds it at 10% / 64KB floor)
+            "max_abs_xla_delta_pct": max(deltas) if deltas else None,
+        }
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    out_path = os.path.join(here, "BENCH_MEM.json")
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(result, fh, indent=1)
+    except Exception:
+        pass
+    return result
+
+
 def bench_serving_microbench() -> dict:
     """Serving microbench v2 (ISSUE 6): dense-cache ``generate()`` vs
     the UNIFIED ragged prefill+decode engine on a GPT-2-small-
@@ -857,7 +916,8 @@ def main():
         sub = sys.argv[1]
         fns = {"serving_microbench": bench_serving_microbench,
                "comm_microbench": bench_comm_microbench,
-               "lint_graph": bench_lint_graph}
+               "lint_graph": bench_lint_graph,
+               "mem_lint": bench_mem_lint}
         if sub not in fns:
             print(json.dumps({"error": f"unknown subcommand {sub!r}; "
                                        f"have {sorted(fns)}"}))

@@ -39,6 +39,9 @@ from .edges import (CommEdge, EdgeMatch, grad_comm_edges, makes_edge_claim,
 from .jaxpr_walk import (collect_collectives, compute_dtype_histogram,
                          donation_candidates, iter_eqns,
                          unreduced_scalar_outputs)
+from .memory import (MemoryBuffer, MemoryReport, has_remat_region,
+                     liveness_walk, parse_input_output_aliases,
+                     predict_memory, xla_memory_stats)
 from .report import (AnalysisReport, CollectiveRecord, ExecutableReport,
                      Finding, load_baseline, save_baseline)
 from .rules import (DEFAULT_OPTIONS, RULES, AnalysisContext, ParamInfo,
@@ -53,6 +56,8 @@ __all__ = [
     "grad_comm_prediction", "iter_executables", "makes_edge_claim",
     "match_edges", "predict_edges", "register_executable", "rule",
     "run_rules", "verify_grad_comm", "load_baseline", "save_baseline",
+    "MemoryBuffer", "MemoryReport", "has_remat_region", "liveness_walk",
+    "parse_input_output_aliases", "predict_memory", "xla_memory_stats",
 ]
 
 
@@ -74,6 +79,11 @@ def build_context(handle: ExecutableHandle, compile: bool = False,
         serving = serving()
     mesh_axes = dict(meta.get("mesh_axes", {}))
     train = bool(meta.get("train", meta.get("kind") == "train_step"))
+    try:
+        memory = predict_memory(handle, xla=compile)
+    except Exception:
+        memory = None    # the memory pass is advisory: a walk failure
+        #                  must not take down the collectives linter
     ctx = AnalysisContext(
         name=handle.name,
         jaxpr=jaxpr,
@@ -89,6 +99,8 @@ def build_context(handle: ExecutableHandle, compile: bool = False,
         serving=serving,
         meta=meta,
         edges=predict_edges(meta, mesh_axes, train),
+        memory=memory,
+        handle=handle,
         train=train,
     )
     if options:
@@ -113,6 +125,8 @@ def analyze_handle(handle: ExecutableHandle, compile: bool = False,
             rep.meta["gspmd_collectives"] = dict(em.gspmd_counts)
         rep.meta["edges"] = ctx.edges
         rep.meta["edge_match"] = em
+    if ctx.memory is not None:
+        rep.meta["memory"] = ctx.memory
     return rep
 
 

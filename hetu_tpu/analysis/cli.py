@@ -34,6 +34,19 @@ emits; then:
 * ``--explain``: after the summary, print each finding's offending
   edge/record plus a concrete remediation hint (pspec change, donation,
   narrower transport, capacity factor).
+* ``--memory``: print the static peak-HBM section per executable
+  (predicted peak, per-kind breakdown, XLA cross-check delta; with
+  ``--explain``, the top-contributor attribution table).  The numbers
+  are always computed and gated — the flag only controls the text
+  section; ``--format json`` always carries them.
+* ``--hbm-budget``: device HBM budget in GiB for the ``oom-risk`` rule
+  (default: the rule's v5p budget).
+
+The memory gate (on by default, with ``--tolerance``): per-executable
+predicted peak bytes are pinned in the baseline and may not grow; and
+every compiled executable's prediction must stay within ±10% of XLA's
+own ``compiled.memory_analysis()`` totals — a drifting memory model is
+itself a gate failure, so the planner numbers stay honest.
 
 Exit codes (stable, documented for CI): **0** clean, **1** findings or
 baseline regressions, **2** baseline missing (run ``--update-baseline``
@@ -230,9 +243,10 @@ def build_gate_executables():
     return names + sorted(f"gate_serving/{k}" for k in eng._compiled)
 
 
-def explain_report(report, out=sys.stdout) -> None:
+def explain_report(report, out=sys.stdout, memory: bool = False) -> None:
     """--explain: per finding, the offending edge/record and a concrete
-    remediation hint; per executable, the predicted edge list."""
+    remediation hint; per executable, the predicted edge list (and, with
+    --memory, the peak-HBM attribution table)."""
     for name, rep in sorted(report.executables.items()):
         cov = rep.meta.get("edge_coverage")
         edges = rep.meta.get("edges")
@@ -244,6 +258,13 @@ def explain_report(report, out=sys.stdout) -> None:
             print(f"  predicted edges ({len(edges)}):", file=out)
             for e in edges:
                 print(f"    . {e.describe()}", file=out)
+        mem = rep.meta.get("memory")
+        if memory and mem is not None:
+            print(f"  peak-HBM attribution (top contributors):", file=out)
+            for b in mem.top(10):
+                src = f"  [{b.source}]" if b.source else ""
+                print(f"    . {b.kind:10s} {b.nbytes:>12d} B  "
+                      f"{b.name} {b.detail}{src}", file=out)
         if not rep.findings:
             print("  no findings", file=out)
             continue
@@ -253,10 +274,23 @@ def explain_report(report, out=sys.stdout) -> None:
                 print(f"    fix: {f.hint}", file=out)
 
 
+def memory_section(report, out=sys.stdout) -> None:
+    """--memory: the static peak-HBM model per executable — predicted
+    peak, per-kind breakdown, and the XLA cross-check delta."""
+    print("\nstatic peak-HBM model (analysis/memory):", file=out)
+    for name, rep in sorted(report.executables.items()):
+        mem = rep.meta.get("memory")
+        if mem is None:
+            print(f"  {name}: (memory pass unavailable)", file=out)
+            continue
+        print(f"  {name}: {mem.summary()}", file=out)
+
+
 def run_gate(baseline_path: str = BASELINE_DEFAULT,
              tolerance: float = 0.1, update: bool = False,
              as_json: bool = False, compile: bool = True,
-             explain: bool = False, out=sys.stdout) -> int:
+             explain: bool = False, memory: bool = False,
+             hbm_budget_gib: float = None, out=sys.stdout) -> int:
     """Build, analyze, gate.  Returns the process exit code
     (0 clean / 1 findings / 2 baseline missing)."""
     from . import (AnalysisReport, analyze_handle, get_executable,
@@ -273,12 +307,25 @@ def run_gate(baseline_path: str = BASELINE_DEFAULT,
                   f"and commit the result", file=out)
             return 2
 
+    # rule options: the peak-memory-regression rule reads the frozen
+    # per-executable peaks straight from the baseline, so the rule and
+    # the baseline gate agree on what "regressed" means
+    options = {"memory_tolerance": tolerance}
+    if baseline is not None:
+        options["baseline_peak_bytes"] = {
+            name: ex["memory"]["peak_bytes"]
+            for name, ex in baseline.get("executables", {}).items()
+            if "memory" in ex}
+    if hbm_budget_gib is not None:
+        options["hbm_budget_bytes"] = float(hbm_budget_gib) * (1 << 30)
+
     names = build_gate_executables()
     report = AnalysisReport()
     problems = []
     for name in names:
         handle = get_executable(name)
-        report.add(analyze_handle(handle, compile=compile))
+        rep = report.add(analyze_handle(handle, compile=compile,
+                                        options=options))
         if handle.meta.get("grad_comm"):
             # PR-1 grad-comm emission assertions, via the general pass
             try:
@@ -286,12 +333,32 @@ def run_gate(baseline_path: str = BASELINE_DEFAULT,
             except AssertionError as e:
                 problems.append(f"{name}: grad-comm emission drifted "
                                 f"from the DS prediction: {e}")
+        # XLA cross-check: the static model must stay within ±10% of
+        # compiled.memory_analysis() (abs floor for tiny programs) —
+        # a drifting memory model fails the gate even when the baseline
+        # peak is unchanged, and LOSING the cross-check (memory pass or
+        # memory_analysis gone) fails it too
+        if compile:
+            mem = rep.meta.get("memory")
+            if mem is None:
+                problems.append(f"{name}: static memory pass produced "
+                                f"no report (walk failure?)")
+            elif mem.xla is None:
+                problems.append(f"{name}: compiled.memory_analysis() "
+                                f"unavailable — XLA cross-check lost")
+            elif not mem.xla_within(rel=0.1):
+                problems.append(
+                    f"{name}: static peak {mem.cmp_peak_bytes} B drifted "
+                    f"{mem.xla_delta():+.1%} from XLA's "
+                    f"{mem.xla_total} B (±10% cross-check)")
     if as_json:
         print(report.to_json(records=True), file=out)
     else:
         print(report.summary(), file=out)
+        if memory:
+            memory_section(report, out=out)
     if explain:
-        explain_report(report, out=out)
+        explain_report(report, out=out, memory=memory)
     if update:
         save_baseline(baseline_path, report)
         print(f"baseline written to {baseline_path}", file=out)
@@ -335,10 +402,19 @@ def main(argv=None) -> int:
                     help="print each finding's offending edge plus a "
                          "suggested remediation (pspec change, donation,"
                          " narrower transport, capacity factor)")
+    ap.add_argument("--memory", action="store_true",
+                    help="print the static peak-HBM section (predicted "
+                         "peak, per-kind breakdown, XLA cross-check "
+                         "delta; with --explain, the attribution table)")
+    ap.add_argument("--hbm-budget", type=float, default=None,
+                    metavar="GIB",
+                    help="device HBM budget in GiB for the oom-risk "
+                         "rule (default: the rule's v5p budget)")
     ap.add_argument("--no-compile", action="store_true",
                     help="skip post-SPMD compilation (disables GSPMD "
-                         "accounting: implicit-reshard and the "
-                         "GSPMD half of unexplained-collective)")
+                         "accounting: implicit-reshard, the GSPMD half "
+                         "of unexplained-collective, and the XLA "
+                         "memory cross-check)")
     args = ap.parse_args(argv)
     _force_cpu_mesh()
     return run_gate(baseline_path=args.baseline,
@@ -346,7 +422,9 @@ def main(argv=None) -> int:
                     update=args.update_baseline,
                     as_json=args.json or args.fmt == "json",
                     compile=not args.no_compile,
-                    explain=args.explain)
+                    explain=args.explain,
+                    memory=args.memory,
+                    hbm_budget_gib=args.hbm_budget)
 
 
 if __name__ == "__main__":

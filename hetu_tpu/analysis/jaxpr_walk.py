@@ -290,7 +290,9 @@ def unreduced_scalar_outputs(jaxpr) -> List[Tuple[str, str, str]]:
 
 
 def donation_candidates(args_info, out_avals,
-                        min_bytes: int = 1 << 20) -> List[Tuple[str, int]]:
+                        min_bytes: int = 1 << 20,
+                        alias_pairs: Optional[List[Tuple[int, int]]] = None
+                        ) -> List[Tuple[str, int]]:
     """Un-donated input buffers that could have been donated.
 
     ``args_info`` is ``jax.stages.Lowered.args_info`` (leaves carry
@@ -298,6 +300,14 @@ def donation_candidates(args_info, out_avals,
     ``min_bytes`` whose (shape, dtype) matches an output aval is a
     candidate — XLA could reuse its buffer in place.  Returns one
     ``(arg_path, total_bytes)`` per offending top-level argument.
+
+    ``alias_pairs`` — ``(output_index, parameter_number)`` pairs from the
+    compiled HLO's ``input_output_alias`` table
+    (:func:`hetu_tpu.analysis.memory.parse_input_output_aliases`).  When
+    given, output slots XLA *already* aliased are retired by exact index
+    instead of the shape/dtype guess: a shape-matched output that is in
+    fact absorbed by a different donated input stops producing a
+    false-positive candidate.
     """
     import jax
 
@@ -309,20 +319,36 @@ def donation_candidates(args_info, out_avals,
             return 0
 
     out_shapes: Dict[Tuple, int] = {}
-    for o in jax.tree_util.tree_leaves(out_avals):
-        if hasattr(o, "shape"):
-            key = (tuple(o.shape), np.dtype(o.dtype).name)
-            out_shapes[key] = out_shapes.get(key, 0) + 1
+    out_leaves = [o for o in jax.tree_util.tree_leaves(out_avals)
+                  if hasattr(o, "shape")]
+    aliased_outs = {oi for oi, _p in (alias_pairs or ())}
+    for oi, o in enumerate(out_leaves):
+        if oi in aliased_outs:
+            continue        # XLA already writes this output in place
+        key = (tuple(o.shape), np.dtype(o.dtype).name)
+        out_shapes[key] = out_shapes.get(key, 0) + 1
     flat, _ = jax.tree_util.tree_flatten_with_path(args_info)
     # donated inputs claim their matching output slots FIRST: a second
     # same-shaped input has nothing left to alias and is not a
     # candidate (e.g. decode's tokens aliases the greedy output; pos,
-    # the same [B] int32, cannot)
+    # the same [B] int32, cannot).  With the compiled alias table the
+    # absorbed slots are already retired by index above, so only
+    # donations the compiler DROPPED still consume a slot here —
+    # honored ones (their parameter number appears in the table) must
+    # not retire twice, which would hide a real candidate.
+    honored_params = {p for _oi, p in (alias_pairs or ())}
+    param_idx = -1
     for _path, leaf in flat:
-        if getattr(leaf, "donated", False) and hasattr(leaf, "shape"):
-            key = (tuple(leaf.shape), np.dtype(leaf.dtype).name)
-            if out_shapes.get(key, 0) > 0:
-                out_shapes[key] -= 1
+        if not hasattr(leaf, "shape"):
+            continue
+        param_idx += 1
+        if not getattr(leaf, "donated", False):
+            continue
+        if alias_pairs is not None and param_idx in honored_params:
+            continue    # absorbed: its output already retired by index
+        key = (tuple(leaf.shape), np.dtype(leaf.dtype).name)
+        if out_shapes.get(key, 0) > 0:
+            out_shapes[key] -= 1
     by_arg: Dict[str, int] = {}
     for path, leaf in flat:
         if getattr(leaf, "donated", False) or not hasattr(leaf, "shape"):

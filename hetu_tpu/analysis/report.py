@@ -111,6 +111,12 @@ class ExecutableReport:
             d["edge_coverage"] = dict(self.meta["edge_coverage"])
         if "gspmd_collectives" in self.meta:
             d["gspmd_collectives"] = dict(self.meta["gspmd_collectives"])
+        # static peak-HBM prediction (analysis/memory): the baseline pins
+        # peak_bytes (gated with the byte tolerance); the per-kind
+        # breakdown and the XLA cross-check delta ride along as the
+        # reviewable evidence for a re-freeze
+        if "memory" in self.meta:
+            d["memory"] = self.meta["memory"].to_dict()
         if records:
             d["records"] = [r.to_dict() for r in self.records]
         return d
@@ -230,6 +236,26 @@ class AnalysisReport:
                         f"{name}: unexplained collectives regressed "
                         f"{w_un} -> {g_un} (edge coverage "
                         f"{got_c['explained']}/{got_c['total']})")
+            # static peak-HBM: may not grow beyond the byte tolerance,
+            # and an executable may not silently lose its memory
+            # accounting (same philosophy as the GSPMD counts above —
+            # stopping to measure IS the regression)
+            want_m = base.get("memory")
+            got_m = rep.meta.get("memory")
+            if want_m:
+                if got_m is None:
+                    problems.append(
+                        f"{name}: baseline records peak-HBM accounting "
+                        f"but the report has none (memory pass failed?)")
+                else:
+                    b = float(want_m.get("peak_bytes", 0))
+                    g = float(got_m.peak_bytes)
+                    if g > b * (1.0 + tolerance) and g - b > 1:
+                        problems.append(
+                            f"{name}: predicted peak HBM regressed "
+                            f"{b:.0f} -> {g:.0f} B "
+                            f"(> {tolerance:.0%} tolerance; dominant "
+                            f"class {got_m.dominant_kind()})")
             for field, value in (("payload_bytes", rep.total_payload_bytes),
                                  ("wire_bytes", rep.total_wire_bytes)):
                 b = float(base.get(field, 0))

@@ -57,6 +57,28 @@ Rule catalog (DESIGN.md §9 for the rationale of each):
                              dispatch/combine all-to-alls move more
                              bytes than the routing math requires
                              (dropless mode is exempt: no capacity).
+``peak-memory-regression``   the static peak-HBM prediction
+                             (analysis/memory) grew beyond the frozen
+                             per-executable baseline + tolerance — a
+                             silent memory regression (lost donation,
+                             widened dtype, new long-lived buffer).
+``oom-risk``                 predicted peak exceeds the configured
+                             device HBM budget: the program will OOM on
+                             the target chip before it runs once.  The
+                             hint names the dominant buffer class and
+                             its class-specific remedy.
+``remat-opportunity``        saved-activation liveness dominates the
+                             predicted peak, the peak is large enough
+                             to matter, and no remat/checkpoint region
+                             covers the program — rematerialization
+                             would trade FLOPs for the dominant buffer.
+``replicated-state-under-shard`` optimizer/master/gradient bytes not
+                             sharded down by dp while the mesh has
+                             dp > 1 — ZeRO (zero=1/2) or the flat
+                             dp-sharded state would divide exactly
+                             these bytes (generalizes
+                             ``replicated-large-param`` from params to
+                             the state that usually dwarfs them).
 
 Thresholds live in :data:`DEFAULT_OPTIONS` and are overridable per
 context (tests seed violations with tiny thresholds).
@@ -91,6 +113,18 @@ DEFAULT_OPTIONS: Dict[str, Any] = {
     # moe-capacity-overprovision: tolerated payload slack over the
     # capacity-factor prediction (1.0 = exact)
     "moe_capacity_slack": 1.0,
+    # oom-risk: per-device HBM budget the static peak is checked
+    # against (default: v5p 95 GB x the usable fraction below)
+    "hbm_budget_bytes": 95e9,
+    "hbm_usable_fraction": 0.9,
+    # peak-memory-regression: {executable name -> frozen peak bytes}
+    # (the CLI injects this from ANALYSIS_BASELINE.json) + tolerance
+    "baseline_peak_bytes": None,
+    "memory_tolerance": 0.1,
+    # remat-opportunity: only peaks above this matter, and only when
+    # saved activations dominate by this fraction
+    "remat_min_bytes": 1 << 30,
+    "remat_activation_fraction": 0.5,
 }
 
 
@@ -144,6 +178,12 @@ class AnalysisContext:
     # predicted DS-transition edges (analysis/edges.predict_edges);
     # None = the executable makes no per-edge claim
     edges: Optional[List[Any]] = None
+    # static peak-HBM prediction (analysis/memory.predict_memory);
+    # None when the memory pass could not run for this executable
+    memory: Optional[Any] = None
+    # the registered ExecutableHandle (compiled-artifact access for
+    # rules that consult XLA's own tables)
+    handle: Optional[Any] = None
     # whether this executable differentiates (enables autodiff-dual
     # matching in the edge pass) — set once by build_context so the
     # edge predictor and the matcher share one definition
@@ -296,9 +336,21 @@ def _donation_miss(ctx: AnalysisContext) -> List[Finding]:
     if ctx.args_info is None or ctx.out_avals is None:
         return []
     thr = ctx.opt("donation_bytes_threshold")
+    # when the program was compiled, XLA's own input_output_alias table
+    # says which output slots are ALREADY absorbed — consult it instead
+    # of assuming every donated input aliases a shape-matched output
+    # (the shape/dtype guess both misses aliases it can't see and
+    # invents ones XLA dropped)
+    alias_pairs = None
+    if ctx.compiled_text:
+        from .memory import parse_input_output_aliases
+        # an empty table carries no information (regex miss, or a
+        # program with no donations at all): keep the shape-based guess
+        alias_pairs = parse_input_output_aliases(ctx.compiled_text) or None
     out = []
     for arg, nbytes in donation_candidates(ctx.args_info, ctx.out_avals,
-                                           min_bytes=thr):
+                                           min_bytes=thr,
+                                           alias_pairs=alias_pairs):
         out.append(Finding(
             rule="", subject=f"arg{arg}",
             message=f"input {arg} ({nbytes} B across its leaves) matches "
@@ -440,6 +492,164 @@ def _moe_capacity_overprovision(ctx: AnalysisContext) -> List[Finding]:
                  f"to dispatch_mode='dropless' (capacity-free blocked "
                  f"group-GEMM, no padding at all)"))
     return out
+
+
+def _fmt_mem(n) -> str:
+    from .memory import _fmt_bytes
+    return _fmt_bytes(n)
+
+
+#: class-specific remedies the memory rules name for the dominant
+#: buffer kind — each hint is the mechanism that divides exactly that
+#: class's bytes
+_KIND_REMEDY = {
+    "param": "shard params over tp (pspec on the large dims) or go "
+             "ZeRO-3/FSDP so only the 1/dp shard lives at rest",
+    "opt-state": "Optimizer(zero=1|2) or flat_state=True dp-shards the "
+                 "fp32 master/m/v — the usual biggest win",
+    "grad": "Optimizer(zero=2) / flat_state=True keeps gradients "
+            "reduce-scattered instead of replicated",
+    "activation": "wrap blocks in jax.checkpoint (remat) to trade one "
+                  "extra forward for the saved-activation set, or "
+                  "shrink the micro-batch",
+    "kv-page": "lower num_pages / page_size, or shard the pool over tp "
+               "(kv_heads) so each device holds 1/tp of the pages",
+    "feed": "shard the batch dim over dp (pspec=P('dp', ...)) so each "
+            "device feeds 1/dp of the global batch",
+    "output": "donate the matching input (jit donate_argnums) so the "
+              "output aliases it instead of costing fresh HBM",
+    "input": "donate round-tripping buffers, or shard them over the "
+             "mesh so each device holds a slice",
+}
+
+
+@rule("peak-memory-regression")
+def _peak_memory_regression(ctx: AnalysisContext) -> List[Finding]:
+    """Static peak-HBM prediction vs the frozen per-executable baseline:
+    growth beyond the tolerance is a silent memory regression the
+    numeric tests cannot see (a lost donation, a widened dtype, a new
+    long-lived buffer)."""
+    base_map = ctx.opt("baseline_peak_bytes")
+    if ctx.memory is None or not base_map:
+        return []
+    base = base_map.get(ctx.name)
+    if base is None:
+        return []
+    tol = float(ctx.opt("memory_tolerance"))
+    got = int(ctx.memory.peak_bytes)
+    if got <= base * (1.0 + tol):
+        return []
+    dom = ctx.memory.dominant_kind()
+    return [Finding(
+        rule="", subject="peak",
+        message=f"predicted peak HBM regressed {_fmt_mem(base)} -> "
+                f"{_fmt_mem(got)} ({got / max(base, 1) - 1.0:+.1%}, "
+                f"tolerance {tol:.0%}); dominant class now {dom} "
+                f"({_fmt_mem(ctx.memory.by_kind.get(dom, 0))})",
+        hint=f"inspect the attribution table (--memory --explain) for "
+             f"the buffer that grew; if the change is intentional, "
+             f"re-freeze with --update-baseline.  For {dom}: "
+             f"{_KIND_REMEDY.get(dom, 'shard or donate it')}")]
+
+
+@rule("oom-risk")
+def _oom_risk(ctx: AnalysisContext) -> List[Finding]:
+    """Predicted peak vs the device HBM budget: the program OOMs on the
+    target chip before it runs once.  Static, so the verdict arrives
+    without burning a pod allocation on a doomed launch."""
+    if ctx.memory is None:
+        return []
+    budget = float(ctx.opt("hbm_budget_bytes")) \
+        * float(ctx.opt("hbm_usable_fraction"))
+    peak = int(ctx.memory.peak_bytes)
+    if peak <= budget:
+        return []
+    dom = ctx.memory.dominant_kind()
+    top = ctx.memory.top(3)
+    top_s = "; ".join(f"{b.kind}:{b.name} {_fmt_mem(b.nbytes)}"
+                      for b in top)
+    return [Finding(
+        rule="", subject="peak", severity="error",
+        message=f"predicted peak {_fmt_mem(peak)} exceeds the "
+                f"{_fmt_mem(budget)} usable-HBM budget "
+                f"({peak / max(budget, 1):.2f}x) — the program will OOM "
+                f"on the target chip.  Dominant class: {dom} "
+                f"({_fmt_mem(ctx.memory.by_kind.get(dom, 0))}); top "
+                f"buffers: {top_s}",
+        hint=f"{_KIND_REMEDY.get(dom, 'shard the dominant buffers')} "
+             f"(budget: hbm_budget_bytes x hbm_usable_fraction, "
+             f"override via analysis options / --hbm-budget)")]
+
+
+@rule("remat-opportunity")
+def _remat_opportunity(ctx: AnalysisContext) -> List[Finding]:
+    """Saved-activation liveness dominates the predicted peak, the peak
+    is big enough to matter, and no remat/checkpoint region covers the
+    program: rematerialization would trade one extra forward for
+    exactly the dominant buffer class."""
+    if ctx.memory is None or ctx.jaxpr is None:
+        return []
+    if not ctx.train:
+        return []       # no backward pass: nothing holds saved
+        # activations across the forward, checkpoint reclaims nothing
+    peak = int(ctx.memory.peak_bytes)
+    act = int(ctx.memory.activation_peak_bytes)
+    if peak < int(ctx.opt("remat_min_bytes")):
+        return []
+    frac = float(ctx.opt("remat_activation_fraction"))
+    if act < frac * peak:
+        return []
+    from .memory import has_remat_region
+    if has_remat_region(ctx.jaxpr):
+        return []       # already rematerialized: the walk priced it in
+    srcs = [b.source for b in ctx.memory.top(5)
+            if b.kind == "activation" and b.source]
+    src_s = f" (largest at {srcs[0]})" if srcs else ""
+    return [Finding(
+        rule="", subject="activations",
+        message=f"activation liveness {_fmt_mem(act)} is "
+                f"{act / max(peak, 1):.0%} of the {_fmt_mem(peak)} "
+                f"predicted peak and no remat/checkpoint region covers "
+                f"the program{src_s} — rematerialization would reclaim "
+                f"most of it for ~1/3 more compute",
+        source=srcs[0] if srcs else "",
+        hint="wrap the repeated block in jax.checkpoint (nn layers: "
+             "remat=True / policy=dots_saveable) so the backward "
+             "recomputes activations instead of holding them across "
+             "the whole forward")]
+
+
+@rule("replicated-state-under-shard")
+def _replicated_state_under_shard(ctx: AnalysisContext) -> List[Finding]:
+    """Optimizer/master-state bytes replicated over a dp > 1 mesh while
+    nothing shards them: ZeRO-1/2 or the flat dp-sharded state would
+    divide exactly these bytes by dp.  Generalizes
+    ``replicated-large-param`` from params to the fp32 state that
+    usually dwarfs them (Adam: master + m + v = 3x fp32)."""
+    if ctx.memory is None:
+        return []
+    dp = int(ctx.mesh_axes.get(ctx.dp_axis or "dp", 1))
+    if dp <= 1:
+        return []
+    meta = ctx.meta or {}
+    gc = meta.get("grad_comm") or {}
+    zero = int(meta.get("zero", gc.get("zero", 0)) or 0)
+    flat = bool(meta.get("flat_state", gc.get("flat", False)))
+    if zero >= 1 or flat:
+        return []       # the state IS dp-sharded (by contract)
+    state_bytes = int(ctx.memory.by_kind.get("opt-state", 0))
+    if state_bytes < int(ctx.opt("param_bytes_threshold")):
+        return []
+    return [Finding(
+        rule="", subject="opt-state",
+        message=f"{_fmt_mem(state_bytes)} of optimizer state is "
+                f"replicated on every rank of a dp={dp} mesh (zero=0, "
+                f"no flat state): {_fmt_mem(state_bytes * (dp - 1) // dp)}"
+                f" per device is pure redundancy ZeRO would reclaim",
+        hint=f"Optimizer(zero=1) dp-shards optimizer state, zero=2 "
+             f"adds gradients, flat_state=True packs it into "
+             f"reduce-scatter-geometry flat buckets (1/{dp} of these "
+             f"bytes per device, checkpoint-compatible)")]
 
 
 @rule("cow-page-write")

@@ -78,6 +78,7 @@ class ExecutableHandle:
         self.meta: Dict[str, Any] = dict(meta or {})
         self._traced = None
         self._lowered = None
+        self._compiled = None
         self._compiled_text = None
 
     def trace(self):
@@ -94,10 +95,17 @@ class ExecutableHandle:
             self._lowered = self.trace().lower()
         return self._lowered
 
+    def compile(self):
+        """The compiled executable (cached): GSPMD accounting reads its
+        HLO text, the memory pass its ``memory_analysis()``."""
+        if self._compiled is None:
+            self._compiled = self.lower().compile()
+        return self._compiled
+
     def compiled_text(self) -> str:
         """Post-SPMD optimized HLO text (compiles on first call)."""
         if self._compiled_text is None:
-            self._compiled_text = self.lower().compile().as_text()
+            self._compiled_text = self.compile().as_text()
         return self._compiled_text
 
     def __repr__(self):
@@ -1161,6 +1169,73 @@ class DefineAndRunGraph(Graph):
                 })
         return edges
 
+    def _arg_memory_facts(self, abstract_pool, mesh_axes, update_node):
+        """(divisors, kinds): pytrees mirroring the plan's abstract arg
+        tuple ``(var_state, opt_state, grad_accum, feeds)``, carrying per
+        leaf how many ways it is sharded (product of mesh axis sizes in
+        its pspec) and what buffer class it is — the registered facts the
+        static memory pass (analysis/memory) prices resident HBM from."""
+        var_state, opt_state, grad_accum, feeds = abstract_pool
+
+        from ..parallel.dstates import pspec_shard_divisor
+
+        def _div(pspec) -> int:
+            return pspec_shard_divisor(pspec, mesh_axes)
+
+        def _tensor_div(tid) -> int:
+            t = self._var_tensors.get(tid) or self._placeholders.get(tid)
+            return _div(self._pspec_for(t)) if t is not None else 1
+
+        opt = update_node.attrs["optimizer"] if update_node is not None \
+            else None
+        dp = int(mesh_axes.get(opt.dp_axis, 1)) if opt is not None else 1
+        opt_shardings = getattr(opt, "_shardings", {}) if opt is not None \
+            else {}
+
+        def _slot_div(tid) -> int:
+            # per-param slots ride the sharding the optimizer actually
+            # device_put them with (the param's own pspec, plus ZeRO's
+            # dp dim-0 shard when enabled) — recorded in _shardings
+            sh = opt_shardings.get(tid)
+            if sh is not None and getattr(sh, "spec", None) is not None:
+                return _div(sh.spec)
+            return _tensor_div(tid) if isinstance(tid, int) else 1
+
+        def _opt_entry(name, sub):
+            if isinstance(name, str) and name.startswith("flat_"):
+                # flat buffers are sharded P(dp) in equal rank chunks
+                return _mirror(sub, lambda _l, _k: dp), \
+                    _mirror(sub, lambda _l, _k: "opt-state")
+            div = _mirror(sub, lambda _l, k: _slot_div(k))
+            return div, _mirror(sub, lambda _l, _k: "opt-state")
+
+        def _mirror(obj, fn, key=None):
+            if isinstance(obj, dict):
+                return {k: _mirror(v, fn, k) for k, v in obj.items()}
+            if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+                # NamedTuple states (optax-style, e.g. FactoredState)
+                # construct positionally, not from one iterable
+                return type(obj)(*(_mirror(v, fn, key) for v in obj))
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(_mirror(v, fn, key) for v in obj)
+            return fn(obj, key)
+
+        var_div = {k: _tensor_div(k) for k in var_state}
+        var_kind = {k: "param" for k in var_state}
+        opt_div, opt_kind = {}, {}
+        for name, sub in (opt_state or {}).items():
+            opt_div[name], opt_kind[name] = _opt_entry(name, sub)
+        accum_div = _mirror(grad_accum or {},
+                            lambda _l, k: _tensor_div(k)
+                            if isinstance(k, int) else 1)
+        accum_kind = _mirror(grad_accum or {}, lambda _l, _k: "grad")
+        feed_div = _mirror(feeds or {},
+                           lambda _l, k: _tensor_div(k)
+                           if isinstance(k, int) else 1)
+        feed_kind = _mirror(feeds or {}, lambda _l, _k: "feed")
+        return (var_div, opt_div, accum_div, feed_div), \
+            (var_kind, opt_kind, accum_kind, feed_kind)
+
     def _register_plan_for_analysis(self, key, jit_step, gc_state,
                                     update_node, real_fetches,
                                     num_micro_batches,
@@ -1210,9 +1285,26 @@ class DefineAndRunGraph(Graph):
                 if isinstance(f, Tensor) and len(f.shape) == 0),
             "moe": [dict(m) for m in getattr(self, "_moe_meta", ())],
         }
+        # static memory model facts (analysis/memory): per-argument
+        # sharding divisors + buffer kinds, mirroring the abstract arg
+        # tree (var_state, opt_state, grad_accum, feeds).  Advisory:
+        # an unmirrorable state container must degrade the memory pass
+        # to its (shape, dtype) fallback, never break plan registration
+        try:
+            divisors, kinds = self._arg_memory_facts(
+                self._abstract_pool[key], mesh_axes, update_node)
+            meta["arg_divisors"] = divisors
+            meta["arg_kinds"] = kinds
+        except Exception:
+            pass
         if update_node is not None:
             opt = update_node.attrs["optimizer"]
             meta["dp_axis"] = opt.dp_axis
+            # recorded for every train step (implicit-sync plans too):
+            # the replicated-state-under-shard rule needs to know whether
+            # the optimizer shards its state down by dp
+            meta["zero"] = int(opt.zero)
+            meta["flat_state"] = bool(flat_mode)
             if gc_state[0] and flat_mode:
                 # reduce-scatter-only sync: the updated params leave the
                 # manual region fully gathered, so the per-param

@@ -372,6 +372,24 @@ def _ds_from_splits(device_num: int,
     return DistributedStates(device_num, states, order)
 
 
+def pspec_shard_divisor(pspec, mesh_axes: Dict[str, int]) -> int:
+    """How many ways a ``PartitionSpec`` shards a value over the mesh:
+    the product of the named-axis sizes it mentions (tuple entries
+    flattened, unknown axes size 1).  ``None`` pspec = replicated = 1.
+    Shared by graph registration (``_arg_memory_facts``) and the static
+    memory pass (``analysis.memory.classify_args``) so registered and
+    fallback divisors can never disagree on pspec semantics."""
+    if pspec is None:
+        return 1
+    d = 1
+    for entry in pspec:
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            d *= int(mesh_axes.get(str(a), 1))
+    return d
+
+
 def pspec_to_ds(pspec, ndim: int, mesh_axes: Dict[str, int]
                 ) -> DistributedStates:
     """Lower a ``PartitionSpec`` over a named mesh into a

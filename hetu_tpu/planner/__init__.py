@@ -4,11 +4,13 @@ Covers the reference's Galvatron tool (``tools/Galvatron``) and v1
 auto-parallel strategies (``hetu/v1/python/hetu/distributed_strategies/``)
 as first-class framework components.
 """
-from .cost_model import (CHIPS, ChipSpec, ClusterSpec, LayerSpec, Strategy,
-                         all_gather_time, all_reduce_time, all_to_all_time,
-                         embedding_layer_spec, grad_sync_time, layer_memory,
-                         layer_time, p2p_time, pipeline_time,
-                         reduce_scatter_time, transformer_layer_spec)
+from .cost_model import (CHIPS, ChipSpec, ClusterSpec, LayerSpec,
+                         MemoryCalibration, Strategy, all_gather_time,
+                         all_reduce_time, all_to_all_time,
+                         calibrate_layer_memory, embedding_layer_spec,
+                         grad_sync_time, layer_memory, layer_time, p2p_time,
+                         pipeline_time, reduce_scatter_time,
+                         transformer_layer_spec)
 from .dispatch import (DispatchStrategy, batching_strategy, dynamic_dispatch,
                        fit_cost_model, generate_strategy_pool,
                        max_seqlen_for, quadratic_predict,
@@ -23,10 +25,11 @@ from .strategies import (BaseSearching, FlexFlowSearching, GPipeSearching,
                          PipeOptSearching, SearchResult)
 
 __all__ = [
-    "CHIPS", "ChipSpec", "ClusterSpec", "LayerSpec", "Strategy",
-    "all_gather_time", "all_reduce_time", "all_to_all_time",
-    "embedding_layer_spec", "layer_memory", "layer_time", "p2p_time",
-    "pipeline_time", "reduce_scatter_time", "transformer_layer_spec",
+    "CHIPS", "ChipSpec", "ClusterSpec", "LayerSpec", "MemoryCalibration",
+    "Strategy", "all_gather_time", "all_reduce_time", "all_to_all_time",
+    "calibrate_layer_memory", "embedding_layer_spec", "layer_memory",
+    "layer_time", "p2p_time", "pipeline_time", "reduce_scatter_time",
+    "transformer_layer_spec",
     "solve_layer_strategies", "solve_pipeline_partition",
     "DispatchStrategy", "batching_strategy", "dynamic_dispatch",
     "fit_cost_model", "generate_strategy_pool", "max_seqlen_for",

@@ -215,10 +215,19 @@ def grad_sync_time(layer: LayerSpec, st: Strategy,
 def layer_memory(layer: LayerSpec, st: Strategy, cluster: ClusterSpec,
                  num_microbatches: int = 1,
                  optimizer_mult: float = 6.0,
-                 dp_splits_batch: bool = True) -> float:
+                 dp_splits_batch: bool = True,
+                 calibration: Optional["MemoryCalibration"] = None
+                 ) -> float:
     """HBM bytes for one layer under strategy st: params + grads +
     optimizer states (Adam: 2 fp32 moments + fp32 master = ~6x bf16 param
-    bytes) + live activations."""
+    bytes) + live activations.
+
+    ``calibration`` scales the closed form by the ratio the static
+    peak-HBM pass (``analysis/memory.predict_memory``) measured on a
+    lowered single-layer probe (:func:`calibrate_layer_memory`) — the
+    planner's budget check then runs on the same numbers the analysis
+    gate pins, not an unvalidated heuristic.
+    """
     sc = layer.scaled(st.tp, st.dp if dp_splits_batch else 1)
     p = sc.param_bytes
     opt = p * optimizer_mult
@@ -230,7 +239,127 @@ def layer_memory(layer: LayerSpec, st: Strategy, cluster: ClusterSpec,
     if st.zero >= 3:
         p /= st.dp
     act = sc.boundary_bytes if st.recompute else sc.act_bytes
-    return p + grads + opt + act * num_microbatches
+    total = p + grads + opt + act * num_microbatches
+    if calibration is not None:
+        total = calibration.apply(total)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# calibration of layer_memory against the static peak-HBM pass
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class MemoryCalibration:
+    """Validation of :func:`layer_memory` against the static pass.
+
+    ``static_bytes`` is the analysis-side prediction
+    (``analysis/memory.predict_memory``) for a lowered single-layer
+    train-step probe; ``model_bytes`` the closed-form estimate for the
+    same workload; ``scale`` their ratio.  Feeding the calibration into
+    :func:`layer_memory` / :class:`~hetu_tpu.planner.search.SearchEngine`
+    constrains the planner by the analysis-backed numbers — the same
+    model the CI gate cross-checks against XLA to ±10%.
+    """
+    scale: float = 1.0
+    static_bytes: int = 0          # predict_memory peak on the probe
+    model_bytes: float = 0.0       # closed-form layer_memory estimate
+    xla_bytes: Optional[int] = None    # XLA's own total, when compiled
+    probe: str = ""                # probe description (shapes/dtype)
+
+    def apply(self, bytes_: float) -> float:
+        return bytes_ * self.scale
+
+
+def calibrate_layer_memory(batch: int = 4, seq: int = 64,
+                           hidden: int = 64, ffn: Optional[int] = None,
+                           dtype: str = "float32",
+                           xla_check: bool = False) -> MemoryCalibration:
+    """Lower a single-transformer-layer train-step probe and measure the
+    ratio of the static peak-HBM pass over the closed-form
+    :func:`layer_memory` estimate.
+
+    The probe is the planner's unit of placement made real: one
+    pre-norm attention+MLP block with Adam state, fwd+bwd+update in one
+    donated jit — the same program shape :func:`transformer_layer_spec`
+    prices.  ``predict_memory`` walks its jaxpr exactly as the CI gate
+    does for the gate families, so the returned scale carries the
+    model's validated liveness rules into the planner's budget check.
+    With ``xla_check=True`` the probe is also compiled and XLA's
+    ``memory_analysis()`` total recorded (CPU-priced; slower).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..analysis.memory import predict_memory
+    from ..graph.graph import ExecutableHandle
+
+    f = ffn if ffn is not None else 4 * hidden
+    h = hidden
+    dt = np.dtype(dtype)
+
+    def _params():
+        return {
+            "ln1": jnp.ones((h,), dt), "ln2": jnp.ones((h,), dt),
+            "qkv": jnp.zeros((h, 3 * h), dt), "proj": jnp.zeros((h, h), dt),
+            "fc1": jnp.zeros((h, f), dt), "fc2": jnp.zeros((f, h), dt),
+        }
+
+    def _block(p, x):
+        # pre-norm attention + MLP, the shape transformer_layer_spec
+        # prices (single head: head count doesn't change bytes/flops)
+        xn = x * p["ln1"]
+        qkv = xn @ p["qkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        a = jax.nn.softmax(q @ k.transpose(0, 2, 1)
+                           / np.sqrt(h), axis=-1)
+        x = x + (a @ v) @ p["proj"]
+        xn = x * p["ln2"]
+        return x + jax.nn.gelu(xn @ p["fc1"]) @ p["fc2"]
+
+    def _step(params, m, v, x):
+        def loss_fn(p):
+            return jnp.mean(_block(p, x) ** 2)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_m = jax.tree_util.tree_map(
+            lambda mi, g: 0.9 * mi + 0.1 * g.astype(jnp.float32), m, grads)
+        new_v = jax.tree_util.tree_map(
+            lambda vi, g: 0.99 * vi + 0.01
+            * jnp.square(g.astype(jnp.float32)), v, grads)
+        new_p = jax.tree_util.tree_map(
+            lambda p, mi, vi: (p - 1e-3 * mi
+                               / (jnp.sqrt(vi) + 1e-8)).astype(p.dtype),
+            params, new_m, new_v)
+        return loss, new_p, new_m, new_v
+
+    params = _params()
+    fp32 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    x = jnp.zeros((batch, seq, h), dt)
+    fn = jax.jit(_step, donate_argnums=(0, 1, 2))
+    handle = ExecutableHandle(
+        "planner_probe/layer_mem", fn, (params, fp32, fp32, x),
+        meta={"kind": "train_step",
+              "params": [{"name": k, "shape": tuple(v.shape),
+                          "dtype": str(v.dtype), "pspec": None}
+                         for k, v in params.items()]})
+    static = predict_memory(handle, xla=xla_check)
+
+    spec = transformer_layer_spec(batch, seq, h, f,
+                                  dtype_bytes=dt.itemsize)
+    # the probe's optimizer state: fp32 m + v (+ no separate master —
+    # params update in place), grads transient fp32
+    opt_mult = 2 * 4 / dt.itemsize
+    model = layer_memory(spec, Strategy(), ClusterSpec(),
+                         optimizer_mult=opt_mult)
+    xla_total = static.xla_total if xla_check else None
+    return MemoryCalibration(
+        scale=float(static.peak_bytes) / max(model, 1.0),
+        static_bytes=int(static.peak_bytes),
+        model_bytes=float(model),
+        xla_bytes=int(xla_total) if xla_total is not None else None,
+        probe=f"block b{batch} s{seq} h{h} f{f} {dt.name}")
 
 
 def pipeline_time(stage_times: Sequence[float], num_microbatches: int,

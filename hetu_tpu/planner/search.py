@@ -93,7 +93,8 @@ class PlanResult:
 def plan_for_gpt(cfg, global_batch: int, seq: int, n_chips: int,
                  calibration=None, micro_batch_options=None,
                  num_slices: int = 1, mem_fraction: float = 0.9,
-                 max_tp: Optional[int] = None) -> PlanResult:
+                 max_tp: Optional[int] = None,
+                 memory_calibration="auto") -> PlanResult:
     """Close the planner loop for a GPT model: build the layer chain from
     a ``models.gpt.GPTConfig``, fold a live-hardware
     :class:`~hetu_tpu.planner.profile_hardware.Calibration` into the chip
@@ -105,9 +106,16 @@ def plan_for_gpt(cfg, global_batch: int, seq: int, n_chips: int,
     The search covers (pp, dp, tp, zero, recompute) jointly with the
     micro-batch size (``micro_batch_options`` defaults to the powers of
     two ≤ global_batch/dp candidates the schedule allows).
+
+    ``memory_calibration`` feeds the HBM budget check: ``"auto"``
+    (default) lowers a single-layer probe in the model's dtype and
+    scales the closed-form ``layer_memory`` by the static peak-HBM
+    pass's measurement (``cost_model.calibrate_layer_memory``), a
+    :class:`~hetu_tpu.planner.cost_model.MemoryCalibration` is used as
+    given, and ``None`` keeps the uncalibrated closed form.
     """
     import jax
-    from .cost_model import CHIPS, ChipSpec
+    from .cost_model import (CHIPS, ChipSpec, calibrate_layer_memory)
     from .profile_hardware import _kind_key
 
     if calibration is not None:
@@ -119,6 +127,15 @@ def plan_for_gpt(cfg, global_batch: int, seq: int, n_chips: int,
                           num_slices=num_slices)
     dtype_bytes = 2 if "bf16" in str(cfg.dtype) or "bfloat16" in \
         str(cfg.dtype) else 4
+    if memory_calibration == "auto":
+        # probe in the model's compute dtype so the scale carries the
+        # right activation widths; failures (no jax, walk error) fall
+        # back to the uncalibrated closed form rather than blocking
+        try:
+            memory_calibration = calibrate_layer_memory(
+                dtype="bfloat16" if dtype_bytes == 2 else "float32")
+        except Exception:
+            memory_calibration = None
     layers = [embedding_layer_spec(global_batch, seq, cfg.hidden_size,
                                    cfg.vocab_size, dtype_bytes, name="wte")]
     layers += [transformer_layer_spec(global_batch, seq, cfg.hidden_size,
@@ -150,7 +167,8 @@ def plan_for_gpt(cfg, global_batch: int, seq: int, n_chips: int,
     best: Optional[PlanResult] = None
     for mb in micro_batch_options:
         eng = SearchEngine(cluster, layers, global_batch, mb,
-                           mem_fraction=mem_fraction, max_tp=max_tp)
+                           mem_fraction=mem_fraction, max_tp=max_tp,
+                           memory_calibration=memory_calibration)
         try:
             plan = eng.search(pp_options=pp_options)
         except RuntimeError:
@@ -197,7 +215,8 @@ class SearchEngine:
                  mem_fraction: float = 0.9,
                  allow_recompute: bool = True,
                  allow_zero: bool = True,
-                 max_tp: Optional[int] = None):
+                 max_tp: Optional[int] = None,
+                 memory_calibration=None):
         self.cluster = cluster
         self.layers = list(layers)
         self.global_batch = global_batch
@@ -206,6 +225,11 @@ class SearchEngine:
         self.allow_recompute = allow_recompute
         self.allow_zero = allow_zero
         self.max_tp = max_tp or cluster.num_chips
+        # analysis-backed memory model: a MemoryCalibration from
+        # cost_model.calibrate_layer_memory scales every layer_memory
+        # number the DP budget check sees, so the planner is constrained
+        # by the same statically-validated model the CI gate pins
+        self.memory_calibration = memory_calibration
 
     # -- candidate (dp, tp) decompositions of a stage's chips --------------
 
@@ -290,7 +314,8 @@ class SearchEngine:
                 for s, st in enumerate(cands):
                     need = layer_memory(lay, st, self.cluster,
                                         num_microbatches=min(m, pp),
-                                        dp_splits_batch=False)
+                                        dp_splits_batch=False,
+                                        calibration=self.memory_calibration)
                     # over-budget layers stay infeasible (> inclusive cap)
                     mem[i, s] = min(MEM_UNITS + 1,
                                     int(math.ceil(need / unit)))

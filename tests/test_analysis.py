@@ -598,6 +598,16 @@ def test_lint_graph_gate_passes_on_clean_tree():
         cov = ex["edge_coverage"]
         assert cov["explained"] == cov["total"], (name, cov)
         assert ex["findings"] == [], (name, ex["findings"])
+        # ISSUE 8: the memory gate rides the same tier-1 marker — every
+        # gated executable carries the static peak-HBM accounting with
+        # the XLA cross-check inside ±10% (abs floor for sub-64KB
+        # programs, enforced by the CLI itself via exit code 0 above)
+        mem = ex.get("memory")
+        assert mem and mem["peak_bytes"] > 0, (name, mem)
+        assert mem.get("xla_total_bytes", 0) > 0, (name, mem)
+        delta = abs(mem["peak_bytes"] - mem["xla_total_bytes"])
+        assert delta <= max(0.1 * mem["xla_total_bytes"], 1 << 16) \
+            or abs(mem.get("xla_delta_pct") or 0) <= 10.0, (name, mem)
     # --explain printed the per-executable edge sections after the JSON
     assert "predicted edges" in proc.stdout
     assert "=== gate_tp/plan0 ===" in proc.stdout

@@ -1,0 +1,373 @@
+"""Static peak-HBM model tests (ISSUE 8): the liveness walker's seeded
+cases, the four memory lint rules firing exactly once with hints, and
+the XLA cross-check on real probes.
+
+Walker contracts demonstrated here:
+(a) dropping a donation raises the predicted peak by ~the buffer size,
+    and the ``donation-miss`` finding agrees with the peak delta;
+(b) wrapping the repeated block in ``jax.checkpoint`` lowers the
+    predicted peak and makes ``remat-opportunity`` stop firing;
+(c) scan body temporaries peak once — they do not accumulate x trips.
+"""
+import os
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import analysis
+from hetu_tpu.analysis import analyze_handle, predict_memory, run_rules
+from hetu_tpu.analysis.memory import (MemoryReport, has_remat_region,
+                                      liveness_walk,
+                                      parse_input_output_aliases)
+from hetu_tpu.graph.graph import clear_executables, register_executable
+
+
+def _sds(shape, dtype=np.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _register(name, fn, args, **meta):
+    meta.setdefault("mesh_axes", {})
+    meta.setdefault("params", [])
+    meta.setdefault("allowed_gspmd", None)
+    clear_executables(name)
+    return register_executable(name, fn, args, meta)
+
+
+def _fired(rep, rule):
+    return [f for f in rep.findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# (a) donation drop: peak delta ~ buffer size, agrees with donation-miss
+# ---------------------------------------------------------------------------
+
+class TestDonationPeak:
+    def test_dropping_donation_raises_peak_by_buffer_size(self):
+        def f(x, delta):
+            return x + delta
+
+        args = (_sds((256, 1024)), _sds((1024,)))
+        buf = 256 * 1024 * 4
+        h_don = _register("t_mem/don", jax.jit(f, donate_argnums=(0,)),
+                          args)
+        h_not = _register("t_mem/nodon", jax.jit(f), args)
+        m_don = predict_memory(h_don)
+        m_not = predict_memory(h_not)
+        # the donated run writes the output in place; dropping the
+        # donation costs ~one fresh output buffer
+        delta = m_not.peak_bytes - m_don.peak_bytes
+        assert 0.9 * buf <= delta <= 1.1 * buf, (delta, buf)
+        assert m_don.output_extra_bytes == 0
+        assert m_not.output_extra_bytes == buf
+
+        # ...and donation-miss names the same bytes: the rule and the
+        # memory model agree on what the dropped donation costs
+        rep = analyze_handle(h_not,
+                             options={"donation_bytes_threshold": 1024})
+        fired = _fired(rep, "donation-miss")
+        assert len(fired) == 1
+        (claimed,) = [int(s) for s in
+                      re.findall(r"\((\d+) B", fired[0].message)]
+        assert abs(claimed - delta) <= 0.1 * buf
+
+    def test_alias_table_silences_false_positive(self):
+        """Satellite: outputs XLA ALREADY absorbed (per the compiled
+        ``input_output_alias`` table) must stop producing shape-matched
+        donation-miss candidates — the shape/dtype guess alone cannot
+        see a second output slot being written in place."""
+        from types import SimpleNamespace as NS
+        from hetu_tpu.analysis import donation_candidates
+
+        leaf = lambda donated: NS(shape=(1024,), dtype=np.float32,
+                                  donated=donated)
+        args_info = (leaf(True), leaf(False))
+        out_avals = (jax.ShapeDtypeStruct((1024,), np.float32),
+                     jax.ShapeDtypeStruct((1024,), np.float32))
+        # shape-only guess: donated arg0 retires ONE of the two output
+        # slots, the second still looks reusable -> arg1 flagged
+        assert len(donation_candidates(args_info, out_avals,
+                                       min_bytes=1024)) == 1
+        # XLA's table says BOTH outputs are already written in place
+        # (e.g. an in-place scatter chain): nothing left to reuse
+        assert donation_candidates(args_info, out_avals, min_bytes=1024,
+                                   alias_pairs=[(0, 0), (1, 0)]) == []
+        # table with one absorbed slot: the other stays a candidate
+        assert len(donation_candidates(args_info, out_avals,
+                                       min_bytes=1024,
+                                       alias_pairs=[(0, 0)])) == 1
+
+    def test_dropped_donation_still_retires_slot_with_table(self):
+        """A donation XLA DROPPED (absent from a non-empty alias table)
+        must still claim its shape-matched output slot: the user already
+        donated for that output, so the same-shaped neighbour is not a
+        candidate — the decode tokens/pos pattern with a table present."""
+        from types import SimpleNamespace as NS
+        from hetu_tpu.analysis import donation_candidates
+
+        leaf = lambda shape, donated: NS(shape=shape, dtype=np.float32,
+                                         donated=donated)
+        # param 0: donated, sig S, donation dropped by XLA
+        # param 1: un-donated, sig S (the would-be false positive)
+        # param 2: donated, sig T, honored (output 1 <- param 2)
+        args_info = (leaf((1024,), True), leaf((1024,), False),
+                     leaf((2048,), True))
+        out_avals = (jax.ShapeDtypeStruct((1024,), np.float32),
+                     jax.ShapeDtypeStruct((2048,), np.float32))
+        assert donation_candidates(args_info, out_avals, min_bytes=1024,
+                                   alias_pairs=[(1, 2)]) == []
+
+    def test_alias_table_parses_from_real_compile(self):
+        """The parser must read jax's actual compiled HLO, not just the
+        seeded text fixture."""
+        f = jax.jit(lambda a: a * 2.0, donate_argnums=(0,))
+        text = f.lower(_sds((64, 64))).compile().as_text()
+        assert parse_input_output_aliases(text) == [(0, 0)]
+
+    def test_parse_input_output_aliases(self):
+        text = ("HloModule m, input_output_alias={ {0}: (2, {}, "
+                "may-alias), {1}: (0, {}, must-alias) }")
+        assert parse_input_output_aliases(text) == [(0, 2), (1, 0)]
+        assert parse_input_output_aliases("HloModule m") == []
+
+
+# ---------------------------------------------------------------------------
+# (b) remat lowers the predicted peak; remat-opportunity stops firing
+# ---------------------------------------------------------------------------
+
+def _chain_step(remat: bool, blocks: int = 4, depth: int = 4,
+                h: int = 256, b: int = 512):
+    # each block holds `depth` internal MATERIALIZED activations (dot
+    # outputs the backward consumes directly — the walk prices fusible
+    # values at zero by design); checkpointing a block trades those for
+    # its one boundary (the classic nn-layer remat shape)
+    def block(x, ws):
+        for w in ws:
+            x = x @ w
+        return x
+
+    blk = jax.checkpoint(block) if remat else block
+
+    def loss(params, x):
+        for ws in params:
+            x = blk(x, ws)
+        return jnp.mean(x ** 2)
+
+    def step(params, x):
+        return jax.grad(loss)(params, x)
+
+    args = (tuple(tuple(_sds((h, h)) for _ in range(depth))
+                  for _ in range(blocks)), _sds((b, h)))
+    # registered as a train step: remat-opportunity only applies where
+    # a backward holds saved activations (the rule guards on ctx.train)
+    return _register(f"t_mem/chain_{'remat' if remat else 'plain'}",
+                     jax.jit(step), args, train=True)
+
+
+class TestRematPeak:
+    def test_remat_lowers_predicted_peak(self):
+        m_plain = predict_memory(_chain_step(remat=False))
+        m_remat = predict_memory(_chain_step(remat=True))
+        # the plain chain holds every layer's saved activations across
+        # the whole forward; checkpointing trades them for recompute
+        assert m_remat.activation_peak_bytes \
+            < 0.7 * m_plain.activation_peak_bytes, \
+            (m_remat.activation_peak_bytes, m_plain.activation_peak_bytes)
+        assert m_remat.peak_bytes < m_plain.peak_bytes
+
+    def test_remat_opportunity_fires_once_then_stops(self):
+        opts = {"remat_min_bytes": 1 << 16,
+                "remat_activation_fraction": 0.3}
+        rep = analyze_handle(_chain_step(remat=False), options=opts)
+        fired = _fired(rep, "remat-opportunity")
+        assert len(fired) == 1, rep.findings
+        assert "jax.checkpoint" in fired[0].hint
+        # the walk sees the remat regions -> already covered, silent
+        rep2 = analyze_handle(_chain_step(remat=True), options=opts)
+        assert not _fired(rep2, "remat-opportunity"), rep2.findings
+
+    def test_remat_opportunity_silent_on_inference(self):
+        """No backward pass -> jax.checkpoint reclaims nothing; the
+        rule must not advise remat on inference-only executables even
+        when materialized temps dominate the peak."""
+        def fwd(params, x):
+            for ws in params:
+                for w in ws:
+                    x = x @ w
+            return x
+
+        h, b = 256, 512
+        args = (tuple(tuple(_sds((h, h)) for _ in range(4))
+                      for _ in range(4)), _sds((b, h)))
+        hdl = _register("t_mem/chain_infer", jax.jit(fwd), args)
+        opts = {"remat_min_bytes": 1 << 16,
+                "remat_activation_fraction": 0.3}
+        rep = analyze_handle(hdl, options=opts)
+        assert not _fired(rep, "remat-opportunity"), rep.findings
+
+    def test_has_remat_region(self):
+        assert has_remat_region(_chain_step(remat=True).jaxpr)
+        assert not has_remat_region(_chain_step(remat=False).jaxpr)
+
+
+# ---------------------------------------------------------------------------
+# (c) scan body temporaries peak once, not x trips
+# ---------------------------------------------------------------------------
+
+class TestScanPeak:
+    def test_scan_temporaries_do_not_accumulate_across_trips(self):
+        w = jnp.zeros((256, 256), np.float32)
+
+        def f(n):
+            def body(c, _):
+                t = c @ w              # 64KB body temporary
+                return jnp.tanh(t), jnp.sum(t)
+            def g(x):
+                return jax.lax.scan(body, x, None, length=n)
+            return jax.make_jaxpr(g)(jnp.zeros((64, 256), np.float32))
+
+        p2 = liveness_walk(f(2)).peak
+        p16 = liveness_walk(f(16)).peak
+        # the body temp is per-trip scratch: 8x the trips must not move
+        # the peak (stacked ys are scalars here)
+        assert p16 <= p2 * 1.05 + 1024, (p2, p16)
+        assert p2 > 0
+
+    def test_final_carry_aliases_running_carry(self):
+        """The scan's carry output reuses the running carry buffer —
+        it must not be double counted as fresh memory."""
+        def g(x):
+            def body(c, _):
+                return jnp.tanh(c), None
+            c, _ = jax.lax.scan(body, x, None, length=4)
+            return jnp.sum(c)
+
+        big = jax.make_jaxpr(g)(jnp.zeros((512, 512), np.float32))
+        # carry is 1MB; the walk's peak must stay ~one carry, not two
+        assert liveness_walk(big).peak <= 1.5 * 512 * 512 * 4
+
+
+# ---------------------------------------------------------------------------
+# memory lint rules: each fires exactly once on a seeded violation
+# ---------------------------------------------------------------------------
+
+class TestMemoryRules:
+    def _handle(self):
+        def f(x, d):
+            return jnp.tanh(x @ d)
+        return _register("t_mem/rules", jax.jit(f),
+                         (_sds((256, 256)), _sds((256, 256))))
+
+    def test_peak_memory_regression_fires_once(self):
+        h = self._handle()
+        mem = predict_memory(h)
+        rep = analyze_handle(h, options={
+            "baseline_peak_bytes": {h.name: mem.peak_bytes // 2},
+            "memory_tolerance": 0.1})
+        fired = _fired(rep, "peak-memory-regression")
+        assert len(fired) == 1, rep.findings
+        assert "--update-baseline" in fired[0].hint
+        # frozen at the actual peak: silent
+        rep2 = analyze_handle(h, options={
+            "baseline_peak_bytes": {h.name: mem.peak_bytes}})
+        assert not _fired(rep2, "peak-memory-regression")
+
+    def test_oom_risk_fires_once(self):
+        h = self._handle()
+        rep = analyze_handle(h, options={"hbm_budget_bytes": 1024.0,
+                                         "hbm_usable_fraction": 1.0})
+        fired = _fired(rep, "oom-risk")
+        assert len(fired) == 1, rep.findings
+        assert fired[0].severity == "error"
+        # the hint names the dominant buffer class's remedy
+        dom = predict_memory(h).dominant_kind()
+        assert dom in fired[0].message
+        assert fired[0].hint
+        rep2 = analyze_handle(h, options={"hbm_budget_bytes": 95e9})
+        assert not _fired(rep2, "oom-risk")
+
+    def test_replicated_state_under_shard_fires_once(self):
+        def step(p, m, v, x):
+            g = x * 0.1
+            nm = 0.9 * m + 0.1 * g
+            nv = 0.99 * v + 0.01 * g * g
+            return p - 1e-3 * nm / (jnp.sqrt(nv) + 1e-8), nm, nv
+
+        s = _sds((512, 512))
+        kinds = ("param", "opt-state", "opt-state", "feed")
+        h = _register(
+            "t_mem/repstate", jax.jit(step), (s, s, s, s),
+            mesh_axes={"dp": 8}, dp_axis="dp", zero=0, flat_state=False,
+            arg_divisors=(1, 1, 1, 8), arg_kinds=kinds)
+        rep = analyze_handle(h, options={"param_bytes_threshold": 1 << 20})
+        fired = _fired(rep, "replicated-state-under-shard")
+        assert len(fired) == 1, rep.findings
+        assert "zero" in fired[0].hint.lower()
+        # zero=1 contracts the state to be dp-sharded: silent
+        h2 = _register(
+            "t_mem/repstate_z1", jax.jit(step), (s, s, s, s),
+            mesh_axes={"dp": 8}, dp_axis="dp", zero=1, flat_state=False,
+            arg_divisors=(1, 8, 8, 8), arg_kinds=kinds)
+        rep2 = analyze_handle(
+            h2, options={"param_bytes_threshold": 1 << 20})
+        assert not _fired(rep2, "replicated-state-under-shard")
+        # dp=1 mesh: nothing to shard over, silent
+        h3 = _register(
+            "t_mem/repstate_dp1", jax.jit(step), (s, s, s, s),
+            mesh_axes={"dp": 1}, dp_axis="dp", zero=0, flat_state=False,
+            arg_divisors=(1, 1, 1, 1), arg_kinds=kinds)
+        rep3 = analyze_handle(
+            h3, options={"param_bytes_threshold": 1 << 20})
+        assert not _fired(rep3, "replicated-state-under-shard")
+
+
+# ---------------------------------------------------------------------------
+# resident accounting + XLA cross-check on a real probe
+# ---------------------------------------------------------------------------
+
+class TestResidentAndXla:
+    def test_arg_divisors_shard_resident_bytes(self):
+        def f(w, x):
+            return x @ w
+
+        h = _register("t_mem/shard", jax.jit(f),
+                      (_sds((1024, 1024)), _sds((8, 1024))),
+                      arg_divisors=(8, 1), arg_kinds=("param", "feed"))
+        mem = predict_memory(h)
+        assert mem.by_kind["param"] == 1024 * 1024 * 4 // 8
+        assert mem.by_kind["feed"] == 8 * 1024 * 4
+
+    def test_resident_model_is_exact_vs_xla_arguments(self):
+        """The resident side of the model must match XLA's own
+        ``argument_size_in_bytes`` EXACTLY on an Adam-style fused train
+        step — every input leaf's bytes, donation-independent.  (The
+        ±10% whole-peak acceptance criterion is pinned per gate family
+        by the CI gate itself; the attention probe's fusible softmax
+        residuals are a documented model gap on the temp side.)"""
+        from hetu_tpu.planner.cost_model import calibrate_layer_memory
+        cal = calibrate_layer_memory(xla_check=True)
+        assert cal.xla_bytes is not None and cal.xla_bytes > 0
+        assert cal.static_bytes > 0
+        assert cal.scale == pytest.approx(
+            cal.static_bytes / cal.model_bytes)
+
+        def f(x, d):
+            return jnp.tanh(x @ d)
+        h = _register("t_mem/xla_args", jax.jit(f),
+                      (_sds((256, 256)), _sds((256, 256))))
+        mem = predict_memory(h, xla=True)
+        assert mem.xla is not None
+        assert mem.resident_bytes == mem.xla["argument"]
+
+    def test_report_json_shape(self):
+        h = self_handle = _register(
+            "t_mem/json", jax.jit(lambda x: x * 2.0), (_sds((64, 64)),))
+        mem = predict_memory(h, xla=True)
+        d = mem.to_dict(buffers=True)
+        assert d["peak_bytes"] == mem.peak_bytes
+        assert "by_kind" in d and "xla_total_bytes" in d
+        assert isinstance(d["top_buffers"], list)

@@ -127,6 +127,54 @@ class TestSearchEngine:
         with pytest.raises(RuntimeError, match="no feasible plan"):
             eng.search()
 
+    def test_memory_cap_fed_by_analysis_backed_model(self):
+        """ISSUE 8: the planner's HBM budget check runs on the numbers
+        the static peak-HBM pass validated — a MemoryCalibration from
+        ``calibrate_layer_memory`` (ratio of ``analysis.predict_memory``
+        over the closed form on a lowered single-layer train-step
+        probe) scales every ``layer_memory`` byte the DP solver sees."""
+        from hetu_tpu.planner import (MemoryCalibration, layer_memory,
+                                      calibrate_layer_memory)
+        cal = calibrate_layer_memory()
+        # the calibration really comes from the static pass: both sides
+        # measured, scale is their ratio
+        assert cal.static_bytes > 0 and cal.model_bytes > 0
+        assert cal.scale == pytest.approx(
+            cal.static_bytes / cal.model_bytes)
+        spec = transformer_layer_spec(64, 1024, 1024, 4096, 2)
+        base = layer_memory(spec, Strategy(), _cluster())
+        got = layer_memory(spec, Strategy(), _cluster(), calibration=cal)
+        assert got == pytest.approx(base * cal.scale)
+        # the engine threads it into the budget check it hands the DP
+        eng = SearchEngine(_cluster(), _gpt_layers(), global_batch=64,
+                           micro_batch=8, memory_calibration=cal)
+        assert eng.memory_calibration is cal
+        plan = eng.search()
+        assert np.isfinite(plan.time)
+
+    def test_solver_rejects_plan_exceeding_static_peak(self):
+        """ISSUE 8: a plan whose ANALYSIS-PREDICTED peak exceeds the
+        chip HBM budget must be rejected even when the closed-form
+        heuristic would have accepted it — the cap is enforced on the
+        calibrated numbers."""
+        from hetu_tpu.planner import MemoryCalibration
+        cluster = _cluster(hbm=30e9)
+        layers = _gpt_layers(hidden=2048)
+        # uncalibrated closed form: fits comfortably
+        eng = SearchEngine(cluster, layers, global_batch=64,
+                           micro_batch=8, allow_recompute=False,
+                           allow_zero=False)
+        eng.search()
+        # static pass says every layout needs 100x what the heuristic
+        # thought: the same search must now reject every plan
+        bloat = MemoryCalibration(scale=100.0, static_bytes=1,
+                                  model_bytes=1.0)
+        eng2 = SearchEngine(cluster, layers, global_batch=64,
+                            micro_batch=8, allow_recompute=False,
+                            allow_zero=False, memory_calibration=bloat)
+        with pytest.raises(RuntimeError, match="no feasible plan"):
+            eng2.search()
+
     def test_plan_for_gpt_closes_the_loop(self):
         """plan_for_gpt: GPTConfig -> layer chain -> searched plan with a
         micro-batch sweep (the bench.py / train_gpt --auto-parallel entry,
