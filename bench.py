@@ -649,6 +649,15 @@ def bench_serving_microbench() -> dict:
     (HETU_TPU_SERVE_BENCH_{HIDDEN,LAYERS} to override) so the CPU run
     finishes in seconds.
 
+    ISSUE 9 adds the **trace plane microbench**: tracer overhead on
+    warm short replays (no tracer vs disabled SpanTracer vs tracing
+    on, paired back-to-back rounds, median per-round delta; the
+    disabled-vs-none delta is asserted < 2% AFTER the headline JSON is
+    emitted — the no-op path must be free), the Perfetto trace artifact
+    (``scratch/serving_trace.json``), and the predicted-vs-observed
+    reconciliation table over BOTH executable families (serving unified
+    + a tiny traced train step) — all landing in ``BENCH_OBS.json``.
+
     Writes BENCH_SERVING.json next to this file (keeping the previous
     bucketed-engine numbers under a ``v1`` key for the trajectory) and
     returns the dict.
@@ -752,8 +761,124 @@ def bench_serving_microbench() -> dict:
         "    wall = time.perf_counter() - t0\n"
         "    mm = e.metrics_summary()\n"
         "    return e, mm, wall\n"
+        "\n"
+        "# -- trace plane (ISSUE 9): tracer overhead + the Perfetto\n"
+        "# artifact + predicted-vs-observed reconciliation, packaged as\n"
+        "# a function so it can run AFTER every headline measurement\n"
+        "# (and degrade to an error stub) -- the obs section may never\n"
+        "# cost the serving numbers\n"
+        "def obs_section():\n"
+        "    from hetu_tpu import obs\n"
+        "    import statistics\n"
+        "    oh_prompts = [p for p, n in zip(prompts, lens) if n == 64]\n"
+        "    oh_new = 8\n"
+        "    def replay(engine, ps, n_new):\n"
+        "        engine.reset_metrics()\n"
+        "        t0 = time.perf_counter()\n"
+        "        for p in ps:\n"
+        "            engine.add_request(p, n_new, arrival_time=0.0)\n"
+        "        engine.run()\n"
+        "        return time.perf_counter() - t0\n"
+        "    # overhead: (a) no tracer (shared no-op), (b) a real\n"
+        "    # SpanTracer switched off in place (the guard path a\n"
+        "    # service with tracing compiled in but disabled pays),\n"
+        "    # (c) tracing on -- short decode-dominated replays in\n"
+        "    # back-to-back PAIRED rounds, gated on the median of\n"
+        "    # per-round differences: pairing cancels the slow\n"
+        "    # scheduler/thermal drift that makes any unpaired wall\n"
+        "    # comparison (even min-of-N) swing several percent on a\n"
+        "    # busy 2-core host\n"
+        "    tr_off = obs.SpanTracer(capacity=1 << 16)\n"
+        "    tr_off.enabled = False\n"
+        "    tr_on = obs.SpanTracer(capacity=1 << 16)\n"
+        "    nulls, d_off, d_on = [], [], []\n"
+        "    for _ in range(40):\n"
+        "        eng.set_tracer(None)\n"
+        "        a = replay(eng, oh_prompts, oh_new)\n"
+        "        eng.set_tracer(tr_off)\n"
+        "        b = replay(eng, oh_prompts, oh_new)\n"
+        "        eng.set_tracer(tr_on)\n"
+        "        c = replay(eng, oh_prompts, oh_new)\n"
+        "        nulls.append(a)\n"
+        "        d_off.append(b - a)\n"
+        "        d_on.append(c - a)\n"
+        "    eng.set_tracer(None)\n"
+        "    null_wall = statistics.median(nulls)\n"
+        "    disabled_wall = null_wall + statistics.median(d_off)\n"
+        "    traced_wall = null_wall + statistics.median(d_on)\n"
+        "    disabled_delta_pct = abs(statistics.median(d_off)) \\\n"
+        "        / null_wall * 100.0\n"
+        "    traced_overhead_pct = statistics.median(d_on) \\\n"
+        "        / null_wall * 100.0\n"
+        "    # a tiny traced train step joins the reconciliation table\n"
+        "    # as a second executable family (serving is the first)\n"
+        "    import hetu_tpu as ht\n"
+        "    from hetu_tpu import optim\n"
+        "    from hetu_tpu.models import GPTLMHeadModel\n"
+        "    tcfg = GPTConfig(vocab_size=V, hidden_size=64,\n"
+        "                     num_layers=2, num_heads=4, max_seq_len=64,\n"
+        "                     sp=False, dropout=0.0)\n"
+        "    ht.set_seed(0)\n"
+        "    with obs.trace() as ttr:\n"
+        "        with ht.graph('define_and_run', create_new=True,\n"
+        "                      prefix='obs_bench') as g:\n"
+        "            ids = ht.placeholder('int32', (2, 16), name='ids')\n"
+        "            lbl = ht.placeholder('int32', (2, 16), name='lbl')\n"
+        "            tloss = GPTLMHeadModel(tcfg)(ids, lbl)\n"
+        "            top_ = optim.AdamOptimizer(lr=1e-3).minimize(tloss)\n"
+        "            tdata = rng.randint(0, V,\n"
+        "                                size=(2, 16)).astype('int32')\n"
+        "            for _ in range(3):\n"
+        "                g.run(tloss, [tloss, top_],\n"
+        "                      {ids: tdata, lbl: tdata})\n"
+        "        train_events = ttr.events()\n"
+        "    # the frozen artifact: ONE clean traced replay of the full\n"
+        "    # mixed trace (not the 40 overhead mini-replays)\n"
+        "    tr_art = obs.SpanTracer(capacity=1 << 16)\n"
+        "    eng.set_tracer(tr_art)\n"
+        "    replay(eng, prompts, new)\n"
+        "    eng.set_tracer(None)\n"
+        "    all_events = tr_art.events() + train_events\n"
+        f"    art_dir = os.path.join({os.path.dirname(os.path.abspath(__file__))!r}, 'scratch')\n"
+        "    os.makedirs(art_dir, exist_ok=True)\n"
+        "    art_path = os.path.join(art_dir, 'serving_trace.json')\n"
+        "    obs.write_chrome_trace(all_events, art_path)\n"
+        "    rec = obs.reconcile(all_events)\n"
+        "    n_tok_obs = len(oh_prompts) * oh_new\n"
+        "    return {\n"
+        "      'tracer_overhead': {\n"
+        "        'protocol': '40 back-to-back paired rounds x 3 '\n"
+        "                    'configs; gate = |median per-round delta| '\n"
+        "                    '/ median null wall, short decode trace '\n"
+        "                    'on the warm executable',\n"
+        "        'untraced_wall_s': round(null_wall, 3),\n"
+        "        'disabled_wall_s': round(disabled_wall, 3),\n"
+        "        'traced_wall_s': round(traced_wall, 3),\n"
+        "        'untraced_tokens_per_sec':\n"
+        "            round(n_tok_obs / null_wall, 1),\n"
+        "        'disabled_tokens_per_sec':\n"
+        "            round(n_tok_obs / disabled_wall, 1),\n"
+        "        'traced_tokens_per_sec':\n"
+        "            round(n_tok_obs / traced_wall, 1),\n"
+        "        'disabled_delta_pct': round(disabled_delta_pct, 2),\n"
+        "        'traced_overhead_pct': round(traced_overhead_pct, 2),\n"
+        "        'disabled_lt_2pct': bool(disabled_delta_pct < 2.0),\n"
+        "      },\n"
+        "      'trace_artifact': art_path,\n"
+        "      'trace_events': len(all_events),\n"
+        "      'trace_dropped': int(tr_art.dropped),\n"
+        "      'reconcile': rec.to_dict(),\n"
+        "    }, disabled_delta_pct\n"
+        "\n"
         "e_cold, m_cold, wall_cold = shared_trace(False)\n"
         "e_hit, m_hit, wall_hit = shared_trace(True)\n"
+        "# headline + prefix-cache numbers are all in the can: the obs\n"
+        "# section runs last and degrades to an error stub\n"
+        "try:\n"
+        "    obs_res, obs_delta = obs_section()\n"
+        "except Exception as e:\n"
+        "    obs_res = {'error': f'{type(e).__name__}: {e}'}\n"
+        "    obs_delta = None\n"
         "prompt_toks = sum(len(u) for u in users)\n"
         "saved = int(m_hit['prefix_cache_tokens_saved'])\n"
         "shared = {\n"
@@ -819,6 +944,7 @@ def bench_serving_microbench() -> dict:
         "    'compile_count': int(m['compile_count']),\n"
         "    'host_logit_fetches': int(m['host_logit_fetches'])},\n"
         "  'prefix_cache': shared,\n"
+        "  'obs': obs_res,\n"
         "}\n"
         "res['kv_bytes_ratio_dense_vs_paged'] = round(\n"
         "    dense_bytes_per_req / np.mean(paged_bytes), 2)\n"
@@ -828,6 +954,15 @@ def bench_serving_microbench() -> dict:
         "# warmup) over the whole mixed trace -- vs the v1 bucket grid\n"
         "res['compile_count_ok'] = m['compile_count'] <= 2\n"
         "print(json.dumps(res))\n"
+        "# the obs acceptance gate, AFTER the headline JSON is out so a\n"
+        "# noisy host can never cost the serving numbers: the no-op\n"
+        "# tracer path must be free\n"
+        "if obs_delta is not None:\n"
+        "    assert obs_delta < 2.0, (\n"
+        "        f'disabled-tracer overhead {obs_delta:.2f}% >= 2%')\n"
+        "else:\n"
+        "    assert 'error' not in obs_res, (\n"
+        "        'obs section failed: ' + str(obs_res))\n"
     )
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -840,8 +975,25 @@ def bench_serving_microbench() -> dict:
             return {"error": f"rc={proc.returncode}: "
                              f"{proc.stderr.strip()[-400:]}"}
         result = json.loads(lines[-1])
+        if proc.returncode != 0:
+            # the post-print obs gate tripped: headline numbers are
+            # intact, but surface the failed gate loudly
+            result["obs_gate_error"] = proc.stderr.strip()[-200:]
     except Exception as e:  # never fail the headline bench on this
         return {"error": f"{type(e).__name__}: {e}"}
+    # trace-plane numbers (tracer overhead + reconciliation table,
+    # ISSUE 9) live in their own BENCH_OBS.json next to the trace
+    # artifact pointer; BENCH_SERVING.json keeps the serving trajectory
+    obs_res = result.pop("obs", None)
+    if obs_res is not None:
+        try:
+            obs_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_OBS.json")
+            with open(obs_path, "w") as fh:
+                json.dump(obs_res, fh, indent=1)
+        except Exception:
+            pass
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_SERVING.json")
     try:

@@ -34,12 +34,16 @@ def serve_demo(state, cfg, args):
     per-request TTFT/latency and aggregate tokens/s."""
     import time
 
+    from hetu_tpu import obs
     from hetu_tpu.serving import Engine
 
     rng = np.random.RandomState(0)
     period = np.array([3, 7, 1, 12], np.int32)
+    # --trace-out: record the full per-request trace plane and dump a
+    # Perfetto-loadable chrome trace after the run (DESIGN.md §15)
+    tracer = obs.SpanTracer() if args.trace_out else None
     eng = Engine(state, cfg, num_pages=64, page_size=8, max_batch=8,
-                 prefix_cache=not args.no_prefix_cache)
+                 prefix_cache=not args.no_prefix_cache, tracer=tracer)
     n = args.serve_requests
     t0 = time.monotonic()
     reqs = []
@@ -88,6 +92,16 @@ def serve_demo(state, cfg, args):
     if args.temperature == 0.0:
         print("self-check OK: every served request matches its solo "
               "generate() run bit-for-bit")
+    if tracer is not None:
+        events = tracer.events()
+        obs.write_chrome_trace(events, args.trace_out)
+        print(f"\nper-request serving timelines (from the trace):")
+        print(obs.timeline_summary(events))
+        print("\npredicted-vs-observed reconciliation:")
+        print(obs.reconcile(events).summary())
+        print(f"\nwrote {len(events)} trace events to {args.trace_out} — "
+              f"open it at https://ui.perfetto.dev (one track per "
+              f"request)")
 
 
 def main():
@@ -107,6 +121,10 @@ def main():
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable copy-on-write prefix caching "
                          "(DESIGN.md §13; on by default)")
+    ap.add_argument("--trace-out", type=str, default="",
+                    help="with --serve: trace the demo and write a "
+                         "Perfetto-loadable chrome trace JSON here, "
+                         "printing the per-request timeline summary")
     args = ap.parse_args()
     ckpt = args.ckpt or os.path.join(tempfile.mkdtemp(), "gpt")
 

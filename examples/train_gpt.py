@@ -71,6 +71,10 @@ def parse_args():
                    help="checkpoint dir (saved at the end)")
     p.add_argument("--load", type=str, default=None)
     p.add_argument("--log-every", type=int, default=5)
+    p.add_argument("--trace-out", type=str, default=None,
+                   help="trace the run (per-step feed/executable/commit "
+                        "phase spans) and write a Perfetto-loadable "
+                        "chrome trace JSON here")
     return p.parse_args()
 
 
@@ -164,6 +168,11 @@ def main():
             log.info("resumed from %s", args.load)
 
         sp_prof = StepProfiler(warmup=2)
+        tracer = None
+        if args.trace_out:
+            from hetu_tpu import obs
+            tracer = obs.SpanTracer()
+            obs.install_tracer(tracer)   # graph.run phases pick it up
         step = 0
         while step < args.steps:
             for batch in loader:
@@ -186,6 +195,13 @@ def main():
                           f"{float(np.asarray(out[0])):.4f} | "
                           f"{st['mean'] * 1e3:.1f} ms/step | "
                           f"{tput_fmt(tput)}")
+        if tracer is not None:
+            from hetu_tpu import obs
+            obs.install_tracer(None)
+            obs.write_chrome_trace(tracer.events(), args.trace_out)
+            print(obs.reconcile(tracer.events()).summary())
+            print(f"wrote {len(tracer.events())} trace events to "
+                  f"{args.trace_out} (open at https://ui.perfetto.dev)")
         if args.save:
             from hetu_tpu.utils.checkpoint import save_model
             d = os.path.dirname(os.path.abspath(args.save))

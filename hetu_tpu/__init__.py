@@ -31,6 +31,7 @@ from . import nn   # noqa: E402
 from . import optim  # noqa: E402
 from . import serving  # noqa: E402
 from . import analysis  # noqa: E402
+from . import obs  # noqa: E402
 
 __version__ = "0.1.0"
 

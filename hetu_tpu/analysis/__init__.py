@@ -58,7 +58,34 @@ __all__ = [
     "run_rules", "verify_grad_comm", "load_baseline", "save_baseline",
     "MemoryBuffer", "MemoryReport", "has_remat_region", "liveness_walk",
     "parse_input_output_aliases", "predict_memory", "xla_memory_stats",
+    "predicted_cost_stats",
 ]
+
+
+def predicted_cost_stats(handle: ExecutableHandle) -> Dict[str, Any]:
+    """Static per-executable cost facts for the runtime trace plane
+    (``hetu_tpu.obs.reconcile``): predicted wire bytes (the sum over the
+    executable's predicted comm-edge set — ``payload_bytes x count`` per
+    :class:`CommEdge`; None when the registration makes no edge claim)
+    and predicted peak HBM (``predict_memory`` native + comparable
+    peaks).  This is the join key between "what the analysis plane said
+    this executable would cost" and "what the tracer observed it do"."""
+    meta = handle.meta
+    mesh_axes = dict(meta.get("mesh_axes", {}))
+    train = bool(meta.get("train", meta.get("kind") == "train_step"))
+    wire: Optional[int] = None
+    if makes_edge_claim(meta):
+        edges = predict_edges(meta, mesh_axes, train)
+        wire = int(sum(e.payload_bytes * max(e.count, 1) for e in edges
+                       if e.kind != "identity"))
+    peak = cmp_peak = None
+    try:
+        mem = predict_memory(handle)
+        peak, cmp_peak = int(mem.peak_bytes), int(mem.cmp_peak_bytes)
+    except Exception:
+        pass       # advisory, same stance as build_context's memory pass
+    return {"wire_bytes": wire, "peak_hbm_bytes": peak,
+            "cmp_peak_bytes": cmp_peak}
 
 
 def build_context(handle: ExecutableHandle, compile: bool = False,

@@ -36,6 +36,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..core.dtype import canonicalize_dtype
+from ..obs.tracer import get_tracer
 from .tensor import SymbolicDim, Tensor, concrete_shape
 
 _op_ids = itertools.count()
@@ -136,6 +137,11 @@ def iter_executables(prefix: str = "") -> List[ExecutableHandle]:
 def clear_executables(prefix: str = "") -> None:
     for n in [n for n in _EXECUTABLE_REGISTRY if n.startswith(prefix)]:
         del _EXECUTABLE_REGISTRY[n]
+    # the trace plane's prediction cache holds a strong ref to each
+    # priced handle (whose meta may close over an engine's KV pool):
+    # evict alongside the registry or retiring an engine leaks its pool
+    from ..obs.reconcile import clear_prediction_cache
+    clear_prediction_cache(prefix)
 
 
 class OpNode:
@@ -1387,11 +1393,24 @@ class DefineAndRunGraph(Graph):
         if mode is None:
             mode = SwitchMode.ORIGIN_PARAM if optimizer is None \
                 else SwitchMode.ORIGIN_PARAM_AND_OPTIMIZER
-        sw = SwitchExecGraph(self, new_mesh, pspec_overrides, mode, dtype)
-        prof = sw.switch(optimizer)
-        self.cur_strategy_id += 1
-        self.num_strategy = max(self.num_strategy, self.cur_strategy_id + 1)
-        return prof
+        tr = get_tracer()
+        sp = tr.begin("switch_strategy", track="train",
+                      from_strategy=self.cur_strategy_id) if tr.enabled \
+            else None
+        try:
+            sw = SwitchExecGraph(self, new_mesh, pspec_overrides, mode,
+                                 dtype)
+            prof = sw.switch(optimizer)
+            self.cur_strategy_id += 1
+            self.num_strategy = max(self.num_strategy,
+                                    self.cur_strategy_id + 1)
+            if sp is not None:
+                tr.end(sp, to_strategy=self.cur_strategy_id,
+                       **prof.as_dict())
+            return prof
+        finally:
+            if sp is not None:
+                tr.end(sp)      # idempotent: only fires if we raised
 
     # -- run ----------------------------------------------------------------
 
@@ -1460,6 +1479,35 @@ class DefineAndRunGraph(Graph):
         self._last_plan = jit_step  # for cost_analysis()
         self._last_plan_key = key
 
+        # trace plane (hetu_tpu/obs): per-step phase spans on the
+        # "train" track — feed marshalling, the executable call, state
+        # commit — nested under one step span.  NULL tracer: all guards
+        # read False and nothing below allocates.  The try/finally
+        # closes the step span even when the body raises (ending the
+        # outermost span pops-and-discards any open children), so a
+        # caught-and-retried failing step never corrupts the
+        # per-thread nesting stack.
+        tr = get_tracer()
+        step_sp = tr.begin(
+            "train_step" if update_node is not None else "forward",
+            track="train", run_level=run_level.value,
+            strategy=self.cur_strategy_id) if tr.enabled else None
+        try:
+            return self._run_plan(tr, key, jit_step, gc_state, flat_mode,
+                                  update_node, real_fetches,
+                                  update_positions, feed_dict,
+                                  num_micro_batches)
+        finally:
+            if step_sp is not None:
+                tr.end(step_sp)
+
+    def _run_plan(self, tr, key, jit_step, gc_state, flat_mode,
+                  update_node, real_fetches, update_positions, feed_dict,
+                  num_micro_batches):
+        """The per-run tail of :meth:`run`: feed marshalling, state
+        assembly, registration, the executable call, and state commit —
+        split out so the step span wraps it in one try/finally."""
+        feed_sp = tr.begin("feed", track="train") if tr.enabled else None
         feeds = {}
         for t, v in feed_dict.items():
             arr = jnp.asarray(v, dtype=t.dtype.to_jnp())
@@ -1470,6 +1518,9 @@ class DefineAndRunGraph(Graph):
         if self._rng_tensor is not None:
             feeds[self._rng_tensor.id] = jnp.asarray(self._fresh_rng_key())
         feeds_mb = self._split_micro_batches(feeds, num_micro_batches)
+        if feed_sp is not None:
+            tr.end(feed_sp, n_feeds=len(feed_dict),
+                   micro_batches=num_micro_batches)
 
         var_state = dict(self._var_data)
         opt_state = {}
@@ -1505,9 +1556,37 @@ class DefineAndRunGraph(Graph):
                                          update_node, real_fetches,
                                          num_micro_batches,
                                          flat_mode=flat_mode)
+        exec_sp = None
+        if tr.enabled:
+            # the span reconciliation joins on: exec= is the registered
+            # plan name; grad-comm/optimizer work happens INSIDE the
+            # executable, attributed here via the plan's comm meta (the
+            # per-bucket comm_tag plane names each collective in the
+            # lowered program itself)
+            plan_name = self._plan_names.get(key, self.name)
+            attrs: Dict[str, Any] = {"exec": plan_name,
+                                     "micro_batches": num_micro_batches}
+            if update_node is not None:
+                opt_tr = update_node.attrs["optimizer"]
+                # explicit coalesced path: name the transport the
+                # comm_tag'd buckets ride; otherwise GSPMD owns the sync
+                attrs["grad_comm"] = getattr(opt_tr, "grad_comm", None) \
+                    if gc_state[0] else "gspmd"
+                attrs["zero"] = int(getattr(opt_tr, "zero", 0))
+                attrs["flat_state"] = bool(flat_mode)
+            from ..obs.reconcile import predicted_span_attrs
+            attrs.update(predicted_span_attrs(plan_name))
+            exec_sp = tr.begin("executable", track="train", **attrs)
         fetch_vals, new_vars, new_opt, new_accum = jit_step(
             var_state, opt_state, grad_accum, feeds_mb)
+        if exec_sp is not None:
+            # the jit call returns async futures: only block for an
+            # honest wall time when the step is actually being traced
+            jax.block_until_ready(fetch_vals)
+            tr.end(exec_sp)
 
+        commit_sp = tr.begin("commit", track="train") if tr.enabled \
+            else None
         self._var_data = dict(new_vars)
         if update_node is not None:
             new_opt = dict(new_opt)
@@ -1525,6 +1604,8 @@ class DefineAndRunGraph(Graph):
             self._memory_profiler = MemoryProfiler()
         if self._memory_profiler.enabled:
             self._memory_profiler.snapshot("step")
+        if commit_sp is not None:
+            tr.end(commit_sp)
         # restore fetch arity: update-op positions yield None
         out = list(fetch_vals)
         for i in update_positions:

@@ -1,0 +1,37 @@
+"""Runtime trace plane: span tracer, Perfetto export, reconciliation.
+
+The observability counterpart of ``hetu_tpu/analysis`` (DESIGN.md §15):
+
+* :mod:`.tracer` — low-overhead structured spans (monotonic clock,
+  parent/child nesting, instant events, capped ring buffer,
+  thread-safe) with a shared no-op ``NULL_TRACER`` so disabled tracing
+  costs ~nothing in the serving/train hot loops;
+* :mod:`.export` — Chrome trace-event JSON (loadable in Perfetto, one
+  track per serving request / per training phase) and a JSONL journal
+  readable with ``utils.metrics.load_jsonl``;
+* :mod:`.reconcile` — joins observed per-executable wall time and
+  device memory peaks against the analysis plane's static wire-byte and
+  peak-HBM predictions.
+
+Instrumented out of the box: ``serving.Engine`` (full per-request
+lifecycle: queue wait, admission + page accounting, prefix-cache
+hit/evict, prefill chunks, decode tokens, preemption, finish, plus the
+scheduler's per-step packing decision), ``DefineAndRunGraph.run``
+(per-step feed / executable / commit phases with grad-comm
+attribution), ``switch_strategy`` and the MPMD pipeline task loop.
+"""
+from .export import (chrome_trace, events_to_jsonl, request_timelines,
+                     timeline_summary, validate_chrome_trace,
+                     write_chrome_trace, write_jsonl)
+from .reconcile import (ReconcileReport, ReconcileRow, predicted_stats,
+                        reconcile)
+from .tracer import (NOOP_SPAN, NULL_TRACER, Span, SpanTracer, get_tracer,
+                     install_tracer, trace)
+
+__all__ = [
+    "Span", "SpanTracer", "NULL_TRACER", "NOOP_SPAN", "get_tracer",
+    "install_tracer", "trace",
+    "chrome_trace", "write_chrome_trace", "events_to_jsonl", "write_jsonl",
+    "validate_chrome_trace", "request_timelines", "timeline_summary",
+    "ReconcileReport", "ReconcileRow", "predicted_stats", "reconcile",
+]

@@ -1,0 +1,205 @@
+"""Predicted-vs-observed reconciliation: close the analysis-plane loop.
+
+The static analysis plane predicts, per registered executable, the
+collective set + wire bytes (``analysis/edges.py``) and the peak HBM
+(``analysis/memory.py``) — without running anything.  The trace plane
+records, per executable *call*, the observed wall time (spans whose
+attrs carry ``exec=<registered name>``) and the device allocator's peak
+(``utils.profiler.device_memory_stats``).  This module joins the two
+into one table — the artifact ROADMAP item 5's hardware-validation
+sweep freezes as evidence, runnable today on CPU with honest
+expectations (the CPU sim exposes no allocator stats, so the HBM column
+reads ``n/a`` instead of a fake zero-delta pass).
+
+    with trace() as tr:
+        ... run serving / training ...
+        rep = reconcile(tr.events())
+    print(rep.summary())
+
+Observed peak memory is a PROCESS-wide allocator high-water mark, not
+per-executable: the per-row check is therefore one-sided — a predicted
+peak LARGER than the observed process peak is a real model error
+(flagged), a smaller one is expected (other executables share the
+device).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+__all__ = ["predicted_stats", "predicted_span_attrs", "reconcile",
+           "ReconcileRow", "ReconcileReport", "clear_prediction_cache"]
+
+# the stats a traced executable span carries, span-attr name -> the
+# predicted_stats key it projects (ONE mapping for every emission site)
+_SPAN_ATTR_KEYS = (("predicted_wire_bytes", "wire_bytes"),
+                   ("predicted_peak_hbm_bytes", "peak_hbm_bytes"))
+
+# predictions require tracing+lowering the executable — cached per
+# registered name so the engine hot loop pays once per process; the
+# entry remembers WHICH handle it priced, so a re-registered name
+# (new engine, new graph plan) recomputes instead of serving stale
+# numbers
+_PRED_CACHE: Dict[str, Any] = {}
+
+
+def clear_prediction_cache(prefix: str = "") -> None:
+    """Drop cached predictions whose executable name starts with
+    ``prefix``.  ``graph.clear_executables`` calls this with the same
+    prefix, so retiring an engine (``unregister_analysis`` / same-name
+    reconstruction) releases the handle — and the KV pool its meta
+    closes over — instead of pinning it here forever."""
+    for name in [n for n in _PRED_CACHE if n.startswith(prefix)]:
+        del _PRED_CACHE[name]
+
+
+def predicted_stats(name_or_handle) -> Dict[str, Optional[int]]:
+    """Static per-executable cost facts: ``wire_bytes`` (sum over the
+    predicted comm-edge set; None when the executable makes no edge
+    claim), ``peak_hbm_bytes`` (native-dtype static peak) and
+    ``cmp_peak_bytes`` (platform-comparable peak).  Cached by name;
+    failures degrade to None fields — a broken prediction must never
+    take down the traced run."""
+    from ..graph.graph import get_executable
+    handle = name_or_handle
+    if isinstance(name_or_handle, str):
+        try:
+            handle = get_executable(name_or_handle)
+        except KeyError:
+            return {"wire_bytes": None, "peak_hbm_bytes": None,
+                    "cmp_peak_bytes": None}
+    cached = _PRED_CACHE.get(handle.name)
+    if cached is not None and cached[0] is handle:
+        return cached[1]
+    from ..analysis import predicted_cost_stats
+    try:
+        stats = predicted_cost_stats(handle)
+    except Exception:
+        stats = {"wire_bytes": None, "peak_hbm_bytes": None,
+                 "cmp_peak_bytes": None}
+    _PRED_CACHE[handle.name] = (handle, stats)
+    return stats
+
+
+def predicted_span_attrs(name_or_handle) -> Dict[str, Any]:
+    """:func:`predicted_stats` projected into the span-attribute
+    namespace (``predicted_*`` keys, None fields dropped) — the single
+    mapping both the serving engine and the train loop attach to their
+    executable spans."""
+    p = predicted_stats(name_or_handle)
+    return {attr: p[key] for attr, key in _SPAN_ATTR_KEYS
+            if p.get(key) is not None}
+
+
+@dataclasses.dataclass
+class ReconcileRow:
+    """One executable's predicted-vs-observed join."""
+    executable: str
+    calls: int = 0
+    total_wall_s: float = 0.0
+    mean_wall_s: float = 0.0
+    p90_wall_s: float = 0.0
+    predicted_wire_bytes: Optional[int] = None
+    predicted_peak_hbm_bytes: Optional[int] = None
+    cmp_peak_bytes: Optional[int] = None
+    observed_peak_hbm_bytes: int = 0          # process-wide allocator peak
+    hbm_check: str = "n/a"                    # ok|over-predicted|n/a
+    tokens: int = 0                           # serving spans carry tokens
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class ReconcileReport:
+    def __init__(self, rows: List[ReconcileRow], platform: str = "",
+                 observed_peak_hbm_bytes: int = 0):
+        self.rows = rows
+        self.platform = platform
+        self.observed_peak_hbm_bytes = observed_peak_hbm_bytes
+
+    @property
+    def families(self) -> int:
+        return len(self.rows)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"platform": self.platform,
+                "observed_peak_hbm_bytes": int(self.observed_peak_hbm_bytes),
+                "rows": [r.to_dict() for r in self.rows]}
+
+    def summary(self) -> str:
+        def fmt_b(v) -> str:
+            if v is None:
+                return "-"
+            from ..analysis.memory import _fmt_bytes
+            return _fmt_bytes(v)
+
+        lines = [f"{'executable':<28}{'calls':>6}{'mean_ms':>9}"
+                 f"{'p90_ms':>8}{'pred_wire':>11}{'pred_peak':>11}"
+                 f"{'obs_peak':>10}  hbm"]
+        for r in self.rows:
+            lines.append(
+                f"{r.executable[:27]:<28}{r.calls:>6}"
+                f"{r.mean_wall_s * 1e3:>9.2f}{r.p90_wall_s * 1e3:>8.2f}"
+                f"{fmt_b(r.predicted_wire_bytes):>11}"
+                f"{fmt_b(r.predicted_peak_hbm_bytes):>11}"
+                f"{fmt_b(r.observed_peak_hbm_bytes):>10}  {r.hbm_check}")
+        if not self.observed_peak_hbm_bytes:
+            lines.append("(no device allocator stats on this platform — "
+                         "HBM reconciliation is n/a; run on TPU for the "
+                         "memory verdict)")
+        return "\n".join(lines)
+
+
+def reconcile(events: Sequence, prefix: str = "",
+              device=None) -> ReconcileReport:
+    """Join traced executable spans against the static predictions.
+
+    ``events``: tracer events (a :class:`SpanTracer` works too).  Spans
+    are grouped by their ``exec`` attr (the registered executable name,
+    optionally filtered by ``prefix``); observed wall time is the span
+    durations, observed memory the live allocator peak."""
+    from ..utils.profiler import device_memory_stats
+    if hasattr(events, "events"):
+        events = events.events()
+    walls: Dict[str, List[float]] = {}
+    tokens: Dict[str, int] = {}
+    for ev in events:
+        name = ev.attrs.get("exec")
+        if name is None or ev.ph != "X" or not str(name).startswith(prefix):
+            continue
+        walls.setdefault(str(name), []).append(ev.dur or 0.0)
+        tokens[str(name)] = tokens.get(str(name), 0) \
+            + int(ev.attrs.get("tokens", 0) or 0)
+    mem = device_memory_stats(device)
+    peak = int(mem.get("peak_bytes_in_use", 0))
+    try:
+        import jax
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "?"
+    from ..utils.metrics import percentile_of
+    rows: List[ReconcileRow] = []
+    for name in sorted(walls):
+        ws = sorted(walls[name])
+        pred = predicted_stats(name)
+        row = ReconcileRow(
+            executable=name, calls=len(ws),
+            total_wall_s=float(sum(ws)),
+            mean_wall_s=float(sum(ws) / len(ws)),
+            p90_wall_s=float(percentile_of(ws, 90)),
+            predicted_wire_bytes=pred.get("wire_bytes"),
+            predicted_peak_hbm_bytes=pred.get("peak_hbm_bytes"),
+            cmp_peak_bytes=pred.get("cmp_peak_bytes"),
+            observed_peak_hbm_bytes=peak,
+            tokens=tokens.get(name, 0))
+        if peak <= 0 or row.predicted_peak_hbm_bytes is None:
+            row.hbm_check = "n/a"
+        elif row.predicted_peak_hbm_bytes > peak:
+            # one-sided: the static peak can never exceed what the
+            # allocator actually high-watered across the whole process
+            row.hbm_check = "over-predicted"
+        else:
+            row.hbm_check = "ok"
+        rows.append(row)
+    return ReconcileReport(rows, platform=platform,
+                           observed_peak_hbm_bytes=peak)

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs.tracer import get_tracer
 from .schedule import (Task, generate_gpipe_schedule,
                        generate_interleaved_1f1b_schedule,
                        generate_pipedream_flush_schedule, max_in_flight,
@@ -295,6 +296,7 @@ class MPMDPipelineRuntime:
         # process per rank)
         remaining = sum(len(s) for sch in scheds for s in sch)
         stats.num_tasks = remaining
+        tracer = get_tracer()
         t_ctrl = time.perf_counter()
         while remaining:
             progress = False
@@ -305,7 +307,21 @@ class MPMDPipelineRuntime:
                         continue
                     t = scheds[p][s][i]
                     if ready(p, s, t):
-                        run_task(p, s, t)
+                        if tracer.enabled:
+                            # per-stage-task span (trace plane): dispatch
+                            # wall time per pipe/stage row — async XLA
+                            # execution overlaps under it, so this shows
+                            # the SCHEDULE shape, not device occupancy
+                            _ts = tracer.now()
+                            run_task(p, s, t)
+                            tracer.complete(
+                                f"{t.kind} mb{t.micro_batch}", _ts,
+                                tracer.now() - _ts,
+                                track=f"pipe{p}/stage{s}", pipe=p,
+                                stage=s, micro_batch=t.micro_batch,
+                                kind=t.kind)
+                        else:
+                            run_task(p, s, t)
                         if self.memory_profiler.enabled:
                             self.memory_profiler.snapshot(
                                 f"pipe{p}.stage{s}.{t.kind}",

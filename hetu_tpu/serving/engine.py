@@ -41,6 +41,18 @@ histograms ``ttft``/``tbt``/``tpot``/``request_latency`` (ttft/tbt are
 Prometheus-bucketed for per-stage latency dashboards) — with the no-op
 fallback when disabled.  ``metrics_summary()`` adds the derived
 ``prefix_cache_hit_rate`` and the live ``prefix_cache_pages`` count.
+``metrics_text()`` renders everything as Prometheus text exposition.
+
+Trace plane (hetu_tpu/obs, DESIGN.md §15): under an installed tracer
+every request gets a complete lifecycle timeline on its own track —
+``enqueue`` instant, ``queued``/``running`` state spans that tile
+[submit, finish] gaplessly across preemptions, ``admit`` (page
+accounting), ``prefix_cache_hit``, per-chunk ``prefill_chunk`` spans
+with their token-budget slice, per-token instants, ``preempt`` and
+``finish`` — plus the scheduler's ``pack`` decision per step and a
+``unified_step`` span per executable call carrying the analysis plane's
+predicted wire bytes / peak HBM for reconciliation.  The default tracer
+is the shared no-op: every emission site guards on ``tracer.enabled``.
 """
 from __future__ import annotations
 
@@ -54,7 +66,8 @@ import numpy as np
 
 from ..models.generate import _Params
 from ..models.gpt import GPTConfig
-from ..utils.metrics import make_instrument
+from ..obs.tracer import get_tracer
+from ..utils.metrics import make_instrument, render_prometheus
 from .decode import build_unified_step_fn
 from .kv_pool import TRASH_PAGE, PagedKVPool
 from .prefix_cache import PrefixCache
@@ -77,9 +90,16 @@ class Engine:
                  latency_buckets: Optional[Sequence[float]] = None,
                  time_fn: Optional[Callable[[], float]] = None,
                  name: str = "serving", analysis_tap: bool = True,
-                 prefix_cache: bool = True, debug: bool = False):
+                 prefix_cache: bool = True, debug: bool = False,
+                 tracer=None):
         self.cfg = cfg
         self.name = name
+        # runtime trace plane (hetu_tpu/obs): None follows the ambient
+        # tracer (obs.install_tracer / obs.trace), which defaults to the
+        # shared no-op — every emission site below guards on
+        # ``tr.enabled`` so disabled tracing stays out of the hot loop
+        self._tracer = tracer
+        self._pred_attrs: Optional[Dict[str, Any]] = None
         # ring buffer of recent packed-step layouts (rows + page tables),
         # consumed by the trash-page-write lint (hetu_tpu/analysis)
         self.tap: Optional[deque] = deque(maxlen=128) if analysis_tap \
@@ -204,14 +224,34 @@ class Engine:
                       arrival_time=now if arrival_time is None
                       else float(arrival_time), stream_cb=stream_cb)
         req.submit_time = max(now, req.arrival_time)
+        req.trace_t0 = req.submit_time      # queued segment opens here
         self._next_id += 1
         self.queue.push(req)
+        tr = self.tracer
+        if tr.enabled:
+            tr.instant("enqueue", track=f"req {req.req_id}",
+                       ts=req.submit_time, req=req.req_id,
+                       prompt_tokens=len(prompt),
+                       max_new_tokens=int(max_new_tokens),
+                       queue_depth=len(self.queue))
         return req
 
     # -- loop ----------------------------------------------------------------
 
     def _now(self) -> float:
         return self._time_fn()
+
+    @property
+    def tracer(self):
+        """The effective tracer: the injected one, else the ambient
+        global (usually ``NULL_TRACER`` — the no-op)."""
+        return self._tracer if self._tracer is not None else get_tracer()
+
+    def set_tracer(self, tracer) -> None:
+        """Swap the engine's tracer live (None reverts to following the
+        ambient global) — lets a service toggle tracing on a running
+        engine, and the obs microbench A/B the same warm executable."""
+        self._tracer = tracer
 
     @property
     def has_work(self) -> bool:
@@ -222,6 +262,7 @@ class Engine:
         into ONE ragged batch, run the unified executable.  Returns the
         number of tokens emitted."""
         now = self._now()
+        tr = self.tracer
         for req in self.scheduler.admit(self.queue, self.running, now):
             self._start(req)
         live = [r for r in self.running if r.state == RUNNING]
@@ -230,7 +271,24 @@ class Engine:
             self.running.remove(req)
             self.queue.push(req)
             self.counters["preemptions"].inc()
+            t = self._now()
+            if tr.enabled:
+                # the running segment ends here; a fresh queued segment
+                # opens at the SAME timestamp (gapless state tiling)
+                tr.complete("running", req.trace_t0, t - req.trace_t0,
+                            track=f"req {req.req_id}", req=req.req_id)
+                tr.instant("preempt", track=f"req {req.req_id}", ts=t,
+                           req=req.req_id,
+                           n_preemptions=req.n_preemptions,
+                           pos_lost=len(req.tokens))
+            req.trace_t0 = t
         rows = self.scheduler.pack(kept)
+        if tr.enabled and rows:
+            tr.instant("pack", track="scheduler", ts=self._now(),
+                       running=len(self.running),
+                       queue_depth=len(self.queue),
+                       free_pages=self.pool.free_pages,
+                       **self.scheduler.slot_mix(rows))
         produced = self._run_unified(rows) if rows else 0
         if self.debug:
             self.pool.check_invariants()
@@ -284,6 +342,11 @@ class Engine:
         freed = self.prefix_cache.evict(n)
         if freed:
             self.counters["prefix_cache_evictions"].inc(freed)
+            tr = self.tracer
+            if tr.enabled:
+                tr.instant("prefix_cache_evict", track="engine",
+                           ts=self._now(), pages_freed=freed,
+                           pages_wanted=n)
         return freed
 
     def _start(self, req: Request) -> None:
@@ -305,7 +368,14 @@ class Engine:
                 req.cached_tokens = req.pos
         need = self.pool.pages_for(len(req.tokens)) - len(req.pages)
         pages = self.pool.alloc(need)
+        tr = self.tracer
         if pages is None:
+            if tr.enabled:
+                # stays queued: the open queued segment keeps running
+                tr.instant("admit_defer", track=f"req {req.req_id}",
+                           ts=self._now(), req=req.req_id,
+                           pages_needed=need,
+                           free_pages=self.pool.free_pages)
             # admission over-committed (another _start this step evicted
             # a cached page the budget counted on): roll back and retry
             # next step — never crash the loop on a page race.  Counters
@@ -330,6 +400,25 @@ class Engine:
         req.peak_pages = max(req.peak_pages, len(req.pages))
         req.state = RUNNING
         self.running.append(req)
+        t = self._now()
+        if tr.enabled:
+            # close the queued segment and open running at the same
+            # instant; the admission decision carries its page math
+            tr.complete("queued", req.trace_t0, t - req.trace_t0,
+                        track=f"req {req.req_id}", req=req.req_id,
+                        preemptions=req.n_preemptions)
+            tr.instant("admit", track=f"req {req.req_id}", ts=t,
+                       req=req.req_id, pages_granted=need,
+                       pages_total=len(req.pages),
+                       cached_pages=req.shared_pages,
+                       free_pages=self.pool.free_pages,
+                       batch=len(self.running))
+            if looked_up and req.shared_pages:
+                tr.instant("prefix_cache_hit", track=f"req {req.req_id}",
+                           ts=t, req=req.req_id,
+                           cached_tokens=req.cached_tokens,
+                           shared_pages=req.shared_pages)
+        req.trace_t0 = t
 
     # -- the unified step ----------------------------------------------------
 
@@ -396,6 +485,15 @@ class Engine:
         dt = self._now() - t0
         self._calls += 1
         self.counters["step_calls"].inc()
+        tr = self.tracer
+        if tr.enabled:
+            # the span every reconciliation row hangs off: exec= names
+            # the registered ExecutableHandle, and the static
+            # predictions ride along as attributes
+            tr.complete("unified_step", t0, dt, track="engine",
+                        exec=f"{self.name}/unified", rows=len(rows),
+                        tokens=int(sum(q for _, q, _ in rows)),
+                        **self._predicted_attrs())
         # classify by SLOT, not q_len: a chunk_size=1 prefill chunk is
         # still a prefill chunk
         s = self.scheduler.max_batch
@@ -409,11 +507,23 @@ class Engine:
             pre = max(0, min(qlen, req.prompt_len - req.pos))
             if pre:
                 self.counters["prefill_tokens"].inc(pre)
+                if tr.enabled:
+                    tr.complete("prefill_chunk", t0, dt,
+                                track=f"req {req.req_id}",
+                                req=req.req_id, q_len=qlen,
+                                prefill_tokens=pre, pos=req.pos,
+                                budget_slice=qlen,
+                                cached_skip=req.cached_tokens)
             req.pos += qlen
             if req.pos == len(req.tokens):      # row reached its tip:
                 self._emit(req, int(toks[row]))  # commit the sample
                 produced += 1
                 now = self._now()
+                if tr.enabled:
+                    tr.instant("token", track=f"req {req.req_id}",
+                               ts=now, req=req.req_id,
+                               n=req.n_generated,
+                               decode_slot=bool(row < s))
                 if req.first_token_time is None:
                     req.first_token_time = now
                     self.histograms["ttft"].observe(now - req.submit_time)
@@ -452,6 +562,17 @@ class Engine:
         req.pages = []
         req.state = FINISHED
         req.finish_time = self._now()
+        tr = self.tracer
+        if tr.enabled:
+            tr.complete("running", req.trace_t0,
+                        req.finish_time - req.trace_t0,
+                        track=f"req {req.req_id}", req=req.req_id)
+            tr.instant("finish", track=f"req {req.req_id}",
+                       ts=req.finish_time, req=req.req_id,
+                       new_tokens=req.n_generated,
+                       preemptions=req.n_preemptions,
+                       peak_pages=req.peak_pages)
+        req.trace_t0 = req.finish_time
         if req in self.running:
             self.running.remove(req)
         self.finished[req.req_id] = req
@@ -515,6 +636,29 @@ class Engine:
         clear_executables(f"{self.name}/")
 
     # -- observability -------------------------------------------------------
+
+    def _predicted_attrs(self) -> Dict[str, Any]:
+        """Static analysis-plane predictions for the unified executable,
+        attached to every traced ``unified_step`` span so the trace
+        alone suffices for reconciliation.  Computed once (tracing the
+        registered handle) on the first TRACED step; failures degrade to
+        no attrs rather than breaking serving."""
+        if self._pred_attrs is None:
+            from ..obs.reconcile import predicted_span_attrs
+            self._pred_attrs = predicted_span_attrs(
+                f"{self.name}/unified")
+        return self._pred_attrs
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of every engine instrument
+        (``utils.metrics.render_prometheus``): counters and gauges
+        as-is, histograms as ``_bucket``/``_sum``/``_count`` — ready
+        for a /metrics scrape endpoint."""
+        insts: Dict[str, Any] = {}
+        insts.update(self.counters)
+        insts.update(self.gauges)
+        insts.update(self.histograms)
+        return render_prometheus(insts)
 
     def reset_metrics(self) -> None:
         """Zero every counter/gauge/histogram AND the step counter (the

@@ -42,6 +42,12 @@ class Request:
     cached_tokens: int = 0
     pos: int = 0                 # KV entries committed (next write index)
     state: str = WAITING
+    # start of the CURRENT lifecycle segment (queued/running) for the
+    # trace plane: the engine closes a state span over
+    # [trace_t0, transition] at every admit/preempt/finish, so the
+    # per-request segments tile [submit, finish] gaplessly (asserted by
+    # the timeline gate in tests/test_obs.py)
+    trace_t0: float = 0.0
     n_preemptions: int = 0
     peak_pages: int = 0
     submit_time: float = 0.0

@@ -123,6 +123,21 @@ class Scheduler:
                 chunk_row += 1
         return rows
 
+    def slot_mix(self, rows: List[Tuple[Request, int, int]]
+                 ) -> dict:
+        """The step's packing decision as a flat dict — the trace
+        plane emits it as the per-step ``pack`` instant event, so a
+        Perfetto timeline shows exactly how each executable call's
+        token budget was split between decode slots and prefill
+        chunks."""
+        n_decode = sum(1 for _, _, row in rows if row < self.max_batch)
+        return {"decode_slots": n_decode,
+                "chunk_slots": len(rows) - n_decode,
+                "tokens": int(sum(q for _, q, _ in rows)),
+                "token_budget": self.token_budget,
+                "chunk": self.chunk,
+                "prefill_rows": self.prefill_rows}
+
     # -- decode page budget --------------------------------------------------
 
     def ensure_decode_pages(self, running: List[Request]

@@ -108,6 +108,19 @@ class Metrics:
 # engine's hot loop pays nothing when observability is disabled.
 
 
+def percentile_of(xs_sorted, p: float) -> float:
+    """Linear-interpolated percentile of an ascending-sorted sequence
+    (numpy's default estimator; ``p`` in [0, 100]) — shared by
+    :meth:`Histogram.percentile` and the trace-plane reconciliation so
+    no consumer re-grows the old nearest-index tail bias."""
+    if not xs_sorted:
+        return 0.0
+    rank = max(0.0, min(100.0, float(p))) / 100.0 * (len(xs_sorted) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(xs_sorted) - 1)
+    return xs_sorted[lo] + (xs_sorted[hi] - xs_sorted[lo]) * (rank - lo)
+
+
 class Counter:
     """Monotonically increasing count (tokens generated, preemptions)."""
 
@@ -193,12 +206,12 @@ class Histogram:
         return self.total / self.count if self.count else 0.0
 
     def percentile(self, p: float) -> float:
-        """Exact percentile over the retained window (p in [0, 100])."""
-        if not self._obs:
-            return 0.0
-        xs = sorted(self._obs)
-        idx = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
-        return xs[idx]
+        """Exact percentile over the retained window (p in [0, 100]),
+        linearly interpolated between ranks (numpy's default).  The old
+        nearest-index rounding biased small-window tails — p90 of
+        [1..10] snapped to a sample instead of 9.1 — which made
+        BENCH_SERVING TTFT/TBT tails jumpy run-to-run."""
+        return percentile_of(sorted(self._obs), p)
 
     def summary(self) -> Dict[str, float]:
         return {"count": self.count, "mean": self.mean,
@@ -255,6 +268,63 @@ def make_instrument(kind: str, name: str = "", enabled: bool = True,
     if cls is None:
         raise ValueError(f"unknown instrument kind {kind!r}")
     return cls(name, **kwargs)
+
+
+def _prom_name(name: str) -> str:
+    """Prometheus metric names: [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    out = "".join(c if c.isalnum() or c in "_:" else "_" for c in name)
+    if not out or not (out[0].isalpha() or out[0] in "_:"):
+        out = "_" + out
+    return out
+
+
+def _prom_value(v: float) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"                  # exposition-format spellings:
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def render_prometheus(instruments) -> str:
+    """Prometheus text exposition (v0.0.4) for a set of instruments.
+
+    ``instruments``: a ``{name: instrument}`` dict (e.g. the engine's
+    ``counters``/``gauges``/``histograms`` merged) or an iterable of
+    instruments (named by their ``name`` attribute).  Counters and
+    gauges render as-is; histograms render the standard
+    ``_bucket``/``_sum``/``_count`` triple via :meth:`bucket_counts`
+    (cumulative, ``+Inf`` included, so ``_bucket{le="+Inf"} == _count``
+    by construction).  No-op instruments are skipped — disabled metrics
+    expose nothing rather than fake zeros.
+    """
+    if isinstance(instruments, dict):
+        items = list(instruments.items())
+    else:
+        items = [(getattr(inst, "name", "") or f"metric_{i}", inst)
+                 for i, inst in enumerate(instruments)]
+    lines: List[str] = []
+    for name, inst in items:
+        if isinstance(inst, _NullInstrument):
+            continue
+        name = _prom_name(name)
+        if isinstance(inst, Counter):
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {_prom_value(inst.value)}")
+        elif isinstance(inst, Gauge):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_prom_value(inst.value)}")
+        elif isinstance(inst, Histogram):
+            lines.append(f"# TYPE {name} histogram")
+            for le, c in inst.bucket_counts().items():
+                # bounds keep the float form ("1.0", not "1") so the
+                # series identity is stable as buckets are retuned
+                le_txt = le if le == "+Inf" else repr(float(le))
+                lines.append(f'{name}_bucket{{le="{le_txt}"}} {int(c)}')
+            lines.append(f"{name}_sum {_prom_value(inst.total)}")
+            lines.append(f"{name}_count {int(inst.count)}")
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 def load_jsonl(path: str) -> List[Dict[str, Any]]:

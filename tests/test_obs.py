@@ -1,0 +1,461 @@
+"""Runtime trace plane (ISSUE 9): tracer semantics, Perfetto export
+schema, Prometheus exposition, percentile interpolation, and the
+lint_graph-marked per-request timeline gate.
+
+The timeline gate is the serving contract the trace plane exists to
+check: on an ADVERSARIAL trace (late arrivals + recompute preemption +
+prefix-cache eviction under a starved page pool, synthetic clock) every
+admitted request's ``queued``/``running`` state spans tile
+``[submit, finish]`` gaplessly and every event timeline is monotonic —
+a scheduling bug that loses a request mid-flight, or an instrumentation
+bug that misses a transition, breaks the tiling.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import obs
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.obs import (NULL_TRACER, SpanTracer, chrome_trace,
+                          events_to_jsonl, get_tracer, install_tracer,
+                          reconcile, request_timelines, timeline_summary,
+                          trace, validate_chrome_trace, write_jsonl)
+from hetu_tpu.serving import Engine
+from hetu_tpu.utils.metrics import (Counter, Gauge, Histogram,
+                                    load_jsonl, make_instrument,
+                                    render_prometheus)
+
+CFG_KW = dict(vocab_size=61, hidden_size=32, num_layers=2, num_heads=4,
+              max_seq_len=64, sp=False, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def tiny_state():
+    cfg = GPTConfig(**CFG_KW)
+    ht.set_seed(7)
+    with ht.graph("eager", create_new=True):
+        model = GPTLMHeadModel(cfg)
+        model.logits(np.zeros((1, 4), np.int32))
+        state = {k: np.asarray(v) for k, v in model.state_dict().items()}
+    return state, cfg
+
+
+def _traced_engine(state, cfg, **kw):
+    clock = [0.0]
+    tracer = SpanTracer(time_fn=lambda: clock[0])
+    kw.setdefault("time_fn", lambda: clock[0])
+    eng = Engine(state, cfg, tracer=tracer, debug=True, **kw)
+    return eng, tracer, clock
+
+
+def _drain(eng, clock, tick=1.0, max_steps=500):
+    steps = 0
+    while eng.has_work and steps < max_steps:
+        eng.step()
+        clock[0] += tick
+        steps += 1
+    assert not eng.has_work, "engine failed to drain the trace"
+
+
+# ---------------------------------------------------------------------------
+# tracer semantics
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_track_inheritance():
+    tr = SpanTracer()
+    with tr.span("outer", track="work", a=1) as outer:
+        with tr.span("inner") as inner:
+            assert inner.parent == "outer"
+            assert inner.track == "work"       # inherited
+        tr.instant("mark")                     # inherits track too
+    assert outer.parent is None
+    evs = tr.events()
+    assert [e.name for e in evs] == ["inner", "mark", "outer"]
+    assert all(e.track == "work" for e in evs)
+    assert tr.open_count() == 0
+    inner_ev = evs[0]
+    outer_ev = evs[-1]
+    assert outer_ev.ts <= inner_ev.ts
+    assert inner_ev.end_ts <= outer_ev.end_ts + 1e-9
+
+
+def test_ring_buffer_caps_and_counts_drops():
+    tr = SpanTracer(capacity=8)
+    for i in range(20):
+        tr.instant(f"e{i}")
+    evs = tr.events()
+    assert len(evs) == 8
+    assert tr.dropped == 12
+    # oldest dropped, newest kept
+    assert [e.name for e in evs] == [f"e{i}" for i in range(12, 20)]
+    tr.clear()
+    assert tr.events() == [] and tr.dropped == 0
+
+
+def test_disabled_tracing_is_noop():
+    # the shared null tracer records nothing and returns the shared
+    # no-op span (no allocation per call)
+    sp = NULL_TRACER.span("x", attr=1)
+    with sp:
+        NULL_TRACER.instant("y")
+    assert sp is NULL_TRACER.begin("z")
+    assert NULL_TRACER.events() == []
+    # a real tracer switched off in place behaves the same without
+    # losing its buffer
+    tr = SpanTracer()
+    tr.instant("kept")
+    tr.enabled = False
+    with tr.span("dropped"):
+        tr.instant("dropped-too")
+    tr.complete("dropped-three", 0.0, 1.0)
+    assert [e.name for e in tr.events()] == ["kept"]
+
+
+def test_out_of_order_end_tolerated():
+    tr = SpanTracer()
+    a = tr.begin("a")
+    b = tr.begin("b")
+    tr.end(a)          # ends b's scope implicitly, never raises
+    assert tr.open_count() == 0
+    assert [e.name for e in tr.events()] == ["a"]
+    tr.end(b)          # already discarded: recorded as closed event
+    assert len(tr.events()) == 2
+
+
+def test_retroactive_complete_and_explicit_ts():
+    tr = SpanTracer(time_fn=lambda: 100.0)
+    tr.complete("past", ts=3.0, dur=2.0, track="t", k=1)
+    tr.instant("then", ts=5.0, track="t")
+    (c, i) = tr.events()
+    assert (c.ts, c.dur, c.end_ts) == (3.0, 2.0, 5.0)
+    assert i.ts == 5.0 and i.ph == "i"
+
+
+def test_end_is_idempotent():
+    tr = SpanTracer()
+    sp = tr.begin("a")
+    tr.end(sp)
+    tr.end(sp)                 # finally-style re-end: no double commit
+    assert len(tr.events()) == 1
+
+
+def test_traced_run_failure_closes_spans(tiny_state):
+    """A raising step must not leave the step span open on the thread
+    stack (a retried training loop would otherwise nest every later
+    span under the dead step)."""
+    _, cfg = tiny_state
+    ht.set_seed(0)
+    with trace() as tr:
+        with ht.graph("define_and_run", create_new=True,
+                      prefix="obs_fail") as g:
+            from hetu_tpu import optim
+            ids = ht.placeholder("int32", (2, 8), name="ids")
+            lbl = ht.placeholder("int32", (2, 8), name="lbl")
+            model = GPTLMHeadModel(cfg)
+            loss = model(ids, lbl)
+            train_op = optim.AdamOptimizer(lr=1e-3).minimize(loss)
+            data = np.zeros((2, 8), np.int32)
+            with pytest.raises(AssertionError):
+                # 3 micro-batches don't divide batch 2: raises inside
+                # the traced feed phase
+                g.run(loss, [loss, train_op], {ids: data, lbl: data},
+                      num_micro_batches=3)
+            assert tr.open_count() == 0
+            g.run(loss, [loss, train_op], {ids: data, lbl: data})
+            assert tr.open_count() == 0
+    steps = [e for e in tr.events() if e.name in ("train_step",)]
+    assert len(steps) == 2                   # failed + succeeded
+    # the successful step's children nest under train_step, not under
+    # a stale span leaked by the failed one
+    ok_exec = [e for e in tr.events() if e.name == "executable"]
+    assert len(ok_exec) == 1 and ok_exec[0].parent == "train_step"
+
+
+def test_clear_executables_evicts_prediction_cache(tiny_state):
+    """Retiring an engine (unregister_analysis / same-name rebuild)
+    must drop its prediction-cache entry too — the cached handle's meta
+    closes over the KV pool and would pin it forever."""
+    from hetu_tpu.obs.reconcile import _PRED_CACHE, predicted_stats
+    state, cfg = tiny_state
+    eng = Engine(state, cfg, num_pages=16, page_size=8, max_batch=2,
+                 name="obs_evict")
+    assert predicted_stats("obs_evict/unified")["peak_hbm_bytes"] > 0
+    assert "obs_evict/unified" in _PRED_CACHE
+    eng.unregister_analysis()
+    assert "obs_evict/unified" not in _PRED_CACHE
+
+
+def test_trace_context_installs_and_restores():
+    assert get_tracer() is NULL_TRACER
+    with trace() as tr:
+        assert get_tracer() is tr
+        prev = install_tracer(None)
+        assert prev is tr and get_tracer() is NULL_TRACER
+        install_tracer(tr)
+    assert get_tracer() is NULL_TRACER
+
+
+# ---------------------------------------------------------------------------
+# histogram percentile interpolation (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_percentile_linear_interpolation_pinned():
+    h = Histogram("lat")
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    # rank = p/100 * (n-1); linear between floor/ceil ranks
+    assert h.percentile(0) == 1.0
+    assert h.percentile(100) == 4.0
+    assert h.percentile(50) == pytest.approx(2.5)
+    assert h.percentile(90) == pytest.approx(3.7)
+    assert h.percentile(99) == pytest.approx(3.97)
+    # the old int(round(...)) nearest-index would give 3.0 / 4.0 / 4.0
+    h2 = Histogram("one")
+    h2.observe(5.0)
+    assert h2.percentile(90) == 5.0
+    assert Histogram("empty").percentile(90) == 0.0
+
+
+def test_percentile_matches_numpy_linear():
+    rng = np.random.RandomState(0)
+    xs = rng.rand(37)
+    h = Histogram("r")
+    for v in xs:
+        h.observe(float(v))
+    for p in (10, 50, 90, 99):
+        assert h.percentile(p) == pytest.approx(
+            float(np.percentile(xs, p)), rel=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_render_prometheus_round_trip():
+    c = Counter("tokens_generated")
+    c.inc(42)
+    g = Gauge("page_utilization")
+    g.set(0.625)
+    h = Histogram("ttft", buckets=[0.1, 1.0])
+    for v in (0.05, 0.5, 2.0, 3.0):
+        h.observe(v)
+    text = render_prometheus({"tokens_generated": c,
+                              "page_utilization": g, "ttft": h})
+    lines = [ln for ln in text.splitlines() if ln]
+    assert "# TYPE tokens_generated counter" in lines
+    assert "tokens_generated 42" in lines
+    assert "page_utilization 0.625" in lines
+    # histogram triple: cumulative buckets match bucket_counts exactly
+    want = h.bucket_counts()
+    got = {}
+    for ln in lines:
+        if ln.startswith("ttft_bucket"):
+            le = ln.split('le="')[1].split('"')[0]
+            got[le] = int(ln.split()[-1])
+    assert got == {"0.1": 1, "1.0": 2, "+Inf": 4}
+    assert got["+Inf"] == want["+Inf"] == h.count
+    assert f"ttft_count {h.count}" in lines
+    assert any(ln.startswith("ttft_sum") for ln in lines)
+    # the no-op instrument exposes nothing (not fake zeros)
+    assert render_prometheus(
+        {"off": make_instrument("counter", "off", enabled=False)}) == ""
+
+
+def test_engine_metrics_text(tiny_state):
+    state, cfg = tiny_state
+    eng = Engine(state, cfg, num_pages=16, page_size=8, max_batch=4)
+    eng.add_request([5, 9, 2], 3, arrival_time=0.0)
+    eng.run()
+    text = eng.metrics_text()
+    assert "# TYPE tokens_generated counter" in text
+    assert "tokens_generated 3" in text
+    assert 'ttft_bucket{le="+Inf"} 1' in text
+    assert "ttft_count 1" in text
+    assert "# TYPE page_utilization gauge" in text
+
+
+# ---------------------------------------------------------------------------
+# chrome trace schema from a real serving run
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_schema_from_serving_run(tiny_state):
+    state, cfg = tiny_state
+    eng, tracer, clock = _traced_engine(state, cfg, num_pages=16,
+                                        page_size=8, max_batch=4)
+    rng = np.random.RandomState(1)
+    for i in range(3):
+        eng.add_request(rng.randint(1, 61, size=5).tolist(), 4,
+                        arrival_time=float(i))
+    _drain(eng, clock)
+    events = tracer.events()
+    assert tracer.open_count() == 0          # all spans properly closed
+    doc = chrome_trace(events)
+    validate_chrome_trace(doc)               # pid/tid/ts/ph on EVERY event
+    txt = json.dumps(doc)                    # must be pure-JSON clean
+    doc2 = json.loads(txt)
+    # per-request tracks present as named thread rows
+    names = [ev["args"]["name"] for ev in doc2["traceEvents"]
+             if ev["ph"] == "M" and ev["name"] == "thread_name"]
+    for i in range(3):
+        assert f"req {i}" in names
+    assert "engine" in names and "scheduler" in names
+    # every request has a complete lifecycle in the trace
+    tls = request_timelines(events)
+    for i in range(3):
+        kinds = [e.name for e in tls[i]]
+        assert kinds[0] == "enqueue" and kinds[-1] == "finish"
+        assert "queued" in kinds and "running" in kinds \
+            and "admit" in kinds and "prefill_chunk" in kinds
+        assert sum(1 for k in kinds if k == "token") == 4
+    # unified_step spans carry the reconciliation join key + predictions
+    un = [e for e in events if e.name == "unified_step"]
+    assert un and all(e.attrs["exec"] == "serving/unified" for e in un)
+    assert all(e.attrs.get("predicted_peak_hbm_bytes", 0) > 0
+               for e in un)
+    assert timeline_summary(events)          # renders without error
+
+
+def test_jsonl_journal_round_trips(tmp_path, tiny_state):
+    state, cfg = tiny_state
+    eng, tracer, clock = _traced_engine(state, cfg, num_pages=16,
+                                        page_size=8, max_batch=2)
+    eng.add_request([3, 1, 4], 2, arrival_time=0.0)
+    _drain(eng, clock)
+    path = str(tmp_path / "journal.jsonl")
+    write_jsonl(tracer.events(), path)
+    back = load_jsonl(path)                  # utils.metrics reader
+    assert len(back) == len(tracer.events())
+    assert [r["step"] for r in back] == list(range(len(back)))
+    assert all({"name", "track", "ph", "ts", "attrs"} <= set(r)
+               for r in back)
+    assert events_to_jsonl(tracer.events())[0]["step"] == 0
+
+
+def test_untraced_engine_stays_silent(tiny_state):
+    state, cfg = tiny_state
+    eng = Engine(state, cfg, num_pages=16, page_size=8, max_batch=2)
+    assert eng.tracer is NULL_TRACER
+    eng.add_request([2, 4], 2, arrival_time=0.0)
+    eng.run()
+    assert NULL_TRACER.events() == []
+
+
+# ---------------------------------------------------------------------------
+# the gapless-timeline CI gate (lint_graph)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.lint_graph
+def test_adversarial_trace_timelines_gapless(tiny_state):
+    """Late arrivals + preemption + prefix-cache eviction under a
+    starved pool: every admitted request's state spans must tile
+    [submit, finish] with no gap and its event stream must be
+    time-monotonic."""
+    state, cfg = tiny_state
+    eng, tracer, clock = _traced_engine(
+        state, cfg, num_pages=10, page_size=4, max_batch=3,
+        chunk_size=8, prefill_rows=1, prefix_cache=True)
+    rng = np.random.RandomState(2)
+    shared = rng.randint(1, 61, size=8).tolist()     # cacheable header
+    arrivals = [0.0, 0.0, 2.0, 6.0, 9.0, 13.0]
+    for i, at in enumerate(arrivals):
+        prompt = shared[:4] + rng.randint(1, 61, size=4).tolist() \
+            if i % 2 else shared
+        eng.add_request(prompt, 8, arrival_time=at)
+    _drain(eng, clock)
+    # the trace must actually be adversarial, or the gate is vacuous
+    m = eng.metrics_summary()
+    assert m["preemptions"] >= 1, "pool never starved: gate is vacuous"
+    assert m["prefix_cache_evictions"] >= 1, \
+        "cache never evicted: gate is vacuous"
+    assert len(eng.finished) == len(arrivals)
+    timelines = request_timelines(tracer.events())
+    for rid, req in eng.finished.items():
+        evs = timelines[rid]
+        # monotonic: events ordered by start, intervals inside the life
+        ts = [e.ts for e in evs]
+        assert ts == sorted(ts), f"req {rid}: non-monotonic timeline"
+        assert evs[0].name == "enqueue" and evs[0].ts == req.submit_time
+        assert evs[-1].name == "finish" \
+            and evs[-1].ts == req.finish_time
+        # gapless state tiling: queued/running segments chain exactly
+        # from submit to finish (preemptions included)
+        segs = [e for e in evs if e.ph == "X"
+                and e.name in ("queued", "running")]
+        assert segs[0].name == "queued" and segs[0].ts == req.submit_time
+        for prev, nxt in zip(segs, segs[1:]):
+            assert abs(nxt.ts - prev.end_ts) < 1e-9, \
+                f"req {rid}: gap between {prev.name} and {nxt.name}"
+            assert prev.name != nxt.name, \
+                f"req {rid}: {prev.name} repeated without transition"
+        assert segs[-1].name == "running" \
+            and abs(segs[-1].end_ts - req.finish_time) < 1e-9
+        # lifecycle counters agree with the trace
+        assert sum(1 for e in evs if e.name == "preempt") \
+            == req.n_preemptions
+        assert sum(1 for e in evs if e.name == "token") \
+            == req.n_generated
+    # scheduler pack decisions stay inside the token budget
+    packs = [e for e in tracer.events() if e.name == "pack"]
+    assert packs
+    for p in packs:
+        assert p.attrs["tokens"] <= p.attrs["token_budget"]
+        assert p.attrs["decode_slots"] <= eng.scheduler.max_batch
+    # cache eviction shows up on the engine track
+    assert any(e.name == "prefix_cache_evict" for e in tracer.events())
+
+
+# ---------------------------------------------------------------------------
+# predicted-vs-observed reconciliation
+# ---------------------------------------------------------------------------
+
+
+def test_reconcile_joins_two_executable_families(tiny_state):
+    """Serving + a train step traced in one session: the report must
+    join observed wall time against the static predictions for BOTH
+    executable families (CPU-honest: the HBM column is n/a here)."""
+    state, cfg = tiny_state
+    with trace() as tr:
+        # family 1: the serving unified step (ambient tracer picked up)
+        eng = Engine(state, cfg, num_pages=16, page_size=8, max_batch=2,
+                     name="obs_serving")
+        eng.add_request([7, 3, 9, 1], 3, arrival_time=0.0)
+        eng.run()
+        # family 2: a train-step plan
+        ht.set_seed(0)
+        with ht.graph("define_and_run", create_new=True,
+                      prefix="obs_train") as g:
+            from hetu_tpu import optim
+            ids = ht.placeholder("int32", (2, 8), name="ids")
+            lbl = ht.placeholder("int32", (2, 8), name="lbl")
+            model = GPTLMHeadModel(GPTConfig(**CFG_KW))
+            loss = model(ids, lbl)
+            opt = optim.AdamOptimizer(lr=1e-3)
+            train_op = opt.minimize(loss)
+            data = np.random.RandomState(0).randint(
+                0, 61, size=(2, 8)).astype(np.int32)
+            for _ in range(2):
+                g.run(loss, [loss, train_op], {ids: data, lbl: data})
+        rep = reconcile(tr.events())
+    assert rep.families >= 2
+    by_name = {r.executable: r for r in rep.rows}
+    srv = by_name["obs_serving/unified"]
+    trn = next(r for r in rep.rows if "obs_train" in r.executable)
+    assert srv.calls >= 1 and srv.mean_wall_s > 0
+    assert trn.calls == 2 and trn.total_wall_s > 0
+    # static predictions joined per family
+    assert srv.predicted_peak_hbm_bytes > 0
+    assert trn.predicted_peak_hbm_bytes > 0
+    assert srv.predicted_wire_bytes == 0     # single-device: zero-edge claim
+    # CPU honesty: no allocator stats -> explicit n/a, never a fake pass
+    assert srv.hbm_check == "n/a" and rep.observed_peak_hbm_bytes == 0
+    assert "n/a" in rep.summary()
+    d = rep.to_dict()
+    assert len(d["rows"]) == rep.families
+    json.dumps(d)                            # BENCH_OBS-serializable
