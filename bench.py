@@ -624,6 +624,111 @@ def bench_mem_lint() -> dict:
     return result
 
 
+def bench_cost_lint() -> dict:
+    """The static step-time model as a bench target (ISSUE 10): runs
+    the analysis gate in a pinned-CPU subprocess and reports, per gated
+    executable, the predicted FLOPs / HBM bytes / step time and the
+    deltas against XLA's own ``compiled.cost_analysis()`` totals — plus
+    the planner loop closed: the calibrated DP search
+    (``planner.search.plan_for_gpt``) must beat every hand-written
+    gate-family layout on predicted step time.  Writes BENCH_COST.json
+    next to this file."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)       # the CLI forces its own device count
+    here = os.path.dirname(os.path.abspath(__file__))
+    result: dict = {}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "hetu_tpu.analysis", "--check",
+             "--format", "json"],
+            cwd=here, env=env, capture_output=True, text=True,
+            timeout=1200)
+        payload = {}
+        try:
+            start = proc.stdout.index("{")
+            payload, _ = json.JSONDecoder().raw_decode(proc.stdout[start:])
+        except Exception:
+            pass
+        rows = {}
+        fdeltas, bdeltas = [], []
+        for name, ex in payload.get("executables", {}).items():
+            cost = ex.get("cost")
+            if not cost:
+                rows[name] = {"error": "no cost accounting"}
+                continue
+            row = {
+                "predicted_flops": int(cost["flops"]),
+                "predicted_hbm_bytes": int(cost["hbm_bytes"]),
+                "predicted_step_time_us": cost["step_time_us"],
+                "comm_time_us": cost.get("comm_time_us"),
+                "bound": cost.get("bound"),
+                "xla_flops": cost.get("xla_flops"),
+                "xla_bytes_accessed": cost.get("xla_bytes_accessed"),
+                "xla_flops_delta_pct": cost.get("xla_flops_delta_pct"),
+                "xla_bytes_delta_pct": cost.get("xla_bytes_delta_pct"),
+            }
+            if cost.get("xla_flops_delta_pct") is not None:
+                fdeltas.append(abs(float(cost["xla_flops_delta_pct"])))
+            if cost.get("xla_bytes_delta_pct") is not None:
+                bdeltas.append(abs(float(cost["xla_bytes_delta_pct"])))
+            rows[name] = row
+        result = {
+            "gate_passed": proc.returncode == 0,
+            "exit_code": proc.returncode,
+            "executables": rows,
+            # headline: worst absolute cross-check deltas over all gate
+            # families (the gate bounds them at 10% / absolute floors)
+            "max_abs_xla_flops_delta_pct": max(fdeltas) if fdeltas
+            else None,
+            "max_abs_xla_bytes_delta_pct": max(bdeltas) if bdeltas
+            else None,
+        }
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    # planner loop: calibrated search vs hand-written gate-family plans
+    # (in-process; the search is pure python over the cost model)
+    code = r"""
+import json, sys
+from hetu_tpu.models.gpt import GPTConfig
+from hetu_tpu.planner.cost_model import calibrate_layer_time
+from hetu_tpu.planner.search import plan_for_gpt, hand_plan_times
+cfg = GPTConfig(vocab_size=50257, hidden_size=768, num_layers=12,
+                num_heads=12, max_seq_len=1024, dtype="bfloat16")
+cal = calibrate_layer_time(dtype="bfloat16")  # probe lowered ONCE
+plan = plan_for_gpt(cfg, global_batch=64, seq=1024, n_chips=8,
+                    time_calibration=cal)
+hand = hand_plan_times(cfg, global_batch=64, seq=1024, n_chips=8,
+                       time_calibration=cal)
+print(json.dumps({
+    "planner_step_time_ms": round(plan.time * 1e3, 3),
+    "planner_layout": {"pp": plan.pp,
+                       "dp": plan.layer_strategies[0].dp,
+                       "tp": plan.layer_strategies[0].tp,
+                       "micro_batch": plan.micro_batch},
+    "hand_plans_ms": {k: round(v * 1e3, 3) for k, v in hand.items()},
+    "planner_beats_all_hand_plans":
+        all(plan.time <= v * (1 + 1e-9) for v in hand.values()),
+}))
+"""
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              cwd=here, capture_output=True, text=True,
+                              timeout=1200)
+        lines = [l for l in proc.stdout.strip().splitlines() if l]
+        result["planner"] = json.loads(lines[-1]) if lines else \
+            {"error": proc.stderr.strip()[-400:]}
+    except Exception as e:
+        result["planner"] = {"error": f"{type(e).__name__}: {e}"}
+    out_path = os.path.join(here, "BENCH_COST.json")
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(result, fh, indent=1)
+    except Exception:
+        pass
+    return result
+
+
 def bench_serving_microbench() -> dict:
     """Serving microbench v2 (ISSUE 6): dense-cache ``generate()`` vs
     the UNIFIED ragged prefill+decode engine on a GPT-2-small-
@@ -1069,7 +1174,8 @@ def main():
         fns = {"serving_microbench": bench_serving_microbench,
                "comm_microbench": bench_comm_microbench,
                "lint_graph": bench_lint_graph,
-               "mem_lint": bench_mem_lint}
+               "mem_lint": bench_mem_lint,
+               "cost_lint": bench_cost_lint}
         if sub not in fns:
             print(json.dumps({"error": f"unknown subcommand {sub!r}; "
                                        f"have {sorted(fns)}"}))

@@ -34,6 +34,9 @@ from typing import Any, Dict, List, Optional, Sequence
 from ..graph.graph import (ExecutableHandle, clear_executables,
                            get_executable, iter_executables,
                            register_executable)
+from .cost import (CommCost, CostEntry, CostReport, cost_walk,
+                   dot_general_flops, predict_cost, price_edges,
+                   xla_cost_stats)
 from .edges import (CommEdge, EdgeMatch, grad_comm_edges, makes_edge_claim,
                     match_edges, predict_edges)
 from .jaxpr_walk import (collect_collectives, compute_dtype_histogram,
@@ -58,7 +61,9 @@ __all__ = [
     "run_rules", "verify_grad_comm", "load_baseline", "save_baseline",
     "MemoryBuffer", "MemoryReport", "has_remat_region", "liveness_walk",
     "parse_input_output_aliases", "predict_memory", "xla_memory_stats",
-    "predicted_cost_stats",
+    "predicted_cost_stats", "CommCost", "CostEntry", "CostReport",
+    "cost_walk", "dot_general_flops", "predict_cost", "price_edges",
+    "xla_cost_stats",
 ]
 
 
@@ -66,10 +71,12 @@ def predicted_cost_stats(handle: ExecutableHandle) -> Dict[str, Any]:
     """Static per-executable cost facts for the runtime trace plane
     (``hetu_tpu.obs.reconcile``): predicted wire bytes (the sum over the
     executable's predicted comm-edge set — ``payload_bytes x count`` per
-    :class:`CommEdge`; None when the registration makes no edge claim)
-    and predicted peak HBM (``predict_memory`` native + comparable
-    peaks).  This is the join key between "what the analysis plane said
-    this executable would cost" and "what the tracer observed it do"."""
+    :class:`CommEdge`; None when the registration makes no edge claim),
+    predicted peak HBM (``predict_memory`` native + comparable peaks),
+    and the predicted step-time decomposition (``predict_cost``
+    roofline + comm terms, seconds).  This is the join key between
+    "what the analysis plane said this executable would cost" and
+    "what the tracer observed it do"."""
     meta = handle.meta
     mesh_axes = dict(meta.get("mesh_axes", {}))
     train = bool(meta.get("train", meta.get("kind") == "train_step"))
@@ -84,8 +91,25 @@ def predicted_cost_stats(handle: ExecutableHandle) -> Dict[str, Any]:
         peak, cmp_peak = int(mem.peak_bytes), int(mem.cmp_peak_bytes)
     except Exception:
         pass       # advisory, same stance as build_context's memory pass
+    step = compute = io = comm = None
+    flops = hbm = None
+    bound = None
+    try:
+        cost = predict_cost(handle)
+        step = float(cost.step_time_s)
+        compute = float(cost.compute_time_s)
+        io = float(cost.io_time_s)
+        comm = float(cost.comm_time_s)
+        flops = int(cost.flops)
+        hbm = int(cost.hbm_bytes)
+        bound = cost.bound
+    except Exception:
+        pass       # advisory: a broken cost pass must not break tracing
     return {"wire_bytes": wire, "peak_hbm_bytes": peak,
-            "cmp_peak_bytes": cmp_peak}
+            "cmp_peak_bytes": cmp_peak,
+            "step_time_s": step, "compute_time_s": compute,
+            "io_time_s": io, "comm_time_s": comm,
+            "flops": flops, "hbm_bytes": hbm, "bound": bound}
 
 
 def build_context(handle: ExecutableHandle, compile: bool = False,
@@ -111,6 +135,10 @@ def build_context(handle: ExecutableHandle, compile: bool = False,
     except Exception:
         memory = None    # the memory pass is advisory: a walk failure
         #                  must not take down the collectives linter
+    try:
+        cost = predict_cost(handle, xla=compile)
+    except Exception:
+        cost = None      # same stance for the step-time pass
     ctx = AnalysisContext(
         name=handle.name,
         jaxpr=jaxpr,
@@ -127,6 +155,7 @@ def build_context(handle: ExecutableHandle, compile: bool = False,
         meta=meta,
         edges=predict_edges(meta, mesh_axes, train),
         memory=memory,
+        cost=cost,
         handle=handle,
         train=train,
     )
@@ -154,6 +183,8 @@ def analyze_handle(handle: ExecutableHandle, compile: bool = False,
         rep.meta["edge_match"] = em
     if ctx.memory is not None:
         rep.meta["memory"] = ctx.memory
+    if ctx.cost is not None:
+        rep.meta["cost"] = ctx.cost
     return rep
 
 

@@ -39,6 +39,12 @@ emits; then:
   ``--explain``, the top-contributor attribution table).  The numbers
   are always computed and gated — the flag only controls the text
   section; ``--format json`` always carries them.
+* ``--cost``: print the static step-time section per executable
+  (FLOP/HBM roofline verdict, comm time, XLA ``cost_analysis()``
+  deltas; with ``--explain``, the top-contributor attribution table).
+  Same contract as ``--memory``: always computed and gated, the flag
+  only controls the text section, ``--format json`` always carries the
+  ``cost`` dict.
 * ``--hbm-budget``: device HBM budget in GiB for the ``oom-risk`` rule
   (default: the rule's v5p budget).
 
@@ -47,6 +53,14 @@ predicted peak bytes are pinned in the baseline and may not grow; and
 every compiled executable's prediction must stay within ±10% of XLA's
 own ``compiled.memory_analysis()`` totals — a drifting memory model is
 itself a gate failure, so the planner numbers stay honest.
+
+The step-time gate works the same way: per-executable predicted FLOPs
+/ HBM bytes / step time are pinned in the baseline and may not grow
+beyond the tolerance, and every compiled executable's comparable FLOP
+and bytes-accessed totals must stay within ±10% (absolute floors for
+toy-scale programs) of XLA's own ``compiled.cost_analysis()`` — the
+same numbers ``planner.cost_model.calibrate_layer_time`` feeds the DP
+solver, so the planner search runs on cross-checked physics.
 
 Exit codes (stable, documented for CI): **0** clean, **1** findings or
 baseline regressions, **2** baseline missing (run ``--update-baseline``
@@ -243,10 +257,11 @@ def build_gate_executables():
     return names + sorted(f"gate_serving/{k}" for k in eng._compiled)
 
 
-def explain_report(report, out=sys.stdout, memory: bool = False) -> None:
+def explain_report(report, out=sys.stdout, memory: bool = False,
+                   cost: bool = False) -> None:
     """--explain: per finding, the offending edge/record and a concrete
     remediation hint; per executable, the predicted edge list (and, with
-    --memory, the peak-HBM attribution table)."""
+    --memory / --cost, the peak-HBM / step-time attribution tables)."""
     for name, rep in sorted(report.executables.items()):
         cov = rep.meta.get("edge_coverage")
         edges = rep.meta.get("edges")
@@ -265,6 +280,21 @@ def explain_report(report, out=sys.stdout, memory: bool = False) -> None:
                 src = f"  [{b.source}]" if b.source else ""
                 print(f"    . {b.kind:10s} {b.nbytes:>12d} B  "
                       f"{b.name} {b.detail}{src}", file=out)
+        co = rep.meta.get("cost")
+        if cost and co is not None:
+            print(f"  step-time attribution (top contributors):",
+                  file=out)
+            for e in co.top(10):
+                src = f"  [{e.source}]" if e.source else ""
+                print(f"    . {e.prim:18s} "
+                      f"{int((e.flops + e.transcendentals) * e.count):>12d}"
+                      f" FLOP {int(e.bytes * e.count):>10d} B"
+                      f"  {e.detail}{src}", file=out)
+            for c in sorted(co.comm, key=lambda c: -c.total_s)[:6]:
+                ov = " (overlapped)" if c.overlapped else ""
+                print(f"    . comm {c.kind:13s} {c.payload_bytes:>10d} B"
+                      f" x{c.count} over {c.group} chips -> "
+                      f"{c.total_s * 1e6:.1f}us{ov}", file=out)
         if not rep.findings:
             print("  no findings", file=out)
             continue
@@ -286,10 +316,23 @@ def memory_section(report, out=sys.stdout) -> None:
         print(f"  {name}: {mem.summary()}", file=out)
 
 
+def cost_section(report, out=sys.stdout) -> None:
+    """--cost: the static step-time model per executable — FLOP/HBM
+    roofline verdict, comm time, and the XLA cost_analysis deltas."""
+    print("\nstatic step-time model (analysis/cost):", file=out)
+    for name, rep in sorted(report.executables.items()):
+        co = rep.meta.get("cost")
+        if co is None:
+            print(f"  {name}: (cost pass unavailable)", file=out)
+            continue
+        print(f"  {name}: {co.summary()}", file=out)
+
+
 def run_gate(baseline_path: str = BASELINE_DEFAULT,
              tolerance: float = 0.1, update: bool = False,
              as_json: bool = False, compile: bool = True,
              explain: bool = False, memory: bool = False,
+             cost: bool = False,
              hbm_budget_gib: float = None, out=sys.stdout) -> int:
     """Build, analyze, gate.  Returns the process exit code
     (0 clean / 1 findings / 2 baseline missing)."""
@@ -310,12 +353,19 @@ def run_gate(baseline_path: str = BASELINE_DEFAULT,
     # rule options: the peak-memory-regression rule reads the frozen
     # per-executable peaks straight from the baseline, so the rule and
     # the baseline gate agree on what "regressed" means
-    options = {"memory_tolerance": tolerance}
+    options = {"memory_tolerance": tolerance,
+               "step_time_tolerance": tolerance}
     if baseline is not None:
         options["baseline_peak_bytes"] = {
             name: ex["memory"]["peak_bytes"]
             for name, ex in baseline.get("executables", {}).items()
             if "memory" in ex}
+        # predicted-step-regression reads the frozen per-executable
+        # step times the same way (baseline pins microseconds)
+        options["baseline_step_time_s"] = {
+            name: float(ex["cost"]["step_time_us"]) * 1e-6
+            for name, ex in baseline.get("executables", {}).items()
+            if "cost" in ex}
     if hbm_budget_gib is not None:
         options["hbm_budget_bytes"] = float(hbm_budget_gib) * (1 << 30)
 
@@ -351,14 +401,36 @@ def run_gate(baseline_path: str = BASELINE_DEFAULT,
                     f"{name}: static peak {mem.cmp_peak_bytes} B drifted "
                     f"{mem.xla_delta():+.1%} from XLA's "
                     f"{mem.xla_total} B (±10% cross-check)")
+            # step-time cross-check, same stance: FLOP and
+            # bytes-accessed totals within ±10% of cost_analysis()
+            # (absolute floors for toy-scale programs), and LOSING the
+            # accounting is itself a gate failure
+            co = rep.meta.get("cost")
+            if co is None:
+                problems.append(f"{name}: static cost pass produced "
+                                f"no report (walk failure?)")
+            elif co.xla is None:
+                problems.append(f"{name}: compiled.cost_analysis() "
+                                f"unavailable — XLA cross-check lost")
+            elif not co.xla_within(rel=0.1):
+                fd, bd = co.xla_flops_delta(), co.xla_bytes_delta()
+                problems.append(
+                    f"{name}: static cost drifted from XLA's "
+                    f"cost_analysis (flops "
+                    f"{fd:+.1%}, bytes "
+                    f"{bd:+.1%}; ±10% cross-check)"
+                    if fd is not None and bd is not None else
+                    f"{name}: static cost cross-check unavailable")
     if as_json:
         print(report.to_json(records=True), file=out)
     else:
         print(report.summary(), file=out)
         if memory:
             memory_section(report, out=out)
+        if cost:
+            cost_section(report, out=out)
     if explain:
-        explain_report(report, out=out, memory=memory)
+        explain_report(report, out=out, memory=memory, cost=cost)
     if update:
         save_baseline(baseline_path, report)
         print(f"baseline written to {baseline_path}", file=out)
@@ -406,6 +478,11 @@ def main(argv=None) -> int:
                     help="print the static peak-HBM section (predicted "
                          "peak, per-kind breakdown, XLA cross-check "
                          "delta; with --explain, the attribution table)")
+    ap.add_argument("--cost", action="store_true",
+                    help="print the static step-time section (FLOP/HBM "
+                         "roofline verdict, comm time, XLA cost_analysis"
+                         " deltas; with --explain, the attribution "
+                         "table)")
     ap.add_argument("--hbm-budget", type=float, default=None,
                     metavar="GIB",
                     help="device HBM budget in GiB for the oom-risk "
@@ -424,6 +501,7 @@ def main(argv=None) -> int:
                     compile=not args.no_compile,
                     explain=args.explain,
                     memory=args.memory,
+                    cost=args.cost,
                     hbm_budget_gib=args.hbm_budget)
 
 

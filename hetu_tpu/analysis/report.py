@@ -117,6 +117,12 @@ class ExecutableReport:
         # reviewable evidence for a re-freeze
         if "memory" in self.meta:
             d["memory"] = self.meta["memory"].to_dict()
+        # static step-time prediction (analysis/cost): the baseline pins
+        # flops / hbm_bytes (byte tolerance) and step_time_us; the
+        # roofline verdict and XLA cross-check deltas ride along as the
+        # reviewable evidence for a re-freeze
+        if "cost" in self.meta:
+            d["cost"] = self.meta["cost"].to_dict()
         if records:
             d["records"] = [r.to_dict() for r in self.records]
         return d
@@ -256,6 +262,31 @@ class AnalysisReport:
                             f"{b:.0f} -> {g:.0f} B "
                             f"(> {tolerance:.0%} tolerance; dominant "
                             f"class {got_m.dominant_kind()})")
+            # static step-time: FLOPs / HBM bytes / predicted step time
+            # may not grow beyond the tolerance, and an executable may
+            # not silently lose its cost accounting (same philosophy as
+            # the memory gate: stopping to measure IS the regression)
+            want_t = base.get("cost")
+            got_t = rep.meta.get("cost")
+            if want_t:
+                if got_t is None:
+                    problems.append(
+                        f"{name}: baseline records step-time accounting "
+                        f"but the report has none (cost pass failed?)")
+                else:
+                    for field, bkey in (("flops", "flops"),
+                                        ("hbm_bytes", "hbm_bytes"),
+                                        ("step_time_us", "step_time_us")):
+                        b = float(want_t.get(bkey, 0))
+                        g = float(getattr(
+                            got_t, field, None) if field != "step_time_us"
+                            else got_t.step_time_s * 1e6)
+                        if g > b * (1.0 + tolerance) and g - b > 1:
+                            problems.append(
+                                f"{name}: predicted {field} regressed "
+                                f"{b:.0f} -> {g:.0f} "
+                                f"(> {tolerance:.0%} tolerance; "
+                                f"{got_t.bound}-bound)")
             for field, value in (("payload_bytes", rep.total_payload_bytes),
                                  ("wire_bytes", rep.total_wire_bytes)):
                 b = float(base.get(field, 0))

@@ -125,6 +125,17 @@ DEFAULT_OPTIONS: Dict[str, Any] = {
     # saved activations dominate by this fraction
     "remat_min_bytes": 1 << 30,
     "remat_activation_fraction": 0.5,
+    # comm-bound-plan: predicted step times below this are CI-scale
+    # toys where fixed collective latency always dominates a
+    # microseconds-long roofline — only real workloads fire
+    "comm_bound_min_step_s": 1e-3,
+    # ...and exposed comm must exceed the roofline by this factor
+    "comm_bound_ratio": 1.5,
+    # predicted-step-regression: {executable name -> frozen step-time
+    # seconds} (the CLI injects this from ANALYSIS_BASELINE.json's
+    # cost.step_time_us) + tolerance
+    "baseline_step_time_s": None,
+    "step_time_tolerance": 0.1,
 }
 
 
@@ -181,6 +192,9 @@ class AnalysisContext:
     # static peak-HBM prediction (analysis/memory.predict_memory);
     # None when the memory pass could not run for this executable
     memory: Optional[Any] = None
+    # static step-time prediction (analysis/cost.predict_cost);
+    # None when the cost pass could not run for this executable
+    cost: Optional[Any] = None
     # the registered ExecutableHandle (compiled-artifact access for
     # rules that consult XLA's own tables)
     handle: Optional[Any] = None
@@ -650,6 +664,76 @@ def _replicated_state_under_shard(ctx: AnalysisContext) -> List[Finding]:
              f"adds gradients, flat_state=True packs it into "
              f"reduce-scatter-geometry flat buckets (1/{dp} of these "
              f"bytes per device, checkpoint-compatible)")]
+
+
+@rule("comm-bound-plan")
+def _comm_bound_plan(ctx: AnalysisContext) -> List[Finding]:
+    """Predicted collective time exceeds the compute/HBM roofline and
+    the plan declares no overlap scheduling: the chips idle on the wire
+    for most of every step.  The hint names the two levers that
+    actually move comm time — a narrower transport (int8/bf16 wire
+    bytes) and the coalesced bucketed sync the latency-hiding scheduler
+    can overlap.  Sub-millisecond predicted steps are exempt (CI-scale
+    toys are latency-dominated by construction)."""
+    c = ctx.cost
+    if c is None:
+        return []
+    if c.step_time_s < float(ctx.opt("comm_bound_min_step_s")):
+        return []
+    roofline = max(c.compute_time_s, c.io_time_s)
+    ratio = float(ctx.opt("comm_bound_ratio"))
+    if c.exposed_comm_s <= ratio * max(roofline, 1e-12):
+        return []
+    # name the widest exposed edge for the remedy
+    widest = max((e for e in c.comm if not e.overlapped),
+                 key=lambda e: e.total_s, default=None)
+    w = f" (widest: {widest.kind} {widest.payload_bytes} B " \
+        f"x{widest.count} over {widest.group} chips, " \
+        f"{widest.total_s * 1e6:.0f}us)" if widest is not None else ""
+    return [Finding(
+        rule="", subject="step",
+        message=f"predicted step time {c.step_time_s * 1e6:.0f}us is "
+                f"comm-bound: {c.exposed_comm_s * 1e6:.0f}us of exposed "
+                f"collective time vs a "
+                f"{roofline * 1e6:.0f}us compute/HBM roofline, and the "
+                f"plan declares no overlap scheduling{w}",
+        hint="narrow the transport (Optimizer(grad_comm='int8'|'bf16') "
+             "prices the wire at 1/4-1/2 the fp32 bytes) and coalesce "
+             "into buckets (bucket_mb=) so the latency-hiding "
+             "scheduler overlaps the sync with backward compute; for "
+             "activation collectives, reshard less often or move the "
+             "axis to a faster link")]
+
+
+@rule("predicted-step-regression")
+def _predicted_step_regression(ctx: AnalysisContext) -> List[Finding]:
+    """Static step-time prediction vs the frozen per-executable
+    baseline: growth beyond the tolerance is a perf regression the
+    numeric tests cannot see (new FLOPs, lost fusion, a widened
+    transport, an extra collective) — the time-plane twin of
+    ``peak-memory-regression``."""
+    base_map = ctx.opt("baseline_step_time_s")
+    if ctx.cost is None or not base_map:
+        return []
+    base = base_map.get(ctx.name)
+    if base is None or base <= 0:
+        return []
+    tol = float(ctx.opt("step_time_tolerance"))
+    got = float(ctx.cost.step_time_s)
+    if got <= base * (1.0 + tol):
+        return []
+    return [Finding(
+        rule="", subject="step",
+        message=f"predicted step time regressed "
+                f"{base * 1e6:.1f}us -> {got * 1e6:.1f}us "
+                f"({got / base - 1.0:+.1%}, tolerance {tol:.0%}); "
+                f"now {ctx.cost.bound}-bound (compute "
+                f"{ctx.cost.compute_time_s * 1e6:.1f}us, hbm "
+                f"{ctx.cost.io_time_s * 1e6:.1f}us, comm "
+                f"{ctx.cost.comm_time_s * 1e6:.1f}us)",
+        hint="inspect the attribution table (--cost --explain) for the "
+             "primitive or edge that grew; if the change is "
+             "intentional, re-freeze with --update-baseline")]
 
 
 @rule("cow-page-write")

@@ -1290,6 +1290,12 @@ class DefineAndRunGraph(Graph):
                 1 for f in real_fetches
                 if isinstance(f, Tensor) and len(f.shape) == 0),
             "moe": [dict(m) for m in getattr(self, "_moe_meta", ())],
+            # step-time cost fact (analysis/cost overlap model): the
+            # explicit coalesced grad sync is bucketed exactly so the
+            # latency-hiding scheduler can run it behind backward
+            # compute — its grad_comm/param_comm edges may hide under
+            # the roofline.  Implicit GSPMD sync makes no such claim.
+            "comm_overlap": bool(gc_state[0]),
         }
         # static memory model facts (analysis/memory): per-argument
         # sharding divisors + buffer kinds, mirroring the abstract arg

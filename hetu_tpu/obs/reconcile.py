@@ -33,7 +33,8 @@ __all__ = ["predicted_stats", "predicted_span_attrs", "reconcile",
 # the stats a traced executable span carries, span-attr name -> the
 # predicted_stats key it projects (ONE mapping for every emission site)
 _SPAN_ATTR_KEYS = (("predicted_wire_bytes", "wire_bytes"),
-                   ("predicted_peak_hbm_bytes", "peak_hbm_bytes"))
+                   ("predicted_peak_hbm_bytes", "peak_hbm_bytes"),
+                   ("predicted_step_time_s", "step_time_s"))
 
 # predictions require tracing+lowering the executable — cached per
 # registered name so the engine hot loop pays once per process; the
@@ -105,6 +106,16 @@ class ReconcileRow:
     observed_peak_hbm_bytes: int = 0          # process-wide allocator peak
     hbm_check: str = "n/a"                    # ok|over-predicted|n/a
     tokens: int = 0                           # serving spans carry tokens
+    # static step-time prediction (analysis/cost roofline + comm) and
+    # its decomposition; wall_ratio = observed mean wall / predicted.
+    # Off-TPU the chip-spec prediction has no absolute meaning, so the
+    # column reports the RATIO only — no pass/fail verdict (a CPU run
+    # that "passed" an absolute-time gate would be lying)
+    predicted_step_s: Optional[float] = None
+    predicted_compute_s: Optional[float] = None
+    predicted_comm_s: Optional[float] = None
+    predicted_bound: Optional[str] = None
+    wall_ratio: Optional[float] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -133,13 +144,22 @@ class ReconcileReport:
             from ..analysis.memory import _fmt_bytes
             return _fmt_bytes(v)
 
+        def fmt_ms(v) -> str:
+            return "-" if v is None else f"{v * 1e3:.2f}"
+
+        def fmt_x(v) -> str:
+            return "-" if v is None else f"{v:.1f}x"
+
         lines = [f"{'executable':<28}{'calls':>6}{'mean_ms':>9}"
-                 f"{'p90_ms':>8}{'pred_wire':>11}{'pred_peak':>11}"
+                 f"{'p90_ms':>8}{'pred_ms':>9}{'wall/pred':>10}"
+                 f"{'pred_wire':>11}{'pred_peak':>11}"
                  f"{'obs_peak':>10}  hbm"]
         for r in self.rows:
             lines.append(
                 f"{r.executable[:27]:<28}{r.calls:>6}"
                 f"{r.mean_wall_s * 1e3:>9.2f}{r.p90_wall_s * 1e3:>8.2f}"
+                f"{fmt_ms(r.predicted_step_s):>9}"
+                f"{fmt_x(r.wall_ratio):>10}"
                 f"{fmt_b(r.predicted_wire_bytes):>11}"
                 f"{fmt_b(r.predicted_peak_hbm_bytes):>11}"
                 f"{fmt_b(r.observed_peak_hbm_bytes):>10}  {r.hbm_check}")
@@ -147,6 +167,10 @@ class ReconcileReport:
             lines.append("(no device allocator stats on this platform — "
                          "HBM reconciliation is n/a; run on TPU for the "
                          "memory verdict)")
+        if any(r.wall_ratio is not None for r in self.rows):
+            lines.append("(wall/pred is a RATIO against the chip-spec "
+                         "step-time model — off-TPU it has no absolute "
+                         "meaning and carries no pass/fail verdict)")
         return "\n".join(lines)
 
 
@@ -191,7 +215,13 @@ def reconcile(events: Sequence, prefix: str = "",
             predicted_peak_hbm_bytes=pred.get("peak_hbm_bytes"),
             cmp_peak_bytes=pred.get("cmp_peak_bytes"),
             observed_peak_hbm_bytes=peak,
-            tokens=tokens.get(name, 0))
+            tokens=tokens.get(name, 0),
+            predicted_step_s=pred.get("step_time_s"),
+            predicted_compute_s=pred.get("compute_time_s"),
+            predicted_comm_s=pred.get("comm_time_s"),
+            predicted_bound=pred.get("bound"))
+        if row.predicted_step_s and row.predicted_step_s > 0:
+            row.wall_ratio = row.mean_wall_s / row.predicted_step_s
         if peak <= 0 or row.predicted_peak_hbm_bytes is None:
             row.hbm_check = "n/a"
         elif row.predicted_peak_hbm_bytes > peak:

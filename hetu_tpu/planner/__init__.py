@@ -5,10 +5,12 @@ auto-parallel strategies (``hetu/v1/python/hetu/distributed_strategies/``)
 as first-class framework components.
 """
 from .cost_model import (CHIPS, ChipSpec, ClusterSpec, LayerSpec,
-                         MemoryCalibration, Strategy, all_gather_time,
-                         all_reduce_time, all_to_all_time,
-                         calibrate_layer_memory, embedding_layer_spec,
-                         grad_sync_time, layer_memory, layer_time, p2p_time,
+                         MemoryCalibration, Strategy, TimeCalibration,
+                         all_gather_time, all_reduce_time,
+                         all_to_all_time, calibrate_layer_memory,
+                         calibrate_layer_time, collective_time,
+                         embedding_layer_spec, grad_sync_time,
+                         layer_memory, layer_time, p2p_time,
                          pipeline_time, reduce_scatter_time,
                          transformer_layer_spec)
 from .dispatch import (DispatchStrategy, batching_strategy, dynamic_dispatch,
@@ -19,17 +21,21 @@ from .dp_solver import solve_layer_strategies, solve_pipeline_partition
 from .profile_hardware import (Calibration, profile_and_calibrate,
                                profile_collectives, profile_hbm,
                                profile_matmul, validate_step_prediction)
-from .search import PlanResult, SearchEngine, plan_for_gpt, plan_summary
+from .search import (HAND_PLANS, PlanResult, SearchEngine,
+                     gpt_layer_chain, hand_plan_times, plan_for_gpt,
+                     plan_summary)
 from .strategies import (BaseSearching, FlexFlowSearching, GPipeSearching,
                          OptCNNSearching, PipeDreamSearching,
                          PipeOptSearching, SearchResult)
 
 __all__ = [
     "CHIPS", "ChipSpec", "ClusterSpec", "LayerSpec", "MemoryCalibration",
-    "Strategy", "all_gather_time", "all_reduce_time", "all_to_all_time",
-    "calibrate_layer_memory", "embedding_layer_spec", "layer_memory",
+    "Strategy", "TimeCalibration", "all_gather_time", "all_reduce_time",
+    "all_to_all_time", "calibrate_layer_memory", "calibrate_layer_time",
+    "collective_time", "embedding_layer_spec", "layer_memory",
     "layer_time", "p2p_time", "pipeline_time", "reduce_scatter_time",
-    "transformer_layer_spec",
+    "transformer_layer_spec", "HAND_PLANS", "gpt_layer_chain",
+    "hand_plan_times",
     "solve_layer_strategies", "solve_pipeline_partition",
     "DispatchStrategy", "batching_strategy", "dynamic_dispatch",
     "fit_cost_model", "generate_strategy_pool", "max_seqlen_for",

@@ -44,10 +44,21 @@ CHIPS: Dict[str, ChipSpec] = {
 @dataclasses.dataclass
 class ClusterSpec:
     """A (possibly multi-slice) TPU cluster: ``num_chips`` per slice
-    connected by ICI, slices connected by DCN."""
+    connected by ICI, slices connected by DCN.
+
+    ``link_alpha_beta`` optionally carries MEASURED per-collective
+    ``(alpha, beta)`` fits (``profile_hardware.profile_collectives``
+    keys: all_reduce / all_gather / reduce_scatter / p2p) — when a kind
+    has a fit, the collective-time formulas below price it as
+    ``alpha + beta * bytes`` instead of the datasheet ring model, so one
+    measured link speed feeds the planner's solver AND the analysis
+    plane's step-time linter identically
+    (:meth:`hetu_tpu.planner.profile_hardware.Calibration.to_cluster_spec`).
+    """
     chip: ChipSpec = dataclasses.field(default_factory=ChipSpec)
     num_chips: int = 8
     num_slices: int = 1
+    link_alpha_beta: Optional[Dict[str, Tuple[float, float]]] = None
 
     @property
     def total_chips(self) -> int:
@@ -60,32 +71,63 @@ class ClusterSpec:
             return self.chip.ici_bw, self.chip.ici_latency
         return self.chip.dcn_bw, self.chip.dcn_latency
 
+    def measured(self, kind: str,
+                 group_size: int = 1) -> Optional[Tuple[float, float]]:
+        """The measured (alpha, beta) fit for ``kind``, or None when
+        there is no fit OR the group spans slices — the fit was taken
+        on one slice's ICI, so a DCN-crossing collective must fall back
+        to the ring/DCN model rather than be underpriced ~10-100x."""
+        if not self.link_alpha_beta or group_size > self.num_chips:
+            return None
+        return self.link_alpha_beta.get(kind)
+
 
 # ---------------------------------------------------------------------------
-# collective costs (alpha-beta / ring models)
+# collective costs (alpha-beta / ring models) — THE one implementation
 # ---------------------------------------------------------------------------
+# Both consumers price communication through these four functions (via
+# :func:`collective_time`): the planner's DP solver (layer_time /
+# grad_sync_time below) and the static step-time pass
+# (``hetu_tpu.analysis.cost``).  Keeping a single implementation is a
+# correctness property — the linter and the solver can never disagree on
+# what a collective costs.  Payload bytes are WIRE bytes: a quantized
+# (bf16/int8) transport passes its narrow payload here, so EQuARX-style
+# transports are priced at their real wire cost, not the fp32 width.
 
 def all_reduce_time(bytes_: float, n: int, cluster: ClusterSpec) -> float:
     if n <= 1:
         return 0.0
+    m = cluster.measured("all_reduce", n)
+    if m is not None:
+        return m[0] + m[1] * bytes_
     bw, lat = cluster.bw_for_group(n)
     return 2.0 * (n - 1) / n * bytes_ / bw + 2 * (n - 1) * lat
 
 
-def all_gather_time(bytes_: float, n: int, cluster: ClusterSpec) -> float:
+def all_gather_time(bytes_: float, n: int, cluster: ClusterSpec,
+                    _kind: str = "all_gather") -> float:
     """bytes_ = full (gathered) size."""
     if n <= 1:
         return 0.0
+    m = cluster.measured(_kind, n)
+    if m is not None:
+        return m[0] + m[1] * bytes_
     bw, lat = cluster.bw_for_group(n)
     return (n - 1) / n * bytes_ / bw + (n - 1) * lat
 
 
-reduce_scatter_time = all_gather_time
+def reduce_scatter_time(bytes_: float, n: int,
+                        cluster: ClusterSpec) -> float:
+    """bytes_ = full (pre-scatter) size."""
+    return all_gather_time(bytes_, n, cluster, _kind="reduce_scatter")
 
 
 def all_to_all_time(bytes_: float, n: int, cluster: ClusterSpec) -> float:
     if n <= 1:
         return 0.0
+    m = cluster.measured("all_to_all", n)
+    if m is not None:
+        return m[0] + m[1] * bytes_
     bw, lat = cluster.bw_for_group(n)
     return (n - 1) / n * bytes_ / bw / max(1, cluster.chip.ici_links // 2) \
         + (n - 1) * lat
@@ -93,9 +135,34 @@ def all_to_all_time(bytes_: float, n: int, cluster: ClusterSpec) -> float:
 
 def p2p_time(bytes_: float, cluster: ClusterSpec,
              cross_slice: bool = False) -> float:
+    m = cluster.measured("p2p", 2)
+    if m is not None and not cross_slice:
+        return m[0] + m[1] * bytes_
     bw = cluster.chip.dcn_bw if cross_slice else cluster.chip.ici_bw
     lat = cluster.chip.dcn_latency if cross_slice else cluster.chip.ici_latency
     return bytes_ / bw + lat
+
+
+#: collective kind (analysis/edges vocabulary) -> pricing function.
+#: ``reshard`` lowers to all-to-all / gather chains — priced at the
+#: all-to-all rate; ``scatter`` / ``identity`` move nothing.
+def collective_time(kind: str, bytes_: float, n: int,
+                    cluster: ClusterSpec) -> float:
+    """Alpha-beta time of ONE collective of ``kind`` moving ``bytes_``
+    payload over a group of ``n`` chips — the single entry point the
+    analysis step-time pass uses, dispatching to the same four formulas
+    the planner's solver prices plans with."""
+    if kind in ("all_reduce", "broadcast", "reduce"):
+        return all_reduce_time(bytes_, n, cluster)
+    if kind == "all_gather":
+        return all_gather_time(bytes_, n, cluster)
+    if kind == "reduce_scatter":
+        return reduce_scatter_time(bytes_, n, cluster)
+    if kind in ("all_to_all", "reshard"):
+        return all_to_all_time(bytes_, n, cluster)
+    if kind == "ppermute":
+        return p2p_time(bytes_, cluster)
+    return 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -176,13 +243,20 @@ class Strategy:
 
 def layer_time(layer: LayerSpec, st: Strategy, cluster: ClusterSpec,
                include_grad_sync: bool = True,
-               dp_splits_batch: bool = True) -> float:
+               dp_splits_batch: bool = True,
+               calibration: Optional["TimeCalibration"] = None) -> float:
     """fwd+bwd time of one layer under strategy st, the roofline max of
     MXU time and HBM time, plus TP/DP collectives.
 
     ``dp_splits_batch``: the layer's costs describe a fixed GLOBAL batch
     that dp divides (v1-searcher semantics).  Pass False when the costs
-    already describe one per-replica micro-batch (SearchEngine)."""
+    already describe one per-replica micro-batch (SearchEngine).
+
+    ``calibration`` scales the roofline (compute/IO) term by the ratio
+    the static step-time pass (``analysis/cost.predict_cost``) measured
+    on a lowered single-layer probe (:func:`calibrate_layer_time`) —
+    the collective terms are added AFTER scaling because the probe is a
+    single-device program (no comm to calibrate against)."""
     chip = cluster.chip
     sc = layer.scaled(st.tp, st.dp if dp_splits_batch else 1)
     # fwd + bwd ~ 3x fwd flops; recompute adds one extra fwd
@@ -190,6 +264,8 @@ def layer_time(layer: LayerSpec, st: Strategy, cluster: ClusterSpec,
     compute = total_flops / (chip.peak_flops * chip.mxu_efficiency)
     io = 3.0 * sc.act_io_bytes / chip.hbm_bw
     t = max(compute, io)
+    if calibration is not None:
+        t = calibration.apply(t)
     if st.tp > 1 and layer.tp_shardable:
         # Megatron TP: 2 allreduce fwd + 2 bwd on the boundary activation
         t += 4 * all_reduce_time(sc.boundary_bytes, st.tp, cluster)
@@ -271,31 +347,22 @@ class MemoryCalibration:
         return bytes_ * self.scale
 
 
-def calibrate_layer_memory(batch: int = 4, seq: int = 64,
-                           hidden: int = 64, ffn: Optional[int] = None,
-                           dtype: str = "float32",
-                           xla_check: bool = False) -> MemoryCalibration:
-    """Lower a single-transformer-layer train-step probe and measure the
-    ratio of the static peak-HBM pass over the closed-form
-    :func:`layer_memory` estimate.
-
-    The probe is the planner's unit of placement made real: one
-    pre-norm attention+MLP block with Adam state, fwd+bwd+update in one
-    donated jit — the same program shape :func:`transformer_layer_spec`
-    prices.  ``predict_memory`` walks its jaxpr exactly as the CI gate
-    does for the gate families, so the returned scale carries the
-    model's validated liveness rules into the planner's budget check.
-    With ``xla_check=True`` the probe is also compiled and XLA's
-    ``memory_analysis()`` total recorded (CPU-priced; slower).
-    """
+def _layer_probe_handle(batch: int, seq: int, hidden: int, ffn: int,
+                        dtype: str, name: str):
+    """The calibration probe both :func:`calibrate_layer_memory` and
+    :func:`calibrate_layer_time` lower: one pre-norm attention+MLP
+    block with Adam state, fwd+bwd+update in one donated jit — the
+    planner's unit of placement (:func:`transformer_layer_spec`) made
+    real, registered as an :class:`~hetu_tpu.graph.graph.ExecutableHandle`
+    so the analysis passes walk it exactly as they walk the gate
+    families."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from ..analysis.memory import predict_memory
     from ..graph.graph import ExecutableHandle
 
-    f = ffn if ffn is not None else 4 * hidden
+    f = ffn
     h = hidden
     dt = np.dtype(dtype)
 
@@ -338,12 +405,42 @@ def calibrate_layer_memory(batch: int = 4, seq: int = 64,
         lambda p: jnp.zeros(p.shape, jnp.float32), params)
     x = jnp.zeros((batch, seq, h), dt)
     fn = jax.jit(_step, donate_argnums=(0, 1, 2))
-    handle = ExecutableHandle(
-        "planner_probe/layer_mem", fn, (params, fp32, fp32, x),
+    return ExecutableHandle(
+        name, fn, (params, fp32, fp32, x),
         meta={"kind": "train_step",
               "params": [{"name": k, "shape": tuple(v.shape),
                           "dtype": str(v.dtype), "pspec": None}
                          for k, v in params.items()]})
+
+
+def calibrate_layer_memory(batch: int = 4, seq: int = 64,
+                           hidden: int = 64, ffn: Optional[int] = None,
+                           dtype: str = "float32",
+                           xla_check: bool = False,
+                           probe_handle=None) -> MemoryCalibration:
+    """Lower a single-transformer-layer train-step probe and measure the
+    ratio of the static peak-HBM pass over the closed-form
+    :func:`layer_memory` estimate.
+
+    The probe (:func:`_layer_probe_handle`) is the planner's unit of
+    placement made real; ``predict_memory`` walks its jaxpr exactly as
+    the CI gate does for the gate families, so the returned scale
+    carries the model's validated liveness rules into the planner's
+    budget check.  With ``xla_check=True`` the probe is also compiled
+    and XLA's ``memory_analysis()`` total recorded (CPU-priced; slower).
+    """
+    import numpy as np
+
+    from ..analysis.memory import predict_memory
+
+    f = ffn if ffn is not None else 4 * hidden
+    h = hidden
+    dt = np.dtype(dtype)
+    # probe_handle: reuse an already-traced probe (plan_for_gpt shares
+    # ONE lowering between the memory and time calibrations — tracing
+    # the probe is the dominant cost of calibrating)
+    handle = probe_handle or _layer_probe_handle(
+        batch, seq, h, f, dtype, "planner_probe/layer_mem")
     static = predict_memory(handle, xla=xla_check)
 
     spec = transformer_layer_spec(batch, seq, h, f,
@@ -359,6 +456,82 @@ def calibrate_layer_memory(batch: int = 4, seq: int = 64,
         static_bytes=int(static.peak_bytes),
         model_bytes=float(model),
         xla_bytes=int(xla_total) if xla_total is not None else None,
+        probe=f"block b{batch} s{seq} h{h} f{f} {dt.name}")
+
+
+# ---------------------------------------------------------------------------
+# calibration of layer_time against the static step-time pass
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class TimeCalibration:
+    """Validation of :func:`layer_time` against the static step-time
+    pass — the time-plane twin of :class:`MemoryCalibration`.
+
+    ``static_s`` is the analysis-side prediction
+    (``analysis/cost.predict_cost`` — the FLOP/HBM roofline the CI gate
+    cross-checks against ``compiled.cost_analysis()`` to ±10%) for a
+    lowered single-layer train-step probe; ``model_s`` the closed-form
+    estimate for the same workload; ``scale`` their ratio.  Feeding the
+    calibration into :func:`layer_time` /
+    :class:`~hetu_tpu.planner.search.SearchEngine` makes the DP solver
+    score candidates with the same counted-FLOP model the analysis gate
+    pins, instead of an unvalidated closed form."""
+    scale: float = 1.0
+    static_s: float = 0.0          # predict_cost step time on the probe
+    model_s: float = 0.0           # closed-form layer_time estimate
+    static_flops: float = 0.0      # counted probe FLOPs (evidence)
+    model_flops: float = 0.0       # closed-form probe FLOPs
+    xla_flops: Optional[float] = None  # XLA's own count, when compiled
+    probe: str = ""                # probe description (shapes/dtype)
+
+    def apply(self, seconds: float) -> float:
+        return seconds * self.scale
+
+
+def calibrate_layer_time(batch: int = 4, seq: int = 64,
+                         hidden: int = 64, ffn: Optional[int] = None,
+                         dtype: str = "float32",
+                         cluster: Optional[ClusterSpec] = None,
+                         xla_check: bool = False,
+                         probe_handle=None) -> TimeCalibration:
+    """Lower a single-transformer-layer train-step probe, run the static
+    step-time pass on it, and measure the ratio over the closed-form
+    :func:`layer_time` estimate — exactly as
+    :func:`calibrate_layer_memory` does for bytes.
+
+    The ratio carries the counted-FLOP/HBM roofline (what the program
+    *actually* computes and moves, per the jaxpr walk the CI gate
+    cross-checks against XLA) into the planner's scoring, correcting
+    the closed form's analytic flop/io estimates.  With
+    ``xla_check=True`` the probe is compiled and XLA's own
+    ``cost_analysis()`` FLOP count recorded (slower)."""
+    import numpy as np
+
+    from ..analysis.cost import predict_cost
+
+    f = ffn if ffn is not None else 4 * hidden
+    h = hidden
+    dt = np.dtype(dtype)
+    cluster = cluster or ClusterSpec(num_chips=1)
+    handle = probe_handle or _layer_probe_handle(
+        batch, seq, h, f, dtype, "planner_probe/layer_time")
+    static = predict_cost(handle, cluster=cluster, xla=xla_check)
+
+    spec = transformer_layer_spec(batch, seq, h, f,
+                                  dtype_bytes=dt.itemsize)
+    model = layer_time(spec, Strategy(), cluster,
+                       include_grad_sync=False)
+    xla_flops = None
+    if xla_check and static.xla is not None:
+        xla_flops = float(static.xla.get("flops", 0.0))
+    return TimeCalibration(
+        scale=float(static.step_time_s) / max(model, 1e-12),
+        static_s=float(static.step_time_s),
+        model_s=float(model),
+        static_flops=float(static.flops),
+        model_flops=3.0 * float(spec.flops),
+        xla_flops=xla_flops,
         probe=f"block b{batch} s{seq} h{h} f{f} {dt.name}")
 
 

@@ -191,6 +191,23 @@ class Calibration:
             kw["ici_latency"] = max(alpha, 1e-9)
         return dataclasses.replace(base, **kw)
 
+    def to_cluster_spec(self, num_chips: int = 8, num_slices: int = 1,
+                        base: Optional[ChipSpec] = None) -> ClusterSpec:
+        """Fold the measurements into a full :class:`ClusterSpec`: the
+        calibrated chip (:meth:`to_chip_spec`) PLUS the per-collective
+        ``(alpha, beta)`` link fits, fed straight into the shared
+        alpha-beta formulas (``cost_model.collective_time``) — so the
+        planner's DP solver and the analysis step-time linter price
+        every collective from the same measured link speeds instead of
+        the datasheet ring model."""
+        return ClusterSpec(
+            chip=self.to_chip_spec(base),
+            num_chips=max(1, int(num_chips)),
+            num_slices=max(1, int(num_slices)),
+            link_alpha_beta={k: (float(a), float(b))
+                             for k, (a, b) in self.collectives.items()}
+            if self.collectives else None)
+
     def elastic_constants(self, batch: int, seq: int, hidden: int,
                           ffn: int, tp: int = 2,
                           dtype_bytes: int = 2) -> Dict[str, float]:

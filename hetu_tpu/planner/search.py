@@ -90,11 +90,102 @@ class PlanResult:
         return out
 
 
+def gpt_layer_chain(cfg, global_batch: int, seq: int,
+                    dtype_bytes: int) -> List[LayerSpec]:
+    """The GPT model as the planner's layer chain: embedding +
+    transformer blocks + untied LM head ([h, V] matmul per token)."""
+    layers = [embedding_layer_spec(global_batch, seq, cfg.hidden_size,
+                                   cfg.vocab_size, dtype_bytes, name="wte")]
+    layers += [transformer_layer_spec(global_batch, seq, cfg.hidden_size,
+                                      cfg.ffn_size, dtype_bytes,
+                                      name=f"block{i}")
+               for i in range(cfg.num_layers)]
+    layers.append(LayerSpec(
+        name="lm_head", flops=2.0 * global_batch * seq * cfg.hidden_size
+        * cfg.vocab_size,
+        param_bytes=cfg.vocab_size * cfg.hidden_size * dtype_bytes,
+        act_bytes=global_batch * seq * cfg.hidden_size * dtype_bytes,
+        act_io_bytes=global_batch * seq * cfg.hidden_size * dtype_bytes,
+        boundary_bytes=global_batch * seq * cfg.hidden_size * dtype_bytes))
+    return layers
+
+
+#: the hand-written gate-family layouts (pp, dp, tp) of the analysis
+#: CI gate, expressed on an 8-chip grid — what an engineer would write
+#: down without the search.  hand_plan_times scores them with the SAME
+#: calibrated cost model the search ranks candidates with, so "the
+#: planner beats every hand plan" is a like-for-like comparison.
+HAND_PLANS = {
+    "dp8_zero2_flat": (1, 8, 1),        # gate_train: pure-dp ZeRO-2
+    "dp2_tp4_sp": (1, 2, 4),            # gate_tp: Megatron-SP
+    "pp4_dp2": (4, 2, 1),               # gate_pipe: 4-stage pipeline
+    "pp2_dp2_tp2": (2, 2, 2),           # gate_pipe_mpmd submesh shape
+}
+
+
+def hand_plan_times(cfg, global_batch: int, seq: int, n_chips: int,
+                    plans: Optional[Dict[str, Tuple[int, int, int]]]
+                    = None,
+                    cluster: Optional[ClusterSpec] = None,
+                    micro_batch_options=None,
+                    mem_fraction: float = 0.9,
+                    memory_calibration=None,
+                    time_calibration="auto") -> Dict[str, float]:
+    """Best predicted step time of each hand-written (pp, dp, tp)
+    layout, scored with the calibrated cost model — each hand plan
+    still gets the per-layer ZeRO/recompute DP and the micro-batch
+    sweep (its best possible showing), so beating it means beating the
+    layout, not a strawman.  Infeasible layouts (don't fit HBM, don't
+    divide the chip grid) are omitted from the result."""
+    import jax
+    from .cost_model import (CHIPS, ChipSpec, calibrate_layer_time)
+    from .profile_hardware import _kind_key
+
+    if cluster is None:
+        kind = getattr(jax.devices()[0], "device_kind", "")
+        cluster = ClusterSpec(chip=CHIPS.get(_kind_key(kind), ChipSpec()),
+                              num_chips=n_chips)
+    dtype_bytes = 2 if "bf16" in str(cfg.dtype) or "bfloat16" in \
+        str(cfg.dtype) else 4
+    if time_calibration == "auto":
+        try:
+            time_calibration = calibrate_layer_time(
+                dtype="bfloat16" if dtype_bytes == 2 else "float32",
+                cluster=ClusterSpec(chip=cluster.chip, num_chips=1))
+        except Exception:
+            time_calibration = None
+    layers = gpt_layer_chain(cfg, global_batch, seq, dtype_bytes)
+    if micro_batch_options is None:
+        micro_batch_options = sorted({
+            mb for mb in (1, 2, 4, 8, 16, 32, 64)
+            if mb <= global_batch and global_batch % mb == 0},
+            reverse=True)
+    out: Dict[str, float] = {}
+    for name, (pp, dp, tp) in (plans or HAND_PLANS).items():
+        if dp * tp * pp != n_chips or cfg.num_layers % pp:
+            continue
+        best = None
+        for mb in micro_batch_options:
+            eng = SearchEngine(cluster, layers, global_batch, mb,
+                               mem_fraction=mem_fraction,
+                               memory_calibration=memory_calibration,
+                               time_calibration=time_calibration)
+            if global_batch < mb * dp:
+                continue
+            plan = eng._search_layout(pp, dp, tp)
+            if plan is not None and (best is None or plan.time < best):
+                best = plan.time
+        if best is not None:
+            out[name] = float(best)
+    return out
+
+
 def plan_for_gpt(cfg, global_batch: int, seq: int, n_chips: int,
                  calibration=None, micro_batch_options=None,
                  num_slices: int = 1, mem_fraction: float = 0.9,
                  max_tp: Optional[int] = None,
-                 memory_calibration="auto") -> PlanResult:
+                 memory_calibration="auto",
+                 time_calibration="auto") -> PlanResult:
     """Close the planner loop for a GPT model: build the layer chain from
     a ``models.gpt.GPTConfig``, fold a live-hardware
     :class:`~hetu_tpu.planner.profile_hardware.Calibration` into the chip
@@ -113,9 +204,19 @@ def plan_for_gpt(cfg, global_batch: int, seq: int, n_chips: int,
     pass's measurement (``cost_model.calibrate_layer_memory``), a
     :class:`~hetu_tpu.planner.cost_model.MemoryCalibration` is used as
     given, and ``None`` keeps the uncalibrated closed form.
+
+    ``time_calibration`` feeds the step-time scoring the same way:
+    ``"auto"`` (default) runs ``cost_model.calibrate_layer_time`` on
+    the same probe shape (the static FLOP/HBM roofline pass over a
+    lowered single-layer train step), so the DP search ranks candidate
+    plans on the counted-cost model the analysis gate cross-checks
+    against ``compiled.cost_analysis()``; pass a
+    :class:`~hetu_tpu.planner.cost_model.TimeCalibration` to reuse a
+    measurement, or ``None`` for the uncalibrated closed form.
     """
     import jax
-    from .cost_model import (CHIPS, ChipSpec, calibrate_layer_memory)
+    from .cost_model import (CHIPS, ChipSpec, calibrate_layer_memory,
+                             calibrate_layer_time)
     from .profile_hardware import _kind_key
 
     if calibration is not None:
@@ -125,31 +226,43 @@ def plan_for_gpt(cfg, global_batch: int, seq: int, n_chips: int,
         chip = CHIPS.get(_kind_key(kind), ChipSpec())
     cluster = ClusterSpec(chip=chip, num_chips=max(1, n_chips // num_slices),
                           num_slices=num_slices)
+    if calibration is not None and getattr(calibration, "collectives",
+                                           None):
+        # measured per-link alpha-beta fits feed the SAME formulas the
+        # solver and the analysis step-time pass share (cost_model)
+        cluster = calibration.to_cluster_spec(
+            num_chips=cluster.num_chips, num_slices=num_slices)
     dtype_bytes = 2 if "bf16" in str(cfg.dtype) or "bfloat16" in \
         str(cfg.dtype) else 4
+    probe_dtype = "bfloat16" if dtype_bytes == 2 else "float32"
+    probe = None
+    if memory_calibration == "auto" or time_calibration == "auto":
+        # ONE probe trace shared by both calibrations — tracing it is
+        # the dominant cost of calibrating
+        from .cost_model import _layer_probe_handle
+        try:
+            probe = _layer_probe_handle(4, 64, 64, 256, probe_dtype,
+                                        "planner_probe/layer")
+        except Exception:
+            probe = None
     if memory_calibration == "auto":
         # probe in the model's compute dtype so the scale carries the
         # right activation widths; failures (no jax, walk error) fall
         # back to the uncalibrated closed form rather than blocking
         try:
             memory_calibration = calibrate_layer_memory(
-                dtype="bfloat16" if dtype_bytes == 2 else "float32")
+                dtype=probe_dtype, probe_handle=probe)
         except Exception:
             memory_calibration = None
-    layers = [embedding_layer_spec(global_batch, seq, cfg.hidden_size,
-                                   cfg.vocab_size, dtype_bytes, name="wte")]
-    layers += [transformer_layer_spec(global_batch, seq, cfg.hidden_size,
-                                      cfg.ffn_size, dtype_bytes,
-                                      name=f"block{i}")
-               for i in range(cfg.num_layers)]
-    # untied LM head: a [h, V] matmul per token
-    layers.append(LayerSpec(
-        name="lm_head", flops=2.0 * global_batch * seq * cfg.hidden_size
-        * cfg.vocab_size,
-        param_bytes=cfg.vocab_size * cfg.hidden_size * dtype_bytes,
-        act_bytes=global_batch * seq * cfg.hidden_size * dtype_bytes,
-        act_io_bytes=global_batch * seq * cfg.hidden_size * dtype_bytes,
-        boundary_bytes=global_batch * seq * cfg.hidden_size * dtype_bytes))
+    if time_calibration == "auto":
+        try:
+            time_calibration = calibrate_layer_time(
+                dtype=probe_dtype,
+                cluster=ClusterSpec(chip=cluster.chip, num_chips=1),
+                probe_handle=probe)
+        except Exception:
+            time_calibration = None
+    layers = gpt_layer_chain(cfg, global_batch, seq, dtype_bytes)
 
     if micro_batch_options is None:
         # descending so predicted-time ties keep the LARGEST micro-batch
@@ -168,7 +281,8 @@ def plan_for_gpt(cfg, global_batch: int, seq: int, n_chips: int,
     for mb in micro_batch_options:
         eng = SearchEngine(cluster, layers, global_batch, mb,
                            mem_fraction=mem_fraction, max_tp=max_tp,
-                           memory_calibration=memory_calibration)
+                           memory_calibration=memory_calibration,
+                           time_calibration=time_calibration)
         try:
             plan = eng.search(pp_options=pp_options)
         except RuntimeError:
@@ -216,7 +330,8 @@ class SearchEngine:
                  allow_recompute: bool = True,
                  allow_zero: bool = True,
                  max_tp: Optional[int] = None,
-                 memory_calibration=None):
+                 memory_calibration=None,
+                 time_calibration=None):
         self.cluster = cluster
         self.layers = list(layers)
         self.global_batch = global_batch
@@ -230,6 +345,19 @@ class SearchEngine:
         # number the DP budget check sees, so the planner is constrained
         # by the same statically-validated model the CI gate pins
         self.memory_calibration = memory_calibration
+        # analysis-backed time model, same stance: a TimeCalibration
+        # from cost_model.calibrate_layer_time scales every layer_time
+        # roofline the DP search scores, so candidate plans compete on
+        # the counted-FLOP/HBM numbers the CI gate cross-checks against
+        # XLA — not on an unvalidated closed form
+        self.time_calibration = time_calibration
+
+    def _layer_time(self, layer: LayerSpec, st: Strategy,
+                    include_grad_sync: bool = False) -> float:
+        return layer_time(layer, st, self.cluster,
+                          include_grad_sync=include_grad_sync,
+                          dp_splits_batch=False,
+                          calibration=self.time_calibration)
 
     # -- candidate (dp, tp) decompositions of a stage's chips --------------
 
@@ -291,8 +419,7 @@ class SearchEngine:
         m = max(1, self.global_batch // (self.micro_batch * dp))
 
         # stage partition on per-micro-batch costs for this layout
-        base = [layer_time(l, Strategy(dp=dp, tp=tp), self.cluster,
-                           include_grad_sync=False, dp_splits_batch=False)
+        base = [self._layer_time(l, Strategy(dp=dp, tp=tp))
                 for l in self.layers]
         comm = [l.boundary_bytes / self.cluster.chip.ici_bw
                 for l in self.layers]
@@ -321,9 +448,7 @@ class SearchEngine:
                                     int(math.ceil(need / unit)))
                     # per-micro-batch compute + the once-per-step grad
                     # sync amortized over the schedule length
-                    intra[i, s] = layer_time(lay, st, self.cluster,
-                                             include_grad_sync=False,
-                                             dp_splits_batch=False) \
+                    intra[i, s] = self._layer_time(lay, st) \
                         + grad_sync_time(lay, st, self.cluster) / m
             cost, picks = solve_layer_strategies(mem, intra, inter,
                                                  MEM_UNITS)

@@ -1,0 +1,90 @@
+"""Scratch: per-opcode byte-mass diff — my walk vs the compiled HLO's
+non-fused instructions (operands+outputs from inline types)."""
+import os
+import re
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+from collections import defaultdict
+
+from hetu_tpu.analysis.cli import build_gate_executables
+from hetu_tpu.analysis.cost import cost_walk
+from hetu_tpu.graph.graph import get_executable
+
+DT = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
+      "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
+      "pred": 1, "f8e4m3fn": 1, "f8e5m2": 1, "c64": 8, "c128": 16}
+
+TYPED = re.compile(r"(\w+)\[([\d,]*)\](?:\{[\d,]*\})?")
+
+
+def nbytes(dt, sh):
+    n = 1
+    for x in sh.split(","):
+        if x:
+            n *= int(x)
+    return n * DT.get(dt, 4)
+
+
+def hlo_bytes_by_op(txt):
+    """Per-opcode operand+output bytes over NON-fused instructions."""
+    out = defaultdict(float)
+    in_fused = False
+    for line in txt.splitlines():
+        ls = line.strip()
+        if ls.endswith("{") and "(" in ls:
+            in_fused = ls.lstrip("%").startswith(("fused", "region"))
+            # region_ = while/cond bodies: DO count those (XLA does)
+            if ls.lstrip("%").startswith("region"):
+                in_fused = False
+            continue
+        if ls == "}":
+            continue
+        m = re.match(r"(?:ROOT )?%?[\w.\-]+ = (\w+)\[([\d,]*)\]"
+                     r"(?:\{[\d,:A-Z()]*\})? ([\w.\-]+)\((.*)", ls)
+        if m is None or in_fused:
+            continue
+        odt, osh, op, rest = m.groups()
+        if op in ("parameter", "constant", "get-tuple-element", "tuple",
+                  "bitcast", "after-all"):
+            continue
+        b = nbytes(odt, osh)
+        for am in TYPED.finditer(rest.split("),")[0] if op != "fusion"
+                                 else rest):
+            adt, ash = am.groups()
+            if adt in DT or adt in ("f32", "s32"):
+                b += nbytes(adt, ash)
+        out[op] += b
+    return out
+
+
+SCALES = {"gate_train/plan0": 0.125, "gate_tp/plan0": 0.125,
+          "gate_moe/plan0": 0.125, "gate_serving/unified": 1.0,
+          "gate_pipe_mpmd/pipe0-stage1": 0.25}
+
+build_gate_executables()
+for name in (sys.argv[1:] or ("gate_serving/unified", "gate_moe/plan0",
+                              "gate_tp/plan0")):
+    h = get_executable(name)
+    txt = h.compiled_text()
+    xla = hlo_bytes_by_op(txt)
+    w = cost_walk(h.jaxpr, scale=SCALES.get(name, 1.0), upcast=True,
+                  multiply_trips=False)
+    mine = defaultdict(float)
+    for e in w.entries:
+        mine[e.prim] += e.bytes * e.count
+    print(f"\n=== {name} ===   mine {sum(mine.values()):.0f}  "
+          f"xla-est {sum(xla.values()):.0f}")
+    print("  XLA side (non-fused op masses):")
+    for op, b in sorted(xla.items(), key=lambda kv: -kv[1])[:14]:
+        print(f"    {op:24s} {b:>11.0f}")
+    print("  my side:")
+    for op, b in sorted(mine.items(), key=lambda kv: -kv[1])[:14]:
+        print(f"    {op:24s} {b:>11.0f}")

@@ -1,0 +1,28 @@
+"""Scratch: compare predict_cost vs compiled.cost_analysis() on the
+gate executables (the tuning loop for the ±10% cross-check)."""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+from hetu_tpu.analysis.cli import build_gate_executables
+from hetu_tpu.analysis.cost import predict_cost
+from hetu_tpu.graph.graph import get_executable
+
+names = build_gate_executables()
+for name in names:
+    h = get_executable(name)
+    r = predict_cost(h, xla=True)
+    fd, bd = r.xla_flops_delta(), r.xla_bytes_delta()
+    print(f"{name:28s} flops {r.cmp_flops + r.cmp_transcendentals:>12.0f} "
+          f"xla {r.xla['flops'] + r.xla['transcendentals']:>12.0f} "
+          f"d {('%+.1f%%' % (100 * fd)) if fd is not None else 'n/a':>8s}  "
+          f"bytes {r.cmp_bytes:>11.0f} xla {r.xla['bytes_accessed']:>11.0f} "
+          f"d {('%+.1f%%' % (100 * bd)) if bd is not None else 'n/a':>8s}  "
+          f"within={r.xla_within()}")
+    print(f"  {r.summary()}")

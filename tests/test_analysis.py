@@ -608,6 +608,19 @@ def test_lint_graph_gate_passes_on_clean_tree():
         delta = abs(mem["peak_bytes"] - mem["xla_total_bytes"])
         assert delta <= max(0.1 * mem["xla_total_bytes"], 1 << 16) \
             or abs(mem.get("xla_delta_pct") or 0) <= 10.0, (name, mem)
+        # ISSUE 10: the step-time gate rides the same tier-1 marker —
+        # every gated executable carries the cost accounting with the
+        # XLA cost_analysis cross-check (±10% / absolute floors,
+        # enforced by the CLI itself via exit code 0 above) and the
+        # baseline pins its cost.* keys
+        cost = ex.get("cost")
+        assert cost and cost["flops"] > 0, (name, cost)
+        assert cost["hbm_bytes"] > 0 and cost["step_time_us"] > 0, \
+            (name, cost)
+        assert cost["bound"] in ("compute", "hbm", "comm"), (name, cost)
+        assert cost.get("xla_flops", 0) > 0, (name, cost)
+        assert cost.get("xla_bytes_accessed", 0) > 0, (name, cost)
+        assert cost.get("xla_flops_delta_pct") is not None, (name, cost)
     # --explain printed the per-executable edge sections after the JSON
     assert "predicted edges" in proc.stdout
     assert "=== gate_tp/plan0 ===" in proc.stdout

@@ -1,0 +1,332 @@
+"""Static step-time model tests (ISSUE 10): the FLOP/HBM walker's
+pinned contracts, the two new lint rules firing exactly once with
+hints, the shared comm-pricing formulas, and agreement with XLA's own
+``compiled.cost_analysis()`` on a toy matmul chain.
+
+Walker contracts demonstrated here:
+(a) ``dot_general`` FLOPs are exact contraction math (2·|out|·K), for
+    plain and batched dots;
+(b) scan bodies multiply by the trip count in the native inventory and
+    count ONCE in the XLA-comparable one (XLA's while convention);
+(c) ``shard_map`` region costs are per-device block costs — the
+    predicted FLOPs of a dp8-sharded matmul are global/8;
+(d) a conditional charges its most expensive branch, not the sum.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.analysis import analyze_handle, predict_cost
+from hetu_tpu.analysis.cost import (CostReport, cost_walk, price_edges)
+from hetu_tpu.analysis.edges import CommEdge
+from hetu_tpu.graph.graph import clear_executables, register_executable
+from hetu_tpu.planner.cost_model import (ClusterSpec, all_reduce_time,
+                                         all_to_all_time, collective_time)
+
+
+def _sds(shape, dtype=np.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _register(name, fn, args, **meta):
+    meta.setdefault("mesh_axes", {})
+    meta.setdefault("params", [])
+    meta.setdefault("allowed_gspmd", None)
+    clear_executables(name)
+    return register_executable(name, fn, args, meta)
+
+
+def _fired(rep, rule):
+    return [f for f in rep.findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# (a) dot_general contraction math
+# ---------------------------------------------------------------------------
+
+class TestDotFlops:
+    def test_matmul_flops_exact(self):
+        h = _register("t_cost/mm", jax.jit(lambda a, b: a @ b),
+                      (_sds((64, 128)), _sds((128, 32))))
+        r = predict_cost(h)
+        assert r.flops == 2 * 64 * 128 * 32
+
+    def test_batched_dot_flops_exact(self):
+        f = jax.jit(lambda a, b: jnp.einsum("bij,bjk->bik", a, b))
+        h = _register("t_cost/bmm", f, (_sds((4, 16, 32)),
+                                        _sds((4, 32, 8))))
+        r = predict_cost(h)
+        assert r.flops == 2 * 4 * 16 * 32 * 8
+
+    def test_matmul_chain_agrees_with_xla_cost_analysis(self):
+        """The headline contract on a program XLA prices exactly:
+        predicted FLOPs AND bytes accessed match cost_analysis()."""
+        f = jax.jit(lambda x, a, b: (x @ a) @ b)
+        h = _register("t_cost/chain", f, (_sds((64, 128)),
+                                          _sds((128, 256)),
+                                          _sds((256, 32))))
+        r = predict_cost(h, xla=True)
+        assert r.xla is not None and r.xla["flops"] > 0
+        # flops: exact (converts/fusion noise zero on an f32 chain)
+        assert r.cmp_flops == r.xla["flops"]
+        # bytes: operand+result of each dot, exactly XLA's accounting
+        assert r.cmp_bytes == r.xla["bytes_accessed"]
+        assert r.xla_within() is True
+
+
+# ---------------------------------------------------------------------------
+# (b) scan trip multiplication
+# ---------------------------------------------------------------------------
+
+class TestScanTrips:
+    def _scan_handle(self, trips):
+        def f(x, w):
+            def body(c, _):
+                return c @ w, ()
+            out, _ = jax.lax.scan(body, x, None, length=trips)
+            return out
+        return _register(f"t_cost/scan{trips}", jax.jit(f),
+                         (_sds((32, 64)), _sds((64, 64))))
+
+    def test_native_flops_multiply_by_trips(self):
+        one_dot = 2 * 32 * 64 * 64
+        r5 = predict_cost(self._scan_handle(5))
+        assert r5.flops == 5 * one_dot
+        # ...and the body is priced once, then multiplied — not
+        # re-walked into accumulating temps (the attribution entry
+        # carries count=5, flops=one body)
+        dots = [e for e in r5.entries if e.prim == "dot_general"]
+        assert len(dots) == 1 and dots[0].count == 5
+        assert dots[0].flops == one_dot
+
+    def test_cmp_flops_count_body_once(self):
+        """XLA's cost_analysis counts a while/scan body ONCE — the
+        comparable inventory must follow or every scanned program
+        would fail the ±10% cross-check by ×trips."""
+        r5 = predict_cost(self._scan_handle(5), xla=True)
+        one_dot = 2 * 32 * 64 * 64
+        assert r5.cmp_flops < 2 * one_dot        # body once, not x5
+        assert abs(r5.cmp_flops - r5.xla["flops"]) \
+            <= 0.1 * r5.xla["flops"] + 64
+
+
+# ---------------------------------------------------------------------------
+# (c) shard_map mesh-axis division
+# ---------------------------------------------------------------------------
+
+class TestShardMapDivision:
+    def test_per_device_flops_divide_by_mesh_axis(self, devices8):
+        from jax.sharding import Mesh, PartitionSpec as P
+        from hetu_tpu.parallel.comm import shard_map
+        mesh = Mesh(np.array(devices8), ("dp",))
+        f = jax.jit(shard_map(lambda x, w: x @ w, mesh,
+                              in_specs=(P("dp", None), P(None, None)),
+                              out_specs=P("dp", None)))
+        h = _register("t_cost/smap", f, (_sds((64, 128)),
+                                         _sds((128, 128))),
+                      mesh_axes={"dp": 8})
+        r = predict_cost(h)
+        assert r.flops == 2 * 64 * 128 * 128 / 8
+
+    def test_gspmd_scale_divides_by_whole_mesh(self, devices8):
+        # outside a manual region, global avals divide by prod(mesh)
+        h = _register("t_cost/gspmd", jax.jit(lambda a, b: a @ b),
+                      (_sds((64, 128)), _sds((128, 128))),
+                      mesh_axes={"dp": 2, "tp": 4})
+        r = predict_cost(h)
+        assert r.flops == 2 * 64 * 128 * 128 / 8
+
+
+# ---------------------------------------------------------------------------
+# (d) conditionals charge the max branch
+# ---------------------------------------------------------------------------
+
+class TestCondMaxBranch:
+    def test_cond_charges_most_expensive_branch(self):
+        def f(i, x, w):
+            return jax.lax.switch(i, [
+                lambda x, w: jnp.sum(x),            # cheap
+                lambda x, w: jnp.sum(x @ w),        # the dot branch
+                lambda x, w: jnp.sum(x * 2.0),      # cheap
+            ], x, w)
+        h = _register("t_cost/switch", jax.jit(f),
+                      (_sds((), np.int32), _sds((64, 128)),
+                       _sds((128, 128))))
+        r = predict_cost(h)
+        dot = 2 * 64 * 128 * 128
+        assert r.flops >= dot                 # the dot branch is charged
+        assert r.flops < 1.5 * dot            # ...but not summed x3
+
+
+# ---------------------------------------------------------------------------
+# comm pricing: one implementation, transport-aware
+# ---------------------------------------------------------------------------
+
+class TestCommPricing:
+    def test_linter_and_solver_share_the_formulas(self):
+        """price_edges must route through planner.cost_model.
+        collective_time — measured link overrides change BOTH."""
+        cluster = ClusterSpec(num_chips=8)
+        edge = CommEdge(kind="all_reduce", axes=("dp",),
+                        payload_bytes=1 << 20)
+        [c] = price_edges([edge], {"dp": 8}, cluster)
+        assert c.time_s == all_reduce_time(float(1 << 20), 8, cluster)
+        # measured alpha-beta override: same number on both sides
+        cal = ClusterSpec(num_chips=8,
+                          link_alpha_beta={"all_reduce": (1e-5, 2e-9)})
+        [cm] = price_edges([edge], {"dp": 8}, cal)
+        want = 1e-5 + 2e-9 * (1 << 20)
+        assert abs(cm.time_s - want) < 1e-12
+        assert abs(all_reduce_time(float(1 << 20), 8, cal) - want) \
+            < 1e-12
+        # kinds without a fit keep the ring model
+        assert all_to_all_time(1e6, 8, cal) \
+            == all_to_all_time(1e6, 8, cluster)
+
+    def test_quantized_transport_prices_real_wire_bytes(self):
+        """An int8 bucket edge carries 1/4 the payload of fp32 — the
+        alpha-beta time must reflect the narrow wire, not the compute
+        dtype (EQuARX pricing)."""
+        cluster = ClusterSpec(num_chips=8)
+        fp32 = CommEdge(kind="all_reduce", axes=("dp",),
+                        payload_bytes=256 << 20)
+        int8 = CommEdge(kind="all_reduce", axes=("dp",),
+                        payload_bytes=64 << 20)
+        [c32], [c8] = (price_edges([e], {"dp": 8}, cluster)
+                       for e in (fp32, int8))
+        # bandwidth term dominates at 256 MB: int8 must be ~4x cheaper
+        assert c8.time_s < 0.3 * c32.time_s
+
+    def test_collective_time_kind_dispatch(self):
+        cluster = ClusterSpec(num_chips=8)
+        assert collective_time("identity", 1e6, 8, cluster) == 0.0
+        assert collective_time("scatter", 1e6, 8, cluster) == 0.0
+        assert collective_time("all_reduce", 1e6, 8, cluster) > 0
+        assert collective_time("reshard", 1e6, 8, cluster) > 0
+
+
+# ---------------------------------------------------------------------------
+# the two new rules: seeded, fire exactly once, hints carried
+# ---------------------------------------------------------------------------
+
+class TestCostRules:
+    def _comm_heavy(self, name, overlap):
+        # trivial compute + one declared 1 GB all_reduce x4: exposed
+        # comm dwarfs the roofline and the step is far above the
+        # CI-toy threshold
+        edge = {"kind": "all_reduce", "axes": ("dp",),
+                "payload_bytes": 1 << 30, "count": 4,
+                "origin": "grad_comm"}
+        return _register(name, jax.jit(lambda x: x + 1.0),
+                         (_sds((8, 8)),),
+                         mesh_axes={"dp": 8},
+                         declared_edges=[edge],
+                         comm_overlap=overlap)
+
+    def test_comm_bound_plan_fires_once_with_hint(self):
+        rep = analyze_handle(self._comm_heavy("t_cost/bound", False))
+        fired = _fired(rep, "comm-bound-plan")
+        assert len(fired) == 1
+        assert "comm-bound" in fired[0].message
+        assert "int8" in fired[0].hint       # names the transport remedy
+        assert "bucket" in fired[0].hint     # ...and the bucket remedy
+
+    def test_overlap_scheduled_plan_is_exempt(self):
+        """Same wire bytes, but the plan declares the coalesced
+        overlap-schedulable sync: the grad_comm edges hide under the
+        roofline and the rule must stay silent."""
+        rep = analyze_handle(self._comm_heavy("t_cost/olap", True))
+        assert _fired(rep, "comm-bound-plan") == []
+        cost = rep.meta["cost"]
+        assert cost.overlap and cost.overlapped_comm_s > 0
+        assert cost.exposed_comm_s == 0.0
+
+    def test_tiny_steps_are_exempt(self):
+        # big RELATIVE comm share but a microseconds step: CI-scale toy
+        edge = {"kind": "all_reduce", "axes": ("dp",),
+                "payload_bytes": 1 << 10, "count": 1}
+        h = _register("t_cost/tiny", jax.jit(lambda x: x + 1.0),
+                      (_sds((8, 8)),), mesh_axes={"dp": 8},
+                      declared_edges=[edge])
+        rep = analyze_handle(h)
+        assert _fired(rep, "comm-bound-plan") == []
+
+    def test_predicted_step_regression_fires_once(self):
+        h = _register("t_cost/reg", jax.jit(lambda a, b: a @ b),
+                      (_sds((64, 128)), _sds((128, 128))))
+        base = predict_cost(h).step_time_s
+        rep = analyze_handle(h, options={
+            "baseline_step_time_s": {"t_cost/reg": base / 2.0}})
+        fired = _fired(rep, "predicted-step-regression")
+        assert len(fired) == 1
+        assert "regressed" in fired[0].message
+        assert "--update-baseline" in fired[0].hint
+        # within tolerance: silent
+        rep_ok = analyze_handle(h, options={
+            "baseline_step_time_s": {"t_cost/reg": base}})
+        assert _fired(rep_ok, "predicted-step-regression") == []
+
+
+# ---------------------------------------------------------------------------
+# report plumbing
+# ---------------------------------------------------------------------------
+
+class TestCostReportPlumbing:
+    def test_cost_dict_shape_and_baseline_gate(self):
+        h = _register("t_cost/dict", jax.jit(lambda a, b: a @ b),
+                      (_sds((64, 128)), _sds((128, 128))))
+        rep = analyze_handle(h)
+        d = rep.to_dict(records=False)
+        assert d["cost"]["flops"] == 2 * 64 * 128 * 128
+        assert d["cost"]["step_time_us"] > 0
+        assert d["cost"]["bound"] in ("compute", "hbm", "comm")
+        # losing the accounting fails the baseline gate
+        from hetu_tpu.analysis.report import AnalysisReport
+        ar = AnalysisReport()
+        ar.add(rep)
+        base = ar.to_dict()
+        del rep.meta["cost"]
+        problems = ar.check_against_baseline(base)
+        assert any("step-time accounting" in p for p in problems)
+
+    def test_flop_growth_fails_baseline(self):
+        h = _register("t_cost/grow", jax.jit(lambda a, b: a @ b),
+                      (_sds((64, 128)), _sds((128, 128))))
+        from hetu_tpu.analysis.report import AnalysisReport
+        ar = AnalysisReport()
+        rep = ar.add(analyze_handle(h))
+        base = ar.to_dict()
+        base["executables"]["t_cost/grow"]["cost"]["flops"] /= 2
+        problems = ar.check_against_baseline(base)
+        assert any("predicted flops regressed" in p for p in problems)
+
+    def test_predicted_cost_stats_carries_step_components(self):
+        from hetu_tpu.analysis import predicted_cost_stats
+        h = _register("t_cost/stats", jax.jit(lambda a, b: a @ b),
+                      (_sds((64, 128)), _sds((128, 128))))
+        s = predicted_cost_stats(h)
+        assert s["step_time_s"] > 0
+        assert s["flops"] == 2 * 64 * 128 * 128
+        assert s["bound"] in ("compute", "hbm", "comm")
+        assert s["comm_time_s"] == 0.0       # no edge claim -> no comm
+
+
+# ---------------------------------------------------------------------------
+# attribution
+# ---------------------------------------------------------------------------
+
+class TestAttribution:
+    def test_top_entries_carry_provenance_and_rank_by_time(self):
+        f = jax.jit(lambda x, a, b: jnp.tanh(x @ a) @ b)
+        h = _register("t_cost/attr", f, (_sds((64, 256)),
+                                         _sds((256, 256)),
+                                         _sds((256, 64))))
+        r = predict_cost(h)
+        top = r.top(3)
+        assert top and top[0].prim == "dot_general"
+        # the big dot ranks first, and entries know their source file
+        assert any(e.source for e in r.entries if e.prim == "dot_general")
+        d = r.to_dict(entries=True)
+        assert d["top_entries"][0]["prim"] == "dot_general"

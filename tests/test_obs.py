@@ -456,6 +456,17 @@ def test_reconcile_joins_two_executable_families(tiny_state):
     # CPU honesty: no allocator stats -> explicit n/a, never a fake pass
     assert srv.hbm_check == "n/a" and rep.observed_peak_hbm_bytes == 0
     assert "n/a" in rep.summary()
+    # ISSUE 10: the step-time prediction joins as a RATIO-only column —
+    # off-TPU the chip-spec model has no absolute meaning, so the table
+    # reports wall/pred with no pass/fail verdict
+    assert srv.predicted_step_s is not None and srv.predicted_step_s > 0
+    assert trn.predicted_step_s is not None and trn.predicted_step_s > 0
+    assert srv.wall_ratio == pytest.approx(
+        srv.mean_wall_s / srv.predicted_step_s)
+    assert trn.predicted_bound in ("compute", "hbm", "comm")
+    summary = rep.summary()
+    assert "wall/pred" in summary and "RATIO" in summary
     d = rep.to_dict()
     assert len(d["rows"]) == rep.families
+    assert d["rows"][0]["predicted_step_s"] is not None
     json.dumps(d)                            # BENCH_OBS-serializable
