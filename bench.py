@@ -1121,6 +1121,167 @@ def bench_serving_microbench() -> dict:
     return result
 
 
+def bench_router_bench() -> dict:
+    """Serving-cluster heavy-traffic bench (ISSUE 11): Poisson arrivals,
+    Zipf-shared prefixes, and a burst phase that forces preemption +
+    prefix-cache eviction, driven through ``serving.cluster`` three
+    ways — ONE replica (the scale-up ceiling), N=3 replicas with
+    prefix-aware placement, and N=3 with seeded random placement (the
+    baseline prefix-aware routing must beat).  Freezes TTFT/TBT
+    p50/p99 under load per configuration into ``BENCH_ROUTER.json``
+    with the acceptance booleans (prefix-aware beats random on cache
+    hit rate AND TTFT p99 at N>=3), plus a disaggregated
+    prefill/decode run recording the priced KV-page handoff totals
+    (payload bytes + alpha-beta predicted wire seconds — the CPU-honest
+    stand-in for hardware page streaming).
+
+    All four clusters share ONE compiled unified-step program (the
+    cluster's own fleet-sharing mechanism, reused across configs), so
+    compile cost is paid once and the walls compare engines, not XLA.
+    """
+    code = (
+        "import os, sys, json, time\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from hetu_tpu.models import GPTConfig\n"
+        "from hetu_tpu.serving import EngineCluster\n"
+        "H = int(os.environ.get('HETU_TPU_ROUTER_BENCH_HIDDEN', '64'))\n"
+        "L = int(os.environ.get('HETU_TPU_ROUTER_BENCH_LAYERS', '2'))\n"
+        "V, NH, NKV = 512, 8, 4\n"
+        "cfg = GPTConfig(vocab_size=V, hidden_size=H, num_layers=L,\n"
+        "                num_heads=NH, num_kv_heads=NKV, max_seq_len=512,\n"
+        "                sp=False, dropout=0.0, position='rotary',\n"
+        "                norm='rmsnorm', activation='silu',\n"
+        "                tie_embeddings=True)\n"
+        "hd, f = cfg.head_dim, cfg.ffn_size\n"
+        "rng = np.random.RandomState(0)\n"
+        "def w(*s):\n"
+        "    return (rng.randn(*s) * 0.02).astype(np.float32)\n"
+        "state = {'wte.weight': w(V, H), 'ln_f.weight': np.ones(H, np.float32)}\n"
+        "for i in range(L):\n"
+        "    state[f'h{i}.ln_1.weight'] = np.ones(H, np.float32)\n"
+        "    state[f'h{i}.ln_2.weight'] = np.ones(H, np.float32)\n"
+        "    state[f'h{i}.attn.qkv.weight'] = w((NH + 2 * NKV) * hd, H)\n"
+        "    state[f'h{i}.attn.out.weight'] = w(H, NH * hd)\n"
+        "    state[f'h{i}.mlp.up.weight'] = w(f, H)\n"
+        "    state[f'h{i}.mlp.down.weight'] = w(H, f)\n"
+        "\n"
+        "# -- the heavy-traffic trace: Zipf-shared headers, Poisson\n"
+        "# interarrivals, a 5x burst phase in the middle third --------\n"
+        "PS, NEW, HDR, TAIL = 8, 8, 32, 8\n"
+        "K_HEADERS, N_REQ = 4, 36\n"
+        "zipf_w = 1.0 / np.arange(1, K_HEADERS + 1) ** 1.1\n"
+        "zipf_w /= zipf_w.sum()\n"
+        "headers = [rng.randint(1, V, size=HDR).tolist()\n"
+        "           for _ in range(K_HEADERS)]\n"
+        "trace = []            # (arrival offset s, prompt)\n"
+        "t = 0.0\n"
+        "for i in range(N_REQ):\n"
+        "    burst = N_REQ // 3 <= i < 2 * N_REQ // 3\n"
+        "    t += float(rng.exponential(0.004 if burst else 0.02))\n"
+        "    hdr = headers[int(rng.choice(K_HEADERS, p=zipf_w))]\n"
+        "    trace.append((t, hdr + rng.randint(1, V, size=TAIL).tolist()))\n"
+        "SHAPES = dict(page_size=PS, max_batch=4, chunk_size=16,\n"
+        "              prefill_rows=1, max_model_len=120)\n"
+        "\n"
+        "def run_cluster(n, policy, mode='replicated', num_prefill=1,\n"
+        "                fn=None):\n"
+        "    cl = EngineCluster(state, cfg, num_replicas=n, mode=mode,\n"
+        "                       num_prefill=num_prefill, policy=policy,\n"
+        "                       name=f'rb_{mode}_{policy}_{n}',\n"
+        "                       coordinator=False, num_pages=16,\n"
+        "                       step_fn=fn, seed=1, **SHAPES)\n"
+        "    # warm: compile + every header into some cache (identical\n"
+        "    # treatment for every config -- the deltas are pure policy)\n"
+        "    for h in headers:\n"
+        "        cl.add_request(h + [1, 2], 2)\n"
+        "    cl.run()\n"
+        "    t0 = time.monotonic()\n"
+        "    reqs = [cl.add_request(p, NEW, arrival_time=t0 + dt)\n"
+        "            for dt, p in trace]\n"
+        "    cl.run()\n"
+        "    wall = time.monotonic() - t0\n"
+        "    ms = cl.metrics_summary()\n"
+        "    ttft, tbt = cl.histograms['ttft'], cl.histograms['tbt']\n"
+        "    out = {\n"
+        "      'replicas': n, 'policy': policy, 'mode': mode,\n"
+        "      'wall_s': round(wall, 2),\n"
+        "      'tokens_per_sec': round(N_REQ * NEW / wall, 1),\n"
+        "      'ttft_p50_ms': round(ttft.percentile(50) * 1e3, 1),\n"
+        "      'ttft_p99_ms': round(ttft.percentile(99) * 1e3, 1),\n"
+        "      'tbt_p50_ms': round(tbt.percentile(50) * 1e3, 1),\n"
+        "      'tbt_p99_ms': round(tbt.percentile(99) * 1e3, 1),\n"
+        "      'hit_rate': round(float(ms['prefix_cache_hit_rate']), 3),\n"
+        "      'prefill_tokens_saved':\n"
+        "          int(ms['prefix_cache_tokens_saved']),\n"
+        "      'preemptions': int(ms['preemptions']),\n"
+        "      'cache_evictions': int(ms['prefix_cache_evictions']),\n"
+        "      'reroutes': int(ms['cluster_reroutes']),\n"
+        "      'handoffs': int(ms['cluster_handoffs']),\n"
+        "      'handoff_payload_bytes': int(ms['handoff_payload_bytes']),\n"
+        "      'handoff_predicted_wire_s':\n"
+        "          round(float(ms['handoff_predicted_s']), 6),\n"
+        "      'completed': int(ms['cluster_requests_completed']),\n"
+        "    }\n"
+        "    fn_out = cl.replicas[0].engine._compiled['unified']\n"
+        "    cl.close()\n"
+        "    return out, fn_out\n"
+        "\n"
+        "single, fn = run_cluster(1, 'prefix')\n"
+        "prefix3, fn = run_cluster(3, 'prefix', fn=fn)\n"
+        "random3, fn = run_cluster(3, 'random', fn=fn)\n"
+        "disagg, fn = run_cluster(3, 'prefix', mode='disaggregated',\n"
+        "                         num_prefill=1, fn=fn)\n"
+        "res = {\n"
+        "  'model': {'hidden': H, 'layers': L, 'vocab': V},\n"
+        "  'trace': {'requests': N_REQ, 'headers': K_HEADERS,\n"
+        "            'zipf_exponent': 1.1, 'header_tokens': HDR,\n"
+        "            'tail_tokens': TAIL, 'max_new_tokens': NEW,\n"
+        "            'poisson_mean_interarrival_s': 0.02,\n"
+        "            'burst_mean_interarrival_s': 0.004,\n"
+        "            'burst_phase': 'middle third'},\n"
+        "  'single_replica': single,\n"
+        "  'prefix_routing_3x': prefix3,\n"
+        "  'random_routing_3x': random3,\n"
+        "  'disaggregated_3x': disagg,\n"
+        "  # acceptance gates (ISSUE 11), recorded as booleans\n"
+        "  'prefix_beats_random_hit_rate':\n"
+        "      prefix3['hit_rate'] > random3['hit_rate'],\n"
+        "  'prefix_beats_random_ttft_p99':\n"
+        "      prefix3['ttft_p99_ms'] < random3['ttft_p99_ms'],\n"
+        "  'burst_forced_pressure': (prefix3['preemptions']\n"
+        "      + prefix3['cache_evictions'] + random3['preemptions']\n"
+        "      + random3['cache_evictions']) > 0,\n"
+        "  'no_request_lost': all(c['completed'] == N_REQ + 4 for c in\n"
+        "      (single, prefix3, random3, disagg)),\n"
+        "}\n"
+        "print(json.dumps(res))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True,
+                              timeout=1200)
+        lines = proc.stdout.strip().splitlines()
+        if not lines:
+            return {"error": f"rc={proc.returncode}: "
+                             f"{proc.stderr.strip()[-400:]}"}
+        result = json.loads(lines[-1])
+    except Exception as e:  # never fail the bench driver on this
+        return {"error": f"{type(e).__name__}: {e}"}
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_ROUTER.json")
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(result, fh, indent=1)
+    except Exception:
+        pass
+    return result
+
+
 def _probe_backend(timeout_s: float = 180.0) -> str:
     """Probe the default backend in a SUBPROCESS with a timeout: a wedged
     TPU runtime hangs on init (round-3 postmortem: BENCH_r03 rc=1 /
@@ -1175,7 +1336,8 @@ def main():
                "comm_microbench": bench_comm_microbench,
                "lint_graph": bench_lint_graph,
                "mem_lint": bench_mem_lint,
-               "cost_lint": bench_cost_lint}
+               "cost_lint": bench_cost_lint,
+               "router_bench": bench_router_bench}
         if sub not in fns:
             print(json.dumps({"error": f"unknown subcommand {sub!r}; "
                                        f"have {sorted(fns)}"}))

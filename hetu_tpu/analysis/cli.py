@@ -7,7 +7,11 @@ so the gate runs on CPU in CI:
   ZeRO-2 + flat state + explicit int8 grad sync;
 * ``gate_serving`` — the unified ragged prefill+decode step of a small
   continuous-batching engine over the paged KV pool (ONE executable;
-  the v1 bucketed prefill/decode grid is gone);
+  the v1 bucketed prefill/decode grid is gone), PLUS a disaggregated
+  2-replica serving cluster whose prefill and decode engines register
+  under distinct per-replica names (``gate_serving@r{i}/unified``) and
+  whose prefill→decode KV-page handoffs must carry priced edge claims
+  (``kv-handoff-unpriced``);
 * ``gate_tp``      — a TP/SP train graph (dp=2 x tp=4, Megatron-SP
   layers from ``nn/parallel.py``), implicit GSPMD sync;
 * ``gate_pipe``    — a pipeline run, both ways: MPMD per-stage programs
@@ -254,7 +258,36 @@ def build_gate_executables():
         clock[0] += 1.0
     eng.pool.check_invariants(force=True)
     assert eng.compile_count == 1, "the bucket grid came back"
-    return names + sorted(f"gate_serving/{k}" for k in eng._compiled)
+    names += sorted(f"gate_serving/{k}" for k in eng._compiled)
+
+    # -- serving cluster: a disaggregated 2-replica fleet (1 prefill +
+    # 1 decode) over the SAME model — each replica's unified executable
+    # registers under its own name (gate_serving@r{i}/unified), the
+    # prefill→decode KV-page handoff must carry a priced edge claim
+    # (kv-handoff-unpriced audits the records the decode replica's
+    # meta exposes), and both replicas share ONE compiled program -----
+    from hetu_tpu.serving import EngineCluster
+    cclock = [0.0]
+    cl = EngineCluster(state, scfg, num_replicas=2,
+                       mode="disaggregated", num_prefill=1,
+                       name="gate_serving", num_pages=16, page_size=8,
+                       max_batch=4, chunk_size=4,
+                       time_fn=lambda: cclock[0], ttl=3600.0)
+    cl.add_request([1, 2, 3, 4, 5, 6, 7, 8, 9], max_new_tokens=3)
+    cl.add_request([1, 2, 3, 4, 5, 6, 7, 8, 11], max_new_tokens=3)
+    guard = 0
+    while cl.has_work:
+        cl.step()
+        cclock[0] += 1.0
+        guard += 1
+        assert guard < 200, "cluster gate trace did not drain"
+    assert len(cl.transport.records) == 2, "prefill->decode handoff gone"
+    assert all(r["predicted_s"] > 0 for r in cl.transport.records), \
+        "handoff lost its alpha-beta pricing"
+    for r in cl.replicas:
+        r.engine.pool.check_invariants(force=True)
+    cl.close()
+    return names + [f"gate_serving@r{i}/unified" for i in range(2)]
 
 
 def explain_report(report, out=sys.stdout, memory: bool = False,

@@ -29,6 +29,14 @@ Rule catalog (DESIGN.md §9 for the rationale of each):
                              free-list/allocated set, or a live decode
                              row's page table targets it (padding rows
                              are the only legitimate trash-page writers).
+``kv-handoff-unpriced``      serving cluster: a cross-replica KV-page
+                             move (disaggregated prefill→decode
+                             handoff) whose record carries no priced
+                             edge claim — every page stream must
+                             declare a CommEdge-shaped claim with its
+                             payload bytes and an alpha-beta predicted
+                             time, so the disaggregation design stays
+                             priced before hardware exists.
 ``cow-page-write``           serving: a unified-step KV write plan entry
                              targets a CACHED page — read-only by the
                              CoW contract whatever its sharer count
@@ -734,6 +742,69 @@ def _predicted_step_regression(ctx: AnalysisContext) -> List[Finding]:
         hint="inspect the attribution table (--cost --explain) for the "
              "primitive or edge that grew; if the change is "
              "intentional, re-freeze with --update-baseline")]
+
+
+@rule("kv-handoff-unpriced")
+def _kv_handoff_unpriced(ctx: AnalysisContext) -> List[Finding]:
+    """Disaggregated serving contract: every cross-replica KV-page move
+    (the prefill→decode handoff) must carry a PRICED edge claim — a
+    CommEdge-shaped dict whose payload matches the pages moved, plus
+    the alpha-beta predicted seconds through the shared
+    ``collective_time`` formulas.  A handoff without the claim is wire
+    traffic the analysis plane cannot see: the whole point of the
+    CPU-honest cluster design is that the page stream is priced BEFORE
+    TPU hardware exists, so an unpriced move fails CI.  Executables
+    with no ``kv_handoff`` meta (everything but cluster decode
+    replicas) are out of scope."""
+    records = (ctx.meta or {}).get("kv_handoff")
+    if records is None:
+        return []
+    if callable(records):
+        try:
+            records = records()
+        except Exception:
+            return [Finding(
+                rule="", subject="kv_handoff", severity="error",
+                message="kv_handoff record hook raised — the handoff "
+                        "accounting is lost, which is itself a gate "
+                        "failure")]
+    out: List[Finding] = []
+    for i, rec in enumerate(records or ()):
+        edge = rec.get("edge") or {}
+        payload = int(rec.get("payload_bytes", 0) or 0)
+        problems = []
+        if not edge:
+            problems.append("no edge claim")
+        else:
+            if int(edge.get("payload_bytes", 0) or 0) != payload:
+                problems.append(
+                    f"edge claims {edge.get('payload_bytes')} B but the "
+                    f"move carried {payload} B")
+            if not edge.get("kind"):
+                problems.append("edge has no collective kind")
+        if payload <= 0 and int(rec.get("pages", 0) or 0) > 0:
+            problems.append("pages moved with zero payload bytes")
+        pred = rec.get("predicted_s")
+        if pred is None or float(pred) <= 0.0:
+            problems.append("no alpha-beta predicted time")
+        if not problems:
+            continue
+        out.append(Finding(
+            rule="",
+            subject=f"handoff@{i}:r{rec.get('src', '?')}->"
+                    f"r{rec.get('dst', '?')}",
+            severity="error",
+            message=f"cross-replica KV-page move #{i} "
+                    f"(r{rec.get('src', '?')} -> r{rec.get('dst', '?')},"
+                    f" {rec.get('pages', '?')} pages) is unpriced: "
+                    + "; ".join(problems),
+            hint="route the move through a PageTransport that records "
+                 "a priced edge claim (LocalPageTransport prices via "
+                 "planner.cost_model.collective_time — the SAME "
+                 "alpha-beta formulas the planner and step-time linter "
+                 "use); a handoff the analysis plane cannot price "
+                 "cannot be gated before hardware"))
+    return out
 
 
 @rule("cow-page-write")

@@ -25,12 +25,12 @@ from .export import (chrome_trace, events_to_jsonl, request_timelines,
                      write_chrome_trace, write_jsonl)
 from .reconcile import (ReconcileReport, ReconcileRow, predicted_stats,
                         reconcile)
-from .tracer import (NOOP_SPAN, NULL_TRACER, Span, SpanTracer, get_tracer,
-                     install_tracer, trace)
+from .tracer import (NOOP_SPAN, NULL_TRACER, PrefixedTracer, Span,
+                     SpanTracer, get_tracer, install_tracer, trace)
 
 __all__ = [
-    "Span", "SpanTracer", "NULL_TRACER", "NOOP_SPAN", "get_tracer",
-    "install_tracer", "trace",
+    "Span", "SpanTracer", "PrefixedTracer", "NULL_TRACER", "NOOP_SPAN",
+    "get_tracer", "install_tracer", "trace",
     "chrome_trace", "write_chrome_trace", "events_to_jsonl", "write_jsonl",
     "validate_chrome_trace", "request_timelines", "timeline_summary",
     "ReconcileReport", "ReconcileRow", "predicted_stats", "reconcile",

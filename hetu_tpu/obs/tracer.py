@@ -37,8 +37,8 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional
 
-__all__ = ["Span", "SpanTracer", "NULL_TRACER", "NOOP_SPAN", "get_tracer",
-           "install_tracer", "trace"]
+__all__ = ["Span", "SpanTracer", "PrefixedTracer", "NULL_TRACER",
+           "NOOP_SPAN", "get_tracer", "install_tracer", "trace"]
 
 
 class Span:
@@ -270,6 +270,74 @@ class _NullTracer:
 
     def __len__(self) -> int:
         return 0
+
+
+class PrefixedTracer:
+    """A view onto another tracer that namespaces every track.
+
+    The serving cluster hands each replica engine
+    ``PrefixedTracer(shared, "r0/")`` so N engines' identically-named
+    tracks (``engine``, ``scheduler``, ``req 3``) land as distinct
+    ``r0/engine`` / ``r1/engine`` rows in ONE merged Perfetto trace —
+    the engines need no cluster awareness and the router's own
+    ``router`` track sits alongside.  Purely a pass-through otherwise:
+    ``enabled`` follows the base tracer live (toggling the shared
+    tracer toggles every replica view), events land in the base ring.
+    """
+
+    __slots__ = ("base", "prefix")
+
+    def __init__(self, base, prefix: str):
+        self.base = base
+        self.prefix = str(prefix)
+
+    @property
+    def enabled(self) -> bool:
+        return self.base.enabled
+
+    @property
+    def dropped(self) -> int:
+        return self.base.dropped
+
+    def _track(self, track: Optional[str]) -> Optional[str]:
+        return None if track is None else self.prefix + track
+
+    def now(self) -> float:
+        return self.base.now()
+
+    def begin(self, name: str, track: Optional[str] = None,
+              ts: Optional[float] = None, **attrs: Any):
+        return self.base.begin(name, track=self._track(track), ts=ts,
+                               **attrs)
+
+    def end(self, span, ts: Optional[float] = None, **attrs: Any) -> None:
+        self.base.end(span, ts=ts, **attrs)
+
+    def span(self, name: str, track: Optional[str] = None,
+             ts: Optional[float] = None, **attrs: Any):
+        return self.base.span(name, track=self._track(track), ts=ts,
+                              **attrs)
+
+    def instant(self, name: str, track: Optional[str] = None,
+                ts: Optional[float] = None, **attrs: Any) -> None:
+        self.base.instant(name, track=self._track(track), ts=ts, **attrs)
+
+    def complete(self, name: str, ts: float, dur: float,
+                 track: Optional[str] = None, **attrs: Any) -> None:
+        self.base.complete(name, ts, dur, track=self._track(track),
+                           **attrs)
+
+    def events(self) -> List[Span]:
+        return self.base.events()
+
+    def open_count(self) -> int:
+        return self.base.open_count()
+
+    def clear(self) -> None:
+        self.base.clear()
+
+    def __len__(self) -> int:
+        return len(self.base)
 
 
 NULL_TRACER = _NullTracer()

@@ -183,7 +183,12 @@ class CoordinatorServer:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 world_size: Optional[int] = None):
+                 world_size: Optional[int] = None, ttl: float = 10.0):
+        # default liveness TTL for dead_ranks() — serving clusters run
+        # much tighter failure-detection windows than training jobs, so
+        # the server (and each client, see CoordinatorClient(ttl=))
+        # carries its own default instead of one hard-coded 10 s
+        self.ttl = float(ttl)
         self.state = _State(world_size)
         self._srv = _TCPServer((host, port), _Handler)
         self._srv.state = self.state  # type: ignore[attr-defined]
@@ -212,7 +217,8 @@ class CoordinatorServer:
 
     # -- monitor-side helpers ------------------------------------------------
 
-    def dead_ranks(self, ttl: float = 10.0) -> List[int]:
+    def dead_ranks(self, ttl: Optional[float] = None) -> List[int]:
+        ttl = self.ttl if ttl is None else float(ttl)
         now = time.time()
         with self.state.lock:
             return sorted(r for r, t in self.state.last_heartbeat.items()
@@ -223,7 +229,13 @@ class CoordinatorClient:
     """Worker-side client (reference C++ ``rpc_client.cc`` surface)."""
 
     def __init__(self, address: str, uid: Optional[str] = None,
-                 hostname: Optional[str] = None, connect_timeout: float = 30.0):
+                 hostname: Optional[str] = None,
+                 connect_timeout: float = 30.0, ttl: float = 10.0):
+        # per-client liveness TTL: alive() calls without an explicit ttl
+        # use this, so a monitor tuned for fast failover (serving
+        # router) and one tuned for slow links (multi-host training)
+        # can share a coordinator without renegotiating every call
+        self.ttl = float(ttl)
         host, port = address.rsplit(":", 1)
         deadline = time.time() + connect_timeout
         while True:
@@ -297,8 +309,10 @@ class CoordinatorClient:
     def heartbeat(self) -> None:
         self._call(op="heartbeat", rank=self.rank)
 
-    def alive(self, ttl: float = 10.0) -> Tuple[List[int], List[int]]:
-        r = self._call(op="alive", ttl=ttl)
+    def alive(self, ttl: Optional[float] = None
+              ) -> Tuple[List[int], List[int]]:
+        r = self._call(op="alive",
+                       ttl=self.ttl if ttl is None else float(ttl))
         return r["alive"], r["dead"]
 
     def exit(self) -> None:

@@ -12,10 +12,14 @@ driving ONE unified ragged prefill+decode executable.
 See DESIGN.md §8 for the page-size/TP-tiling rationale, §12 for the
 unified ragged step (token-budget packing, chunked prefill, on-device
 temperature/top-k/top-p sampling, the one-executable compile contract),
-and §13 for copy-on-write prefix caching (chained page hashing,
-refcounted read-only pages, LRU eviction — on by default, disable with
-``Engine(..., prefix_cache=False)``).
+§13 for copy-on-write prefix caching (chained page hashing, refcounted
+read-only pages, LRU eviction — on by default, disable with
+``Engine(..., prefix_cache=False)``), and §17 for the cluster plane
+(``serving.cluster.EngineCluster``: prefix-aware routing over N
+replicas, disaggregated prefill/decode, priced KV-page streaming).
 """
+from .cluster import (ClusterRequest, EngineCluster, LocalPageTransport,
+                      PageTransport, Replica, Router)
 from .engine import Engine
 from .kv_pool import PagedKVPool, TRASH_PAGE
 from .prefix_cache import CacheEntry, PrefixCache
@@ -24,4 +28,6 @@ from .scheduler import Scheduler
 
 __all__ = ["Engine", "PagedKVPool", "TRASH_PAGE", "PrefixCache",
            "CacheEntry", "Request", "RequestQueue", "Scheduler",
-           "WAITING", "RUNNING", "FINISHED"]
+           "WAITING", "RUNNING", "FINISHED",
+           "EngineCluster", "ClusterRequest", "Replica", "Router",
+           "PageTransport", "LocalPageTransport"]

@@ -1,0 +1,31 @@
+"""Serving cluster plane: prefix-aware routing over N engine replicas
+with disaggregated prefill/decode and priced KV-page streaming.
+
+    from hetu_tpu.serving.cluster import EngineCluster
+
+    # replicated: every replica serves prefill+decode, requests placed
+    # on the replica whose prefix cache holds their longest prefix
+    cl = EngineCluster(state, cfg, num_replicas=3, num_pages=32,
+                       page_size=16, max_batch=4, chunk_size=16)
+    cl.add_request(prompt_ids, max_new_tokens=32)
+    outputs = cl.run()                 # {req_id: generated tokens}
+    print(cl.metrics_text())           # one exposition, replica-labeled
+
+    # disaggregated: prefill replicas stream KV pages to decode
+    # replicas through a priced PageTransport
+    cl = EngineCluster(state, cfg, num_replicas=2,
+                       mode="disaggregated", num_prefill=1, ...)
+
+See DESIGN.md §17: replica digests and the placement policy, handoff
+pricing through the planner's alpha-beta formulas, heartbeat-driven
+re-route on replica death, and why process-local hosts keep the CPU
+path honest.
+"""
+from .cluster import ClusterRequest, EngineCluster
+from .replica import DECODE, PREFILL, UNIFIED, Replica
+from .router import Router, digest_match_pages
+from .transport import LocalPageTransport, PageTransport
+
+__all__ = ["EngineCluster", "ClusterRequest", "Replica", "Router",
+           "PageTransport", "LocalPageTransport", "digest_match_pages",
+           "UNIFIED", "PREFILL", "DECODE"]

@@ -1,0 +1,599 @@
+"""The serving cluster plane: N engine replicas behind one front door.
+
+``EngineCluster`` scales the single-host engine out the way ROADMAP
+item 1 names: N ``serving.Engine`` replicas wrapped as process-local
+hosts (``replica.py``) registered through the ``rpc`` coordinator
+(heartbeat → health), a prefix-aware router (``router.py``) spreading
+request streams across them, and an optional **disaggregated** mode
+where dedicated prefill replicas compute prompt KV and stream the pages
+to dedicated decode replicas through a priced ``PageTransport``
+(``transport.py``).
+
+Two modes:
+
+* ``"replicated"`` (default) — every replica serves prefill+decode; the
+  router places each request on the replica whose prefix cache holds
+  its longest prefix (digest lookup), falling back to least-loaded,
+  with per-replica queue-depth backpressure.
+* ``"disaggregated"`` — the first ``num_prefill`` replicas ONLY
+  prefill: each request runs there with ``max_new_tokens=1`` (prefill +
+  first sampled token), then its KV pages are extracted, streamed
+  through the transport (priced via the planner's alpha-beta formulas),
+  injected into a decode replica's pool, and the request is ADOPTED
+  mid-flight (``Engine.adopt_request``) to continue decoding.  Temp-0
+  output is bit-for-bit the monolithic engine's (asserted in
+  tests/test_cluster.py): the decode replica reads byte-identical KV
+  through the identical kernel, and the position-keyed sampler makes
+  even sampled modes replay exactly.
+
+All replicas share ONE jitted unified-step program (identical shapes →
+one compile for the whole fleet), each registered for analysis under
+its own name (``{name}@r{i}/unified``).  A dead replica — missed
+heartbeats past the TTL, or an explicit :meth:`Replica.kill` — has its
+unfinished requests pulled back into the backlog and re-placed on
+survivors; no request is lost (completion-set equality asserted).
+
+Failure/consistency contract: a re-routed or preempted request replays
+from its accumulated tokens, so at temperature 0 (and under the
+seeded sampler) the final output is independent of deaths, handoffs,
+preemptions and placement — the same contract the single engine already
+made, extended across the fleet.
+"""
+from __future__ import annotations
+
+import heapq
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from ...obs.tracer import PrefixedTracer, get_tracer
+from ...utils.metrics import make_instrument, merge_prometheus_texts
+from ..engine import Engine
+from .replica import DECODE, PREFILL, UNIFIED, Replica
+from .router import Router
+from .transport import LocalPageTransport, PageTransport
+
+MODES = ("replicated", "disaggregated")
+
+
+@dataclass
+class ClusterRequest:
+    """One request as the CLUSTER sees it: stable identity across
+    placements (a death re-route or a prefill→decode handoff changes
+    which engine-level Request serves it, never which ClusterRequest
+    it is)."""
+    req_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    seed: int = 0
+    eos_token_id: Optional[int] = None
+    arrival_time: float = 0.0
+    submit_time: float = 0.0
+
+    # runtime
+    out_tokens: List[int] = field(default_factory=list)
+    token_times: List[float] = field(default_factory=list)
+    replica: Optional[int] = None     # current owner (engine placement)
+    prefill_replica: Optional[int] = None
+    stage: str = ""                   # "" | prefill | final
+    handoff_pending: bool = False
+    n_reroutes: int = 0
+    finish_time: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.finish_time is not None
+
+    @property
+    def first_token_time(self) -> Optional[float]:
+        return self.token_times[0] if self.token_times else None
+
+
+class _FollowTracer:
+    """Resolves the cluster's effective tracer at every use (injected
+    tracer, else the ambient global) — so ``obs.trace()`` around a
+    cluster run captures every replica without re-wiring engines."""
+
+    def __init__(self, cluster: "EngineCluster"):
+        self._cluster = cluster
+
+    def __getattr__(self, name):
+        return getattr(self._cluster.tracer, name)
+
+    def __len__(self) -> int:
+        return len(self._cluster.tracer)
+
+
+class EngineCluster:
+    def __init__(self, state: Dict[str, Any], cfg,
+                 num_replicas: int = 2, mode: str = "replicated",
+                 num_prefill: int = 1, name: str = "cluster",
+                 policy: str = "prefix",
+                 max_queue_depth: Optional[int] = None,
+                 heartbeat_interval: float = 0.25, ttl: float = 2.0,
+                 coordinator: bool = True,
+                 transport: Optional[PageTransport] = None,
+                 time_fn=None, tracer=None, seed: int = 0,
+                 metrics: bool = True, step_fn=None, **engine_kw):
+        if mode not in MODES:
+            raise ValueError(f"unknown mode {mode!r}; have {MODES}")
+        if num_replicas < 1:
+            raise ValueError("need at least one replica")
+        if mode == "disaggregated":
+            if num_replicas < 2:
+                raise ValueError("disaggregated mode needs >= 2 replicas")
+            if not (1 <= num_prefill < num_replicas):
+                raise ValueError(
+                    f"num_prefill must be in [1, {num_replicas - 1}], "
+                    f"got {num_prefill}")
+        self.name = name
+        self.mode = mode
+        self.cfg = cfg
+        self._time = time_fn or time.monotonic
+        self._tracer = tracer
+        follow = _FollowTracer(self)
+        self.transport = transport if transport is not None \
+            else LocalPageTransport()
+
+        # -- replica plane: coordinator + N engines sharing one compile
+        self.server = None
+        if coordinator:
+            from ...rpc.coordinator import (CoordinatorClient,
+                                            CoordinatorServer)
+            self.server = CoordinatorServer(world_size=num_replicas,
+                                            ttl=ttl).start()
+        roles = [UNIFIED] * num_replicas if mode == "replicated" else \
+            [PREFILL] * num_prefill + \
+            [DECODE] * (num_replicas - num_prefill)
+        self.replicas: List[Replica] = []
+        # one jitted program for the whole fleet: the first engine
+        # builds it (or the caller injects an already-warm one — e.g.
+        # a rolling restart reusing the old fleet's program)
+        shared_fn = step_fn
+        for i, role in enumerate(roles):
+            eng = Engine(state, cfg, name=f"{name}@r{i}",
+                         time_fn=self._time, metrics=metrics,
+                         tracer=PrefixedTracer(follow, f"r{i}/"),
+                         step_fn=shared_fn, **engine_kw)
+            if shared_fn is None:
+                shared_fn = eng._compiled["unified"]
+            client = None
+            if self.server is not None:
+                client = CoordinatorClient(self.server.address,
+                                           uid=f"{name}-r{i}", ttl=ttl)
+            self.replicas.append(Replica(
+                i, eng, role=role, client=client,
+                heartbeat_interval=heartbeat_interval))
+        if mode == "disaggregated":
+            # expose each decode replica's handoff records to the
+            # analysis plane: the kv-handoff-unpriced rule audits that
+            # every cross-replica page move carried a priced edge claim
+            from ...graph.graph import get_executable
+            for r in self.replicas:
+                if r.role == DECODE:
+                    h = get_executable(f"{r.engine.name}/unified")
+                    h.meta["kv_handoff"] = \
+                        (lambda t=self.transport, d=r.idx:
+                         t.records_for(d))
+
+        self.router = Router(policy=policy,
+                             max_queue_depth=max_queue_depth,
+                             seed=seed, tracer=follow,
+                             time_fn=self._time)
+        self._next_id = 0
+        self.steps = 0
+        self._backlog: List = []                      # heap
+        self._pending_handoffs: List[Dict[str, Any]] = []
+        # (replica idx, engine req id) -> (creq, stage): live ownership
+        self._placed: Dict = {}
+        self.requests: Dict[int, ClusterRequest] = {}
+        self.finished: Dict[int, ClusterRequest] = {}
+        self._dead_handled: set = set()
+        # reset-robust per-replica counter accumulation (see
+        # metrics_summary): replica -> counter -> (base, last_seen)
+        self._counter_acc: Dict[int, Dict[str, List[float]]] = \
+            {r.idx: {} for r in self.replicas}
+        m = metrics
+        self.counters = {k: make_instrument("counter", k, m) for k in
+                         ("requests_completed", "reroutes", "handoffs",
+                          "routed")}
+        self.histograms = {k: make_instrument("histogram", k, m) for k in
+                           ("ttft", "tbt", "request_latency")}
+
+    # -- tracer --------------------------------------------------------------
+
+    @property
+    def tracer(self):
+        return self._tracer if self._tracer is not None else get_tracer()
+
+    # -- submission ----------------------------------------------------------
+
+    def add_request(self, prompt_ids: Sequence[int], max_new_tokens: int,
+                    temperature: float = 0.0, top_k: int = 0,
+                    top_p: float = 0.0, seed: int = 0,
+                    eos_token_id: Optional[int] = None,
+                    arrival_time: Optional[float] = None
+                    ) -> ClusterRequest:
+        prompt = [int(t) for t in prompt_ids]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # fail at the front door, not on a replica mid-route: every
+        # replica shares the same engine configuration, so one pool
+        # speaks for the fleet (the engines re-check at submission)
+        pool = self.replicas[0].engine.pool
+        total = len(prompt) + int(max_new_tokens)
+        if total > self.replicas[0].engine.max_model_len:
+            raise ValueError(
+                f"prompt+max_new_tokens = {total} exceeds max_model_len "
+                f"{self.replicas[0].engine.max_model_len}")
+        if pool.pages_for(total) > pool.num_usable:
+            raise ValueError(
+                f"request needs {pool.pages_for(total)} pages; each "
+                f"replica pool has {pool.num_usable} — it could never "
+                f"run anywhere")
+        now = self._time()
+        creq = ClusterRequest(
+            req_id=self._next_id, prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p), seed=int(seed),
+            eos_token_id=eos_token_id,
+            arrival_time=now if arrival_time is None
+            else float(arrival_time))
+        creq.submit_time = max(now, creq.arrival_time)
+        self._next_id += 1
+        self.requests[creq.req_id] = creq
+        heapq.heappush(self._backlog,
+                       (creq.arrival_time, creq.req_id, creq))
+        tr = self.tracer
+        if tr.enabled:
+            tr.instant("enqueue", track="router", ts=creq.submit_time,
+                       req=creq.req_id, prompt_tokens=len(prompt),
+                       backlog=len(self._backlog))
+        return creq
+
+    # -- loop ----------------------------------------------------------------
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._backlog) or bool(self._pending_handoffs) \
+            or any(r.alive and r.engine.has_work for r in self.replicas)
+
+    def step(self) -> int:
+        """One cluster iteration: health check (re-route the dead
+        replicas' work), route ready backlog, land pending handoffs,
+        step every live engine.  Returns tokens emitted this step."""
+        now = self._time()
+        self._check_health()
+        self._sync_counters()
+        self._route_ready(now)
+        self._process_handoffs(now)
+        produced = 0
+        for r in self.replicas:
+            if r.alive and r.serving and r.engine.has_work:
+                produced += r.engine.step()
+        self._collect_finished()
+        self.steps += 1
+        return produced
+
+    def run(self, max_steps: Optional[int] = None
+            ) -> Dict[int, List[int]]:
+        while self.has_work:
+            if max_steps is not None and self.steps >= max_steps:
+                break
+            if not any(r.alive for r in self.replicas):
+                raise RuntimeError("no live replicas but work remains")
+            self.step()
+        return {rid: list(c.out_tokens)
+                for rid, c in self.finished.items()}
+
+    # -- health / re-route ---------------------------------------------------
+
+    def _check_health(self) -> None:
+        dead_ranks: set = set()
+        if self.server is not None:
+            dead_ranks = set(self.server.dead_ranks())
+        for r in self.replicas:
+            if r.idx in self._dead_handled:
+                continue
+            # with a coordinator, death is DECLARED only by missed
+            # heartbeats past the TTL (the replica may have stopped
+            # serving well before the verdict lands — exactly a real
+            # crash); without one, the stopped process is its own proof
+            died = (r.rank is not None and r.rank in dead_ranks) \
+                or (self.server is None and not r.serving) \
+                or (not r.alive)
+            if not died:
+                continue
+            r.alive = False
+            self._dead_handled.add(r.idx)
+            tr = self.tracer
+            if tr.enabled:
+                tr.instant("replica_dead", track="router",
+                           ts=self._time(), replica=r.idx)
+            for key in [k for k in self._placed if k[0] == r.idx]:
+                creq, _stage = self._placed.pop(key)
+                if creq.done or creq.handoff_pending:
+                    # a staged handoff survives its source's death: the
+                    # pages are already extracted host-side
+                    continue
+                self.router.note_reroute(creq, r.idx)
+                creq.n_reroutes += 1
+                creq.replica = None
+                creq.stage = ""
+                creq.token_times = []
+                self.counters["reroutes"].inc()
+                heapq.heappush(self._backlog,
+                               (creq.arrival_time, creq.req_id, creq))
+
+    # -- routing -------------------------------------------------------------
+
+    def _prefill_pool(self) -> List[Replica]:
+        if self.mode == "disaggregated":
+            pre = [r for r in self.replicas
+                   if r.role == PREFILL and r.alive]
+            if pre:
+                return pre
+            # every prefill replica died: the survivors serve requests
+            # end-to-end (monolithic degradation beats a dead cluster)
+        return list(self.replicas)
+
+    def _route_ready(self, now: float) -> None:
+        while self._backlog and self._backlog[0][0] <= now:
+            _arr, _rid, creq = self._backlog[0]
+            rep = self.router.place(creq, self._prefill_pool())
+            if rep is None:
+                break          # backpressured: FIFO holds, retry later
+            heapq.heappop(self._backlog)
+            self._submit(creq, rep, now)
+
+    def _submit(self, creq: ClusterRequest, rep: Replica,
+                now: float) -> None:
+        # a prefill stage only makes sense while a decode replica is
+        # alive to adopt the handoff — otherwise the placed replica
+        # serves the request end-to-end (so a dead decode fleet can't
+        # trap requests in a prefill→handoff→requeue loop)
+        has_decode = any(r.role == DECODE and r.alive
+                         for r in self.replicas)
+        stage = "prefill" if (self.mode == "disaggregated"
+                              and rep.role == PREFILL and has_decode
+                              and creq.max_new_tokens > 1) else "final"
+        mnt = 1 if stage == "prefill" else creq.max_new_tokens
+
+        def cb(ereq, tok, creq=creq, stage=stage, ridx=rep.idx):
+            creq.token_times.append(self._time())
+            if stage == "prefill":
+                if creq.eos_token_id is not None \
+                        and int(tok) == creq.eos_token_id:
+                    return     # eos on the first token: no decode stage
+                self._stage_handoff(creq, ereq, ridx, int(tok))
+
+        ereq = rep.engine.add_request(
+            creq.prompt, mnt, temperature=creq.temperature,
+            top_k=creq.top_k, top_p=creq.top_p, seed=creq.seed,
+            eos_token_id=creq.eos_token_id, arrival_time=now,
+            stream_cb=cb)
+        creq.replica = rep.idx
+        creq.stage = stage
+        if stage == "prefill":
+            creq.prefill_replica = rep.idx
+        self._placed[(rep.idx, ereq.req_id)] = (creq, stage)
+        self.counters["routed"].inc()
+
+    # -- disaggregated handoff ----------------------------------------------
+
+    def _stage_handoff(self, creq: ClusterRequest, ereq, src_idx: int,
+                       first_tok: int) -> None:
+        """Called from the prefill engine's emit path, while the pages
+        are still owned: extract them NOW (the engine retires them into
+        its prefix cache at finish), queue the injection."""
+        pool = self.replicas[src_idx].engine.pool
+        n = pool.pages_for(ereq.pos)
+        staged = self.transport.extract(pool, ereq.pages[:n])
+        creq.handoff_pending = True
+        self._pending_handoffs.append(
+            {"creq": creq, "staged": staged, "src": src_idx,
+             "first": int(first_tok), "pos": int(ereq.pos)})
+        tr = self.tracer
+        if tr.enabled:
+            tr.instant("handoff_staged", track="router",
+                       ts=self._time(), req=creq.req_id, src=src_idx,
+                       pages=int(staged["n_pages"]),
+                       payload_bytes=int(staged["payload_bytes"]))
+
+    def _process_handoffs(self, now: float) -> None:
+        still: List[Dict[str, Any]] = []
+        for h in self._pending_handoffs:
+            creq: ClusterRequest = h["creq"]
+            decode = [r for r in self.replicas
+                      if r.role == DECODE and r.alive]
+            cands = self.router.candidates(decode)
+            if not cands:
+                if not decode:
+                    # every decode replica died: replay from scratch on
+                    # whatever still lives (the backlog router decides)
+                    creq.handoff_pending = False
+                    creq.token_times = []
+                    creq.n_reroutes += 1
+                    self.counters["reroutes"].inc()
+                    heapq.heappush(self._backlog,
+                                   (creq.arrival_time, creq.req_id, creq))
+                    continue
+                still.append(h)          # backpressured: retry
+                continue
+            rep = min(cands, key=lambda r: (r.outstanding_tokens(),
+                                            r.idx))
+            pool = rep.engine.pool
+            n = pool.pages_for(h["pos"])
+            pages = None
+            if n <= pool.num_usable:
+                pages = pool.alloc(n)
+            if pages is None and n <= pool.num_usable:
+                still.append(h)          # pool full right now: retry
+                continue
+            if pages is not None:
+                rec = self.transport.inject(
+                    pool, h["staged"], pages, src_replica=h["src"],
+                    dst_replica=rep.idx)
+                self.counters["handoffs"].inc()
+                tr = self.tracer
+                if tr.enabled:
+                    tr.instant("handoff", track="router", ts=now,
+                               req=creq.req_id, src=h["src"],
+                               dst=rep.idx, pages=rec["pages"],
+                               payload_bytes=rec["payload_bytes"],
+                               predicted_wire_s=rec["predicted_s"])
+                pos = h["pos"]
+            else:
+                # pages can NEVER fit this decode pool: degrade to a
+                # full re-prefill on the decode replica (correct, just
+                # not disaggregated for this one request)
+                pos = 0
+            ereq = rep.engine.adopt_request(
+                creq.prompt, [h["first"]], creq.max_new_tokens,
+                pages=pages, pos=pos, temperature=creq.temperature,
+                top_k=creq.top_k, top_p=creq.top_p, seed=creq.seed,
+                eos_token_id=creq.eos_token_id, arrival_time=now,
+                stream_cb=self._final_cb(creq))
+            creq.handoff_pending = False
+            creq.replica = rep.idx
+            creq.stage = "final"
+            self._placed[(rep.idx, ereq.req_id)] = (creq, "final")
+        self._pending_handoffs = still
+
+    def _final_cb(self, creq: ClusterRequest):
+        def cb(ereq, tok, creq=creq):
+            creq.token_times.append(self._time())
+        return cb
+
+    # -- finish collection ---------------------------------------------------
+
+    def _collect_finished(self) -> None:
+        for r in self.replicas:
+            if not r.alive:
+                continue
+            for erid, ereq in list(r.engine.finished.items()):
+                ent = self._placed.pop((r.idx, erid), None)
+                if ent is None:
+                    continue              # not cluster-placed
+                # collected: drain it from the engine so this scan
+                # stays O(new finishes), not O(requests ever served)
+                del r.engine.finished[erid]
+                creq, stage = ent
+                if stage == "prefill" and creq.handoff_pending:
+                    # the decode stage owns the finish (staging always
+                    # precedes the prefill finish: the stream callback
+                    # runs inside the emit, before _maybe_finish)
+                    continue
+                # prefill stage without a staged handoff = eos on the
+                # first sampled token: the request IS complete
+                self._finish(creq, ereq)
+
+    def _finish(self, creq: ClusterRequest, ereq) -> None:
+        creq.out_tokens = list(ereq.out_tokens)
+        creq.finish_time = self._time()
+        self.finished[creq.req_id] = creq
+        self.counters["requests_completed"].inc()
+        if creq.token_times:
+            self.histograms["ttft"].observe(
+                creq.token_times[0] - creq.submit_time)
+            for a, b in zip(creq.token_times, creq.token_times[1:]):
+                self.histograms["tbt"].observe(b - a)
+        self.histograms["request_latency"].observe(
+            creq.finish_time - creq.submit_time)
+        tr = self.tracer
+        if tr.enabled:
+            tr.instant("finish", track="router", ts=creq.finish_time,
+                       req=creq.req_id, replica=creq.replica,
+                       new_tokens=len(creq.out_tokens),
+                       reroutes=creq.n_reroutes)
+
+    # -- replica management --------------------------------------------------
+
+    def kill_replica(self, idx: int) -> None:
+        """Simulate (or administratively force) a replica death: stops
+        its heartbeat and serving immediately; the next :meth:`step`
+        re-routes its unfinished requests."""
+        self.replicas[idx].kill()
+
+    def close(self) -> None:
+        for r in self.replicas:
+            r.close()
+        if self.server is not None:
+            self.server.stop()
+
+    def __enter__(self) -> "EngineCluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- aggregate metrics ---------------------------------------------------
+
+    def _replica_counter_total(self, r: Replica, key: str) -> float:
+        """Cumulative counter across the replica's resets: a current
+        value SMALLER than the last-seen one means ``reset_metrics``
+        ran — bank the last-seen total and keep counting, so the
+        cluster sum never double-counts nor loses a reset epoch.
+        :meth:`step` snapshots every counter BEFORE the engines run
+        (``_sync_counters``), so the monotonicity test can only miss a
+        reset raced by same-step regrowth — and counters only grow
+        inside the step, after the snapshot."""
+        cur = float(r.engine.counters[key].value)
+        acc = self._counter_acc[r.idx].setdefault(key, [0.0, 0.0])
+        if cur < acc[1]:
+            acc[0] += acc[1]
+        acc[1] = cur
+        return acc[0] + cur
+
+    def _sync_counters(self) -> None:
+        for r in self.replicas:
+            for key in r.engine.counters:
+                self._replica_counter_total(r, key)
+
+    def metrics_summary(self) -> Dict[str, Any]:
+        """Cluster-wide rollup: replica counters SUMMED (reset-robust),
+        cluster-level latency histograms, per-replica hit rates."""
+        out: Dict[str, Any] = {}
+        counter_keys = list(self.replicas[0].engine.counters)
+        for key in counter_keys:
+            out[key] = sum(self._replica_counter_total(r, key)
+                           for r in self.replicas)
+        hits = out.get("prefix_cache_hits", 0.0)
+        miss = out.get("prefix_cache_misses", 0.0)
+        out["prefix_cache_hit_rate"] = hits / max(hits + miss, 1.0)
+        for k, c in self.counters.items():
+            out[f"cluster_{k}"] = c.value
+        for k, h in self.histograms.items():
+            out[k] = h.summary()
+        out["replicas"] = len(self.replicas)
+        out["alive_replicas"] = sum(1 for r in self.replicas if r.alive)
+        out["backlog"] = len(self._backlog)
+        out["pending_handoffs"] = len(self._pending_handoffs)
+        out["per_replica"] = {
+            f"r{r.idx}": {
+                "alive": r.alive, "role": r.role,
+                "queue_depth": r.queue_depth(),
+                "outstanding_tokens": r.outstanding_tokens(),
+                "cached_pages": r.engine.pool.cached_pages,
+                "prefix_cache_hit_rate":
+                    r.engine.metrics_summary()["prefix_cache_hit_rate"],
+            } for r in self.replicas}
+        out["handoff_payload_bytes"] = getattr(
+            self.transport, "total_payload_bytes", 0)
+        out["handoff_predicted_s"] = getattr(
+            self.transport, "total_predicted_s", 0.0)
+        return out
+
+    def metrics_text(self) -> str:
+        """One Prometheus exposition for the fleet: every replica's
+        ``Engine.metrics_text()`` merged under a ``replica`` label
+        (``utils.metrics.merge_prometheus_texts``)."""
+        return merge_prometheus_texts(
+            {f"r{r.idx}": r.engine.metrics_text()
+             for r in self.replicas}, label="replica")

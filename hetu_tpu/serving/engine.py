@@ -91,7 +91,7 @@ class Engine:
                  time_fn: Optional[Callable[[], float]] = None,
                  name: str = "serving", analysis_tap: bool = True,
                  prefix_cache: bool = True, debug: bool = False,
-                 tracer=None):
+                 tracer=None, step_fn: Optional[Callable] = None):
         self.cfg = cfg
         self.name = name
         # runtime trace plane (hetu_tpu/obs): None follows the ambient
@@ -177,9 +177,13 @@ class Engine:
                                                "request_latency", m),
         }
         # THE executable: fixed (max_seqs, chunk, prefill_rows) shapes,
-        # compiled exactly once — no bucket grid, no per-request prefill
+        # compiled exactly once — no bucket grid, no per-request prefill.
+        # ``step_fn`` lets N identically-shaped engines (cluster
+        # replicas) share ONE jitted program: the jit cache keys on
+        # argument shapes, so the whole replica fleet compiles once.
         self._compiled: Dict[str, Callable] = {
-            "unified": build_unified_step_fn(
+            "unified": step_fn if step_fn is not None
+            else build_unified_step_fn(
                 cfg, self.scheduler.max_batch, self.scheduler.chunk,
                 self.scheduler.prefill_rows, self.max_pages_per_seq,
                 page_size, use_kernel=self.use_kernel)}
@@ -233,6 +237,84 @@ class Engine:
                        ts=req.submit_time, req=req.req_id,
                        prompt_tokens=len(prompt),
                        max_new_tokens=int(max_new_tokens),
+                       queue_depth=len(self.queue))
+        return req
+
+    def adopt_request(self, prompt: Sequence[int],
+                      generated: Sequence[int], max_new_tokens: int,
+                      pages: Optional[Sequence[int]] = None,
+                      pos: int = 0, temperature: float = 0.0,
+                      top_k: int = 0, top_p: float = 0.0, seed: int = 0,
+                      eos_token_id: Optional[int] = None,
+                      arrival_time: Optional[float] = None,
+                      stream_cb: Optional[Callable] = None) -> Request:
+        """Admit a MID-FLIGHT request: ``generated`` tokens already
+        sampled elsewhere and (optionally) ``pages`` in THIS engine's
+        pool already holding KV for positions ``[0, pos)`` — the
+        disaggregated prefill→decode handoff entry point
+        (``serving/cluster``): a prefill replica finishes the prompt,
+        the transport copies its pages into this pool, and decode
+        resumes here from ``pos`` without recomputing the prefill.
+
+        The adopted request rides the normal admission path (WAITING →
+        ``_start`` grants any additional pages → packed steps), so
+        backpressure, preemption and tracing all behave normally; a
+        preemption falls back to local re-prefill of the full
+        accumulated sequence, which reproduces the identical
+        continuation at temperature 0 (and under the position-keyed
+        sampler for every mode).  Sampling params must match the
+        original request or the continuation diverges by design."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        generated = [int(t) for t in
+                     np.asarray(generated, np.int64).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(generated) >= max_new_tokens:
+            raise ValueError("request already finished: "
+                             f"{len(generated)} >= {max_new_tokens}")
+        total = len(prompt) + int(max_new_tokens)
+        if total > self.max_model_len:
+            raise ValueError(
+                f"prompt+max_new_tokens = {total} exceeds max_model_len "
+                f"{self.max_model_len}")
+        if self.pool.pages_for(total) > self.pool.num_usable:
+            # same guard as add_request: a request the pool can never
+            # hold would otherwise defer at admission forever
+            raise ValueError(
+                f"request needs {self.pool.pages_for(total)} pages; pool "
+                f"has {self.pool.num_usable} — it could never run")
+        pages = list(pages or ())
+        pos = int(pos)
+        if pos > len(prompt) + len(generated):
+            raise ValueError(f"pos {pos} past the accumulated tokens")
+        if pos and len(pages) < self.pool.pages_for(pos):
+            raise ValueError(
+                f"pages cover {len(pages) * self.pool.page_size} tokens "
+                f"but pos is {pos}")
+        now = self._now()
+        req = Request(req_id=self._next_id, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature), top_k=int(top_k),
+                      top_p=float(top_p), seed=int(seed),
+                      eos_token_id=eos_token_id,
+                      arrival_time=now if arrival_time is None
+                      else float(arrival_time), stream_cb=stream_cb)
+        req.tokens = prompt + generated
+        req.out_tokens = list(generated)
+        req.pages = pages
+        req.peak_pages = len(pages)
+        req.pos = pos
+        req.submit_time = max(now, req.arrival_time)
+        req.trace_t0 = req.submit_time
+        self._next_id += 1
+        self.queue.push(req)
+        tr = self.tracer
+        if tr.enabled:
+            tr.instant("adopt", track=f"req {req.req_id}",
+                       ts=req.submit_time, req=req.req_id,
+                       prompt_tokens=len(prompt),
+                       generated_tokens=len(generated), pos=pos,
+                       handoff_pages=len(pages),
                        queue_depth=len(self.queue))
         return req
 
@@ -380,13 +462,17 @@ class Engine:
             # a cached page the budget counted on): roll back and retry
             # next step — never crash the loop on a page race.  Counters
             # deliberately untouched: the retried start is the SAME
-            # logical start, not a second hit/miss
-            if self.prefix_cache is not None and req.shared_pages:
-                self.prefix_cache.release(req)
-            req.pages = []
-            req.shared_pages = 0
-            req.cached_tokens = 0
-            req.pos = 0
+            # logical start, not a second hit/miss.  Only the cache
+            # attach this call made is undone — an ADOPTED request
+            # (handoff pages pre-attached, pos past the prompt) keeps
+            # its pages and cursor for the retry
+            if looked_up:
+                if self.prefix_cache is not None and req.shared_pages:
+                    self.prefix_cache.release(req)
+                req.pages = []
+                req.shared_pages = 0
+                req.cached_tokens = 0
+                req.pos = 0
             self.queue.push(req)
             return
         if looked_up:

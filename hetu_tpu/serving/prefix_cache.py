@@ -55,12 +55,56 @@ re-enters the free list, so the index never references a writable page.
 """
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from .kv_pool import PagedKVPool
 
 ROOT = -1                       # parent id of a first-page entry
+
+#: seed of the content-chained digest hashes (the "hash of the empty
+#: prefix") — any fixed 64-bit value works; sharing it between
+#: :func:`chain_hash` producers and consumers is what matters
+ROOT_HASH = 0x9E3779B97F4A7C15
+
+
+def chain_hash(parent_hash: int, page_tokens: Sequence[int]) -> int:
+    """Content-chained 64-bit page hash: ``H(parent_hash, tokens)``.
+
+    The in-process index chains by ``(parent_eid, tokens)`` tuple keys —
+    exact, but entry ids are private to one cache.  The CLUSTER router
+    needs a prefix key that two *different* replicas compute
+    identically from token content alone, so the exported digest chains
+    by hash instead: equal chain hashes imply equal full token prefixes
+    up to 64-bit collision odds (~2^-32 across millions of pages —
+    fine for *placement*, which is a heuristic; correctness still rides
+    the exact in-replica index at admission time)."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(int(parent_hash).to_bytes(8, "little", signed=False))
+    h.update(np.asarray(list(page_tokens), np.int64).tobytes())
+    return int.from_bytes(h.digest(), "little")
+
+
+def token_chain_hashes(tokens: Sequence[int], page_size: int,
+                       max_pages: Optional[int] = None) -> List[int]:
+    """The chain hashes of every FULL page prefix of ``tokens`` (at most
+    ``max_pages``; default caps at ``(len - 1) // page_size`` exactly
+    like :meth:`PrefixCache.match` — the final prompt token must always
+    run).  ``result[i]`` keys the prefix ``tokens[:(i+1)*page_size]``;
+    the router probes replica digests with these."""
+    ps = int(page_size)
+    n = max(0, len(tokens) - 1) // ps
+    if max_pages is not None:
+        n = min(n, int(max_pages))
+    out: List[int] = []
+    h = ROOT_HASH
+    for i in range(n):
+        h = chain_hash(h, tokens[i * ps:(i + 1) * ps])
+        out.append(h)
+    return out
 
 
 @dataclass
@@ -101,6 +145,33 @@ class PrefixCache:
         too (sharers of a child share its parents), so leaf-first
         eviction reaches every one of them."""
         return sum(1 for e in self._index.values() if e.refs == 0)
+
+    @property
+    def version(self) -> Tuple[int, int]:
+        """Cheap change stamp for digest memoization: ``_next_id``
+        moves on every insertion and the index size on every eviction,
+        so any mutation sequence changes the pair (a dedup'd re-insert
+        creates no entry and correctly leaves the digest unchanged)."""
+        return (self._next_id, len(self._index))
+
+    def digest(self) -> Dict[int, int]:
+        """Compact content-chained snapshot of the cached prefix tree:
+        ``{chain_hash: depth + 1}`` — one 64-bit key per cached page,
+        position-stamped so a router can read "this replica holds the
+        first ``depth+1`` pages of any prompt whose page-``depth`` chain
+        hash is ``chain_hash``".  Entries are computed parents-first
+        (sorted by depth), so each hash extends its parent's in O(1);
+        the whole export is O(cached pages) — tens to hundreds of
+        entries, cheap enough to refresh per routing sync."""
+        hashes: Dict[int, int] = {}        # eid -> chain hash
+        out: Dict[int, int] = {}
+        for e in sorted(self._index.values(), key=lambda e: e.depth):
+            parent_h = ROOT_HASH if e.parent == ROOT \
+                else hashes[e.parent]
+            h = chain_hash(parent_h, e.tokens)
+            hashes[e.eid] = h
+            out[h] = e.depth + 1
+        return out
 
     # -- lookup / attach -----------------------------------------------------
 

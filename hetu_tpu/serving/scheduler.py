@@ -61,8 +61,14 @@ class Scheduler:
     def admit(self, queue: RequestQueue, running: List[Request],
               now: float) -> List[Request]:
         """Pop arrived requests while a sequence slot AND the pages for
-        prompt+first-token fit.  Stops at the first request that doesn't
-        fit (FIFO — no small-request overtaking, keeps TTFT fair).
+        prompt+first-token fit.  FRESH requests stop at the first that
+        doesn't fit (FIFO — no small-request overtaking, keeps TTFT
+        fair); a PAGE-HOLDING request (disaggregated-handoff adoption:
+        pages already attached while WAITING) may overtake a blocked
+        head.  That overtake is the deadlock breaker, not a fairness
+        leak: a page-holder behind a blocked head means nothing is
+        running and nothing will free pages — admitting the holder lets
+        it finish and return exactly the pages the head is waiting for.
 
         With a prefix cache, a candidate is charged only its UNCACHED
         suffix: matched pages come for free, and refcount-0 cached pages
@@ -70,6 +76,7 @@ class Scheduler:
         on demand at ``_start``) — except the matched ones themselves,
         which this admission is about to pin."""
         admitted: List[Request] = []
+        deferred: List[Request] = []
         # free pages + LRU-reclaimable cached pages not yet claimed
         budget = self.pool.free_pages
         if self.cache is not None:
@@ -79,18 +86,45 @@ class Scheduler:
             req = queue.pop_ready(now)
             if req is None:
                 break
-            need = self.pool.pages_for(len(req.tokens) + 1)
-            if self.cache is not None:
+            if deferred and not req.pages:
+                # fresh-FIFO behind a block: only page-holders may
+                # still admit, so skip the match/pin work entirely —
+                # under a deep backlog this keeps the scan O(ready),
+                # not O(ready x prompt pages)
+                deferred.append(req)
+                continue
+            # an adopted request brings its own pages — charge only
+            # what it still lacks.  Cache matching mirrors _start's
+            # lookup condition exactly (fresh pos-0 requests only):
+            # charging a cached page the start path won't attach would
+            # wedge admission the same way ignoring owned pages did
+            need = self.pool.pages_for(len(req.tokens) + 1) \
+                - len(req.pages)
+            new_pins = []
+            if self.cache is not None and req.pos == 0 and not req.pages:
                 for e in self.cache.match(req.tokens):
                     need -= 1          # cached page: nothing to allocate
                     if e.refs == 0 and e.eid not in pinned:
                         budget -= 1    # ...but it is no longer evictable
                         pinned.add(e.eid)
+                        new_pins.append(e.eid)
+            need = max(0, need)
             if need > budget:
-                queue.push(req)        # original arrival order: stays first
-                break
+                # blocked: the scan continues only so page-holders
+                # further back can still admit.  The pins THIS
+                # candidate took are rolled back — a deferred request
+                # must not shrink the budget later page-holders see, or
+                # the overtake stops working exactly when nothing is
+                # running to free pages
+                for eid in new_pins:
+                    pinned.discard(eid)
+                    budget += 1
+                deferred.append(req)
+                continue
             budget -= need
             admitted.append(req)
+        for req in deferred:
+            queue.push(req)            # heap order restores FIFO
         return admitted
 
     # -- token-budget packing ------------------------------------------------

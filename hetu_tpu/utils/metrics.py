@@ -327,6 +327,60 @@ def render_prometheus(instruments) -> str:
     return "\n".join(lines) + "\n" if lines else ""
 
 
+def merge_prometheus_texts(texts: Dict[str, str],
+                           label: str = "replica") -> str:
+    """Merge several Prometheus expositions into one, tagging every
+    sample with ``label="<key>"`` — the cluster's ``metrics_text()``
+    merges per-replica ``Engine.metrics_text()`` outputs this way, so
+    one scrape endpoint serves the whole replica fleet and dashboards
+    slice by the ``replica`` label.
+
+    Samples are regrouped per metric (one ``# TYPE`` line per metric
+    name, first-seen kind wins, then every labeled sample), which keeps
+    the output a valid exposition: Prometheus requires all samples of a
+    metric to be contiguous under its single TYPE header."""
+    import re
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(.*)$")
+    kinds: Dict[str, str] = {}
+    samples: Dict[str, List[str]] = {}
+    order: List[str] = []
+    for key, text in texts.items():
+        tag = f'{_prom_name(label)}="{key}"'
+        for line in (text or "").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("#"):
+                parts = line.split()
+                if len(parts) >= 4 and parts[1] == "TYPE":
+                    kinds.setdefault(parts[2], parts[3])
+                continue
+            m = sample_re.match(line)
+            if m is None:
+                continue
+            name, labels, value = m.groups()
+            inner = (labels or "{}")[1:-1]
+            labels = "{" + (f"{inner},{tag}" if inner else tag) + "}"
+            # histogram series (_bucket/_sum/_count) group under the
+            # base metric's TYPE header, like the scrape format expects
+            base = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and name[:-len(suffix)] in kinds:
+                    base = name[:-len(suffix)]
+                    break
+            if base not in samples:
+                samples[base] = []
+                order.append(base)
+            samples[base].append(f"{name}{labels} {value}")
+    lines: List[str] = []
+    for base in order:
+        if base in kinds:
+            lines.append(f"# TYPE {base} {kinds[base]}")
+        lines.extend(samples[base])
+    return "\n".join(lines) + "\n" if lines else ""
+
+
 def load_jsonl(path: str) -> List[Dict[str, Any]]:
     """Read back a Metrics JSONL stream."""
     out = []

@@ -1,0 +1,79 @@
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import hetu_tpu as ht
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.models.generate import generate
+from hetu_tpu.serving import EngineCluster
+
+cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+                max_seq_len=64, sp=False, dropout=0.0)
+ht.set_seed(3)
+with ht.graph("eager", create_new=True):
+    model = GPTLMHeadModel(cfg)
+    model.logits(np.zeros((1, 4), np.int32))
+    state = {k: np.asarray(v) for k, v in model.state_dict().items()}
+
+rng = np.random.RandomState(0)
+prompts = [rng.randint(1, 97, size=n).tolist() for n in (5, 9, 12, 7)]
+NEW = 6
+
+def solo(p):
+    return np.asarray(generate(state, cfg, np.asarray([p], np.int32),
+                               NEW, temperature=0.0))[0, len(p):].tolist()
+
+want = [solo(p) for p in prompts]
+
+# --- replicated mode ---
+clock = [0.0]
+cl = EngineCluster(state, cfg, num_replicas=2, name="smoke",
+                   num_pages=16, page_size=8, max_batch=4, chunk_size=8,
+                   time_fn=lambda: clock[0], heartbeat_interval=0.05,
+                   ttl=60.0)
+reqs = [cl.add_request(p, NEW, arrival_time=0.0) for p in prompts]
+n = 0
+while cl.has_work and n < 200:
+    cl.step(); clock[0] += 1.0; n += 1
+out = {r.req_id: r.out_tokens for r in reqs}
+assert all(out[i] == want[i] for i in range(len(prompts))), (out, want)
+print("replicated OK", {i: len(out[i]) for i in out})
+print("summary:", {k: v for k, v in cl.metrics_summary().items()
+                   if k in ("requests_completed", "cluster_routed",
+                            "prefix_cache_hit_rate", "alive_replicas")})
+txt = cl.metrics_text()
+assert 'replica="r0"' in txt and 'replica="r1"' in txt
+cl.close()
+
+# --- disaggregated mode ---
+clock2 = [0.0]
+cl2 = EngineCluster(state, cfg, num_replicas=2, mode="disaggregated",
+                    num_prefill=1, name="smoke2",
+                    num_pages=16, page_size=8, max_batch=4, chunk_size=8,
+                    time_fn=lambda: clock2[0], heartbeat_interval=0.05,
+                    ttl=60.0)
+reqs2 = [cl2.add_request(p, NEW, arrival_time=float(i))
+         for i, p in enumerate(prompts)]
+n = 0
+while cl2.has_work and n < 300:
+    cl2.step(); clock2[0] += 1.0; n += 1
+out2 = {r.req_id: r.out_tokens for r in reqs2}
+assert all(out2[i] == want[i] for i in range(len(prompts))), (out2, want)
+ms = cl2.metrics_summary()
+print("disagg OK; handoffs:", ms["cluster_handoffs"],
+      "payload:", ms["handoff_payload_bytes"],
+      "pred_s:", ms["handoff_predicted_s"])
+assert ms["cluster_handoffs"] == len(prompts)
+assert len(cl2.transport.records) == len(prompts)
+assert all(r["predicted_s"] > 0 for r in cl2.transport.records)
+
+# rule check on the decode replica
+from hetu_tpu import analysis
+rep = analysis.analyze_registered("smoke2@r1/")
+print("decode replica findings:", rep.total_findings if hasattr(rep, "total_findings") else
+      sum(len(e.findings) for e in rep.executables.values()))
+for name, e in rep.executables.items():
+    for f in e.findings:
+        print("  !", name, f)
+cl2.close()
+print("ALL SMOKE OK")

@@ -320,6 +320,37 @@ class TestSeededViolations:
                                serving={"pool": pool2, "tap": tap_ok})
         assert not run_rules(ctx3, only=["trash-page-write"])
 
+    def test_kv_handoff_unpriced_fires_once_per_seed(self):
+        """Serving-cluster handoff contract (ISSUE 11): a cross-replica
+        KV-page move whose record lacks the priced edge claim fires
+        exactly once; a fully-priced record (what LocalPageTransport
+        writes) is silent, and executables without kv_handoff meta are
+        out of scope."""
+        priced = {"src": 0, "dst": 1, "pages": 3, "payload_bytes": 3072,
+                  "edge": {"kind": "ppermute", "payload_bytes": 3072,
+                           "count": 1, "tag": "kv_handoff"},
+                  "predicted_s": 1.2e-6, "wall_s": 0.001}
+        # seed 1: no predicted time at all
+        bad = dict(priced, predicted_s=None)
+        ctx = AnalysisContext(name="t_handoff",
+                              meta={"kv_handoff": [priced, bad]})
+        fired = run_rules(ctx, only=["kv-handoff-unpriced"])
+        assert len(fired) == 1 and fired[0].severity == "error"
+        assert "handoff@1" in fired[0].subject
+        # seed 2: edge payload disagrees with the bytes actually moved
+        lying = dict(priced, edge=dict(priced["edge"],
+                                       payload_bytes=1))
+        ctx2 = AnalysisContext(name="t_handoff2",
+                               meta={"kv_handoff": [lying]})
+        fired2 = run_rules(ctx2, only=["kv-handoff-unpriced"])
+        assert len(fired2) == 1 and "1 B" in fired2[0].message
+        # exemptions: a priced record, a callable hook, and no meta
+        ctx3 = AnalysisContext(name="t_handoff3",
+                               meta={"kv_handoff": lambda: [priced]})
+        assert not run_rules(ctx3, only=["kv-handoff-unpriced"])
+        ctx4 = AnalysisContext(name="t_handoff4", meta={})
+        assert not run_rules(ctx4, only=["kv-handoff-unpriced"])
+
     def test_cow_page_write_fires_once_per_seed(self):
         """Copy-on-write contract: a unified-step tap record whose KV
         write plan targets a CACHED page (in the refcount snapshot —

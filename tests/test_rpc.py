@@ -91,6 +91,29 @@ def test_heartbeat_failure_detection():
         assert b.rank not in dead2
 
 
+def test_per_client_ttl_and_server_ttl():
+    """ISSUE 11 satellite: liveness TTL is configurable per client (a
+    serving router wants a sub-second failure window, a training
+    monitor wants a lax one — same coordinator) and per server
+    (``dead_ranks()`` with no argument uses the instance TTL)."""
+    with CoordinatorServer(world_size=2, ttl=0.2) as srv:
+        fast = CoordinatorClient(srv.address, uid="fast", ttl=0.2)
+        lax = CoordinatorClient(srv.address, uid="lax", ttl=30.0)
+        fast.connect(), lax.connect()
+        time.sleep(0.35)           # neither heartbeats after connect
+        # the fast client's default TTL sees both ranks dead...
+        alive_f, dead_f = fast.alive()
+        assert set(dead_f) == {fast.rank, lax.rank}
+        # ...the lax client's default TTL sees both alive...
+        alive_l, dead_l = lax.alive()
+        assert dead_l == [] and set(alive_l) == {fast.rank, lax.rank}
+        # ...an explicit argument still overrides either default...
+        assert lax.alive(ttl=0.2)[1] == sorted([fast.rank, lax.rank])
+        # ...and the server-side monitor uses ITS configured default
+        assert srv.dead_ranks() == sorted([fast.rank, lax.rank])
+        assert srv.dead_ranks(ttl=30.0) == []
+
+
 def test_jax_coordinator_exchange():
     with CoordinatorServer(world_size=2) as srv:
         a = CoordinatorClient(srv.address, uid="a")
