@@ -1282,6 +1282,237 @@ def bench_router_bench() -> dict:
     return result
 
 
+def bench_chaos_bench() -> dict:
+    """Fault-plane bench (ISSUE 13): goodput and TTFT p99 under a FIXED
+    fault schedule (decode-replica crash + transport drop/dup/delay)
+    vs the fault-free run of the same trace, recovery time from the
+    kill to the first re-routed token, and the elastic trainer's MTTR
+    for an injected worker death — frozen into ``BENCH_CHAOS.json``
+    with the acceptance booleans ``no_request_lost``,
+    ``bitwise_survivors``, ``recovery_under_2s`` and
+    ``loss_curve_continues``.
+
+    Runs in a subprocess (cpu-pinned, 8 virtual devices for the
+    trainer half) like the other bench targets, so a wedged backend
+    can never hang the driver."""
+    code = (
+        "import os, sys, json, time\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from hetu_tpu.models import GPTConfig\n"
+        "from hetu_tpu.serving import EngineCluster\n"
+        "from hetu_tpu.fault import (ChaosController, FaultEvent,\n"
+        "                            FaultPlan)\n"
+        "H, L, V, NH, NKV = 64, 2, 512, 8, 4\n"
+        "cfg = GPTConfig(vocab_size=V, hidden_size=H, num_layers=L,\n"
+        "                num_heads=NH, num_kv_heads=NKV, max_seq_len=512,\n"
+        "                sp=False, dropout=0.0, position='rotary',\n"
+        "                norm='rmsnorm', activation='silu',\n"
+        "                tie_embeddings=True)\n"
+        "hd, f = cfg.head_dim, cfg.ffn_size\n"
+        "rng = np.random.RandomState(0)\n"
+        "def w(*s):\n"
+        "    return (rng.randn(*s) * 0.02).astype(np.float32)\n"
+        "state = {'wte.weight': w(V, H),\n"
+        "         'ln_f.weight': np.ones(H, np.float32)}\n"
+        "for i in range(L):\n"
+        "    state[f'h{i}.ln_1.weight'] = np.ones(H, np.float32)\n"
+        "    state[f'h{i}.ln_2.weight'] = np.ones(H, np.float32)\n"
+        "    state[f'h{i}.attn.qkv.weight'] = w((NH + 2 * NKV) * hd, H)\n"
+        "    state[f'h{i}.attn.out.weight'] = w(H, NH * hd)\n"
+        "    state[f'h{i}.mlp.up.weight'] = w(f, H)\n"
+        "    state[f'h{i}.mlp.down.weight'] = w(H, f)\n"
+        "PS, NEW, N_REQ = 8, 8, 24\n"
+        "KILL_AT_S = 0.12\n"
+        "SHAPES = dict(page_size=PS, max_batch=4, chunk_size=16,\n"
+        "              prefill_rows=1, max_model_len=120)\n"
+        "trace = []\n"
+        "t = 0.0\n"
+        "for i in range(N_REQ):\n"
+        "    t += float(rng.exponential(0.01))\n"
+        "    trace.append((t, rng.randint(1, V, size=24).tolist()))\n"
+        "\n"
+        "def run(name, plan=None, fn=None):\n"
+        "    cl = EngineCluster(state, cfg, num_replicas=3,\n"
+        "                       mode='disaggregated', num_prefill=1,\n"
+        "                       name=name, coordinator=False,\n"
+        "                       num_pages=16, step_fn=fn, seed=1,\n"
+        "                       **SHAPES)\n"
+        "    cl.add_request(trace[0][1], 2)   # warm/compile\n"
+        "    cl.run()\n"
+        "    chaos = None\n"
+        "    if plan is not None:\n"
+        "        chaos = ChaosController(plan)\n"
+        "        cl.chaos = chaos\n"
+        "    t0 = time.monotonic()\n"
+        "    reqs = [cl.add_request(p, NEW, arrival_time=t0 + dt)\n"
+        "            for dt, p in trace]\n"
+        "    # the crash is triggered at a fixed TRACE-TIME offset (a\n"
+        "    # wall-clock trace reaches any given step index in\n"
+        "    # microseconds while the backlog waits on arrivals, so a\n"
+        "    # step-keyed kill would always beat the traffic); the\n"
+        "    # transport faults stay on the deterministic attempt\n"
+        "    # ordinals of the FaultPlan\n"
+        "    kill_ts = None\n"
+        "    while cl.has_work:\n"
+        "        cl.step()\n"
+        "        if plan is not None and kill_ts is None \\\n"
+        "                and time.monotonic() - t0 > KILL_AT_S:\n"
+        "            cl.kill_replica(1)\n"
+        "            kill_ts = time.monotonic()\n"
+        "    wall = time.monotonic() - t0\n"
+        "    ms = cl.metrics_summary()\n"
+        "    ttft = cl.histograms['ttft']\n"
+        "    out = {\n"
+        "      'wall_s': round(wall, 2),\n"
+        "      'goodput_tok_per_s': round(N_REQ * NEW / wall, 1),\n"
+        "      'ttft_p50_ms': round(ttft.percentile(50) * 1e3, 1),\n"
+        "      'ttft_p99_ms': round(ttft.percentile(99) * 1e3, 1),\n"
+        "      'completed': int(ms['cluster_requests_completed']) - 1,\n"
+        "      'replica_deaths': int(ms['replica_deaths']),\n"
+        "      'requests_rerouted': int(ms['requests_rerouted']),\n"
+        "      'handoff_retries': int(ms['handoff_retries']),\n"
+        "      'handoffs_restaged': int(ms['handoffs_restaged']),\n"
+        "      'stale_completions_dropped':\n"
+        "          int(ms['stale_completions_dropped']),\n"
+        "      'duplicate_deliveries_dropped':\n"
+        "          int(ms['duplicate_deliveries_dropped']),\n"
+        "      'requests_shed': int(ms['requests_shed']),\n"
+        "    }\n"
+        "    outs = {r.req_id: list(r.out_tokens) for r in reqs}\n"
+        "    # recovery time: kill instant -> first token of a\n"
+        "    # re-routed request delivered after it\n"
+        "    rec_s = None\n"
+        "    if kill_ts is not None:\n"
+        "        cand = [r.token_times[0] for r in reqs\n"
+        "                if r.n_reroutes > 0 and r.token_times\n"
+        "                and r.token_times[0] >= kill_ts]\n"
+        "        if cand:\n"
+        "            rec_s = min(cand) - kill_ts\n"
+        "    fn_out = cl.replicas[0].engine._compiled['unified']\n"
+        "    cl.close()\n"
+        "    return out, outs, rec_s, fn_out\n"
+        "\n"
+        "free, free_outs, _, fn = run('cb_free')\n"
+        "# the fixed fault schedule: kill decode replica 1 (the first\n"
+        "# least-loaded pick, so it holds adopted work) mid-trace, drop\n"
+        "# the first injection attempt, dup + delay two more\n"
+        "plan = FaultPlan(\n"
+        "    transport={0: ('drop', 0.0), 2: ('dup', 0.0),\n"
+        "               3: ('delay', 0.02)})\n"
+        "chaos, chaos_outs, rec_s, fn = run('cb_chaos', plan, fn)\n"
+        "\n"
+        "# -- trainer MTTR: injected worker death, dp8 -> dp4 ---------\n"
+        "import hetu_tpu as ht\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from hetu_tpu.elastic import (FaultTolerantTrainer, TrainBuild,\n"
+        "                              WorkerMonitor)\n"
+        "from hetu_tpu.graph import ctor\n"
+        "from hetu_tpu.models import GPTLMHeadModel, llama_config\n"
+        "from hetu_tpu.parallel import create_mesh\n"
+        "def build_fn(dp, devices):\n"
+        "    ctor._seed_counter[0] = 777\n"
+        "    mesh = create_mesh({'dp': dp}, devices[:dp])\n"
+        "    tcfg = llama_config(vocab_size=64, hidden_size=32,\n"
+        "                        num_layers=1, num_heads=4,\n"
+        "                        max_seq_len=16, sp=False)\n"
+        "    gctx = ht.graph('define_and_run', create_new=True,\n"
+        "                    mesh=mesh)\n"
+        "    g = gctx.__enter__()\n"
+        "    ids = ht.parallel_placeholder('int32', (8, 16),\n"
+        "                                  pspec=P('dp', None),\n"
+        "                                  name='ids')\n"
+        "    labels = ht.parallel_placeholder('int32', (8, 16),\n"
+        "                                     pspec=P('dp', None),\n"
+        "                                     name='labels')\n"
+        "    model = GPTLMHeadModel(tcfg)\n"
+        "    loss = model(ids, labels)\n"
+        "    opt = ht.optim.AdamOptimizer(lr=1e-2, zero=2,\n"
+        "                                 grad_comm='fp32',\n"
+        "                                 flat_state=True)\n"
+        "    train_op = opt.minimize(loss)\n"
+        "    drng = np.random.RandomState(0)\n"
+        "    IDS = drng.randint(0, 64, (8, 16)).astype(np.int32)\n"
+        "    feed = {ids: IDS, labels: np.roll(IDS, -1, axis=1)}\n"
+        "    def step_fn(step):\n"
+        "        out = g.run(loss, [loss, train_op], feed)\n"
+        "        return float(np.asarray(out[0]))\n"
+        "    return TrainBuild(graph=g, model=model, optimizer=opt,\n"
+        "                      step_fn=step_fn,\n"
+        "                      close=lambda: gctx.__exit__(None, None,\n"
+        "                                                  None))\n"
+        "devices = jax.devices()[:8]\n"
+        "STEPS = 8\n"
+        "ref_build = build_fn(8, devices)\n"
+        "ref = [ref_build.step_fn(i) for i in range(STEPS)]\n"
+        "ref_build.close()\n"
+        "mon = WorkerMonitor(4, devices, ttl=0.3,\n"
+        "                    heartbeat_interval=0.05)\n"
+        "trainer = FaultTolerantTrainer(build_fn, devices, monitor=mon,\n"
+        "                               checkpoint_dir='/tmp/cb_ck',\n"
+        "                               checkpoint_every=2)\n"
+        "tplan = FaultPlan(events=[FaultEvent(step=5,\n"
+        "                  kind='worker_death', target=3)])\n"
+        "losses = trainer.train(STEPS, fault_plan=tplan)\n"
+        "mon.close(); trainer.close()\n"
+        "rec = trainer.recoveries[0] if trainer.recoveries else {}\n"
+        "loss_ok = bool(np.allclose(losses, ref, rtol=1e-6))\n"
+        "\n"
+        "res = {\n"
+        "  'model': {'hidden': H, 'layers': L, 'vocab': V},\n"
+        "  'trace': {'requests': N_REQ, 'max_new_tokens': NEW,\n"
+        "            'mean_interarrival_s': 0.01},\n"
+        "  'fault_schedule': {'crash':\n"
+        "                         'decode replica 1 @ trace t+0.12s',\n"
+        "                     'transport': 'drop@0, dup@2, delay@3'},\n"
+        "  'fault_free': free,\n"
+        "  'chaos': chaos,\n"
+        "  'recovery_s': None if rec_s is None else round(rec_s, 3),\n"
+        "  'trainer': {'steps': STEPS, 'death_at_step': 5,\n"
+        "              'resumed_from_step':\n"
+        "                  rec.get('resumed_from_step'),\n"
+        "              'dp_after': rec.get('dp'),\n"
+        "              'mttr_s': round(rec.get('mttr_s', -1.0), 3)},\n"
+        "  # acceptance booleans (ISSUE 13)\n"
+        "  'no_request_lost':\n"
+        "      free['completed'] == N_REQ and\n"
+        "      chaos['completed'] == N_REQ,\n"
+        "  'bitwise_survivors': chaos_outs == free_outs,\n"
+        "  'recovery_under_2s': rec_s is not None and rec_s < 2.0,\n"
+        "  'loss_curve_continues': loss_ok,\n"
+        "}\n"
+        "print(json.dumps(res))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True,
+                              timeout=1200)
+        lines = proc.stdout.strip().splitlines()
+        if not lines:
+            return {"error": f"rc={proc.returncode}: "
+                             f"{proc.stderr.strip()[-400:]}"}
+        result = json.loads(lines[-1])
+    except Exception as e:  # never fail the bench driver on this
+        return {"error": f"{type(e).__name__}: {e}"}
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_CHAOS.json")
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(result, fh, indent=1)
+    except Exception:
+        pass
+    return result
+
+
 def _probe_backend(timeout_s: float = 180.0) -> str:
     """Probe the default backend in a SUBPROCESS with a timeout: a wedged
     TPU runtime hangs on init (round-3 postmortem: BENCH_r03 rc=1 /
@@ -1337,7 +1568,8 @@ def main():
                "lint_graph": bench_lint_graph,
                "mem_lint": bench_mem_lint,
                "cost_lint": bench_cost_lint,
-               "router_bench": bench_router_bench}
+               "router_bench": bench_router_bench,
+               "chaos_bench": bench_chaos_bench}
         if sub not in fns:
             print(json.dumps({"error": f"unknown subcommand {sub!r}; "
                                        f"have {sorted(fns)}"}))

@@ -37,6 +37,16 @@ Rule catalog (DESIGN.md §9 for the rationale of each):
                              payload bytes and an alpha-beta predicted
                              time, so the disaggregation design stays
                              priced before hardware exists.
+``unfenced-handoff``         serving cluster: a cross-replica page move
+                             or a mid-flight request adoption lacking
+                             an epoch/fence token — without one, a
+                             revived or re-registered replica (or a
+                             retried wire delivery whose ack was lost)
+                             can double-deliver: two engines decode the
+                             same request, duplicated tokens.  Records
+                             flagged ``fence_exempt`` (a local,
+                             same-pool degrade that never crosses
+                             replicas) are exempt.
 ``cow-page-write``           serving: a unified-step KV write plan entry
                              targets a CACHED page — read-only by the
                              CoW contract whatever its sharer count
@@ -756,18 +766,15 @@ def _kv_handoff_unpriced(ctx: AnalysisContext) -> List[Finding]:
     TPU hardware exists, so an unpriced move fails CI.  Executables
     with no ``kv_handoff`` meta (everything but cluster decode
     replicas) are out of scope."""
-    records = (ctx.meta or {}).get("kv_handoff")
-    if records is None:
+    if "kv_handoff" not in (ctx.meta or {}):
         return []
-    if callable(records):
-        try:
-            records = records()
-        except Exception:
-            return [Finding(
-                rule="", subject="kv_handoff", severity="error",
-                message="kv_handoff record hook raised — the handoff "
-                        "accounting is lost, which is itself a gate "
-                        "failure")]
+    records, lost = _call_meta_records(ctx.meta, "kv_handoff")
+    if lost:
+        return [Finding(
+            rule="", subject="kv_handoff", severity="error",
+            message="kv_handoff record hook raised — the handoff "
+                    "accounting is lost, which is itself a gate "
+                    "failure")]
     out: List[Finding] = []
     for i, rec in enumerate(records or ()):
         edge = rec.get("edge") or {}
@@ -804,6 +811,71 @@ def _kv_handoff_unpriced(ctx: AnalysisContext) -> List[Finding]:
                  "alpha-beta formulas the planner and step-time linter "
                  "use); a handoff the analysis plane cannot price "
                  "cannot be gated before hardware"))
+    return out
+
+
+def _call_meta_records(meta, key: str):
+    """Resolve a meta record hook (list or callable); ``None`` signals
+    the hook raised — the accounting itself is lost."""
+    records = (meta or {}).get(key)
+    if callable(records):
+        try:
+            records = records()
+        except Exception:
+            return None, True
+    return records, False
+
+
+@rule("unfenced-handoff")
+def _unfenced_handoff(ctx: AnalysisContext) -> List[Finding]:
+    """Fencing contract of the fault plane (DESIGN.md §18): every
+    cross-replica KV-page move AND every mid-flight request adoption
+    must carry a fence token (``epoch``).  The token is what makes
+    recovery idempotent — a revived TTL-expired replica, a
+    re-registered rank, or a duplicated wire delivery is dropped by the
+    ``(request id, epoch)`` dedup instead of double-delivering tokens.
+    A move or adoption without the token is un-fenceable traffic: under
+    any of those races it duplicates work, so it fails CI.  Records
+    flagged ``fence_exempt`` (the monolithic-degrade path: a local
+    re-prefill that never crosses pools) are exempt; executables with
+    neither ``kv_handoff`` nor ``adoptions`` meta are out of scope."""
+    meta = ctx.meta or {}
+    if "kv_handoff" not in meta and "adoptions" not in meta:
+        return []
+    out: List[Finding] = []
+    for key, what in (("kv_handoff", "cross-replica KV-page move"),
+                      ("adoptions", "mid-flight request adoption")):
+        if key not in meta:
+            continue
+        records, lost = _call_meta_records(meta, key)
+        if lost:
+            out.append(Finding(
+                rule="", subject=key, severity="error",
+                message=f"{key} record hook raised — the fencing "
+                        "accounting is lost, which is itself a gate "
+                        "failure"))
+            continue
+        for i, rec in enumerate(records or ()):
+            if rec.get("fence_exempt"):
+                continue
+            epoch = rec.get("epoch")
+            if isinstance(epoch, bool) or not isinstance(epoch, int):
+                out.append(Finding(
+                    rule="",
+                    subject=f"{key}@{i}",
+                    severity="error",
+                    message=f"{what} #{i} "
+                            f"(req {rec.get('req_id', '?')}, "
+                            f"r{rec.get('src', '?')} -> "
+                            f"r{rec.get('dst', '?')}) carries no "
+                            f"epoch/fence token",
+                    hint="stamp the move/adoption with its staging "
+                         "epoch (PageTransport.inject(epoch=) / the "
+                         "cluster's _land_handoff) so a revived "
+                         "replica or a duplicated delivery is dropped "
+                         "by the (request id, epoch) dedup instead of "
+                         "double-delivering; flag genuinely local "
+                         "same-pool moves fence_exempt"))
     return out
 
 

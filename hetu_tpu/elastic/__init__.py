@@ -1,12 +1,19 @@
 """Elastic training engine (Malleus).
 
 TPU-native re-expression of the reference's ``python/elastic/engine``:
-straggler profiling, heterogeneity-aware strategy solving, and a Trainer
-that live-switches the graph between parallel layouts.
+straggler profiling, heterogeneity-aware strategy solving, a Trainer
+that live-switches the graph between parallel layouts, and — the fault
+plane (DESIGN.md §18) — a :class:`FaultTolerantTrainer` that survives
+an actual worker death: periodic flat-state snapshots through
+``safetensors_io``, coordinator-backed death detection
+(:class:`WorkerMonitor`), re-plan on the survivors, restore, and the
+loss curve continues exactly.
 """
+from .ft import FaultTolerantTrainer, TrainBuild, WorkerMonitor
 from .straggler import Straggler, StragglerWorkload
 from .strategy import Strategy, StrategyModel
 from .trainer import Trainer
 
-__all__ = ["Straggler", "StragglerWorkload", "Strategy", "StrategyModel",
-           "Trainer"]
+__all__ = ["FaultTolerantTrainer", "Straggler", "StragglerWorkload",
+           "Strategy", "StrategyModel", "TrainBuild", "Trainer",
+           "WorkerMonitor"]

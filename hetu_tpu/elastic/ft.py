@@ -1,0 +1,311 @@
+"""Fault-tolerant elastic training: survive an actual worker death.
+
+The elastic loop so far could re-plan around *stragglers*; a dead
+worker was fatal — its parameter and optimizer shards live in its HBM
+and are simply gone.  This module closes that gap the Malleus way
+(SURVEY.md §3.5) with three pieces the repo already has, driven end to
+end:
+
+* **Durable snapshots** — every ``checkpoint_every`` steps the trainer
+  saves model params + FLAT optimizer state through
+  ``utils.checkpoint.save_checkpoint`` (``safetensors_io`` decomposes
+  the flat buffers per-parameter, so the snapshot restores into ANY dp
+  size — the dp8→dp4 round-trip the IO layer already asserts).
+* **Death detection** — a :class:`WorkerMonitor`: N process-local
+  training workers registered on the ``rpc`` coordinator exactly like
+  serving replicas, each owning an equal slice of the device list; a
+  rank that stops heartbeating past the TTL maps to lost devices.
+* **Re-plan + restore** — on a death verdict the trainer asks
+  :class:`~hetu_tpu.elastic.strategy.StrategyModel` for the best layout
+  over the survivors, rebuilds the graph there (``build_fn``), restores
+  the latest snapshot, rewinds to its step, and keeps training.  The
+  loss curve *continues exactly*: flat-state math is bit-identical
+  across dp sizes, so the recovered run's per-step losses equal a
+  fault-free run's (asserted in tests/test_fault.py and gated by
+  ``bench.py chaos_bench``'s ``loss_curve_continues``).
+
+MTTR (kill → first completed post-recovery step) is recorded per
+recovery in :attr:`FaultTolerantTrainer.recoveries`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..obs.tracer import get_tracer
+from ..rpc.coordinator import CoordinatorClient, CoordinatorServer
+from .strategy import StrategyModel
+
+
+class WorkerMonitor:
+    """Process-local training workers on the rpc liveness plane.
+
+    Each rank owns ``len(devices) // num_workers`` devices; killing a
+    rank (chaos ``worker_death``) stops its heartbeat thread, the
+    coordinator's TTL declares it dead, and
+    :meth:`surviving_devices` shrinks accordingly.  The same
+    coordinator machinery the serving cluster and the multi-host
+    bootstrap use — one liveness plane for the whole system."""
+
+    def __init__(self, num_workers: int, devices: Sequence[Any],
+                 ttl: float = 0.5, heartbeat_interval: float = 0.1,
+                 server: Optional[CoordinatorServer] = None):
+        if num_workers < 1 or len(devices) % num_workers:
+            raise ValueError(
+                f"{len(devices)} devices do not split over "
+                f"{num_workers} workers")
+        self.devices = list(devices)
+        self.num_workers = int(num_workers)
+        self.per_worker = len(devices) // num_workers
+        self._own_server = server is None
+        self.server = server if server is not None else \
+            CoordinatorServer(world_size=num_workers, ttl=ttl).start()
+        self.clients: List[CoordinatorClient] = []
+        self._hb_stops = []
+        for i in range(num_workers):
+            c = CoordinatorClient(self.server.address,
+                                  uid=f"trainer-w{i}", ttl=ttl)
+            c.connect()
+            self.clients.append(c)
+            self._hb_stops.append(
+                c.start_heartbeat_thread(interval=heartbeat_interval))
+
+    def kill_worker(self, rank: int) -> None:
+        """The injected death: heartbeats stop NOW, the verdict lands
+        once the TTL lapses — the same two-step reality a crashed
+        remote host has."""
+        self._hb_stops[rank].set()
+
+    def dead_workers(self) -> List[int]:
+        return self.server.dead_ranks()
+
+    def wait_for_verdict(self, rank: int, timeout: float = 10.0) -> bool:
+        """Block until ``rank`` is declared dead (test/bench helper —
+        a real loop just polls :meth:`dead_workers` between steps)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if rank in self.dead_workers():
+                return True
+            time.sleep(0.02)
+        return False
+
+    def surviving_devices(self, dead: Sequence[int]) -> List[Any]:
+        dead = set(dead)
+        out: List[Any] = []
+        for r in range(self.num_workers):
+            if r not in dead:
+                out.extend(self.devices[r * self.per_worker:
+                                        (r + 1) * self.per_worker])
+        return out
+
+    def close(self) -> None:
+        for s in self._hb_stops:
+            s.set()
+        for c in self.clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        if self._own_server:
+            self.server.stop()
+
+
+@dataclass
+class TrainBuild:
+    """What ``build_fn(dp, devices)`` returns: a freshly-built graph on
+    the given layout.  ``step_fn(step) -> float`` runs one optimizer
+    step and returns the loss; ``model``/``optimizer`` feed the
+    checkpoint plane."""
+    graph: Any
+    model: Any
+    optimizer: Any
+    step_fn: Callable[[int], float]
+    close: Optional[Callable[[], None]] = None
+
+
+class FaultTolerantTrainer:
+    """Checkpoint → detect → re-plan → restore → continue.
+
+    ``build_fn(dp: int, devices) -> TrainBuild`` must rebuild the SAME
+    model deterministically (same init seed) for any dp — recovery
+    calls it on the survivor layout and immediately overwrites params +
+    optimizer state from the snapshot, so only the architecture needs
+    to be reproducible, not the init values.
+    """
+
+    def __init__(self, build_fn: Callable[..., TrainBuild],
+                 devices: Sequence[Any],
+                 monitor: Optional[WorkerMonitor] = None,
+                 checkpoint_dir: str = "/tmp/hetu_ft_ck",
+                 checkpoint_every: int = 4,
+                 solver_factory: Optional[
+                     Callable[[int], StrategyModel]] = None,
+                 keep_checkpoints: int = 2):
+        self.build_fn = build_fn
+        self.devices = list(devices)
+        self.monitor = monitor
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.keep_checkpoints = int(keep_checkpoints)
+        # default layout policy: pure dp over every available device
+        # (the homogeneous solver's own preference); a solver_factory
+        # lets hetero-aware callers re-plan tp/pp too
+        self.solver_factory = solver_factory
+        self.recoveries: List[Dict[str, Any]] = []
+        self.step = 0
+        self._handled: set = set()
+        self._ck_steps: List[int] = []
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        self.dp = self._choose_dp(len(self.devices))
+        self.build = build_fn(self.dp, self.devices)
+        # the step-0 snapshot: a death before the first periodic
+        # checkpoint must still have something to restore
+        self._checkpoint()
+
+    # -- layout choice -------------------------------------------------------
+
+    def _choose_dp(self, n: int) -> int:
+        if self.solver_factory is not None:
+            plan = self.solver_factory(n).make_plans([1.0] * n,
+                                                     top_k=1)[0]
+            return int(plan.dp)
+        # default policy: the largest power of two <= n — global batch
+        # sizes are overwhelmingly power-of-two, and a dp that does not
+        # divide the batch cannot build (a 4-worker fleet losing one
+        # worker of 8 devices recovers on dp=4 of the 6 survivors)
+        dp = 1
+        while dp * 2 <= n:
+            dp *= 2
+        return dp
+
+    # -- checkpoint plane ----------------------------------------------------
+
+    def _ck_path(self, step: int) -> str:
+        return os.path.join(self.checkpoint_dir, f"step{step}")
+
+    def _checkpoint(self) -> None:
+        from ..utils.checkpoint import save_checkpoint
+        save_checkpoint(self.build.model, self.build.optimizer,
+                        self._ck_path(self.step), step=self.step)
+        self._ck_steps.append(self.step)
+        tr = get_tracer()
+        if tr.enabled:
+            tr.instant("checkpoint", track="trainer", ts=tr.now(),
+                       step=self.step)
+        while len(self._ck_steps) > self.keep_checkpoints:
+            old = self._ck_steps.pop(0)
+            path = self._ck_path(old)
+            try:
+                for f in os.listdir(path):
+                    os.remove(os.path.join(path, f))
+                os.rmdir(path)
+            except OSError:
+                pass
+
+    def latest_checkpoint(self) -> int:
+        return self._ck_steps[-1]
+
+    # -- recovery ------------------------------------------------------------
+
+    def _recover(self, dead: Sequence[int], losses: Dict[int, float],
+                 killed_at: Optional[float]) -> None:
+        from ..utils.checkpoint import load_checkpoint
+        t0 = time.perf_counter()
+        survivors = self.monitor.surviving_devices(self._handled)
+        if not survivors:
+            raise RuntimeError("every worker died; nothing to recover on")
+        tr = get_tracer()
+        if tr.enabled:
+            tr.instant("worker_dead", track="trainer", ts=tr.now(),
+                       dead=sorted(dead), survivors=len(survivors),
+                       step=self.step)
+        detect_step = self.step
+        new_dp = self._choose_dp(len(survivors))
+        # the dead workers' HBM shards are GONE: rebuild on the
+        # survivor layout and restore the last durable snapshot —
+        # never read the old graph's device state
+        if self.build.close is not None:
+            self.build.close()
+        self.build = self.build_fn(new_dp, survivors)
+        ck_step = self.latest_checkpoint()
+        load_checkpoint(self.build.model, self.build.optimizer,
+                        self._ck_path(ck_step))
+        rewound = self.step - ck_step
+        for s in range(ck_step, self.step):
+            losses.pop(s, None)
+        self.step = ck_step
+        self.dp = new_dp
+        rec = {"dead": sorted(dead), "detected_at_step": detect_step,
+               "resumed_from_step": ck_step, "rewound_steps": rewound,
+               "dp": new_dp, "devices": len(survivors),
+               "rebuild_s": time.perf_counter() - t0,
+               "killed_at": killed_at}
+        self.recoveries.append(rec)
+        if tr.enabled:
+            tr.instant("recovered", track="trainer", ts=tr.now(),
+                       **{k: v for k, v in rec.items()
+                          if k not in ("killed_at",)})
+
+    # -- the loop ------------------------------------------------------------
+
+    def train(self, total_steps: int, fault_plan=None) -> List[float]:
+        """Train ``total_steps`` with death detection between steps.
+        ``fault_plan`` events of kind ``worker_death`` are injected at
+        their step (the chaos seam); recovery rewinds to the last
+        snapshot, so per-step losses are keyed and re-computed steps
+        overwrite with — by the flat-state contract — identical
+        values."""
+        losses: Dict[int, float] = {}
+        killed_at: Optional[float] = None
+        while self.step < total_steps:
+            if fault_plan is not None and self.monitor is not None:
+                for ev in fault_plan.due(self.step):
+                    if ev.kind != "worker_death":
+                        continue
+                    if ev.target in self._handled:
+                        continue
+                    self.monitor.kill_worker(ev.target)
+                    killed_at = time.perf_counter()
+                    tr = get_tracer()
+                    if tr.enabled:
+                        tr.instant("fault", track="chaos", ts=tr.now(),
+                                   kind="worker_death",
+                                   target=ev.target, step=self.step)
+                    # the verdict needs the TTL to lapse; a real fleet
+                    # just keeps stepping until it lands
+                    self.monitor.wait_for_verdict(ev.target)
+            if self.monitor is not None:
+                dead = set(self.monitor.dead_workers()) - self._handled
+                if dead:
+                    self._handled |= dead
+                    self._recover(dead, losses, killed_at)
+                    if killed_at is not None and self.recoveries:
+                        self.recoveries[-1]["mttr_pending"] = True
+            losses[self.step] = float(self.build.step_fn(self.step))
+            if self.recoveries and \
+                    self.recoveries[-1].pop("mttr_pending", False):
+                self.recoveries[-1]["mttr_s"] = \
+                    time.perf_counter() - (killed_at or time.perf_counter())
+            self.step += 1
+            if self.step % self.checkpoint_every == 0 \
+                    and self.step < total_steps:
+                self._checkpoint()
+        return [losses[s] for s in range(total_steps)]
+
+    def close(self) -> None:
+        if self.build.close is not None:
+            self.build.close()
+
+
+def write_recovery_report(trainer: FaultTolerantTrainer,
+                          path: str) -> Dict[str, Any]:
+    """Freeze the recovery record (bench/CI artifact)."""
+    out = {"recoveries": trainer.recoveries,
+           "checkpoints": list(trainer._ck_steps),
+           "final_dp": trainer.dp}
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    return out

@@ -138,18 +138,66 @@ class ElasticMPMDTrainer:
             "strategy": best.describe(),
             "switch_seconds": time.perf_counter() - t0,
         })
+        from ..obs.tracer import get_tracer
+        tr = get_tracer()
+        if tr.enabled:
+            # the recovery half of the chaos pair: fault (straggler
+            # injected) -> recover (layout switched around it)
+            tr.instant("strategy_switch", track="trainer", ts=tr.now(),
+                       step=self.step_idx, strategy=best.describe(),
+                       switch_seconds=self.history[-1]["switch_seconds"])
         return True
 
     def run(self, total_steps: int, retune_every: int = 0,
             ratio_provider: Optional[Callable[[int], Sequence[float]]]
-            = None) -> List[float]:
+            = None, fault_plan=None) -> List[float]:
+        """Train ``total_steps``; when ``retune_every`` > 0, retune
+        every that many steps.  ``fault_plan`` (hetu_tpu.fault) is the
+        chaos seam: ``straggler`` events due at a step slow their
+        device by ``ratio`` (duration in steps, 0 = permanent) and the
+        next retune re-plans around them — each injection and each
+        switch is a tracer instant, so the Perfetto timeline shows
+        fault → re-plan like the serving plane does."""
+        from ..obs.tracer import get_tracer
         losses: List[float] = []
+        ratios = [1.0] * self.solver.n
+        heal_at: Dict[int, int] = {}       # device -> step to heal at
         while len(losses) < total_steps:
+            if fault_plan is not None:
+                for ev in fault_plan.due(self.step_idx):
+                    if ev.kind != "straggler" or ev.target < 0 \
+                            or ev.target >= self.solver.n:
+                        continue
+                    ratios[ev.target] = float(ev.ratio)
+                    if ev.duration:
+                        heal_at[ev.target] = \
+                            self.step_idx + int(ev.duration)
+                    tr = get_tracer()
+                    if tr.enabled:
+                        tr.instant("fault", track="chaos", ts=tr.now(),
+                                   kind="straggler", target=ev.target,
+                                   ratio=float(ev.ratio),
+                                   step=self.step_idx)
+            for dev, at in list(heal_at.items()):
+                if self.step_idx >= at:
+                    ratios[dev] = 1.0
+                    del heal_at[dev]
             chunk = min(retune_every or total_steps,
                         total_steps - len(losses))
+            if fault_plan is not None:
+                # a chunk is atomic: stop at the next scheduled event
+                # (or heal) so no mid-chunk step is silently skipped —
+                # due() matches by exact equality
+                upcoming = [e.step for e in fault_plan.events
+                            if e.step > self.step_idx] + \
+                    [at for at in heal_at.values()
+                     if at > self.step_idx]
+                if upcoming:
+                    chunk = min(chunk,
+                                min(upcoming) - self.step_idx)
             losses += self.train_steps(chunk)
             if retune_every and len(losses) < total_steps:
-                ratios = ratio_provider(self.step_idx) if ratio_provider \
-                    else [1.0] * self.solver.n
-                self.retune(ratios)
+                cur = ratio_provider(self.step_idx) if ratio_provider \
+                    else list(ratios)
+                self.retune(cur)
         return losses

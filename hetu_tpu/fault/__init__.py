@@ -1,0 +1,22 @@
+"""Fault plane: deterministic chaos injection + fenced recovery.
+
+The robustness half of scale-out (ROADMAP items 1 and 3, DESIGN.md
+§18): a seeded :class:`FaultPlan` schedules replica crashes, zombies
+(heartbeat stall while the engine keeps stepping), handoff transport
+drops/duplicates/delays, coordinator refusals and stragglers; a
+:class:`ChaosController` injects them at the serving cluster's
+instrumented seams; and the recovery machinery the harness proves out —
+fencing epochs, capped-exponential retry with deadlines
+(:class:`RetryPolicy`), destination-death re-staging, load shedding —
+keeps every invariant: no request lost, no duplicated token, temp-0
+outputs bit-for-bit equal to the fault-free run.
+"""
+from .backoff import RetryPolicy, unit_hash
+from .chaos import ChaosController, check_cluster_invariants
+from .plan import EVENT_KINDS, TRANSPORT_KINDS, FaultEvent, FaultPlan
+
+__all__ = [
+    "ChaosController", "EVENT_KINDS", "FaultEvent", "FaultPlan",
+    "RetryPolicy", "TRANSPORT_KINDS", "check_cluster_invariants",
+    "unit_hash",
+]

@@ -1,0 +1,128 @@
+"""The chaos controller: injects a FaultPlan at the cluster's seams.
+
+``EngineCluster(chaos=ChaosController(plan))`` wires the controller
+into the serving loop: at the top of every cluster step the controller
+applies the events due at that step (crash / zombie / revive / readmit
+/ straggler / coordinator refusal), and every handoff injection attempt
+asks it for a transport verdict (drop / dup / delay).  Injection is
+*observable by construction*: every injected fault emits a ``fault``
+instant on the ``chaos`` tracer track, and the cluster's recovery
+machinery emits its own instants (``replica_dead``, ``reroute``,
+``handoff_retry``, ``handoff_restaged``, ``duplicate_dropped``,
+``stale_completion_dropped``, ``shed``, ``replica_readmitted``), so one
+Perfetto trace shows the full fail → detect → recover chain per event.
+
+The controller is deterministic: it owns no RNG — all randomness lives
+in the seeded :class:`~hetu_tpu.fault.plan.FaultPlan` — and the
+transport-attempt ordinal is a plain counter, so replaying the same
+plan against the same trace injects the same faults at the same
+instants.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from .plan import FaultEvent, FaultPlan
+
+
+class ChaosController:
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.injected: List[Dict[str, Any]] = []   # audit log
+        self._attempts = 0                         # handoff ordinal
+        self._applied: set = set()                 # event identity guard
+
+    # -- cluster seam --------------------------------------------------------
+
+    def on_step(self, cluster, step: int, now: float) -> None:
+        """Apply every event due at ``step`` to the cluster."""
+        for ev in self.plan.due(step):
+            key = (ev.step, ev.kind, ev.target)
+            if key in self._applied:
+                continue
+            self._applied.add(key)
+            self._apply(cluster, ev, now)
+
+    def _apply(self, cluster, ev: FaultEvent, now: float) -> None:
+        tr = cluster.tracer
+        if tr.enabled:
+            tr.instant("fault", track="chaos", ts=now, kind=ev.kind,
+                       target=ev.target, step=ev.step,
+                       duration=ev.duration)
+        self.injected.append({"step": ev.step, "kind": ev.kind,
+                              "target": ev.target, "ts": now})
+        if ev.kind == "coord_refuse":
+            if cluster.server is not None:
+                cluster.server.refuse_for(float(ev.duration))
+            return
+        if ev.kind == "worker_death":
+            # a training-plane event reaching a serving cluster is a
+            # plan-authoring error; ignore rather than corrupt state
+            return
+        r = cluster.replicas[ev.target]
+        if ev.kind == "crash":
+            r.kill()
+            if cluster.server is None:
+                # no coordinator: the stopped process is its own proof,
+                # _check_health picks `not serving` up next step
+                pass
+        elif ev.kind == "zombie":
+            # heartbeats stall, the engine keeps stepping.  With a
+            # coordinator the TTL verdict lands on real time; without
+            # one the synthetic-clock world gets the verdict NOW (the
+            # cluster's _check_health treats `not alive` as the landed
+            # verdict and fences the replica)
+            r.pause_heartbeat()
+            if cluster.server is None:
+                r.alive = False
+        elif ev.kind == "revive":
+            # heartbeats return; quarantine (alive=False) is sticky
+            # until an explicit readmit — asserted by the revival-race
+            # tests
+            r.resume_heartbeat()
+        elif ev.kind == "readmit":
+            cluster.readmit_replica(ev.target)
+        elif ev.kind == "straggler":
+            r.slow_until = cluster.steps + max(1.0, float(ev.duration))
+
+    # -- transport seam ------------------------------------------------------
+
+    def handoff_verdict(self) -> Tuple[str, float]:
+        """The verdict for the NEXT handoff injection attempt; consumes
+        one ordinal.  ``("ok", 0)`` when the plan says nothing."""
+        v = self.plan.transport_verdict(self._attempts)
+        self._attempts += 1
+        return v if v is not None else ("ok", 0.0)
+
+
+def check_cluster_invariants(cluster) -> None:
+    """The chaos-fuzz safety net, asserted after EVERY step: request
+    accounting is exact (each request is in exactly one of backlog /
+    placed / staged-handoff / finished / shed), nothing is both finished
+    and shed, no output overran its token budget, and every live pool's
+    own invariants hold."""
+    backlog_ids = {rid for _, rid, _ in cluster._backlog}
+    placed_ids = {creq.req_id
+                  for (creq, _stage, _epoch) in cluster._placed.values()}
+    handoff_ids = {h["creq"].req_id for h in cluster._pending_handoffs
+                   if not h.get("redelivery")}
+    finished_ids = set(cluster.finished)
+    shed_ids = set(cluster.shed)
+    assert not (finished_ids & shed_ids), \
+        f"requests both finished and shed: {finished_ids & shed_ids}"
+    for rid, creq in cluster.requests.items():
+        homes = [rid in backlog_ids,
+                 rid in finished_ids,
+                 rid in shed_ids,
+                 rid in placed_ids or rid in handoff_ids]
+        assert sum(bool(h) for h in homes) == 1, \
+            (f"request {rid} accounting broken: backlog={homes[0]} "
+             f"finished={homes[1]} shed={homes[2]} live={homes[3]} "
+             f"(stage={creq.stage!r}, pending={creq.handoff_pending})")
+        assert len(creq.out_tokens) <= creq.max_new_tokens, \
+            f"request {rid} overran its budget (duplicated tokens?)"
+    for r in cluster.replicas:
+        if r.serving and r.engine.debug:
+            r.engine.pool.check_invariants()
+            if r.engine.prefix_cache is not None:
+                r.engine.prefix_cache.check_invariants()

@@ -50,7 +50,14 @@ class _Handler(socketserver.StreamRequestHandler):
                     continue
                 try:
                     req = json.loads(line.decode())
-                    resp = self._dispatch(st, req)
+                    # chaos seam: an installed fault injector may refuse
+                    # any op BEFORE dispatch — a refused op proves
+                    # nothing (no heartbeat refresh), exactly like a
+                    # connection the real coordinator never accepted
+                    inj = getattr(self.server, "fault_injector", None)
+                    err = inj(req.get("op"), req) if inj else None
+                    resp = {"ok": False, "error": err} if err \
+                        else self._dispatch(st, req)
                 except Exception as e:  # noqa: BLE001 — report, keep serving
                     resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
                 self.wfile.write((json.dumps(resp) + "\n").encode())
@@ -192,6 +199,7 @@ class CoordinatorServer:
         self.state = _State(world_size)
         self._srv = _TCPServer((host, port), _Handler)
         self._srv.state = self.state  # type: ignore[attr-defined]
+        self._srv.fault_injector = None  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -214,6 +222,32 @@ class CoordinatorServer:
 
     def __exit__(self, *exc):
         self.stop()
+
+    # -- fault injection (chaos harness seam) --------------------------------
+
+    def set_fault_injector(self, injector) -> None:
+        """Install ``injector(op, req) -> Optional[str]``: a non-None
+        return refuses the request with that error string, before
+        dispatch (no liveness refresh).  ``None`` uninstalls."""
+        self._srv.fault_injector = injector  # type: ignore[attr-defined]
+
+    def refuse_for(self, seconds: float, ops: Optional[set] = None
+                   ) -> None:
+        """Refuse every op (or just ``ops``) for the next ``seconds``
+        of wall time — the ``coord_refuse`` chaos event.  Clients see
+        ``RuntimeError: coordinator error: refused (fault injection)``;
+        their heartbeat threads must survive it by backing off and
+        retrying (``start_heartbeat_thread``)."""
+        until = time.time() + float(seconds)
+
+        def injector(op, req):
+            if time.time() >= until:
+                self.set_fault_injector(None)   # window over: heal
+                return None
+            if ops is not None and op not in ops:
+                return None
+            return "refused (fault injection)"
+        self.set_fault_injector(injector)
 
     # -- monitor-side helpers ------------------------------------------------
 
@@ -341,15 +375,34 @@ class CoordinatorClient:
     def start_heartbeat_thread(self, interval: float = 2.0
                                ) -> threading.Event:
         """Background heartbeat (the reference workers ping inside their
-        poll loop).  Returns an Event; set it to stop."""
+        poll loop).  Returns an Event; set it to stop.
+
+        A refused heartbeat (coordinator fault window, transient server
+        error) no longer kills the thread: it backs off with the capped
+        exponential :class:`~hetu_tpu.fault.backoff.RetryPolicy` and
+        keeps trying, so an outage shorter than the liveness TTL never
+        turns into a false-dead verdict.  Only a dead transport (the
+        socket itself gone) ends the loop — there is nothing left to
+        retry onto."""
+        from ..fault.backoff import RetryPolicy
         stop = threading.Event()
+        policy = RetryPolicy(base=interval, cap=max(4 * interval, 0.5),
+                             jitter=0.25)
 
         def loop():
-            while not stop.wait(interval):
+            failures = 0
+            while True:
+                delay = interval if failures == 0 \
+                    else policy.delay(failures - 1, key=self.rank or 0)
+                if stop.wait(delay):
+                    return
                 try:
                     self.heartbeat()
+                    failures = 0
+                except (ConnectionError, OSError, ValueError):
+                    return            # transport dead / socket closed
                 except Exception:
-                    return
+                    failures += 1     # refused: back off, retry
         threading.Thread(target=loop, daemon=True).start()
         return stop
 

@@ -19,7 +19,11 @@ with disaggregated prefill/decode and priced KV-page streaming.
 See DESIGN.md §17: replica digests and the placement policy, handoff
 pricing through the planner's alpha-beta formulas, heartbeat-driven
 re-route on replica death, and why process-local hosts keep the CPU
-path honest.
+path honest.  DESIGN.md §18 covers the fault plane layered on top:
+seeded chaos injection (``EngineCluster(chaos=...)``,
+``hetu_tpu.fault``), fencing epochs, backoff retries with deadlines,
+destination-death re-staging, load shedding, and sticky quarantine
+with explicit :meth:`EngineCluster.readmit_replica`.
 """
 from .cluster import ClusterRequest, EngineCluster
 from .replica import DECODE, PREFILL, UNIFIED, Replica

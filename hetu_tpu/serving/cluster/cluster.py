@@ -38,6 +38,26 @@ from its accumulated tokens, so at temperature 0 (and under the
 seeded sampler) the final output is independent of deaths, handoffs,
 preemptions and placement — the same contract the single engine already
 made, extended across the fleet.
+
+Fault plane (DESIGN.md §18, ``hetu_tpu/fault``): every death verdict
+bumps the replica's **fencing epoch** — placements, stream callbacks
+and handoff injections all carry the epoch they were made under, so a
+zombie (heartbeat stall while the engine keeps stepping), a revived
+TTL-expired replica, or a duplicated wire delivery can never
+double-deliver: stale completions are dropped in ``_collect_finished``
+(``stale_completions_dropped``), stale stream tokens are ignored at the
+callback, and handoff injection is idempotent by ``(request id,
+staging epoch)``.  The bare retry loops are gone: handoff attempts back
+off with a capped-exponential :class:`~hetu_tpu.fault.RetryPolicy`, a
+staged handoff whose pinned destination dies mid-transfer is re-staged
+to a survivor (``handoffs_restaged``), and a request that every live
+replica has backpressured past its deadline is SHED with a retriable
+rejection (``requests_shed``) instead of growing the backlog without
+bound.  A quarantined replica rejoins only through
+:meth:`readmit_replica`, which aborts its stale engine state first.
+Chaos injection (``EngineCluster(chaos=ChaosController(plan))``) drives
+all of it deterministically; every fault and every recovery action is a
+tracer instant, so one Perfetto trace shows fail → detect → recover.
 """
 from __future__ import annotations
 
@@ -46,6 +66,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
+from ...fault.backoff import RetryPolicy
 from ...obs.tracer import PrefixedTracer, get_tracer
 from ...utils.metrics import make_instrument, merge_prometheus_texts
 from ..engine import Engine
@@ -82,6 +103,11 @@ class ClusterRequest:
     handoff_pending: bool = False
     n_reroutes: int = 0
     finish_time: Optional[float] = None
+    # load shedding: a shed request is terminal but NOT completed — the
+    # rejection is retriable (the caller may resubmit when the fleet
+    # has headroom)
+    rejected: bool = False
+    reject_reason: str = ""
 
     @property
     def done(self) -> bool:
@@ -117,7 +143,10 @@ class EngineCluster:
                  coordinator: bool = True,
                  transport: Optional[PageTransport] = None,
                  time_fn=None, tracer=None, seed: int = 0,
-                 metrics: bool = True, step_fn=None, **engine_kw):
+                 metrics: bool = True, step_fn=None,
+                 chaos=None, retry: Optional[RetryPolicy] = None,
+                 request_deadline: Optional[float] = None,
+                 max_backlog: Optional[int] = None, **engine_kw):
         if mode not in MODES:
             raise ValueError(f"unknown mode {mode!r}; have {MODES}")
         if num_replicas < 1:
@@ -134,6 +163,19 @@ class EngineCluster:
         self.cfg = cfg
         self._time = time_fn or time.monotonic
         self._tracer = tracer
+        # fault plane: chaos injection + recovery policy.  The retry
+        # policy governs handoff re-attempts (capped exponential,
+        # deterministic jitter); request_deadline bounds how long a
+        # request may wait backpressured (backlog or staged handoff)
+        # before it degrades — sheds with a retriable rejection, or
+        # falls back to monolithic serving; max_backlog bounds the
+        # front-door queue (beyond it, arrivals shed immediately)
+        self.chaos = chaos
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.request_deadline = None if request_deadline is None \
+            else float(request_deadline)
+        self.max_backlog = None if max_backlog is None \
+            else int(max_backlog)
         follow = _FollowTracer(self)
         self.transport = transport if transport is not None \
             else LocalPageTransport()
@@ -168,9 +210,11 @@ class EngineCluster:
                 i, eng, role=role, client=client,
                 heartbeat_interval=heartbeat_interval))
         if mode == "disaggregated":
-            # expose each decode replica's handoff records to the
-            # analysis plane: the kv-handoff-unpriced rule audits that
-            # every cross-replica page move carried a priced edge claim
+            # expose each decode replica's handoff + adoption records to
+            # the analysis plane: kv-handoff-unpriced audits that every
+            # cross-replica page move carried a priced edge claim, and
+            # unfenced-handoff that every move AND every mid-flight
+            # adoption carried a fence token (epoch)
             from ...graph.graph import get_executable
             for r in self.replicas:
                 if r.role == DECODE:
@@ -178,6 +222,9 @@ class EngineCluster:
                     h.meta["kv_handoff"] = \
                         (lambda t=self.transport, d=r.idx:
                          t.records_for(d))
+                    h.meta["adoptions"] = \
+                        (lambda c=self, d=r.idx:
+                         [a for a in c._adoptions if a["dst"] == d])
 
         self.router = Router(policy=policy,
                              max_queue_depth=max_queue_depth,
@@ -187,11 +234,30 @@ class EngineCluster:
         self.steps = 0
         self._backlog: List = []                      # heap
         self._pending_handoffs: List[Dict[str, Any]] = []
-        # (replica idx, engine req id) -> (creq, stage): live ownership
+        # (replica idx, engine req id) -> (creq, stage, fence epoch):
+        # live ownership, stamped with the epoch it was placed under
         self._placed: Dict = {}
         self.requests: Dict[int, ClusterRequest] = {}
         self.finished: Dict[int, ClusterRequest] = {}
+        self.shed: Dict[int, ClusterRequest] = {}
         self._dead_handled: set = set()
+        # fencing epochs: bumped at every death verdict; anything
+        # stamped with an older epoch is stale and must be dropped
+        self._fence: Dict[int, int] = {r.idx: 0 for r in self.replicas}
+        # engine requests a fenced replica still owes us a (stale)
+        # completion for: (replica idx, engine req id) -> cluster req id
+        self._stale_expected: Dict = {}
+        # idempotent handoff injection: (cluster req id, staging epoch)
+        # pairs already landed — a duplicated delivery (retry after a
+        # lost ack, chaos dup) is dropped here, never adopted twice.
+        # Staging epochs come from one cluster-wide monotonic counter,
+        # so a request that re-enters the disaggregated path after a
+        # degrade can never collide with its own past key
+        self._injected: set = set()
+        self._stage_seq = 0
+        # mid-flight adoption audit trail (the unfenced-handoff rule
+        # reads these through the decode replicas' executable meta)
+        self._adoptions: List[Dict[str, Any]] = []
         # reset-robust per-replica counter accumulation (see
         # metrics_summary): replica -> counter -> (base, last_seen)
         self._counter_acc: Dict[int, Dict[str, List[float]]] = \
@@ -199,7 +265,12 @@ class EngineCluster:
         m = metrics
         self.counters = {k: make_instrument("counter", k, m) for k in
                          ("requests_completed", "reroutes", "handoffs",
-                          "routed")}
+                          "routed",
+                          # failure plane (DESIGN.md §18)
+                          "replica_deaths", "handoff_retries",
+                          "handoffs_restaged", "requests_shed",
+                          "stale_completions_dropped",
+                          "duplicate_deliveries_dropped", "readmits")}
         self.histograms = {k: make_instrument("histogram", k, m) for k in
                            ("ttft", "tbt", "request_latency")}
 
@@ -248,6 +319,12 @@ class EngineCluster:
         creq.submit_time = max(now, creq.arrival_time)
         self._next_id += 1
         self.requests[creq.req_id] = creq
+        if self.max_backlog is not None \
+                and len(self._backlog) >= self.max_backlog:
+            # bounded backlog: graceful degradation instead of
+            # unbounded queue growth — the rejection is retriable
+            self._shed(creq, "backlog_full", now)
+            return creq
         heapq.heappush(self._backlog,
                        (creq.arrival_time, creq.req_id, creq))
         tr = self.tracer
@@ -257,6 +334,23 @@ class EngineCluster:
                        backlog=len(self._backlog))
         return creq
 
+    def _shed(self, creq: ClusterRequest, reason: str,
+              now: float) -> None:
+        """Load shedding: mark ``creq`` terminally rejected (retriable
+        — the caller may resubmit) and count it.  Sheds only ever
+        happen at the front door (bounded backlog) or once the whole
+        live fleet has backpressured the request past its deadline."""
+        creq.rejected = True
+        creq.reject_reason = reason
+        creq.finish_time = now
+        self.shed[creq.req_id] = creq
+        self.counters["requests_shed"].inc()
+        tr = self.tracer
+        if tr.enabled:
+            tr.instant("shed", track="router", ts=now, req=creq.req_id,
+                       reason=reason, retriable=True,
+                       backlog=len(self._backlog))
+
     # -- loop ----------------------------------------------------------------
 
     @property
@@ -265,18 +359,28 @@ class EngineCluster:
             or any(r.alive and r.engine.has_work for r in self.replicas)
 
     def step(self) -> int:
-        """One cluster iteration: health check (re-route the dead
-        replicas' work), route ready backlog, land pending handoffs,
-        step every live engine.  Returns tokens emitted this step."""
+        """One cluster iteration: inject due chaos, health check
+        (re-route the dead replicas' work), route ready backlog, land
+        pending handoffs, step every serving engine.  Returns tokens
+        emitted this step (stale tokens from fenced replicas are
+        excluded — a zombie's engine still steps, exactly like a real
+        partitioned process, but its output is quarantined)."""
         now = self._time()
+        if self.chaos is not None:
+            self.chaos.on_step(self, self.steps, now)
         self._check_health()
         self._sync_counters()
         self._route_ready(now)
         self._process_handoffs(now)
         produced = 0
         for r in self.replicas:
-            if r.alive and r.serving and r.engine.has_work:
-                produced += r.engine.step()
+            if not r.serving or not r.engine.has_work:
+                continue
+            if r.slow_until > self.steps:
+                continue               # straggler: this beat is skipped
+            out = r.engine.step()
+            if r.alive:
+                produced += out
         self._collect_finished()
         self.steps += 1
         return produced
@@ -312,12 +416,21 @@ class EngineCluster:
                 continue
             r.alive = False
             self._dead_handled.add(r.idx)
+            # fence the epoch: anything this replica delivers from here
+            # on (it may be a zombie still stepping) is stale
+            self._fence[r.idx] += 1
+            self.counters["replica_deaths"].inc()
             tr = self.tracer
             if tr.enabled:
                 tr.instant("replica_dead", track="router",
-                           ts=self._time(), replica=r.idx)
+                           ts=self._time(), replica=r.idx,
+                           fence_epoch=self._fence[r.idx],
+                           zombie=bool(r.serving))
             for key in [k for k in self._placed if k[0] == r.idx]:
-                creq, _stage = self._placed.pop(key)
+                creq, _stage, _epoch = self._placed.pop(key)
+                # the fenced engine may still finish this request: owe
+                # it a stale-completion drop, never a second finish
+                self._stale_expected[key] = creq.req_id
                 if creq.done or creq.handoff_pending:
                     # a staged handoff survives its source's death: the
                     # pages are already extracted host-side
@@ -348,7 +461,15 @@ class EngineCluster:
             _arr, _rid, creq = self._backlog[0]
             rep = self.router.place(creq, self._prefill_pool())
             if rep is None:
-                break          # backpressured: FIFO holds, retry later
+                # whole fleet backpressured.  Past the deadline the
+                # request is shed (retriable rejection) — bounded wait,
+                # graceful degradation; inside it, FIFO holds
+                if self.request_deadline is not None \
+                        and now - creq.submit_time > self.request_deadline:
+                    heapq.heappop(self._backlog)
+                    self._shed(creq, "backpressured_past_deadline", now)
+                    continue
+                break
             heapq.heappop(self._backlog)
             self._submit(creq, rep, now)
 
@@ -364,8 +485,12 @@ class EngineCluster:
                               and rep.role == PREFILL and has_decode
                               and creq.max_new_tokens > 1) else "final"
         mnt = 1 if stage == "prefill" else creq.max_new_tokens
+        epoch = self._fence[rep.idx]
 
-        def cb(ereq, tok, creq=creq, stage=stage, ridx=rep.idx):
+        def cb(ereq, tok, creq=creq, stage=stage, ridx=rep.idx,
+               epoch=epoch):
+            if self._fence[ridx] != epoch:
+                return         # fenced epoch: stale stream token
             creq.token_times.append(self._time())
             if stage == "prefill":
                 if creq.eos_token_id is not None \
@@ -382,7 +507,7 @@ class EngineCluster:
         creq.stage = stage
         if stage == "prefill":
             creq.prefill_replica = rep.idx
-        self._placed[(rep.idx, ereq.req_id)] = (creq, stage)
+        self._placed[(rep.idx, ereq.req_id)] = (creq, stage, epoch)
         self.counters["routed"].inc()
 
     # -- disaggregated handoff ----------------------------------------------
@@ -398,7 +523,15 @@ class EngineCluster:
         creq.handoff_pending = True
         self._pending_handoffs.append(
             {"creq": creq, "staged": staged, "src": src_idx,
-             "first": int(first_tok), "pos": int(ereq.pos)})
+             "first": int(first_tok), "pos": int(ereq.pos),
+             # recovery state: capped-exp backoff attempts, the staging
+             # epoch (fresh on every (re-)stage — the idempotency key's
+             # second half), and the in-flight pin (set while a delayed
+             # transfer has a destination + pages reserved)
+             "attempt": 0, "not_before": float("-inf"),
+             "epoch": self._next_stage_epoch(),
+             "dst": None, "dst_pages": None, "lands_at": None,
+             "redelivery": False})
         tr = self.tracer
         if tr.enabled:
             tr.instant("handoff_staged", track="router",
@@ -406,25 +539,110 @@ class EngineCluster:
                        pages=int(staged["n_pages"]),
                        payload_bytes=int(staged["payload_bytes"]))
 
+    def _next_stage_epoch(self) -> int:
+        self._stage_seq += 1
+        return self._stage_seq
+
+    def _retry_handoff(self, h: Dict[str, Any], now: float,
+                       still: List[Dict[str, Any]]) -> None:
+        """Schedule the next attempt: capped-exponential backoff with
+        deterministic per-request jitter (no bare spin retry)."""
+        self.counters["handoff_retries"].inc()
+        delay = self.retry.delay(h["attempt"], key=h["creq"].req_id)
+        h["attempt"] += 1
+        h["not_before"] = now + delay
+        tr = self.tracer
+        if tr.enabled:
+            tr.instant("handoff_retry", track="router", ts=now,
+                       req=h["creq"].req_id, attempt=h["attempt"],
+                       next_in=delay)
+        still.append(h)
+
+    def _degrade_to_local(self, creq: ClusterRequest, reason: str,
+                          now: float) -> None:
+        """Give up on the disaggregated path for this request: replay
+        it end-to-end on whatever still lives (the backlog router
+        decides — monolithic serving beats a trapped request)."""
+        creq.handoff_pending = False
+        creq.token_times = []
+        creq.n_reroutes += 1
+        self.counters["reroutes"].inc()
+        heapq.heappush(self._backlog,
+                       (creq.arrival_time, creq.req_id, creq))
+        tr = self.tracer
+        if tr.enabled:
+            tr.instant("handoff_degraded", track="router", ts=now,
+                       req=creq.req_id, reason=reason)
+
     def _process_handoffs(self, now: float) -> None:
         still: List[Dict[str, Any]] = []
         for h in self._pending_handoffs:
             creq: ClusterRequest = h["creq"]
+            key = (creq.req_id, h["epoch"])
+            # idempotent injection: this (request, staging epoch) has
+            # already landed — a retried delivery whose ack was lost,
+            # or a chaos-duplicated packet.  Drop, never adopt twice.
+            if key in self._injected:
+                self.counters["duplicate_deliveries_dropped"].inc()
+                tr = self.tracer
+                if tr.enabled:
+                    tr.instant("duplicate_dropped", track="router",
+                               ts=now, req=creq.req_id,
+                               epoch=h["epoch"])
+                continue
+            if creq.done:
+                continue               # finished through another path
+            # -- in-flight (delayed) transfer: the destination is
+            # pinned and may die mid-transfer
+            if h["dst"] is not None:
+                dst = self.replicas[h["dst"]]
+                if not dst.alive:
+                    # destination died mid-transfer: re-stage to a
+                    # survivor.  The staged bytes are host-side, so the
+                    # transfer restarts under a NEW staging epoch (the
+                    # fence against the old delivery surfacing late).
+                    # The reserved pages go back to the dead pool's
+                    # free list — host bookkeeping, and a later
+                    # readmission must not inherit leaked pages
+                    if h["dst_pages"] is not None:
+                        dst.engine.pool.free(h["dst_pages"])
+                    h["epoch"] = self._next_stage_epoch()
+                    h["dst"] = None
+                    h["dst_pages"] = None
+                    h["lands_at"] = None
+                    h["attempt"] = 0
+                    h["not_before"] = float("-inf")
+                    self.counters["handoffs_restaged"].inc()
+                    tr = self.tracer
+                    if tr.enabled:
+                        tr.instant("handoff_restaged", track="router",
+                                   ts=now, req=creq.req_id,
+                                   dead_dst=dst.idx, epoch=h["epoch"])
+                elif now < h["lands_at"]:
+                    still.append(h)    # still on the wire
+                    continue
+                else:
+                    self._land_handoff(h, dst, h["dst_pages"], now)
+                    continue
+            # -- fresh attempt (possibly right after a re-stage)
+            if now < h["not_before"]:
+                still.append(h)        # backing off
+                continue
             decode = [r for r in self.replicas
                       if r.role == DECODE and r.alive]
+            if not decode:
+                # every decode replica died: degrade to monolithic
+                self._degrade_to_local(creq, "decode_fleet_empty", now)
+                continue
             cands = self.router.candidates(decode)
             if not cands:
-                if not decode:
-                    # every decode replica died: replay from scratch on
-                    # whatever still lives (the backlog router decides)
-                    creq.handoff_pending = False
-                    creq.token_times = []
-                    creq.n_reroutes += 1
-                    self.counters["reroutes"].inc()
-                    heapq.heappush(self._backlog,
-                                   (creq.arrival_time, creq.req_id, creq))
+                # live decode fleet, all backpressured: bounded retry
+                if self.request_deadline is not None \
+                        and now - creq.submit_time > self.request_deadline:
+                    self._degrade_to_local(
+                        creq, "backpressured_past_deadline", now)
                     continue
-                still.append(h)          # backpressured: retry
+                self._retry_handoff(h, now, still)
                 continue
             rep = min(cands, key=lambda r: (r.outstanding_tokens(),
                                             r.idx))
@@ -433,41 +651,84 @@ class EngineCluster:
             pages = None
             if n <= pool.num_usable:
                 pages = pool.alloc(n)
-            if pages is None and n <= pool.num_usable:
-                still.append(h)          # pool full right now: retry
+                if pages is None:
+                    self._retry_handoff(h, now, still)  # pool full
+                    continue
+            # chaos seam: the wire's verdict for this attempt
+            verdict, vdur = ("ok", 0.0)
+            if self.chaos is not None and not h["redelivery"]:
+                verdict, vdur = self.chaos.handoff_verdict()
+            if verdict == "drop":
+                # the wire ate it: the staged copy is still host-side,
+                # release the reserved pages and back off
+                if pages is not None:
+                    pool.free(pages)
+                self._retry_handoff(h, now, still)
                 continue
-            if pages is not None:
-                rec = self.transport.inject(
-                    pool, h["staged"], pages, src_replica=h["src"],
-                    dst_replica=rep.idx)
-                self.counters["handoffs"].inc()
-                tr = self.tracer
-                if tr.enabled:
-                    tr.instant("handoff", track="router", ts=now,
-                               req=creq.req_id, src=h["src"],
-                               dst=rep.idx, pages=rec["pages"],
-                               payload_bytes=rec["payload_bytes"],
-                               predicted_wire_s=rec["predicted_s"])
-                pos = h["pos"]
-            else:
-                # pages can NEVER fit this decode pool: degrade to a
-                # full re-prefill on the decode replica (correct, just
-                # not disaggregated for this one request)
-                pos = 0
-            ereq = rep.engine.adopt_request(
-                creq.prompt, [h["first"]], creq.max_new_tokens,
-                pages=pages, pos=pos, temperature=creq.temperature,
-                top_k=creq.top_k, top_p=creq.top_p, seed=creq.seed,
-                eos_token_id=creq.eos_token_id, arrival_time=now,
-                stream_cb=self._final_cb(creq))
-            creq.handoff_pending = False
-            creq.replica = rep.idx
-            creq.stage = "final"
-            self._placed[(rep.idx, ereq.req_id)] = (creq, "final")
+            if verdict == "delay":
+                # in flight: destination + pages pinned until it lands
+                h["dst"] = rep.idx
+                h["dst_pages"] = pages
+                h["lands_at"] = now + max(vdur, 0.0)
+                still.append(h)
+                continue
+            self._land_handoff(h, rep, pages, now)
+            if verdict == "dup":
+                # delivered but the ack was lost: the sender re-sends.
+                # The redelivery must hit the (req_id, epoch) dedup and
+                # be dropped — never adopted twice
+                dup = dict(h, redelivery=True, dst=None,
+                           dst_pages=None, lands_at=None)
+                still.append(dup)
         self._pending_handoffs = still
 
-    def _final_cb(self, creq: ClusterRequest):
-        def cb(ereq, tok, creq=creq):
+    def _land_handoff(self, h: Dict[str, Any], rep: Replica,
+                      pages, now: float) -> None:
+        """Inject the staged pages and ADOPT the request mid-flight on
+        ``rep`` — the single place a handoff becomes engine state, and
+        the single place the ``(request id, epoch)`` idempotency key is
+        written."""
+        creq: ClusterRequest = h["creq"]
+        pool = rep.engine.pool
+        if pages is not None:
+            rec = self.transport.inject(
+                pool, h["staged"], pages, src_replica=h["src"],
+                dst_replica=rep.idx, epoch=h["epoch"])
+            self.counters["handoffs"].inc()
+            tr = self.tracer
+            if tr.enabled:
+                tr.instant("handoff", track="router", ts=now,
+                           req=creq.req_id, src=h["src"],
+                           dst=rep.idx, pages=rec["pages"],
+                           payload_bytes=rec["payload_bytes"],
+                           predicted_wire_s=rec["predicted_s"],
+                           epoch=h["epoch"])
+            pos = h["pos"]
+        else:
+            # pages can NEVER fit this decode pool: degrade to a
+            # full re-prefill on the decode replica (correct, just
+            # not disaggregated for this one request)
+            pos = 0
+        fence = self._fence[rep.idx]
+        ereq = rep.engine.adopt_request(
+            creq.prompt, [h["first"]], creq.max_new_tokens,
+            pages=pages, pos=pos, temperature=creq.temperature,
+            top_k=creq.top_k, top_p=creq.top_p, seed=creq.seed,
+            eos_token_id=creq.eos_token_id, arrival_time=now,
+            stream_cb=self._final_cb(creq, rep.idx, fence))
+        self._injected.add((creq.req_id, h["epoch"]))
+        self._adoptions.append({"req_id": creq.req_id,
+                                "epoch": h["epoch"], "dst": rep.idx,
+                                "fence_epoch": fence})
+        creq.handoff_pending = False
+        creq.replica = rep.idx
+        creq.stage = "final"
+        self._placed[(rep.idx, ereq.req_id)] = (creq, "final", fence)
+
+    def _final_cb(self, creq: ClusterRequest, ridx: int, epoch: int):
+        def cb(ereq, tok, creq=creq, ridx=ridx, epoch=epoch):
+            if self._fence[ridx] != epoch:
+                return         # fenced epoch: stale stream token
             creq.token_times.append(self._time())
         return cb
 
@@ -475,24 +736,49 @@ class EngineCluster:
 
     def _collect_finished(self) -> None:
         for r in self.replicas:
-            if not r.alive:
-                continue
+            if not (r.alive or r.serving):
+                continue       # fully dead process: nothing new appears
             for erid, ereq in list(r.engine.finished.items()):
                 ent = self._placed.pop((r.idx, erid), None)
                 if ent is None:
-                    continue              # not cluster-placed
+                    # a fenced epoch's completion surfacing late (the
+                    # zombie kept stepping): drop it — the re-routed
+                    # copy owns the finish.  Anything else is simply
+                    # not cluster-placed (direct engine use)
+                    if self._stale_expected.pop((r.idx, erid),
+                                                None) is not None:
+                        del r.engine.finished[erid]
+                        self._drop_stale(r.idx, erid)
+                    continue
                 # collected: drain it from the engine so this scan
                 # stays O(new finishes), not O(requests ever served)
                 del r.engine.finished[erid]
-                creq, stage = ent
+                creq, stage, epoch = ent
+                if epoch != self._fence[r.idx]:
+                    # belt-and-braces: a placement from a fenced epoch
+                    # that somehow survived the death sweep
+                    self._drop_stale(r.idx, erid)
+                    continue
                 if stage == "prefill" and creq.handoff_pending:
                     # the decode stage owns the finish (staging always
                     # precedes the prefill finish: the stream callback
                     # runs inside the emit, before _maybe_finish)
                     continue
+                if creq.done:
+                    # already completed elsewhere: never finish twice
+                    self._drop_stale(r.idx, erid)
+                    continue
                 # prefill stage without a staged handoff = eos on the
                 # first sampled token: the request IS complete
                 self._finish(creq, ereq)
+
+    def _drop_stale(self, ridx: int, erid: int) -> None:
+        self.counters["stale_completions_dropped"].inc()
+        tr = self.tracer
+        if tr.enabled:
+            tr.instant("stale_completion_dropped", track="router",
+                       ts=self._time(), replica=ridx, engine_req=erid,
+                       fence_epoch=self._fence[ridx])
 
     def _finish(self, creq: ClusterRequest, ereq) -> None:
         creq.out_tokens = list(ereq.out_tokens)
@@ -520,6 +806,35 @@ class EngineCluster:
         its heartbeat and serving immediately; the next :meth:`step`
         re-routes its unfinished requests."""
         self.replicas[idx].kill()
+
+    def readmit_replica(self, idx: int) -> None:
+        """Explicitly re-admit a quarantined replica.  Quarantine is
+        sticky by design: a TTL-expired replica that resumes
+        heartbeating must NOT race its own replacement back into the
+        candidate set — its fence epoch already advanced and its
+        in-flight work was re-routed.  Re-admission aborts whatever
+        stale engine state it still holds (pages freed, shared refs
+        released, nothing collected), drains its stale finished set,
+        restarts heartbeats, and only THEN clears the verdict; new
+        placements are stamped with the current (post-death) epoch, so
+        nothing it delivered from the fenced past can ever land."""
+        r = self.replicas[idx]
+        if r.alive:
+            return
+        for erid in r.engine.abort_all():
+            self._stale_expected.pop((idx, erid), None)
+        for erid in list(r.engine.finished):
+            if self._stale_expected.pop((idx, erid), None) is not None:
+                del r.engine.finished[erid]
+                self._drop_stale(idx, erid)
+        r.resurrect()
+        self._dead_handled.discard(idx)
+        self.counters["readmits"].inc()
+        tr = self.tracer
+        if tr.enabled:
+            tr.instant("replica_readmitted", track="router",
+                       ts=self._time(), replica=idx,
+                       fence_epoch=self._fence[idx])
 
     def close(self) -> None:
         for r in self.replicas:
@@ -569,12 +884,21 @@ class EngineCluster:
         out["prefix_cache_hit_rate"] = hits / max(hits + miss, 1.0)
         for k, c in self.counters.items():
             out[f"cluster_{k}"] = c.value
+        # failure-plane counters under their own names too (DESIGN.md
+        # §18 / dashboards): requests_rerouted is the reroutes counter
+        for k in ("replica_deaths", "handoff_retries",
+                  "handoffs_restaged", "requests_shed",
+                  "stale_completions_dropped",
+                  "duplicate_deliveries_dropped", "readmits"):
+            out[k] = self.counters[k].value
+        out["requests_rerouted"] = self.counters["reroutes"].value
         for k, h in self.histograms.items():
             out[k] = h.summary()
         out["replicas"] = len(self.replicas)
         out["alive_replicas"] = sum(1 for r in self.replicas if r.alive)
         out["backlog"] = len(self._backlog)
         out["pending_handoffs"] = len(self._pending_handoffs)
+        out["shed"] = len(self.shed)
         out["per_replica"] = {
             f"r{r.idx}": {
                 "alive": r.alive, "role": r.role,
@@ -593,7 +917,16 @@ class EngineCluster:
     def metrics_text(self) -> str:
         """One Prometheus exposition for the fleet: every replica's
         ``Engine.metrics_text()`` merged under a ``replica`` label
-        (``utils.metrics.merge_prometheus_texts``)."""
-        return merge_prometheus_texts(
-            {f"r{r.idx}": r.engine.metrics_text()
-             for r in self.replicas}, label="replica")
+        (``utils.metrics.merge_prometheus_texts``), plus the cluster's
+        own counters (routing, handoffs, and the failure plane —
+        replica_deaths / handoff_retries / handoffs_restaged /
+        requests_shed / stale_completions_dropped) and latency
+        histograms under ``replica="router"``."""
+        from ...utils.metrics import render_prometheus
+        insts: Dict[str, Any] = {}
+        insts.update(self.counters)
+        insts.update(self.histograms)
+        texts = {f"r{r.idx}": r.engine.metrics_text()
+                 for r in self.replicas}
+        texts["router"] = render_prometheus(insts)
+        return merge_prometheus_texts(texts, label="replica")

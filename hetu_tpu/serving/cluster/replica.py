@@ -46,6 +46,10 @@ class Replica:
         self.client = client
         self.rank: Optional[int] = None
         self._hb_stop = None
+        self._hb_interval = float(heartbeat_interval)
+        # chaos straggler window: the cluster skips this replica's
+        # engine beats while its step counter is below slow_until
+        self.slow_until: float = 0.0
         # ``alive`` is the cluster's health VERDICT (flipped by the
         # coordinator's missed-heartbeat detection, or directly when no
         # coordinator runs); ``serving`` is the simulated process state
@@ -108,6 +112,37 @@ class Replica:
         if self._hb_stop is not None:
             self._hb_stop.set()
         self.serving = False
+
+    def pause_heartbeat(self) -> None:
+        """The zombie seam: heartbeats stall while the engine keeps
+        stepping — the coordinator's TTL verdict will land even though
+        the 'process' is alive, and the cluster must fence it."""
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            self._hb_stop = None
+
+    def resume_heartbeat(self) -> None:
+        """A zombie's heartbeats return.  Deliberately does NOT clear
+        the quarantine: a replica the cluster already declared dead
+        stays fenced until :meth:`EngineCluster.readmit_replica` — a
+        revived replica racing its own replacement is the
+        double-delivery hazard the fence exists for."""
+        if self.client is not None and self._hb_stop is None:
+            self._hb_stop = self.client.start_heartbeat_thread(
+                interval=self._hb_interval)
+            try:
+                self.client.heartbeat()   # refresh the verdict input NOW
+            except Exception:
+                pass
+
+    def resurrect(self) -> None:
+        """Operator re-admission (the cluster aborts the stale engine
+        state first): serving and heartbeats restart, the liveness
+        verdict resets."""
+        self.serving = True
+        self.alive = True
+        self.slow_until = 0.0
+        self.resume_heartbeat()
 
     def close(self) -> None:
         if self._hb_stop is not None:

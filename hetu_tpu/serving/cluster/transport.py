@@ -31,7 +31,7 @@ disaggregation design priced before TPU hardware exists.
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -53,7 +53,8 @@ class PageTransport:
 
     def inject(self, dst_pool: PagedKVPool, staged: Any,
                dst_pages: Sequence[int], src_replica: int = -1,
-               dst_replica: int = -1) -> Dict[str, Any]:
+               dst_replica: int = -1,
+               epoch: Optional[int] = None) -> Dict[str, Any]:
         raise NotImplementedError
 
     def records_for(self, dst_replica: int) -> List[Dict[str, Any]]:
@@ -95,9 +96,16 @@ class LocalPageTransport(PageTransport):
 
     def inject(self, dst_pool: PagedKVPool, staged: Dict[str, Any],
                dst_pages: Sequence[int], src_replica: int = -1,
-               dst_replica: int = -1) -> Dict[str, Any]:
+               dst_replica: int = -1,
+               epoch: Optional[int] = None) -> Dict[str, Any]:
         """Land staged pages into ``dst_pages`` (already allocated in
-        ``dst_pool``) and append the priced handoff record."""
+        ``dst_pool``) and append the priced handoff record.  ``epoch``
+        is the fence token: the cluster's per-handoff staging epoch
+        (fresh on every re-stage).  It deliberately has NO usable
+        default — a call site that omits it records ``epoch: None``
+        and the ``unfenced-handoff`` rule fails CI, which is exactly
+        how a regression to the unfenced PR-11 signature gets
+        caught."""
         idx = jnp.asarray(list(dst_pages), jnp.int32)
         if int(idx.shape[0]) != int(staged["n_pages"]):
             raise ValueError(
@@ -113,6 +121,7 @@ class LocalPageTransport(PageTransport):
         rec = self._price(int(staged["n_pages"]),
                           int(staged["payload_bytes"]),
                           src_replica, dst_replica, wall)
+        rec["epoch"] = None if epoch is None else int(epoch)
         self.records.append(rec)
         return rec
 

@@ -506,6 +506,32 @@ class Engine:
                            shared_pages=req.shared_pages)
         req.trace_t0 = t
 
+    def abort_all(self) -> List[int]:
+        """Abort every queued + running request: owned pages return to
+        the free list, shared prefix-cache references are released,
+        nothing enters ``finished``.  The re-admission path for a
+        fenced cluster replica — its re-routed work already lives on
+        survivors, so whatever this engine still holds is stale by
+        definition.  Returns the aborted engine request ids."""
+        victims = [r for _, _, r in self.queue._heap]
+        victims.extend(self.running)
+        for req in victims:
+            self.pool.free(req.pages[req.shared_pages:])
+            if self.prefix_cache is not None and req.shared_pages:
+                self.prefix_cache.release(req)
+            req.pages = []
+            req.shared_pages = 0
+            req.cached_tokens = 0
+            req.pos = 0
+            req.state = FINISHED          # terminal, but never collected
+        self.queue._heap.clear()
+        self.running.clear()
+        if self.debug:
+            self.pool.check_invariants()
+            if self.prefix_cache is not None:
+                self.prefix_cache.check_invariants()
+        return [r.req_id for r in victims]
+
     # -- the unified step ----------------------------------------------------
 
     def _pack_arrays(self, rows: List[Tuple[Request, int, int]]):

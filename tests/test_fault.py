@@ -1,0 +1,655 @@
+"""Fault plane (ISSUE 13): deterministic chaos injection + fenced
+retry/backoff recovery across the serving cluster and elastic trainer.
+
+The invariant under EVERY seeded FaultPlan (crash, zombie, transport
+drop/dup/delay, straggler, randomized fuzz): zero requests lost, zero
+duplicated tokens, temp-0 outputs of surviving requests bit-for-bit
+equal to the fault-free run.  Plus: a revived TTL-expired replica stays
+quarantined until explicit re-admission (the revival race), backoff
+retries replace the bare handoff spin loops, the whole-fleet
+backpressure path sheds with a retriable rejection instead of growing
+the backlog without bound, and an injected worker death in the elastic
+trainer re-plans on the survivors and continues the exact checkpointed
+loss curve.
+"""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.fault import (ChaosController, FaultEvent, FaultPlan,
+                            RetryPolicy, check_cluster_invariants)
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.models.generate import generate
+from hetu_tpu.obs.tracer import SpanTracer
+from hetu_tpu.serving import EngineCluster
+
+CFG_KW = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+              max_seq_len=64, sp=False, dropout=0.0)
+SHAPE_KW = dict(page_size=8, max_batch=4, chunk_size=8, prefill_rows=1,
+                max_model_len=56)
+
+
+@pytest.fixture(scope="module")
+def model_state():
+    cfg = GPTConfig(**CFG_KW)
+    ht.set_seed(3)
+    with ht.graph("eager", create_new=True):
+        model = GPTLMHeadModel(cfg)
+        model.logits(np.zeros((1, 4), np.int32))
+        state = {k: np.asarray(v) for k, v in model.state_dict().items()}
+    return state, cfg
+
+
+@pytest.fixture(scope="module")
+def shared_fn():
+    from hetu_tpu.serving.decode import build_unified_step_fn
+    cfg = GPTConfig(**CFG_KW)
+    return build_unified_step_fn(
+        cfg, SHAPE_KW["max_batch"], SHAPE_KW["chunk_size"],
+        SHAPE_KW["prefill_rows"],
+        -(-SHAPE_KW["max_model_len"] // SHAPE_KW["page_size"]),
+        SHAPE_KW["page_size"], use_kernel=False)
+
+
+def _make_cluster(state, cfg, fn=None, **kw):
+    clock = [0.0]
+    kw.setdefault("time_fn", lambda: clock[0])
+    kw.setdefault("num_pages", 12)
+    for k, v in SHAPE_KW.items():
+        kw.setdefault(k, v)
+    kw.setdefault("debug", True)
+    kw.setdefault("ttl", 3600.0)
+    kw.setdefault("coordinator", False)
+    cl = EngineCluster(state, cfg, step_fn=fn, **kw)
+    cl._test_clock = clock
+    return cl
+
+
+def _drain(cl, limit=800, invariants=False):
+    n = 0
+    while cl.has_work:
+        cl.step()
+        if invariants:
+            check_cluster_invariants(cl)
+        cl._test_clock[0] += 1.0
+        n += 1
+        assert n < limit, "cluster did not drain"
+    return n
+
+
+def _trace(rng, n, vocab=97, lo=8, hi=20):
+    return [rng.randint(1, vocab, size=rng.randint(lo, hi)).tolist()
+            for _ in range(n)]
+
+
+def _fault_free(state, cfg, fn, prompts, new, name, **kw):
+    """The reference outputs every chaos run must reproduce."""
+    cl = _make_cluster(state, cfg, fn, name=name, **kw)
+    for i, p in enumerate(prompts):
+        cl.add_request(p, new, arrival_time=float(i))
+    _drain(cl)
+    out = {rid: list(c.out_tokens) for rid, c in cl.finished.items()}
+    cl.close()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# policy / plan units
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_caps_and_is_deterministic():
+    p = RetryPolicy(base=0.5, cap=4.0, jitter=0.25, deadline=10.0)
+    d = [p.delay(a, key=7) for a in range(10)]
+    # deterministic: a second evaluation is identical
+    assert d == [p.delay(a, key=7) for a in range(10)]
+    # capped: never above cap * (1 + jitter), grows from base scale
+    assert max(d) <= 4.0 * 1.25 + 1e-9
+    assert d[0] <= 0.5 * 1.25 + 1e-9
+    assert d[5] > d[0]
+    # jitter is keyed: a different request sees different jitter
+    assert [p.delay(a, key=8) for a in range(10)] != d
+    # deadlines
+    assert p.deadline_for(2.0) == 12.0
+    assert not p.expired(2.0, 11.0) and p.expired(2.0, 12.5)
+    assert RetryPolicy(deadline=None).deadline_for(2.0) is None
+
+
+def test_fault_plan_random_is_survivable_and_deterministic():
+    for seed in range(6):
+        plan = FaultPlan.random(seed, num_replicas=3, steps=50,
+                                n_events=80)
+        alive = {0, 1, 2}
+        for ev in plan.events:
+            if ev.kind in ("crash", "zombie"):
+                alive.discard(ev.target)
+            elif ev.kind == "readmit":
+                alive.add(ev.target)
+            assert alive, f"plan {seed} killed every replica"
+    a = FaultPlan.random(3, 3, 50, n_events=40)
+    b = FaultPlan.random(3, 3, 50, n_events=40)
+    assert a.events == b.events and a.transport == b.transport
+    assert FaultPlan.random(4, 3, 50, n_events=40).events != a.events
+
+
+def test_fault_plan_rejects_unknown_kinds():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultEvent(0, "meteor", 0)
+    with pytest.raises(ValueError, match="unknown transport verdict"):
+        FaultPlan(transport={0: ("teleport", 0.0)})
+
+
+# ---------------------------------------------------------------------------
+# crash / zombie / revival race
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_crash_bitforbit_and_trace(model_state, shared_fn):
+    """A scheduled crash: the dead replica's work re-routes, outputs
+    stay bit-for-bit the fault-free run's, and the tracer shows the
+    full fail -> detect -> recover chain."""
+    state, cfg = model_state
+    rng = np.random.RandomState(0)
+    prompts = _trace(rng, 6)
+    NEW = 8
+    want = _fault_free(state, cfg, shared_fn, prompts, NEW, "f_ref")
+
+    plan = FaultPlan(events=[FaultEvent(step=3, kind="crash", target=1)])
+    tracer = SpanTracer()
+    cl = _make_cluster(state, cfg, shared_fn, num_replicas=2,
+                       name="f_crash", policy="load",
+                       chaos=ChaosController(plan), tracer=tracer)
+    reqs = [cl.add_request(p, NEW, arrival_time=float(i))
+            for i, p in enumerate(prompts)]
+    _drain(cl, invariants=True)
+    assert set(cl.finished) == {r.req_id for r in reqs}   # nothing lost
+    for r in reqs:
+        assert r.out_tokens == want[r.req_id]
+    ms = cl.metrics_summary()
+    assert ms["replica_deaths"] == 1
+    assert ms["requests_rerouted"] >= 1
+    names = [e.name for e in tracer.events()]
+    for evname in ("fault", "replica_dead", "reroute"):
+        assert evname in names, f"missing {evname} instant"
+    # fail -> detect -> recover ordering on the merged timeline
+    assert names.index("fault") < names.index("replica_dead") \
+        < names.index("reroute")
+    cl.close()
+
+
+def test_chaos_zombie_fenced_no_duplicate_tokens(model_state, shared_fn):
+    """The zombie: heartbeats stall, the engine keeps stepping.  The
+    cluster fences it — its late completions are dropped, its stream
+    tokens ignored — and every request finishes exactly once with
+    fault-free outputs."""
+    state, cfg = model_state
+    rng = np.random.RandomState(1)
+    prompts = _trace(rng, 6)
+    NEW = 8
+    want = _fault_free(state, cfg, shared_fn, prompts, NEW, "f_zref")
+
+    plan = FaultPlan(events=[FaultEvent(step=4, kind="zombie",
+                                        target=1)])
+    cl = _make_cluster(state, cfg, shared_fn, num_replicas=2,
+                       name="f_zombie", policy="load",
+                       chaos=ChaosController(plan))
+    reqs = [cl.add_request(p, NEW, arrival_time=float(i))
+            for i, p in enumerate(prompts)]
+    _drain(cl, invariants=True)
+    z = cl.replicas[1]
+    assert z.serving and not z.alive, "zombie state lost"
+    assert set(cl.finished) == {r.req_id for r in reqs}
+    for r in reqs:
+        assert r.out_tokens == want[r.req_id], \
+            "zombie double-delivery corrupted a request"
+        assert len(r.out_tokens) == NEW            # no duplicated token
+    # the zombie really kept finishing work that had to be dropped
+    assert cl.metrics_summary()["stale_completions_dropped"] > 0
+    cl.close()
+
+
+def test_revived_replica_stays_quarantined_until_readmit(model_state,
+                                                         shared_fn):
+    """The revival race: a TTL-expired replica that resumes
+    heartbeating must NOT re-enter the candidate set by itself; after
+    explicit re-admission it serves again under the new fence epoch."""
+    state, cfg = model_state
+    plan = FaultPlan(events=[FaultEvent(step=2, kind="zombie", target=1),
+                             FaultEvent(step=6, kind="revive", target=1)])
+    cl = _make_cluster(state, cfg, shared_fn, num_replicas=2,
+                       name="f_revive", policy="load",
+                       chaos=ChaosController(plan))
+    rng = np.random.RandomState(2)
+    prompts = _trace(rng, 5)
+    reqs = [cl.add_request(p, 6, arrival_time=float(i))
+            for i, p in enumerate(prompts)]
+    _drain(cl, invariants=True)
+    assert not cl.replicas[1].alive, \
+        "revived replica re-admitted itself (revival race)"
+    assert set(cl.finished) == {r.req_id for r in reqs}
+    fence_at_death = cl._fence[1]
+    # explicit re-admission: stale state aborted, replica serves again
+    cl.readmit_replica(1)
+    assert cl.replicas[1].alive
+    assert not cl.replicas[1].engine.has_work, "stale work survived"
+    assert cl.metrics_summary()["readmits"] == 1
+    late = cl.add_request([4, 5, 6, 7], 4,
+                          arrival_time=cl._test_clock[0])
+    # force it onto the readmitted replica by loading r0's queue
+    _drain(cl, invariants=True)
+    assert late.out_tokens == \
+        _solo(state, cfg, late.prompt, 4)
+    assert cl._fence[1] == fence_at_death   # epoch advances on death only
+    cl.close()
+
+
+def _solo(state, cfg, prompt, n_new):
+    return np.asarray(generate(state, cfg,
+                               np.asarray([prompt], np.int32), n_new,
+                               temperature=0.0))[0, len(prompt):].tolist()
+
+
+# ---------------------------------------------------------------------------
+# transport chaos (disaggregated handoffs)
+# ---------------------------------------------------------------------------
+
+
+def _disagg(state, cfg, fn, name, plan=None, n=3, **kw):
+    chaos = ChaosController(plan) if plan is not None else None
+    return _make_cluster(state, cfg, fn, num_replicas=n,
+                         mode="disaggregated", num_prefill=1,
+                         name=name, chaos=chaos, **kw)
+
+
+def test_transport_drop_retries_with_backoff(model_state, shared_fn):
+    state, cfg = model_state
+    rng = np.random.RandomState(3)
+    prompts = _trace(rng, 5)
+    NEW = 8
+    want = _fault_free(state, cfg, shared_fn, prompts, NEW, "f_dref",
+                       num_replicas=3, mode="disaggregated",
+                       num_prefill=1)
+    # drop the first two injection attempts outright
+    plan = FaultPlan(transport={0: ("drop", 0.0), 1: ("drop", 0.0)})
+    cl = _disagg(state, cfg, shared_fn, "f_drop", plan)
+    reqs = [cl.add_request(p, NEW, arrival_time=float(i))
+            for i, p in enumerate(prompts)]
+    _drain(cl, invariants=True)
+    ms = cl.metrics_summary()
+    assert ms["handoff_retries"] >= 2          # the drops really hit
+    assert set(cl.finished) == {r.req_id for r in reqs}
+    for r in reqs:
+        assert r.out_tokens == want[r.req_id]
+    cl.close()
+
+
+def test_transport_dup_deduped_by_request_epoch(model_state, shared_fn):
+    """A delivery whose ack was lost gets re-sent; the (request id,
+    staging epoch) dedup drops the duplicate — the request is adopted
+    exactly once, tokens are not duplicated."""
+    state, cfg = model_state
+    rng = np.random.RandomState(4)
+    prompts = _trace(rng, 5)
+    NEW = 8
+    want = _fault_free(state, cfg, shared_fn, prompts, NEW, "f_dupref",
+                       num_replicas=3, mode="disaggregated",
+                       num_prefill=1)
+    plan = FaultPlan(transport={0: ("dup", 0.0), 2: ("dup", 0.0)})
+    cl = _disagg(state, cfg, shared_fn, "f_dup", plan)
+    reqs = [cl.add_request(p, NEW, arrival_time=float(i))
+            for i, p in enumerate(prompts)]
+    _drain(cl, invariants=True)
+    ms = cl.metrics_summary()
+    assert ms["duplicate_deliveries_dropped"] >= 2
+    assert set(cl.finished) == {r.req_id for r in reqs}
+    for r in reqs:
+        assert r.out_tokens == want[r.req_id]
+        assert len(r.out_tokens) == NEW
+    cl.close()
+
+
+def test_destination_death_restages_handoff(model_state, shared_fn):
+    """A delayed (in-flight) handoff whose pinned destination dies
+    mid-transfer is re-staged to a surviving decode replica; outputs
+    stay exact.  (PR 11 only survived SOURCE death.)"""
+    state, cfg = model_state
+    rng = np.random.RandomState(5)
+    prompts = _trace(rng, 4)
+    NEW = 8
+    want = _fault_free(state, cfg, shared_fn, prompts, NEW, "f_rsref",
+                       num_replicas=3, mode="disaggregated",
+                       num_prefill=1)
+    # every early handoff floats on the wire for 3 clock units; the
+    # first decode replica (the least-loaded pick) dies underneath
+    plan = FaultPlan(
+        events=[FaultEvent(step=3, kind="crash", target=1)],
+        transport={i: ("delay", 3.0) for i in range(4)})
+    cl = _disagg(state, cfg, shared_fn, "f_restage", plan)
+    reqs = [cl.add_request(p, NEW, arrival_time=float(i))
+            for i, p in enumerate(prompts)]
+    _drain(cl, invariants=True)
+    ms = cl.metrics_summary()
+    assert ms["handoffs_restaged"] >= 1, \
+        "no destination death was in flight; test is vacuous"
+    assert set(cl.finished) == {r.req_id for r in reqs}
+    for r in reqs:
+        assert r.out_tokens == want[r.req_id]
+    cl.close()
+
+
+def test_decode_fleet_empty_degrades_to_monolithic(model_state,
+                                                   shared_fn):
+    """Every decode replica dead: staged handoffs degrade to local
+    end-to-end serving on the survivors instead of trapping requests."""
+    state, cfg = model_state
+    rng = np.random.RandomState(6)
+    prompts = _trace(rng, 3)
+    NEW = 6
+    want = _fault_free(state, cfg, shared_fn, prompts, NEW, "f_mref")
+    plan = FaultPlan(events=[FaultEvent(step=2, kind="crash", target=1)])
+    cl = _disagg(state, cfg, shared_fn, "f_mono", plan, n=2)
+    reqs = [cl.add_request(p, NEW, arrival_time=float(i))
+            for i, p in enumerate(prompts)]
+    _drain(cl, invariants=True)
+    assert set(cl.finished) == {r.req_id for r in reqs}
+    for r in reqs:
+        assert r.out_tokens == want[r.req_id]
+    # the prefill replica really served end-to-end after the death
+    assert cl.replicas[0].engine.metrics_summary()["tokens_generated"] \
+        > len(prompts)
+    cl.close()
+
+
+# ---------------------------------------------------------------------------
+# load shedding / bounded backlog
+# ---------------------------------------------------------------------------
+
+
+def test_load_shedding_past_deadline_is_retriable(model_state,
+                                                  shared_fn):
+    """Whole fleet backpressured past the deadline: the request is
+    SHED with a retriable rejection (bounded wait), and a later
+    resubmission completes normally."""
+    state, cfg = model_state
+    cl = _make_cluster(state, cfg, shared_fn, num_replicas=1,
+                       name="f_shed", max_queue_depth=1,
+                       request_deadline=3.0)
+    long = cl.add_request(list(range(1, 17)), 12, arrival_time=0.0)
+    waiters = [cl.add_request([30 + i, 2, 3], 4, arrival_time=0.0)
+               for i in range(3)]
+    _drain(cl, invariants=True)
+    assert long.req_id in cl.finished
+    shed = [w for w in waiters if w.rejected]
+    assert shed, "no request was shed under saturation past deadline"
+    for w in shed:
+        assert w.reject_reason == "backpressured_past_deadline"
+        assert w.req_id in cl.shed and w.req_id not in cl.finished
+    assert cl.metrics_summary()["requests_shed"] == len(shed)
+    # nothing lost: every submission is accounted exactly once
+    assert set(cl.finished) | set(cl.shed) == \
+        {r.req_id for r in [long] + waiters}
+    # the rejection is retriable: resubmit now that the fleet is idle
+    retry = cl.add_request(shed[0].prompt, 4,
+                           arrival_time=cl._test_clock[0])
+    _drain(cl, invariants=True)
+    assert retry.out_tokens == _solo(state, cfg, shed[0].prompt, 4)
+    cl.close()
+
+
+def test_bounded_backlog_sheds_at_front_door(model_state, shared_fn):
+    state, cfg = model_state
+    cl = _make_cluster(state, cfg, shared_fn, num_replicas=1,
+                       name="f_bound", max_backlog=2)
+    reqs = [cl.add_request([i + 1, 2, 3], 3, arrival_time=100.0)
+            for i in range(5)]
+    over = [r for r in reqs if r.rejected]
+    assert len(over) == 3 and all(
+        r.reject_reason == "backlog_full" for r in over)
+    assert cl.metrics_summary()["requests_shed"] == 3
+    cl._test_clock[0] = 100.0
+    _drain(cl, invariants=True)
+    assert set(cl.finished) == {r.req_id for r in reqs
+                                if not r.rejected}
+    cl.close()
+
+
+# ---------------------------------------------------------------------------
+# the seeded chaos fuzz (~300 events)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_fuzz_invariants_hold(model_state, shared_fn):
+    """A randomized ~300-event FaultPlan over a disaggregated cluster:
+    cluster invariants hold after EVERY step, nothing is lost, and all
+    surviving (= all, no shedding configured) outputs are bit-for-bit
+    the fault-free run's."""
+    state, cfg = model_state
+    rng = np.random.RandomState(9)
+    prompts = _trace(rng, 10)
+    NEW = 6
+    want = _fault_free(state, cfg, shared_fn, prompts, NEW, "f_fzref",
+                       num_replicas=3, mode="disaggregated",
+                       num_prefill=1)
+    plan = FaultPlan.random(seed=1234, num_replicas=3, steps=60,
+                            n_events=300, protect=(0,))
+    assert plan.n_events >= 200, plan.describe()
+    cl = _disagg(state, cfg, shared_fn, "f_fuzz", plan)
+    reqs = [cl.add_request(p, NEW, arrival_time=float(i))
+            for i, p in enumerate(prompts)]
+    _drain(cl, limit=1500, invariants=True)
+    assert set(cl.finished) == {r.req_id for r in reqs}, "request lost"
+    for r in reqs:
+        assert r.out_tokens == want[r.req_id], \
+            (r.req_id, plan.describe())
+        assert len(r.out_tokens) == NEW
+    # the plan actually exercised the machinery
+    assert cl.chaos.injected, "no fault ever fired"
+    cl.close()
+
+
+# ---------------------------------------------------------------------------
+# fast chaos smoke (tier-1 gate) + unfenced-handoff rule
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.lint_graph
+def test_chaos_smoke_gate(model_state, shared_fn):
+    """The tier-1 chaos gate: one crash + one drop + one dup over a
+    small disaggregated trace — invariants after every step, nothing
+    lost, outputs exact, and the merged trace carries fault / detect /
+    recover instants for the injected events."""
+    state, cfg = model_state
+    rng = np.random.RandomState(12)
+    prompts = _trace(rng, 4)
+    NEW = 6
+    want = _fault_free(state, cfg, shared_fn, prompts, NEW, "f_smref",
+                       num_replicas=3, mode="disaggregated",
+                       num_prefill=1)
+    plan = FaultPlan(
+        events=[FaultEvent(step=4, kind="crash", target=2)],
+        transport={0: ("drop", 0.0), 1: ("dup", 0.0)})
+    tracer = SpanTracer()
+    cl = _disagg(state, cfg, shared_fn, "f_smoke", plan, tracer=tracer)
+    reqs = [cl.add_request(p, NEW, arrival_time=float(i))
+            for i, p in enumerate(prompts)]
+    _drain(cl, invariants=True)
+    assert set(cl.finished) == {r.req_id for r in reqs}
+    for r in reqs:
+        assert r.out_tokens == want[r.req_id]
+    names = [e.name for e in tracer.events()]
+    assert "fault" in names                       # injected
+    assert "replica_dead" in names                # detected
+    assert "handoff_retry" in names               # recovery: backoff
+    assert "duplicate_dropped" in names           # recovery: dedup
+    ms = cl.metrics_summary()
+    assert ms["replica_deaths"] == 1
+    assert ms["handoff_retries"] >= 1
+    assert ms["duplicate_deliveries_dropped"] >= 1
+    cl.close()
+
+
+@pytest.mark.lint_graph
+def test_unfenced_handoff_rule(model_state, shared_fn):
+    """Repo-standard rule contract: silent on the real (fenced)
+    transport — non-vacuously, records and adoptions present — fires
+    exactly once per stripped fence token, and honors the
+    fence_exempt exemption."""
+    from hetu_tpu.analysis import AnalysisContext, run_rules
+    from hetu_tpu.graph.graph import clear_executables, get_executable
+    state, cfg = model_state
+    cl = _disagg(state, cfg, shared_fn, "f_rule")
+    rng = np.random.RandomState(13)
+    for i in range(3):
+        cl.add_request(rng.randint(1, 97, size=12).tolist(), 4,
+                       arrival_time=float(i))
+    _drain(cl)
+    handle = get_executable("f_rule@r1/unified")
+    records = handle.meta["kv_handoff"]()
+    adoptions = handle.meta["adoptions"]()
+    assert records and adoptions, "gate is vacuous"
+    assert all(isinstance(r["epoch"], int) for r in records)
+    ctx = AnalysisContext(name=handle.name, meta=handle.meta)
+    assert run_rules(ctx, only=["unfenced-handoff"]) == []
+    # strip one r1-bound record's fence token -> exactly one fire
+    victim = next(i for i, r in enumerate(cl.transport.records)
+                  if r["dst"] == 1)
+    saved = cl.transport.records[victim].pop("epoch")
+    fired = run_rules(AnalysisContext(name=handle.name,
+                                      meta=handle.meta),
+                      only=["unfenced-handoff"])
+    assert len(fired) == 1 and fired[0].rule == "unfenced-handoff"
+    assert "fence token" in fired[0].message
+    assert fired[0].severity == "error"
+    # exemption: the same record flagged as a local same-pool move
+    cl.transport.records[victim]["fence_exempt"] = True
+    assert run_rules(AnalysisContext(name=handle.name,
+                                     meta=handle.meta),
+                     only=["unfenced-handoff"]) == []
+    del cl.transport.records[victim]["fence_exempt"]
+    cl.transport.records[victim]["epoch"] = saved
+    # an adoption without the token fires too
+    avict = next(i for i, a in enumerate(cl._adoptions)
+                 if a["dst"] == 1)
+    cl._adoptions[avict] = {k: v for k, v in cl._adoptions[avict].items()
+                            if k != "epoch"}
+    fired = run_rules(AnalysisContext(name=handle.name,
+                                      meta=handle.meta),
+                      only=["unfenced-handoff"])
+    assert len(fired) == 1 and "adoption" in fired[0].message
+    # executables with neither meta key are out of scope
+    pre = get_executable("f_rule@r0/unified")
+    assert run_rules(AnalysisContext(name=pre.name, meta=pre.meta),
+                     only=["unfenced-handoff"]) == []
+    cl.close()
+    clear_executables("f_rule@")
+
+
+# ---------------------------------------------------------------------------
+# elastic trainer: injected worker death -> re-plan -> exact loss curve
+# ---------------------------------------------------------------------------
+
+
+def _gpt_build_fn(dp, devices):
+    from jax.sharding import PartitionSpec as P
+
+    from hetu_tpu.elastic import TrainBuild
+    from hetu_tpu.graph import ctor
+    from hetu_tpu.models import GPTLMHeadModel, llama_config
+    from hetu_tpu.parallel import create_mesh
+    ctor._seed_counter[0] = 777          # identical init on any layout
+    mesh = create_mesh({"dp": dp}, devices[:dp])
+    cfg = llama_config(vocab_size=64, hidden_size=32, num_layers=1,
+                       num_heads=4, max_seq_len=16, sp=False)
+    gctx = ht.graph("define_and_run", create_new=True, mesh=mesh)
+    g = gctx.__enter__()
+    ids = ht.parallel_placeholder("int32", (8, 16), pspec=P("dp", None),
+                                  name="ids")
+    labels = ht.parallel_placeholder("int32", (8, 16),
+                                     pspec=P("dp", None), name="labels")
+    model = GPTLMHeadModel(cfg)
+    loss = model(ids, labels)
+    opt = ht.optim.AdamOptimizer(lr=1e-2, zero=2, grad_comm="fp32",
+                                 flat_state=True)
+    train_op = opt.minimize(loss)
+    rng = np.random.RandomState(0)
+    IDS = rng.randint(0, 64, (8, 16)).astype(np.int32)
+    feed = {ids: IDS, labels: np.roll(IDS, -1, axis=1)}
+
+    def step_fn(step):
+        out = g.run(loss, [loss, train_op], feed)
+        return float(np.asarray(out[0]))
+
+    return TrainBuild(graph=g, model=model, optimizer=opt,
+                      step_fn=step_fn,
+                      close=lambda: gctx.__exit__(None, None, None))
+
+
+def test_trainer_death_recovery_continues_loss_curve(devices8,
+                                                     tmp_path):
+    """The end-to-end drive of the dp8->dp4 checkpoint round-trip: a
+    worker death injected mid-run is detected through the coordinator,
+    the trainer re-plans on the survivors (dp 8 -> 4), restores the
+    flat-state snapshot, and the final loss curve equals the
+    fault-free run's exactly."""
+    from hetu_tpu.elastic import FaultTolerantTrainer, WorkerMonitor
+    STEPS = 8
+    ref_build = _gpt_build_fn(8, devices8)
+    ref = [ref_build.step_fn(i) for i in range(STEPS)]
+    ref_build.close()
+
+    mon = WorkerMonitor(4, devices8, ttl=0.3, heartbeat_interval=0.05)
+    tr = FaultTolerantTrainer(_gpt_build_fn, devices8, monitor=mon,
+                              checkpoint_dir=str(tmp_path / "ck"),
+                              checkpoint_every=2)
+    plan = FaultPlan(events=[FaultEvent(step=5, kind="worker_death",
+                                        target=3)])
+    losses = tr.train(STEPS, fault_plan=plan)
+    mon.close()
+    tr.close()
+    np.testing.assert_allclose(losses, ref, rtol=1e-6)
+    assert len(tr.recoveries) == 1
+    rec = tr.recoveries[0]
+    assert rec["dead"] == [3] and rec["dp"] == 4
+    assert rec["devices"] == 6
+    assert rec["resumed_from_step"] == 4      # the step-4 snapshot
+    assert rec.get("mttr_s", 0) > 0
+
+
+@pytest.mark.slow
+def test_mpmd_trainer_chaos_straggler_seam(devices8):
+    """The mpmd trainer's chaos seam: a FaultPlan straggler event slows
+    a device mid-run; the retune re-plans around it (the injected
+    ratios reach the solver) and training completes."""
+    from hetu_tpu.elastic.mpmd_trainer import ElasticMPMDTrainer
+    from hetu_tpu.elastic.strategy import StrategyModel
+    from hetu_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                    num_heads=4, max_seq_len=16, sp=False, dropout=0.0)
+    solver = StrategyModel(num_devices=4, num_layers=4,
+                           num_micro_batches=2,
+                           tp_candidates=[1], pp_candidates=[2])
+    rng = np.random.RandomState(0)
+
+    def provider(step):
+        ids = rng.randint(0, 64, (8, 16)).astype(np.int32)
+        return ids, np.roll(ids, -1, axis=1)
+
+    trainer = ElasticMPMDTrainer(cfg, solver, provider,
+                                 devices=devices8[:4],
+                                 switch_threshold=0.01)
+    plan = FaultPlan(events=[FaultEvent(step=2, kind="straggler",
+                                        target=0, ratio=4.0)])
+    tracer = SpanTracer()
+    from hetu_tpu.obs.tracer import install_tracer
+    install_tracer(tracer)
+    try:
+        losses = trainer.run(6, retune_every=2, fault_plan=plan)
+    finally:
+        install_tracer(None)
+    assert len(losses) == 6
+    assert all(np.isfinite(losses))
+    names = [e.name for e in tracer.events()]
+    assert "fault" in names, "straggler injection left no trace"
+    # the injected straggler changed the layout (a 4x-slow device on a
+    # 2-stage pipeline forces an asymmetric split or mb shift)
+    assert trainer.history, "retune never re-planned around the fault"

@@ -114,6 +114,101 @@ def test_per_client_ttl_and_server_ttl():
         assert srv.dead_ranks(ttl=30.0) == []
 
 
+def test_ttl_boundary_heartbeat_exactly_at_ttl():
+    """ISSUE 13 satellite: the liveness window is INCLUSIVE — a worker
+    whose last heartbeat is exactly TTL old is still alive; one just
+    past it is dead.  Asserted on injected stamps (no sleeps, no
+    float-race on the boundary)."""
+    with CoordinatorServer(world_size=1) as srv:
+        c = CoordinatorClient(srv.address, uid="w0")
+        c.connect()
+        now = time.time()
+        with srv.state.lock:
+            srv.state.last_heartbeat[c.rank] = now - 5.0
+        # a TTL comfortably past the stamp: alive; short of it: dead
+        # (the margins absorb the microseconds between set and check)
+        assert c.rank not in srv.dead_ranks(ttl=6.0)
+        assert c.rank in srv.dead_ranks(ttl=4.0)
+        alive, dead = c.alive(ttl=6.0)
+        assert c.rank in alive
+        alive, dead = c.alive(ttl=4.0)
+        assert c.rank in dead
+
+
+def test_clock_skewed_client_liveness_is_server_stamped():
+    """A client with a skewed wall clock cannot poison liveness: the
+    protocol never carries client time — heartbeats (and ANY
+    authenticated request) are stamped with the SERVER's clock.
+    Simulate a wildly skewed stamp, then show one authenticated
+    request restores liveness to server-now."""
+    with CoordinatorServer(world_size=1) as srv:
+        c = CoordinatorClient(srv.address, uid="skewed")
+        c.connect()
+        with srv.state.lock:
+            # as if the client had written its own (past) clock
+            srv.state.last_heartbeat[c.rank] = time.time() - 3600.0
+        assert c.rank in srv.dead_ranks(ttl=1.0)
+        # any rank-authenticated request proves liveness, server-stamped
+        c.barrier("poke", world_size=1, timeout=1.0)
+        assert c.rank not in srv.dead_ranks(ttl=1.0)
+
+
+def test_heartbeat_not_starved_by_long_barrier():
+    """The heartbeat thread shares the client's single socket lock: a
+    blocking barrier holds it for seconds, starving the heartbeat
+    thread.  The server must keep the rank alive anyway — waiting at a
+    barrier IS liveness (refreshed inside the barrier wait loop)."""
+    with CoordinatorServer(world_size=2, ttl=0.3) as srv:
+        a = CoordinatorClient(srv.address, uid="a", ttl=0.3)
+        b = CoordinatorClient(srv.address, uid="b", ttl=0.3)
+        a.connect(), b.connect()
+        stop_a = a.start_heartbeat_thread(interval=0.05)
+        done = []
+
+        def long_barrier():
+            a.barrier("starve", world_size=2, timeout=10.0)
+            done.append(True)
+
+        t = threading.Thread(target=long_barrier)
+        t.start()
+        # a's socket is now held by the barrier for >> TTL; the
+        # heartbeat thread cannot send — yet a must stay alive
+        deadline = time.time() + 1.2
+        while time.time() < deadline:
+            assert a.rank not in srv.dead_ranks(), \
+                "long barrier starved the heartbeat into a false death"
+            time.sleep(0.05)
+        b.barrier("starve", world_size=2, timeout=10.0)
+        t.join(timeout=10)
+        assert done
+        stop_a.set()
+
+
+def test_coordinator_refusal_heartbeat_thread_recovers():
+    """ISSUE 13: a coordinator refusing ops (fault window) must not
+    kill the heartbeat thread — it backs off, retries, and the rank
+    returns to alive once the window heals; an outage shorter than the
+    TTL never produces a death verdict."""
+    with CoordinatorServer(world_size=1, ttl=5.0) as srv:
+        c = CoordinatorClient(srv.address, uid="w0", ttl=5.0)
+        c.connect()
+        stop = c.start_heartbeat_thread(interval=0.05)
+        srv.refuse_for(0.4)
+        # refused ops surface as coordinator errors to direct callers
+        with pytest.raises(RuntimeError, match="refused"):
+            c.put("k", 1)
+        time.sleep(1.2)          # window heals; thread must still live
+        with srv.state.lock:
+            age = time.time() - srv.state.last_heartbeat[c.rank]
+        assert age < 1.0, \
+            f"heartbeat thread died during the refusal window (age {age:.2f}s)"
+        assert c.rank not in srv.dead_ranks(ttl=1.0)
+        # the healed window serves ops again
+        c.put("k", 2)
+        assert c.get("k") == 2
+        stop.set()
+
+
 def test_jax_coordinator_exchange():
     with CoordinatorServer(world_size=2) as srv:
         a = CoordinatorClient(srv.address, uid="a")
