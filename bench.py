@@ -1460,6 +1460,82 @@ def bench_chaos_bench() -> dict:
         "rec = trainer.recoveries[0] if trainer.recoveries else {}\n"
         "loss_ok = bool(np.allclose(losses, ref, rtol=1e-6))\n"
         "\n"
+        "# -- numeric sentry + durable generations (ISSUE 14) ---------\n"
+        "# a seeded plan mixing numeric and process faults: grad_nan\n"
+        "# skips, shard_corrupt poisons the newest generation, the\n"
+        "# loss_spike rewind must fall back past it, then a worker\n"
+        "# death re-plans dp8 -> dp4 on the verified restore path\n"
+        "import shutil\n"
+        "shutil.rmtree('/tmp/cb_nm', ignore_errors=True)\n"
+        "TABLE = np.random.RandomState(42).randint(\n"
+        "    0, 64, (64, 8, 16)).astype(np.int32)\n"
+        "def build_sentry(dp, devices):\n"
+        "    ctor._seed_counter[0] = 777\n"
+        "    mesh = create_mesh({'dp': dp}, devices[:dp])\n"
+        "    tcfg = llama_config(vocab_size=64, hidden_size=32,\n"
+        "                        num_layers=1, num_heads=4,\n"
+        "                        max_seq_len=16, sp=False)\n"
+        "    gctx = ht.graph('define_and_run', create_new=True,\n"
+        "                    mesh=mesh)\n"
+        "    g = gctx.__enter__()\n"
+        "    ids = ht.parallel_placeholder('int32', (8, 16),\n"
+        "                                  pspec=P('dp', None),\n"
+        "                                  name='ids')\n"
+        "    labels = ht.parallel_placeholder('int32', (8, 16),\n"
+        "                                     pspec=P('dp', None),\n"
+        "                                     name='labels')\n"
+        "    model = GPTLMHeadModel(tcfg)\n"
+        "    loss = model(ids, labels)\n"
+        "    opt = ht.optim.AdamOptimizer(lr=1e-2, zero=2,\n"
+        "                                 grad_comm='fp32',\n"
+        "                                 flat_state=True, sentry=True)\n"
+        "    train_op = opt.minimize(loss)\n"
+        "    def step_fn(cursor):\n"
+        "        b = TABLE[cursor % 64]\n"
+        "        out = g.run(loss, [loss, train_op],\n"
+        "                    {ids: b, labels: np.roll(b, -1, axis=1)})\n"
+        "        return float(np.asarray(out[0]))\n"
+        "    return TrainBuild(graph=g, model=model, optimizer=opt,\n"
+        "                      step_fn=step_fn,\n"
+        "                      close=lambda: gctx.__exit__(None, None,\n"
+        "                                                  None))\n"
+        "mon2 = WorkerMonitor(4, devices, ttl=0.3,\n"
+        "                     heartbeat_interval=0.05)\n"
+        "tr2 = FaultTolerantTrainer(build_sentry, devices, monitor=mon2,\n"
+        "                           checkpoint_dir='/tmp/cb_nm',\n"
+        "                           checkpoint_every=2,\n"
+        "                           keep_checkpoints=3, rewind_after=2)\n"
+        "nplan = FaultPlan(events=[\n"
+        "    FaultEvent(step=2, kind='grad_nan', target=0),\n"
+        "    FaultEvent(step=3, kind='grad_nan', target=1),\n"
+        "    FaultEvent(step=6, kind='shard_corrupt', target=0),\n"
+        "    FaultEvent(step=6, kind='loss_spike', target=0),\n"
+        "    FaultEvent(step=8, kind='worker_death', target=3)])\n"
+        "NSTEPS = 10\n"
+        "nlosses = tr2.train(NSTEPS, fault_plan=nplan)\n"
+        "mon2.close()\n"
+        "nms = tr2.metrics_summary()\n"
+        "cursors = tr2.committed_cursors()\n"
+        "rewind = next((r for r in tr2.recoveries\n"
+        "               if r.get('kind') == 'numeric_rewind'), {})\n"
+        "tr2.close()\n"
+        "nref_build = build_sentry(8, devices)\n"
+        "nref = [nref_build.step_fn(c) for c in cursors]\n"
+        "nref_build.close()\n"
+        "numeric = {\n"
+        "  'steps': NSTEPS, 'attempts': nms['attempts'],\n"
+        "  'skip_rate': round(nms['steps_skipped']\n"
+        "                     / max(1, nms['attempts']), 3),\n"
+        "  'anomalies': nms['sentry_anomalies'],\n"
+        "  'rewinds': nms['rewinds'],\n"
+        "  'rewind_mttr_s': round(rewind.get('mttr_s', -1.0), 3),\n"
+        "  'restore_fallbacks': nms['restore_fallbacks'],\n"
+        "  'checkpoints_written': nms['checkpoints_written'],\n"
+        "  'worker_recoveries': nms['worker_recoveries'],\n"
+        "}\n"
+        "clean_bitwise = nlosses[:8] == nref[:8]\n"
+        "numeric_loss_ok = bool(np.allclose(nlosses, nref, rtol=1e-6))\n"
+        "\n"
         "res = {\n"
         "  'model': {'hidden': H, 'layers': L, 'vocab': V},\n"
         "  'trace': {'requests': N_REQ, 'max_new_tokens': NEW,\n"
@@ -1475,6 +1551,7 @@ def bench_chaos_bench() -> dict:
         "                  rec.get('resumed_from_step'),\n"
         "              'dp_after': rec.get('dp'),\n"
         "              'mttr_s': round(rec.get('mttr_s', -1.0), 3)},\n"
+        "  'numeric': numeric,\n"
         "  # acceptance booleans (ISSUE 13)\n"
         "  'no_request_lost':\n"
         "      free['completed'] == N_REQ and\n"
@@ -1482,6 +1559,13 @@ def bench_chaos_bench() -> dict:
         "  'bitwise_survivors': chaos_outs == free_outs,\n"
         "  'recovery_under_2s': rec_s is not None and rec_s < 2.0,\n"
         "  'loss_curve_continues': loss_ok,\n"
+        "  # acceptance booleans (ISSUE 14: numeric sentry + durable\n"
+        "  # generations under a mixed numeric/process fault plan)\n"
+        "  'clean_steps_bitwise': bool(clean_bitwise),\n"
+        "  'rewind_under_3s': 0 < rewind.get('mttr_s', -1.0) < 3.0,\n"
+        "  'corrupt_restore_falls_back':\n"
+        "      nms['restore_fallbacks'] >= 1,\n"
+        "  'numeric_loss_curve_continues': numeric_loss_ok,\n"
         "}\n"
         "print(json.dumps(res))\n"
     )

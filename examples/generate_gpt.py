@@ -246,7 +246,10 @@ def main():
         model2 = GPTLMHeadModel(cfg)
         ids2 = ht.placeholder("int32", (1, 8), name="warm")
         model2.logits(ids2)  # materialize params
-        ts = load_checkpoint(model2, None, ckpt)
+        # a demo checkpoint written moments ago has no generation
+        # manifest to verify against — a deliberate raw load says so
+        # (the unverified-restore rule forbids silent ones)
+        ts = load_checkpoint(model2, None, ckpt, verify_exempt=True)
         print(f"restored checkpoint at step {ts['step']}")
         state = {k: np.asarray(v) for k, v in model2.state_dict().items()}
 

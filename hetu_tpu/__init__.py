@@ -32,6 +32,7 @@ from . import optim  # noqa: E402
 from . import serving  # noqa: E402
 from . import analysis  # noqa: E402
 from . import obs  # noqa: E402
+from . import resilience  # noqa: E402
 
 __version__ = "0.1.0"
 

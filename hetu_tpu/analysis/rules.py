@@ -47,6 +47,14 @@ Rule catalog (DESIGN.md §9 for the rationale of each):
                              flagged ``fence_exempt`` (a local,
                              same-pool degrade that never crosses
                              replicas) are exempt.
+``unverified-restore``       a checkpoint restore read tensor bytes
+                             without a digest check against a
+                             generation manifest — bit rot or a torn
+                             write restores garbage silently.  Every
+                             restore must go through the verifying
+                             generation loader
+                             (resilience.load_latest_generation) or be
+                             explicitly flagged ``verify_exempt``.
 ``cow-page-write``           serving: a unified-step KV write plan entry
                              targets a CACHED page — read-only by the
                              CoW contract whatever its sharer count
@@ -876,6 +884,50 @@ def _unfenced_handoff(ctx: AnalysisContext) -> List[Finding]:
                          "by the (request id, epoch) dedup instead of "
                          "double-delivering; flag genuinely local "
                          "same-pool moves fence_exempt"))
+    return out
+
+
+@rule("unverified-restore")
+def _unverified_restore(ctx: AnalysisContext) -> List[Finding]:
+    """Verified-restore contract of the durable checkpoint plane
+    (DESIGN.md §19): every checkpoint restore that reaches tensor bytes
+    must first check each shard's blake2b digest against the generation
+    manifest — a restore without the check loads bit rot or a torn
+    write silently, poisoning the very recovery path the fault plane
+    leans on.  Restore records come from
+    ``utils.checkpoint.restore_records`` via a ``restores`` meta hook
+    (the fault-tolerant trainer attaches its own); records flagged
+    ``verify_exempt`` (a deliberate raw load — e.g. importing a foreign
+    checkpoint that has no manifest) are exempt.  Executables with no
+    ``restores`` meta are out of scope."""
+    meta = ctx.meta or {}
+    if "restores" not in meta:
+        return []
+    records, lost = _call_meta_records(meta, "restores")
+    if lost:
+        return [Finding(
+            rule="", subject="restores", severity="error",
+            message="restore record hook raised — the restore audit "
+                    "is lost, which is itself a gate failure")]
+    out: List[Finding] = []
+    for i, rec in enumerate(records or ()):
+        if rec.get("verify_exempt"):
+            continue
+        if rec.get("verified"):
+            continue
+        out.append(Finding(
+            rule="", subject=f"restore@{i}", severity="error",
+            message=f"checkpoint restore #{i} from "
+                    f"{rec.get('dir', '?')} (step {rec.get('step', '?')})"
+                    f" read tensor bytes with NO digest check against a "
+                    f"generation manifest — bit rot or a half-written "
+                    f"shard restores garbage silently",
+            hint="route the restore through "
+                 "resilience.load_latest_generation (blake2b per-shard "
+                 "digests vs the gen-<step>/ manifest, automatic "
+                 "fallback past corrupted generations), or flag a "
+                 "deliberate raw load with "
+                 "load_checkpoint(..., verify_exempt=True)"))
     return out
 
 

@@ -1,31 +1,52 @@
-"""Fault-tolerant elastic training: survive an actual worker death.
+"""Fault-tolerant elastic training: survive worker deaths AND silent
+numeric/durability failures.
 
 The elastic loop so far could re-plan around *stragglers*; a dead
 worker was fatal — its parameter and optimizer shards live in its HBM
 and are simply gone.  This module closes that gap the Malleus way
-(SURVEY.md §3.5) with three pieces the repo already has, driven end to
-end:
+(SURVEY.md §3.5), and — ISSUE 14 — extends the same recovery loop to
+the failures that never raise anything:
 
 * **Durable snapshots** — every ``checkpoint_every`` steps the trainer
-  saves model params + FLAT optimizer state through
-  ``utils.checkpoint.save_checkpoint`` (``safetensors_io`` decomposes
-  the flat buffers per-parameter, so the snapshot restores into ANY dp
-  size — the dp8→dp4 round-trip the IO layer already asserts).
+  saves model params + FLAT optimizer state as a checksummed
+  checkpoint *generation* (``resilience/generations.py``: fresh
+  ``gen-<step>/`` dir, blake2b manifest committed atomically, last-N
+  retention).  ``safetensors_io`` decomposes the flat buffers
+  per-parameter, so the snapshot restores into ANY dp size.
 * **Death detection** — a :class:`WorkerMonitor`: N process-local
   training workers registered on the ``rpc`` coordinator exactly like
   serving replicas, each owning an equal slice of the device list; a
   rank that stops heartbeating past the TTL maps to lost devices.
-* **Re-plan + restore** — on a death verdict the trainer asks
+* **Re-plan + verified restore** — on a death verdict the trainer asks
   :class:`~hetu_tpu.elastic.strategy.StrategyModel` for the best layout
   over the survivors, rebuilds the graph there (``build_fn``), restores
-  the latest snapshot, rewinds to its step, and keeps training.  The
-  loss curve *continues exactly*: flat-state math is bit-identical
-  across dp sizes, so the recovered run's per-step losses equal a
-  fault-free run's (asserted in tests/test_fault.py and gated by
-  ``bench.py chaos_bench``'s ``loss_curve_continues``).
+  the newest generation that VERIFIES (falling back past corrupted or
+  half-written ones — ``restore_fallbacks``), rewinds to its step, and
+  keeps training.  The loss curve *continues exactly*: flat-state math
+  is bit-identical across dp sizes, and re-run steps replay the SAME
+  data cursors.
+* **Numeric sentry ladder** — when the optimizer carries a
+  :class:`~hetu_tpu.resilience.sentry.NumericSentry`, every step's
+  on-device verdict is read alongside the loss: an anomalous step
+  (NaN/Inf loss or grads, grad-norm spike, relative loss spike) was
+  already SKIPPED on-device with bitwise-zero residue; the trainer
+  burns that data cursor and retries the step on fresh data.  ``k``
+  consecutive anomalies — or a loss spike, which means the optimizer
+  state itself is suspect — rewind to the last good generation and
+  resume with the jumped cursor.
 
-MTTR (kill → first completed post-recovery step) is recorded per
-recovery in :attr:`FaultTolerantTrainer.recoveries`.
+``step_fn(cursor)`` receives a **data cursor**, not the step index:
+committed steps pin their cursor (a rewind replays the same batches —
+that is what makes re-run losses bit-identical), a skipped step burns
+its cursor and draws a fresh one.  FaultPlan seams injected here:
+``worker_death``, ``grad_nan`` / ``grad_spike`` / ``loss_spike``
+(through :meth:`DefineAndRunGraph.inject_numeric_fault` — a fed code,
+never a retrace), ``shard_corrupt`` (byte flips in the newest
+generation) and ``kill_mid_write`` (the checkpoint writer dies between
+shards).  MTTR (detect → first committed post-recovery step) is
+recorded per recovery in :attr:`FaultTolerantTrainer.recoveries`;
+counters land in :meth:`FaultTolerantTrainer.metrics_summary` and the
+Prometheus text of :meth:`metrics_text` (DESIGN.md §19).
 """
 from __future__ import annotations
 
@@ -37,7 +58,16 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..obs.tracer import get_tracer
 from ..rpc.coordinator import CoordinatorClient, CoordinatorServer
+from ..utils.metrics import make_instrument, render_prometheus
 from .strategy import StrategyModel
+
+#: the failure counters the trainer exposes (metrics_summary +
+#: Prometheus), next to PR 12's cluster failure counters
+TRAINER_COUNTERS = (
+    "sentry_anomalies", "steps_skipped", "rewinds", "restore_fallbacks",
+    "emergency_flushes", "checkpoints_written",
+    "checkpoint_write_failures", "worker_recoveries",
+)
 
 
 class WorkerMonitor:
@@ -116,9 +146,9 @@ class WorkerMonitor:
 @dataclass
 class TrainBuild:
     """What ``build_fn(dp, devices)`` returns: a freshly-built graph on
-    the given layout.  ``step_fn(step) -> float`` runs one optimizer
-    step and returns the loss; ``model``/``optimizer`` feed the
-    checkpoint plane."""
+    the given layout.  ``step_fn(cursor) -> float`` runs one optimizer
+    step on the batch the data cursor selects and returns the loss;
+    ``model``/``optimizer`` feed the checkpoint plane."""
     graph: Any
     model: Any
     optimizer: Any
@@ -127,13 +157,25 @@ class TrainBuild:
 
 
 class FaultTolerantTrainer:
-    """Checkpoint → detect → re-plan → restore → continue.
+    """Checkpoint → detect → re-plan → verified restore → continue,
+    plus the numeric-sentry skip/rewind ladder.
 
     ``build_fn(dp: int, devices) -> TrainBuild`` must rebuild the SAME
     model deterministically (same init seed) for any dp — recovery
     calls it on the survivor layout and immediately overwrites params +
     optimizer state from the snapshot, so only the architecture needs
     to be reproducible, not the init values.
+
+    ``rewind_after``: k consecutive sentry anomalies before the policy
+    ladder rewinds to the last good generation (single anomalies are
+    skipped on-device and the step retried on fresh data).
+    ``rewind_on_loss_spike``: a loss-spike verdict rewinds immediately
+    — a spike with finite gradients means the optimizer state already
+    absorbed something poisonous.  ``emergency_flush``: on a death
+    verdict, flush the current (survivor-visible) state as an
+    ``emergency`` generation before re-planning — best-effort and
+    verified on read like every generation, off by default because a
+    death mid-step can leave untrustworthy state.
     """
 
     def __init__(self, build_fn: Callable[..., TrainBuild],
@@ -143,21 +185,44 @@ class FaultTolerantTrainer:
                  checkpoint_every: int = 4,
                  solver_factory: Optional[
                      Callable[[int], StrategyModel]] = None,
-                 keep_checkpoints: int = 2):
+                 keep_checkpoints: int = 2,
+                 rewind_after: int = 3,
+                 rewind_on_loss_spike: bool = True,
+                 max_rewinds: int = 8,
+                 emergency_flush: bool = False):
         self.build_fn = build_fn
         self.devices = list(devices)
         self.monitor = monitor
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = int(checkpoint_every)
         self.keep_checkpoints = int(keep_checkpoints)
+        self.rewind_after = int(rewind_after)
+        self.rewind_on_loss_spike = bool(rewind_on_loss_spike)
+        # termination bound for the ladder: a DETERMINISTIC pathology
+        # (every fresh batch anomalous) would otherwise skip->rewind->
+        # replay forever; past this many rewinds the trainer surrenders
+        # the anomaly loudly instead of churning disk
+        self.max_rewinds = int(max_rewinds)
+        self.emergency_flush = bool(emergency_flush)
         # default layout policy: pure dp over every available device
         # (the homogeneous solver's own preference); a solver_factory
         # lets hetero-aware callers re-plan tp/pp too
         self.solver_factory = solver_factory
         self.recoveries: List[Dict[str, Any]] = []
         self.step = 0
+        self.attempts = 0
         self._handled: set = set()
+        self._injected: set = set()            # fault-event identity guard
         self._ck_steps: List[int] = []
+        self._killed_at: Optional[float] = None
+        # data-cursor plane: committed steps PIN their cursor (rewind
+        # replays the same batches), a sentry skip burns its cursor and
+        # the retry draws a fresh one
+        self._cursor_of_step: Dict[int, int] = {}
+        self._next_cursor = 0
+        self.burned_cursors: List[int] = []
+        self.counters = {name: make_instrument("counter", name)
+                         for name in TRAINER_COUNTERS}
         os.makedirs(checkpoint_dir, exist_ok=True)
         self.dp = self._choose_dp(len(self.devices))
         self.build = build_fn(self.dp, self.devices)
@@ -181,38 +246,97 @@ class FaultTolerantTrainer:
             dp *= 2
         return dp
 
-    # -- checkpoint plane ----------------------------------------------------
+    # -- data-cursor plane ---------------------------------------------------
 
-    def _ck_path(self, step: int) -> str:
-        return os.path.join(self.checkpoint_dir, f"step{step}")
+    def _cursor_for(self, step: int) -> int:
+        cur = self._cursor_of_step.get(step)
+        if cur is None:
+            cur = self._next_cursor
+            self._next_cursor += 1
+            self._cursor_of_step[step] = cur
+        return cur
 
-    def _checkpoint(self) -> None:
-        from ..utils.checkpoint import save_checkpoint
-        save_checkpoint(self.build.model, self.build.optimizer,
-                        self._ck_path(self.step), step=self.step)
-        self._ck_steps.append(self.step)
+    def _burn_cursor(self, step: int) -> None:
+        cur = self._cursor_of_step.pop(step, None)
+        if cur is not None:
+            self.burned_cursors.append(cur)
+
+    def committed_cursors(self) -> List[int]:
+        """The cursor each committed step actually trained on — the
+        clean-batch sequence a fault-free reference run must consume to
+        reproduce this run's losses bit-for-bit."""
+        return [self._cursor_of_step[s] for s in range(self.step)
+                if s in self._cursor_of_step]
+
+    # -- checkpoint plane (checksummed generations) --------------------------
+
+    def _checkpoint(self, emergency: bool = False) -> bool:
+        from ..resilience.generations import save_generation
+        from ..utils.checkpoint import WriterDeathError
         tr = get_tracer()
+        try:
+            save_generation(self.build.model, self.build.optimizer,
+                            self.checkpoint_dir, step=self.step,
+                            keep=self.keep_checkpoints,
+                            emergency=emergency)
+        except WriterDeathError as e:
+            # the kill_mid_write chaos verdict: the writer died between
+            # shards — the partial generation never committed a
+            # manifest, previous generations stay restorable
+            self.counters["checkpoint_write_failures"].inc()
+            if tr.enabled:
+                tr.instant("checkpoint_write_died", track="chaos",
+                           ts=tr.now(), step=self.step, error=str(e))
+            self._sync_ck_steps()
+            return False
+        self.counters["checkpoints_written"].inc()
         if tr.enabled:
             tr.instant("checkpoint", track="trainer", ts=tr.now(),
-                       step=self.step)
-        while len(self._ck_steps) > self.keep_checkpoints:
-            old = self._ck_steps.pop(0)
-            path = self._ck_path(old)
-            try:
-                for f in os.listdir(path):
-                    os.remove(os.path.join(path, f))
-                os.rmdir(path)
-            except OSError:
-                pass
+                       step=self.step, emergency=bool(emergency))
+        self._sync_ck_steps(self.step)
+        return True
+
+    def _sync_ck_steps(self, new_step: Optional[int] = None) -> None:
+        """This run's committed generations, post-retention — never a
+        stale directory another process left under the same root."""
+        from ..resilience.generations import MANIFEST, generation_dir
+        steps = set(self._ck_steps)
+        if new_step is not None:
+            steps.add(int(new_step))
+        self._ck_steps = [
+            s for s in sorted(steps)
+            if os.path.isfile(os.path.join(
+                generation_dir(self.checkpoint_dir, s), MANIFEST))]
 
     def latest_checkpoint(self) -> int:
         return self._ck_steps[-1]
 
-    # -- recovery ------------------------------------------------------------
+    def _restore_latest(self) -> Dict[str, Any]:
+        """Verified restore: newest generation whose digests check,
+        falling back past corrupted/partial ones (each fallback is a
+        counter bump + a chaos-track instant)."""
+        from ..resilience.generations import load_latest_generation
+        info = load_latest_generation(self.build.model,
+                                      self.build.optimizer,
+                                      self.checkpoint_dir,
+                                      steps=self._ck_steps)
+        if info["fallbacks"]:
+            self.counters["restore_fallbacks"].inc(
+                len(info["fallbacks"]))
+            tr = get_tracer()
+            if tr.enabled:
+                for fb in info["fallbacks"]:
+                    tr.instant("restore_fallback", track="chaos",
+                               ts=tr.now(),
+                               generation=fb["generation"],
+                               problem=fb["problems"][0]
+                               if fb["problems"] else "?")
+        return info
+
+    # -- recovery: worker death ----------------------------------------------
 
     def _recover(self, dead: Sequence[int], losses: Dict[int, float],
                  killed_at: Optional[float]) -> None:
-        from ..utils.checkpoint import load_checkpoint
         t0 = time.perf_counter()
         survivors = self.monitor.surviving_devices(self._handled)
         if not survivors:
@@ -223,6 +347,29 @@ class FaultTolerantTrainer:
                        dead=sorted(dead), survivors=len(survivors),
                        step=self.step)
         detect_step = self.step
+        if self.emergency_flush and self.step not in self._ck_steps:
+            # best-effort flush of the current state before teardown
+            # (skipped when this step already has a committed
+            # generation — the flush would re-save identical state and
+            # needlessly churn the newest restore point).  Bit-level
+            # integrity is digest-verified on read; a flush that dies
+            # mid-write never commits and save_generation restores any
+            # generation it displaced.
+            try:
+                if self._checkpoint(emergency=True):
+                    self.counters["emergency_flushes"].inc()
+                    if tr.enabled:
+                        tr.instant("emergency_flush", track="chaos",
+                                   ts=tr.now(), step=self.step)
+            except Exception as e:
+                # a failed flush must not block the recovery, but it
+                # must be VISIBLE (counter + chaos instant), not
+                # silently discarded
+                self.counters["checkpoint_write_failures"].inc()
+                if tr.enabled:
+                    tr.instant("emergency_flush_failed", track="chaos",
+                               ts=tr.now(), step=self.step,
+                               error=str(e)[:120])
         new_dp = self._choose_dp(len(survivors))
         # the dead workers' HBM shards are GONE: rebuild on the
         # survivor layout and restore the last durable snapshot —
@@ -230,18 +377,25 @@ class FaultTolerantTrainer:
         if self.build.close is not None:
             self.build.close()
         self.build = self.build_fn(new_dp, survivors)
-        ck_step = self.latest_checkpoint()
-        load_checkpoint(self.build.model, self.build.optimizer,
-                        self._ck_path(ck_step))
+        info = self._restore_latest()
+        ck_step = info["generation"]
         rewound = self.step - ck_step
         for s in range(ck_step, self.step):
             losses.pop(s, None)
         self.step = ck_step
         self.dp = new_dp
-        rec = {"dead": sorted(dead), "detected_at_step": detect_step,
+        self.counters["worker_recoveries"].inc()
+        self._reset_sentry()
+        rec = {"kind": "worker_death", "dead": sorted(dead),
+               "detected_at_step": detect_step,
                "resumed_from_step": ck_step, "rewound_steps": rewound,
+               "restore_fallbacks": len(info["fallbacks"]),
                "dp": new_dp, "devices": len(survivors),
                "rebuild_s": time.perf_counter() - t0,
+               # MTTR anchor: the kill instant when this death was
+               # injected, else the detection time — per-record, so a
+               # later detection can never inherit a stale kill time
+               "_t0": killed_at if killed_at is not None else t0,
                "killed_at": killed_at}
         self.recoveries.append(rec)
         if tr.enabled:
@@ -249,51 +403,244 @@ class FaultTolerantTrainer:
                        **{k: v for k, v in rec.items()
                           if k not in ("killed_at",)})
 
+    # -- recovery: numeric rewind --------------------------------------------
+
+    def _reset_sentry(self) -> None:
+        sentry = getattr(self.build.optimizer, "sentry", None)
+        if sentry is not None:
+            # the restored state predates the anomaly streak: forget
+            # the EMA/consecutive history with it
+            sentry.reset()
+
+    def _sentry_verdict(self) -> Optional[Dict[str, Any]]:
+        sentry = getattr(self.build.optimizer, "sentry", None)
+        if sentry is None:
+            return None
+        return sentry.last_verdict()
+
+    def _numeric_rewind(self, losses: Dict[int, float],
+                        reason: str) -> None:
+        t0 = time.perf_counter()
+        tr = get_tracer()
+        if not self._ck_steps:
+            # nothing committed to rewind to (the step-0 snapshot
+            # itself failed to write): stay in skip-only mode rather
+            # than abort the run the ladder exists to save — but
+            # BOUNDED, or a deterministic pathology loops forever here
+            # just like the rewind path max_rewinds ends
+            self._rewinds_unavailable = \
+                getattr(self, "_rewinds_unavailable", 0) + 1
+            if tr.enabled:
+                tr.instant("sentry_rewind_unavailable", track="chaos",
+                           ts=tr.now(), step=self.step, reason=reason)
+            if self._rewinds_unavailable > self.max_rewinds:
+                raise RuntimeError(
+                    f"numeric anomaly persists with no committed "
+                    f"checkpoint generation to rewind to "
+                    f"({self._rewinds_unavailable} attempts, last "
+                    f"reason: {reason}) — skip-only mode cannot make "
+                    f"progress")
+            return
+        if int(self.counters["rewinds"].value) >= self.max_rewinds:
+            raise RuntimeError(
+                f"numeric anomaly persists after {self.max_rewinds} "
+                f"rewinds (last reason: {reason}) — this is not a "
+                f"transient fault; inspect the data/lr/model instead "
+                f"of rewinding forever")
+        if tr.enabled:
+            tr.instant("sentry_rewind", track="chaos", ts=tr.now(),
+                       step=self.step, reason=reason)
+        info = self._restore_latest()
+        ck_step = info["generation"]
+        rewound = self.step - ck_step
+        for s in range(ck_step, self.step + 1):
+            losses.pop(s, None)
+        self.step = ck_step
+        self._reset_sentry()
+        self.counters["rewinds"].inc()
+        rec = {"kind": "numeric_rewind", "reason": reason,
+               "resumed_from_step": ck_step, "rewound_steps": rewound,
+               "restore_fallbacks": len(info["fallbacks"]),
+               "rebuild_s": time.perf_counter() - t0,
+               "_t0": t0, "mttr_pending": True}
+        self.recoveries.append(rec)
+        if tr.enabled:
+            tr.instant("recovered", track="trainer", ts=tr.now(),
+                       kind="numeric_rewind", reason=reason,
+                       resumed_from_step=ck_step,
+                       rewound_steps=rewound)
+
+    # -- chaos injection seams -----------------------------------------------
+
+    def _apply_fault_events(self, fault_plan) -> None:
+        from ..fault.plan import NUMERIC_KINDS
+        tr = get_tracer()
+        numeric_armed = False
+        for ev in fault_plan.due(self.step):
+            key = (ev.step, ev.kind, ev.target)
+            if key in self._injected:
+                continue
+            if ev.kind == "worker_death":
+                if self.monitor is None or ev.target in self._handled:
+                    continue
+                self._injected.add(key)
+                self.monitor.kill_worker(ev.target)
+                self._killed_at = time.perf_counter()
+                if tr.enabled:
+                    tr.instant("fault", track="chaos", ts=tr.now(),
+                               kind="worker_death", target=ev.target,
+                               step=self.step)
+                # the verdict needs the TTL to lapse; a real fleet
+                # just keeps stepping until it lands
+                self.monitor.wait_for_verdict(ev.target)
+            elif ev.kind in NUMERIC_KINDS:
+                # one numeric poison per attempt: a second event due at
+                # the same step injects on the retry
+                if numeric_armed:
+                    continue
+                if not hasattr(self.build.graph, "inject_numeric_fault"):
+                    continue
+                if self.monitor is not None and \
+                        set(self.monitor.dead_workers()) - self._handled:
+                    # a death verdict is pending: the recovery rebuild
+                    # would replace the graph and lose the armed code —
+                    # defer (un-marked) to the post-recovery retry
+                    continue
+                self._injected.add(key)
+                numeric_armed = True
+                self.build.graph.inject_numeric_fault(ev.kind)
+                if tr.enabled:
+                    tr.instant("fault", track="chaos", ts=tr.now(),
+                               kind=ev.kind, step=self.step)
+            elif ev.kind == "shard_corrupt":
+                from ..resilience.generations import corrupt_generation
+                try:
+                    path = corrupt_generation(self.checkpoint_dir,
+                                              seed=ev.step)
+                except RuntimeError:
+                    continue   # nothing committed yet: retry when the
+                    # step is revisited, never mark it injected
+                self._injected.add(key)
+                if tr.enabled:
+                    tr.instant("fault", track="chaos", ts=tr.now(),
+                               kind="shard_corrupt", step=self.step,
+                               path=os.path.basename(path))
+            elif ev.kind == "kill_mid_write":
+                from ..utils.checkpoint import arm_kill_mid_write
+                self._injected.add(key)
+                self._armed_kill = True
+                arm_kill_mid_write(after_files=1)
+                if tr.enabled:
+                    tr.instant("fault", track="chaos", ts=tr.now(),
+                               kind="kill_mid_write", step=self.step)
+            # serving-plane kinds in a training plan: ignore
+
     # -- the loop ------------------------------------------------------------
 
     def train(self, total_steps: int, fault_plan=None) -> List[float]:
-        """Train ``total_steps`` with death detection between steps.
-        ``fault_plan`` events of kind ``worker_death`` are injected at
-        their step (the chaos seam); recovery rewinds to the last
-        snapshot, so per-step losses are keyed and re-computed steps
-        overwrite with — by the flat-state contract — identical
-        values."""
+        """Train ``total_steps`` with death detection between steps and
+        the sentry skip/rewind ladder on every step's verdict.
+        ``fault_plan`` events are injected at their step (the chaos
+        seams); recovery rewinds to the newest VERIFYING snapshot and
+        replays the same data cursors, so per-step losses are keyed and
+        re-computed steps overwrite with identical values."""
         losses: Dict[int, float] = {}
-        killed_at: Optional[float] = None
+        tr = get_tracer()
+        try:
+            return self._train_loop(losses, total_steps, fault_plan, tr)
+        finally:
+            # an armed-but-unfired kill_mid_write (no checkpoint write
+            # followed the injection) must not outlive this trainer and
+            # kill an unrelated save in the same process
+            if getattr(self, "_armed_kill", False):
+                from ..utils.checkpoint import disarm_kill_mid_write
+                disarm_kill_mid_write()
+                self._armed_kill = False
+
+    def _train_loop(self, losses: Dict[int, float], total_steps: int,
+                    fault_plan, tr) -> List[float]:
         while self.step < total_steps:
-            if fault_plan is not None and self.monitor is not None:
-                for ev in fault_plan.due(self.step):
-                    if ev.kind != "worker_death":
-                        continue
-                    if ev.target in self._handled:
-                        continue
-                    self.monitor.kill_worker(ev.target)
-                    killed_at = time.perf_counter()
-                    tr = get_tracer()
-                    if tr.enabled:
-                        tr.instant("fault", track="chaos", ts=tr.now(),
-                                   kind="worker_death",
-                                   target=ev.target, step=self.step)
-                    # the verdict needs the TTL to lapse; a real fleet
-                    # just keeps stepping until it lands
-                    self.monitor.wait_for_verdict(ev.target)
+            if fault_plan is not None:
+                self._apply_fault_events(fault_plan)
             if self.monitor is not None:
                 dead = set(self.monitor.dead_workers()) - self._handled
                 if dead:
                     self._handled |= dead
-                    self._recover(dead, losses, killed_at)
-                    if killed_at is not None and self.recoveries:
+                    self._recover(dead, losses, self._killed_at)
+                    self._killed_at = None
+                    if self.recoveries:
                         self.recoveries[-1]["mttr_pending"] = True
-            losses[self.step] = float(self.build.step_fn(self.step))
-            if self.recoveries and \
-                    self.recoveries[-1].pop("mttr_pending", False):
-                self.recoveries[-1]["mttr_s"] = \
-                    time.perf_counter() - (killed_at or time.perf_counter())
+            cursor = self._cursor_for(self.step)
+            loss_val = float(self.build.step_fn(cursor))
+            self.attempts += 1
+            verdict = self._sentry_verdict()
+            if verdict is not None and verdict["anomaly"]:
+                # the update was already skipped ON-DEVICE (bitwise-zero
+                # residue); burn the poisoned batch and retry the step
+                self.counters["sentry_anomalies"].inc()
+                self.counters["steps_skipped"].inc()
+                if tr.enabled:
+                    tr.instant("sentry_skip", track="chaos",
+                               ts=tr.now(), step=self.step,
+                               cursor=cursor,
+                               **{k: verdict[k] for k in
+                                  ("loss_nonfinite", "grad_nonfinite",
+                                   "grad_spike", "loss_spike",
+                                   "consecutive")})
+                self._burn_cursor(self.step)
+                if self.rewind_on_loss_spike and verdict["loss_spike"]:
+                    self._numeric_rewind(losses, reason="loss_spike")
+                elif verdict["consecutive"] >= self.rewind_after:
+                    self._numeric_rewind(
+                        losses,
+                        reason=f"{verdict['consecutive']} consecutive "
+                               f"anomalies")
+                continue
+            losses[self.step] = loss_val
+            # finalize MTTR for EVERY recovery awaiting its first
+            # committed step (a rewind can pile onto a death recovery
+            # before anything commits — both must resolve)
+            for rec in self.recoveries:
+                if rec.pop("mttr_pending", False):
+                    t0 = rec.pop("_t0", None)
+                    if t0 is not None:
+                        rec["mttr_s"] = time.perf_counter() - t0
             self.step += 1
+            self._attach_restore_meta()
             if self.step % self.checkpoint_every == 0 \
                     and self.step < total_steps:
                 self._checkpoint()
         return [losses[s] for s in range(total_steps)]
+
+    # -- observability -------------------------------------------------------
+
+    def _attach_restore_meta(self) -> None:
+        """Expose this trainer's restore audit records on its registered
+        executable(s) so the ``unverified-restore`` rule can gate them
+        (analysis/rules.py)."""
+        g = getattr(self.build, "graph", None)
+        if g is None or not hasattr(g, "analysis_handles"):
+            return
+        from ..utils.checkpoint import restore_records
+        ckdir = self.checkpoint_dir
+
+        def hook():
+            return restore_records(ckdir)
+
+        for h in g.analysis_handles():
+            h.meta.setdefault("restores", hook)
+
+    def metrics_summary(self) -> Dict[str, Any]:
+        out = {name: int(c.value) for name, c in self.counters.items()}
+        out["attempts"] = int(self.attempts)
+        out["step"] = int(self.step)
+        out["recoveries"] = len(self.recoveries)
+        return out
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition of the trainer failure counters."""
+        return render_prometheus(
+            {f"trainer_{k}": v for k, v in self.counters.items()})
 
     def close(self) -> None:
         if self.build.close is not None:
@@ -305,6 +652,7 @@ def write_recovery_report(trainer: FaultTolerantTrainer,
     """Freeze the recovery record (bench/CI artifact)."""
     out = {"recoveries": trainer.recoveries,
            "checkpoints": list(trainer._ck_steps),
+           "metrics": trainer.metrics_summary(),
            "final_dp": trainer.dp}
     with open(path, "w") as fh:
         json.dump(out, fh, indent=1)

@@ -13,10 +13,11 @@ outputs bit-for-bit equal to the fault-free run.
 """
 from .backoff import RetryPolicy, unit_hash
 from .chaos import ChaosController, check_cluster_invariants
-from .plan import EVENT_KINDS, TRANSPORT_KINDS, FaultEvent, FaultPlan
+from .plan import (EVENT_KINDS, NUMERIC_KINDS, TRAINING_KINDS,
+                   TRANSPORT_KINDS, FaultEvent, FaultPlan)
 
 __all__ = [
     "ChaosController", "EVENT_KINDS", "FaultEvent", "FaultPlan",
-    "RetryPolicy", "TRANSPORT_KINDS", "check_cluster_invariants",
-    "unit_hash",
+    "NUMERIC_KINDS", "RetryPolicy", "TRAINING_KINDS",
+    "TRANSPORT_KINDS", "check_cluster_invariants", "unit_hash",
 ]

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Tuple
 
-from .plan import FaultEvent, FaultPlan
+from .plan import TRAINING_KINDS, FaultEvent, FaultPlan
 
 
 class ChaosController:
@@ -55,8 +55,9 @@ class ChaosController:
             if cluster.server is not None:
                 cluster.server.refuse_for(float(ev.duration))
             return
-        if ev.kind == "worker_death":
-            # a training-plane event reaching a serving cluster is a
+        if ev.kind in TRAINING_KINDS:
+            # training-plane events (worker death, numeric sentry,
+            # checkpoint durability) reaching a serving cluster are a
             # plan-authoring error; ignore rather than corrupt state
             return
         r = cluster.replicas[ev.target]

@@ -35,6 +35,25 @@ Event kinds (serving cluster seams, ``fault/chaos.py``):
                  fault-tolerant trainer re-plans on survivors and
                  restores the last snapshot.
 
+Numeric + durability verdicts (the SILENT failures, ISSUE 14 —
+injected by the fault-tolerant trainer at the sentry/checkpoint
+seams, ``resilience/``):
+
+``grad_nan``     the step's gradients go NaN (a silent compute
+                 corruption); the on-device sentry must skip the
+                 update with bitwise-zero residue.
+``grad_spike``   the gradients blow up finite (norm past the sentry
+                 threshold) — same skip contract.
+``loss_spike``   the loss jumps past the relative EMA threshold; the
+                 policy ladder rewinds to the last good checkpoint
+                 generation.
+``shard_corrupt`` bytes flip inside the newest checkpoint generation's
+                 tensor shard (bit rot / torn write); the next verified
+                 restore must fall back past it.
+``kill_mid_write`` the checkpoint writer dies between shard files; the
+                 partial generation never commits a manifest and the
+                 previous generation still restores.
+
 Transport verdicts (``FaultPlan.transport``): the N-th handoff
 injection attempt (a global ordinal counted by the controller) gets
 ``("drop", 0)`` (the wire ate it — retry with backoff), ``("dup", 0)``
@@ -52,7 +71,17 @@ import numpy as np
 
 #: replica/worker-level event kinds
 EVENT_KINDS = ("crash", "zombie", "revive", "readmit", "straggler",
-               "coord_refuse", "worker_death")
+               "coord_refuse", "worker_death",
+               # silent-failure verdicts (numeric sentry + durable
+               # checkpoint seams, resilience/ — trainer-injected)
+               "grad_nan", "grad_spike", "loss_spike",
+               "shard_corrupt", "kill_mid_write")
+#: the subset the numeric sentry detects on-device
+NUMERIC_KINDS = ("grad_nan", "grad_spike", "loss_spike")
+#: training-plane kinds (injected by the fault-tolerant trainer; a
+#: serving ChaosController must ignore them rather than index replicas)
+TRAINING_KINDS = ("worker_death",) + NUMERIC_KINDS + (
+    "shard_corrupt", "kill_mid_write")
 #: handoff-wire verdict kinds
 TRANSPORT_KINDS = ("drop", "dup", "delay")
 

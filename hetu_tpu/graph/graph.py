@@ -144,6 +144,15 @@ def clear_executables(prefix: str = "") -> None:
     clear_prediction_cache(prefix)
 
 
+def _select_tree(flag, new, old):
+    """Per-leaf ``jnp.where(flag, new, old)`` over matching pytrees —
+    the on-device skip primitive the AMP scaler (overflow) and the
+    numeric sentry (anomaly verdict) share: when ``flag`` is True the
+    new values pass through bitwise."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(flag, n, o), new, old)
+
+
 class OpNode:
     """A graph node (reference ``OpDef``, ``operator.h:304``)."""
 
@@ -559,6 +568,42 @@ class DefineAndRunGraph(Graph):
         self._grad_comm_fallback: Optional[str] = None
         # plan key -> registered analysis-handle name (analysis hook)
         self._plan_names: Dict[Tuple, str] = {}
+        # numeric-sentry chaos seam (resilience/sentry.py): an auto-fed
+        # int32 code placeholder (0 = clean) the compiled step reads to
+        # poison gradients/loss at the injection point — feed VALUE
+        # only, so injections never retrace
+        self._sentry_tensor: Optional[Tensor] = None
+        self._sentry_next_code: int = 0
+
+    # -- numeric sentry (resilience/sentry.py) -------------------------------
+
+    def _sentry_code_tensor(self) -> Tensor:
+        if self._sentry_tensor is None:
+            t = Tensor((), "int32", name="_sentry_code", graph=self)
+            self.add_placeholder(t)
+            self._sentry_tensor = t
+        return self._sentry_tensor
+
+    def inject_numeric_fault(self, kind: str) -> None:
+        """Arm a one-shot numeric chaos injection for the NEXT
+        UPDATE-level run (FaultPlan ``grad_nan`` / ``grad_spike`` /
+        ``loss_spike`` verdicts): the fed code makes the compiled step
+        poison its own gradients/loss at the sentry's seam."""
+        from ..resilience.sentry import INJECT_CODES
+        if kind not in INJECT_CODES:
+            raise ValueError(f"unknown numeric fault {kind!r}; have "
+                             f"{sorted(INJECT_CODES)}")
+        self._sentry_next_code = INJECT_CODES[kind]
+
+    @staticmethod
+    def _sentry_for(update_node, run_level) -> Optional[Any]:
+        """The active NumericSentry for this plan, or None — ONE
+        definition shared by plan build, feed marshalling and meta
+        registration so the compiled program and its feeds can never
+        disagree about whether the code input exists."""
+        if update_node is None or run_level != RunLevel.UPDATE:
+            return None
+        return getattr(update_node.attrs["optimizer"], "sentry", None)
 
     # -- shape-plan bucketing ------------------------------------------------
 
@@ -754,7 +799,8 @@ class DefineAndRunGraph(Graph):
     def _plan_explicit_grad_comm(self, opt, fetches: List[Tensor],
                                  feed_tensors: List[Tensor],
                                  num_micro_batches: int,
-                                 loss_t: Optional[Tensor] = None):
+                                 loss_t: Optional[Tensor] = None,
+                                 sentry_active: bool = False):
         """Decide whether the explicit coalesced grad-comm path applies
         and build its shard_map specs.  Returns (plan, None) or
         (None, reason).
@@ -829,6 +875,10 @@ class DefineAndRunGraph(Graph):
         if self._rng_tensor is not None and \
                 all(t.id != self._rng_tensor.id for t in tensors):
             tensors.append(self._rng_tensor)
+        if sentry_active:
+            st = self._sentry_code_tensor()
+            if all(t.id != st.id for t in tensors):
+                tensors.append(st)
         M = num_micro_batches
         for t in tensors:
             base = self._pspec_for(t) or PartitionSpec()
@@ -873,6 +923,23 @@ class DefineAndRunGraph(Graph):
         # shard_map manual region over the dp axis, so gradients stay
         # LOCAL until the optimizer's bucketed collective syncs them —
         # once per step, not once per micro-batch or per parameter.
+        # numeric sentry (resilience/sentry.py): fused finite/spike
+        # verdict + on-device update skip, UPDATE-level plans only
+        sentry = self._sentry_for(update_node, run_level)
+        sentry_tid = None
+        loss_fetch_idx = None
+        if sentry is not None:
+            loss_t_sentry = update_node.attrs["grad_node"].attrs["loss"]
+            loss_fetch_idx = next(
+                (i for i, f in enumerate(fetches)
+                 if isinstance(f, Tensor) and f.id == loss_t_sentry.id),
+                None)
+            if loss_fetch_idx is None:
+                raise ValueError(
+                    "numeric sentry needs the loss among the fetches "
+                    "(its spike/finite verdict reads the merged loss)")
+            sentry_tid = self._sentry_code_tensor().id
+
         explicit = None
         flat_mode = False
         gc_state = (False, None)      # (active, fallback_reason) per plan
@@ -886,7 +953,8 @@ class DefineAndRunGraph(Graph):
                     explicit, why = self._plan_explicit_grad_comm(
                         opt_gc, fetches, feed_tensors, num_micro_batches,
                         loss_t=update_node.attrs["grad_node"]
-                        .attrs["loss"])
+                        .attrs["loss"],
+                        sentry_active=sentry is not None)
                 gc_state = (explicit is not None,
                             None if explicit else why)
                 # reduce-scatter-only ZeRO-2: the update runs on the
@@ -1003,6 +1071,11 @@ class DefineAndRunGraph(Graph):
                 # params exactly once (weight dtype).
                 dpa = explicit["axis"]
                 opt_flat = update_node.attrs["optimizer"]
+                # sentry state never enters the manual region: its
+                # scalars update OUTSIDE from the psum-reduced signals
+                # the region returns
+                opt_region = {k: v for k, v in opt_state.items()
+                              if k != "_sentry"}
 
                 def flat_phase(vstate, fmb, fstate, gaccum):
                     graph._manual_axes = (dpa,)
@@ -1013,33 +1086,71 @@ class DefineAndRunGraph(Graph):
                             # mean-synced and replicated; the dp-mean of
                             # (local + replicated) preserves them exactly
                             acc = {k: acc[k] + gaccum[k] for k in acc}
-                        new_vars, new_fstate = opt_flat._flat_sync_and_update(
-                            vstate, fstate, acc, update_node.attrs["xs"],
-                            dpa)
+                        if sentry is not None:
+                            # the chaos seam: poison the accumulated
+                            # gradients per the fed code (1.0 when clean)
+                            code_l = jnp.reshape(fmb[sentry_tid],
+                                                 (-1,))[0]
+                            acc = sentry.inject_grads(acc, code_l)
+                        new_vars, new_fstate, sqn = \
+                            opt_flat._flat_sync_and_update(
+                                vstate, fstate, acc,
+                                update_node.attrs["xs"], dpa,
+                                want_sq_norm=sentry is not None)
                     finally:
                         graph._manual_axes = ()
                     fv = [lax.pmean(v, dpa) if v.ndim == 0 else v
                           for v in fv]
+                    if sentry is not None:
+                        # sqn is psum-reduced (replicated by reduction),
+                        # so it may leave the region un-linted
+                        return fv, new_vars, new_fstate, sqn
                     return fv, new_vars, new_fstate
 
                 from ..parallel import comm as _comm
-                fspecs = opt_flat._flat_state_pspecs(opt_state)
+                fspecs = opt_flat._flat_state_pspecs(opt_region)
                 # the step counter never leaves the manual region (see
                 # _flat_sync_and_update); it increments out here where
                 # its replication is structural
                 out_fspecs = {k: v for k, v in fspecs.items()
                               if k != "step"}
                 gac_specs = {k: PartitionSpec() for k in grad_accum}
+                out_specs = (explicit["fetch_specs"], PartitionSpec(),
+                             out_fspecs)
+                if sentry is not None:
+                    out_specs = out_specs + (PartitionSpec(),)
                 flat_fn = _comm.shard_map(
                     flat_phase, graph.mesh,
                     in_specs=(PartitionSpec(), explicit["feed_specs"],
                               fspecs, gac_specs),
-                    out_specs=(explicit["fetch_specs"], PartitionSpec(),
-                               out_fspecs))
-                fetch_vals, new_vars, new_opt = flat_fn(
-                    var_state, feeds_mb, opt_state, grad_accum)
+                    out_specs=out_specs)
+                outs = flat_fn(var_state, feeds_mb, opt_region,
+                               grad_accum)
+                if sentry is not None:
+                    fetch_vals, new_vars, new_opt, grad_sq = outs
+                else:
+                    fetch_vals, new_vars, new_opt = outs
                 new_opt = dict(new_opt)
-                new_opt["step"] = opt_state["step"] + 1
+                if sentry is not None:
+                    code = jnp.reshape(feeds_mb[sentry_tid], (-1,))[0]
+                    fetch_vals = list(fetch_vals)
+                    fetch_vals[loss_fetch_idx] = sentry.inject_loss(
+                        fetch_vals[loss_fetch_idx], code)
+                    ok, new_sstate = sentry.update(
+                        fetch_vals[loss_fetch_idx], grad_sq,
+                        opt_state["_sentry"])
+                    # anomalous verdict: select the OLD params, flat
+                    # buffers and step counter — a skipped step leaves
+                    # bitwise-zero residue
+                    old_core = {k: v for k, v in opt_region.items()
+                                if k != "step"}
+                    new_vars = _select_tree(ok, new_vars, var_state)
+                    new_opt = _select_tree(ok, new_opt, old_core)
+                    new_opt["step"] = opt_state["step"] + \
+                        jnp.where(ok, 1, 0).astype(jnp.int32)
+                    new_opt["_sentry"] = new_sstate
+                else:
+                    new_opt["step"] = opt_state["step"] + 1
                 new_accum = {k: jnp.zeros_like(v)
                              for k, v in grad_accum.items()} \
                     if grad_accum else {}
@@ -1081,7 +1192,14 @@ class DefineAndRunGraph(Graph):
 
             # UPDATE: apply optimizer
             opt = update_node.attrs["optimizer"]
-            opt_core = {k: v for k, v in opt_state.items() if k != "_scaler"}
+            opt_core = {k: v for k, v in opt_state.items()
+                        if k not in ("_scaler", "_sentry")}
+            if sentry is not None:
+                # the chaos seam: poison the (accumulated, synced)
+                # gradients per the fed code (multiply by 1.0 = bitwise
+                # identity on a clean step)
+                code = jnp.reshape(feeds_mb[sentry_tid], (-1,))[0]
+                acc_grads = sentry.inject_grads(acc_grads, code)
             new_vars, new_opt = opt._apply_updates(
                 var_state, opt_core, acc_grads, update_node.attrs["xs"])
             if scaler is not None:
@@ -1089,12 +1207,25 @@ class DefineAndRunGraph(Graph):
                 # then grow/backoff the scale (reference update_scale op)
                 from .amp import check_finite
                 finite = check_finite(acc_grads)
-
-                def _sel(new, old):
-                    return jax.tree_util.tree_map(
-                        lambda n, o: jnp.where(finite, n, o), new, old)
-                new_vars = _sel(new_vars, var_state)
-                new_opt = _sel(new_opt, opt_core)
+                new_vars = _select_tree(finite, new_vars, var_state)
+                new_opt = _select_tree(finite, new_opt, opt_core)
+            if sentry is not None:
+                fetch_vals = list(fetch_vals)
+                fetch_vals[loss_fetch_idx] = sentry.inject_loss(
+                    fetch_vals[loss_fetch_idx], code)
+                # the same fp32 sum-of-squares the global-norm clip
+                # reads (Optimizer._grad_sq_norm; XLA CSE dedupes)
+                grad_sq = opt._grad_sq_norm(acc_grads,
+                                            update_node.attrs["xs"])
+                ok, new_sstate = sentry.update(
+                    fetch_vals[loss_fetch_idx], grad_sq,
+                    opt_state["_sentry"])
+                # anomalous verdict: keep OLD params, optimizer state
+                # and step counter — bitwise-zero residue on skip
+                new_vars = _select_tree(ok, new_vars, var_state)
+                new_opt = _select_tree(ok, new_opt, opt_core)
+                new_opt["_sentry"] = new_sstate
+            if scaler is not None:
                 new_opt["_scaler"] = scaler.update_state(
                     opt_state["_scaler"], finite)
             new_accum = {k: jnp.zeros_like(v) for k, v in grad_accum.items()} \
@@ -1312,6 +1443,12 @@ class DefineAndRunGraph(Graph):
         if update_node is not None:
             opt = update_node.attrs["optimizer"]
             meta["dp_axis"] = opt.dp_axis
+            sentry_meta = self._sentry_for(update_node, key[4])
+            if sentry_meta is not None:
+                # registration meta: the thresholds the fused verdict
+                # enforces + the fact the step carries the packed
+                # verdict in its outputs (analysis/bench introspection)
+                meta["sentry"] = sentry_meta.meta()
             # recorded for every train step (implicit-sync plans too):
             # the replicated-state-under-shard rule needs to know whether
             # the optimizer shards its state down by dp
@@ -1351,7 +1488,14 @@ class DefineAndRunGraph(Graph):
                     "device_num": mesh_axes.get(opt.dp_axis, 1),
                     "zero": opt.zero,
                     "flat": bool(flat_mode),
-                    "clip": opt.max_grad_norm is not None,
+                    # the flat sentry's global grad-norm psum shares the
+                    # clip's collective shape (same reduction whether
+                    # clipping fires or not), so the predictor counts it
+                    # under "clip"
+                    "clip": opt.max_grad_norm is not None
+                    or bool(flat_mode
+                            and self._sentry_for(update_node, key[4])
+                            is not None),
                     # each scalar fetch is pmean'd inside the manual
                     # region (one explicit all_reduce apiece)
                     "scalar_fetches": meta["scalar_fetches"],
@@ -1523,6 +1667,14 @@ class DefineAndRunGraph(Graph):
             feeds[t.id] = arr
         if self._rng_tensor is not None:
             feeds[self._rng_tensor.id] = jnp.asarray(self._fresh_rng_key())
+        run_level = key[4]
+        sentry = self._sentry_for(update_node, run_level)
+        if sentry is not None:
+            # the one-shot chaos code (0 = clean): a VALUE, never a
+            # shape — injections can never retrace the plan
+            feeds[self._sentry_code_tensor().id] = jnp.asarray(
+                self._sentry_next_code, jnp.int32)
+            self._sentry_next_code = 0
         feeds_mb = self._split_micro_batches(feeds, num_micro_batches)
         if feed_sp is not None:
             tr.end(feed_sp, n_feeds=len(feed_dict),
@@ -1547,6 +1699,8 @@ class DefineAndRunGraph(Graph):
                 scaler = None
             if scaler is not None:
                 opt_state["_scaler"] = scaler.init_state()
+            if sentry is not None:
+                opt_state["_sentry"] = sentry.init_state()
         grad_accum = dict(self._grad_accum)
 
         if key not in self._abstract_pool:
@@ -1598,6 +1752,10 @@ class DefineAndRunGraph(Graph):
             new_opt = dict(new_opt)
             if scaler is not None and "_scaler" in new_opt:
                 scaler.store_state(new_opt.pop("_scaler"))
+            if sentry is not None and "_sentry" in new_opt:
+                # the verdict rode the step outputs; stash it for the
+                # trainer's policy ladder (no extra device fetch)
+                sentry.store_state(new_opt.pop("_sentry"))
             update_node.attrs["optimizer"]._store_state(new_opt)
         self._grad_accum = dict(new_accum)
         # per-step memory snapshot when HETU_MEMORY_PROFILE is set

@@ -48,7 +48,8 @@ class Optimizer:
                  max_grad_norm: Optional[float] = None,
                  grad_comm: Optional[str] = None,
                  bucket_mb: float = 4.0,
-                 flat_state: bool = False):
+                 flat_state: bool = False,
+                 sentry=None):
         # lr: float, or a schedule callable step -> lr (optim.schedules)
         self.lr = lr
         self.params = list(params) if params is not None else None
@@ -93,6 +94,21 @@ class Optimizer:
                 raise ValueError(
                     f"flat_state=True implies dp-sharded state with "
                     f"replicated params (ZeRO 1/2); got zero={self.zero}")
+        # numeric sentry (resilience/sentry.py): on-device finite/spike
+        # verdict fused into every UPDATE-level step, anomalous updates
+        # skipped with bitwise-zero residue.  True / SentryConfig /
+        # NumericSentry all accepted; None disables.
+        if sentry:
+            from ..resilience.sentry import NumericSentry, SentryConfig
+            if sentry is True:
+                sentry = NumericSentry()
+            elif isinstance(sentry, SentryConfig):
+                sentry = NumericSentry(sentry)
+            elif not isinstance(sentry, NumericSentry):
+                raise ValueError(
+                    f"sentry must be True, a SentryConfig or a "
+                    f"NumericSentry, got {sentry!r}")
+        self.sentry = sentry or None
         self._flat_layout = None        # FlatStateLayout when flat+active
         self._packed_var_writes = -1    # graph._var_writes at last pack
         self._state: Dict[str, Any] = {}
@@ -404,16 +420,21 @@ class Optimizer:
                 for k, v in opt_state.items()}
 
     def _flat_sync_and_update(self, var_state, fstate, grads,
-                              xs: Sequence[Tensor], axis: str):
+                              xs: Sequence[Tensor], axis: str,
+                              want_sq_norm: bool = False):
         """Reduce-scatter -> local-chunk update -> param-dtype all-gather
         (the reference's zero pairing, Communication.h:583, without ever
         materializing a full gradient).  Must run inside the shard_map
         manual region; ``fstate`` leaves arrive as LOCAL chunks.
-        Returns (new param dict, new flat buffers).  The step counter is
-        NOT among the outputs: it is replicated arithmetic the caller
-        increments outside the region (a scalar leaving a manual region
-        with no reduction on its def-chain would — rightly — trip the
-        unreduced-psum-scalar lint)."""
+        Returns (new param dict, new flat buffers, global grad sq-norm
+        or None).  The sq-norm (``want_sq_norm`` or clipping) is the
+        psum-reduced fp32 sum of squares of the SYNCED gradient — the
+        quantity the clip and the numeric sentry share; psum on its
+        def-chain keeps it legal to return from the region.  The step
+        counter is NOT among the outputs: it is replicated arithmetic
+        the caller increments outside the region (a scalar leaving a
+        manual region with no reduction on its def-chain would —
+        rightly — trip the unreduced-psum-scalar lint)."""
         from ..parallel import comm
         from .flat_state import sync_order
         lay = self._flat_layout
@@ -424,11 +445,15 @@ class Optimizer:
             transport=self.grad_comm or "fp32")
         assert tuple(rs_layout.chunks) == tuple(lay.chunks), \
             "flat-state layout drifted from the reduce-scatter geometry"
-        if self.max_grad_norm is not None:
-            # global-norm clip over the scattered chunks: local partial
-            # sums + one psum (padding lanes contribute exact zeros)
+        sq_norm = None
+        if self.max_grad_norm is not None or want_sq_norm:
+            # global sum of squares over the scattered chunks: local
+            # partial sums + one psum (padding lanes contribute exact
+            # zeros) — pre-clip, shared by clip and sentry
             sq = sum(jnp.sum(jnp.square(c)) for c in chunks)
-            norm = jnp.sqrt(jax.lax.psum(sq, axis))
+            sq_norm = jax.lax.psum(sq, axis)
+        if self.max_grad_norm is not None:
+            norm = jnp.sqrt(sq_norm)
             scale = jnp.minimum(1.0, self.max_grad_norm / (norm + 1e-6))
             chunks = [c * scale for c in chunks]
         step = fstate["step"] + 1
@@ -454,7 +479,7 @@ class Optimizer:
         out: Dict[str, Any] = {"flat_master": new_master}
         for s in slots:
             out[f"flat_{s}"] = new_slots[s]
-        return new_vars, out
+        return new_vars, out, sq_norm
 
     def _c_param(self, tid: int, p):
         """ZeRO-3: keep the updated parameter dp-sharded at rest;
@@ -506,14 +531,21 @@ class Optimizer:
         (1-based, traced) step — see optim/schedules.py."""
         return self.lr(step) if callable(self.lr) else self.lr
 
+    def _grad_sq_norm(self, grads: Dict[int, jax.Array],
+                      xs: Sequence[Tensor]):
+        """fp32 global sum of squared gradients — the ONE quantity the
+        global-norm clip and the numeric sentry both read (shared here
+        so XLA CSE makes the reuse literal).  Nonfinite iff any
+        gradient lane is nonfinite."""
+        return sum(jnp.sum(jnp.square(grads[t.id].astype(jnp.float32)))
+                   for t in xs)
+
     def _clip_grads(self, grads: Dict[int, jax.Array],
                     xs: Sequence[Tensor]) -> Dict[int, jax.Array]:
         """Global-norm clip across ALL parameter grads (fp32 norm)."""
         if self.max_grad_norm is None:
             return grads
-        sq = sum(jnp.sum(jnp.square(grads[t.id].astype(jnp.float32)))
-                 for t in xs)
-        norm = jnp.sqrt(sq)
+        norm = jnp.sqrt(self._grad_sq_norm(grads, xs))
         scale = jnp.minimum(1.0, self.max_grad_norm / (norm + 1e-6))
         return {t.id: (grads[t.id].astype(jnp.float32) * scale)
                 .astype(grads[t.id].dtype) for t in xs}

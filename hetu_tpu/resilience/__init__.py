@@ -1,0 +1,34 @@
+"""Resilience plane: silent-failure detection + durable checkpoints.
+
+PR 12's fault plane survives *loud* failures (crashes, zombies, dropped
+handoffs); this package covers the silent ones — the failures that
+corrupt long LLM runs without raising anything:
+
+* :mod:`.sentry` — an on-device numeric sentry fused into the train
+  step: finite-check of loss + gradients and a grad-norm/loss-spike
+  ladder, packed into one verdict vector riding the existing step
+  outputs; anomalous steps skip the update with bitwise-zero residue
+  (``lax.select``-style ``where`` over params/opt-state/step-counter).
+* :mod:`.generations` — checksummed checkpoint *generations*
+  (``gen-<step>/`` + blake2b manifest, atomic commit, retention) with
+  verified restore that falls back past corrupted or half-written
+  generations.
+
+The policy ladder (skip -> rewind) and the chaos-plane integration
+(``grad_nan`` / ``grad_spike`` / ``loss_spike`` / ``shard_corrupt`` /
+``kill_mid_write`` FaultPlan verdicts) are driven end-to-end by
+:class:`hetu_tpu.elastic.FaultTolerantTrainer`.  DESIGN.md §19.
+"""
+from .generations import (corrupt_generation, generation_dir,
+                          list_generations, load_latest_generation,
+                          prune_generations, save_generation,
+                          verify_generation, write_manifest)
+from .sentry import (INJECT_CODES, VERDICT_SLOTS, NumericSentry,
+                     SentryConfig, decode_verdict)
+
+__all__ = [
+    "INJECT_CODES", "NumericSentry", "SentryConfig", "VERDICT_SLOTS",
+    "corrupt_generation", "decode_verdict", "generation_dir",
+    "list_generations", "load_latest_generation", "prune_generations",
+    "save_generation", "verify_generation", "write_manifest",
+]

@@ -1,6 +1,8 @@
 from .safetensors_io import (save_model, load_model, save_split, load_split,
                              save_split_async, AsyncSaveHandle,
-                             save_checkpoint, load_checkpoint)
+                             save_checkpoint, load_checkpoint,
+                             WriterDeathError, arm_kill_mid_write,
+                             disarm_kill_mid_write, restore_records)
 from .converters import (hf_gpt2_to_ht, ht_to_hf_gpt2,
                          megatron_qkv_to_interleaved,
                          interleaved_qkv_to_megatron)

@@ -33,6 +33,83 @@ from ...ops.quantization import (dequantize_4bit, quantize_4bit)
 _VIEW_DTYPES = {"bfloat16": np.uint16, "float16": np.uint16}
 
 
+# ---------------------------------------------------------------------------
+# durability seams (resilience plane, DESIGN.md §19)
+# ---------------------------------------------------------------------------
+
+#: audit log of every checkpoint restore: the ``unverified-restore``
+#: lint rule reads these records — a restore that reached tensor bytes
+#: without a digest check against a generation manifest (``verified``
+#: False, no ``verify_exempt``) fails CI.  Bounded: a long-lived
+#: process (serving host, full pytest session) keeps only the newest
+#: records rather than growing without limit.
+from collections import deque as _deque
+RESTORE_LOG = _deque(maxlen=4096)
+
+
+def restore_records(prefix: Optional[str] = None) -> list:
+    """Copies of the restore audit records, optionally filtered to
+    directories under ``prefix``."""
+    if prefix is None:
+        return [dict(r) for r in RESTORE_LOG]
+    p = os.path.abspath(prefix)
+    # path-component match, not a raw string prefix: /tmp/run1 must not
+    # claim /tmp/run10's records
+    return [dict(r) for r in RESTORE_LOG
+            if r["dir"] == p or r["dir"].startswith(p + os.sep)]
+
+
+class WriterDeathError(RuntimeError):
+    """Simulated checkpoint-writer death (the ``kill_mid_write`` chaos
+    verdict): raised between shard files so the save never commits."""
+
+
+# chaos hook consulted before every shard/index write; fault injection
+# arms it, normal operation leaves it None
+_WRITE_CHAOS: list = [None]
+
+
+def arm_kill_mid_write(after_files: int = 1) -> None:
+    """Arm the ``kill_mid_write`` chaos verdict: the NEXT split write
+    dies (WriterDeathError) after ``after_files`` files have reached
+    disk — a half-written checkpoint with no index and no manifest,
+    exactly what a killed process leaves.  One-shot: disarms on fire."""
+    box = [int(after_files)]
+
+    def hook(fname: str) -> None:
+        if box[0] <= 0:
+            _WRITE_CHAOS[0] = None
+            raise WriterDeathError(
+                f"chaos kill_mid_write: writer died before {fname}")
+        box[0] -= 1
+
+    _WRITE_CHAOS[0] = hook
+
+
+def disarm_kill_mid_write() -> None:
+    _WRITE_CHAOS[0] = None
+
+
+def _chaos_gate(fname: str) -> None:
+    if _WRITE_CHAOS[0] is not None:
+        _WRITE_CHAOS[0](fname)
+
+
+def _prune_stale_shards(dirpath: str, keep) -> None:
+    """Remove shard files a PREVIOUS save into this directory left
+    behind (a re-save with fewer shards/processes): ``load_split``
+    reads only ``index.json``, but stale ``model_*.safetensors`` files
+    poison any consumer that globs the directory — and make the
+    checksummed-generation manifest reject the save wholesale."""
+    for fn in os.listdir(dirpath):
+        if fn.startswith("model_") and fn.endswith(".safetensors") \
+                and fn not in keep:
+            try:
+                os.remove(os.path.join(dirpath, fn))
+            except OSError:
+                pass
+
+
 def _to_numpy(arr) -> np.ndarray:
     if isinstance(arr, np.ndarray):
         return arr
@@ -244,16 +321,23 @@ def _write_split(state, snap, dirpath, pidx, pcount, num_shards,
         # only process 0 touches the filesystem
         if pidx == 0:
             for fname, tensors in files.items():
+                _chaos_gate(fname)
                 save_file(tensors, os.path.join(dirpath, fname),
                           metadata={"format": "hetu_tpu_split",
                                     **metas[fname]})
+            _chaos_gate("index.json")
             _atomic_json(os.path.join(dirpath, "index.json"), index)
+            # a re-save with fewer shards must not leave the old save's
+            # extra shard files for a directory consumer to mix in
+            _prune_stale_shards(dirpath, set(files))
         return
 
     # per-process path: each process owns exactly its shard file + index
     for fname, tensors in files.items():
+        _chaos_gate(fname)
         save_file(tensors, os.path.join(dirpath, fname),
                   metadata={"format": "hetu_tpu_split", **metas[fname]})
+    _chaos_gate(f"index.{pidx}.json")
     _atomic_json(os.path.join(dirpath, f"index.{pidx}.json"), index)
     barrier = _barrier if barrier_fn is None else barrier_fn
     barrier()
@@ -269,7 +353,12 @@ def _write_split(state, snap, dirpath, pidx, pcount, num_shards,
                     continue
                 if i >= pcount:
                     os.remove(os.path.join(dirpath, fn))
-        _merge_indices(dirpath, pcount)
+        merged = _merge_indices(dirpath, pcount)
+        # shard files no slice of the merged index references are a
+        # previous save's leftovers — drop them with the stale indices
+        referenced = {sl["file"] for ent in merged["tensors"].values()
+                      for sl in ent["slices"]}
+        _prune_stale_shards(dirpath, referenced)
     barrier()
 
 
@@ -374,7 +463,7 @@ def _barrier() -> None:
         multihost_utils.sync_global_devices("hetu_tpu_ckpt")
 
 
-def _merge_indices(dirpath: str, pcount: int) -> None:
+def _merge_indices(dirpath: str, pcount: int) -> Dict[str, Any]:
     merged: Dict[str, Any] = {"tensors": {}, "num_files": 0}
     for i in range(pcount):
         with open(os.path.join(dirpath, f"index.{i}.json")) as f:
@@ -387,6 +476,7 @@ def _merge_indices(dirpath: str, pcount: int) -> None:
                                            "slices": []}
             merged["tensors"][name]["slices"].extend(ent["slices"])
     _atomic_json(os.path.join(dirpath, "index.json"), merged)
+    return merged
 
 
 def load_split(dirpath: str, names: Optional[list] = None
@@ -536,9 +626,18 @@ def save_checkpoint(model, optimizer, dirpath: str, step: int = 0,
     return None
 
 
-def load_checkpoint(model, optimizer, dirpath: str) -> Dict[str, Any]:
+def load_checkpoint(model, optimizer, dirpath: str,
+                    verified: bool = False,
+                    verify_exempt: bool = False) -> Dict[str, Any]:
     """Load a checkpoint saved by :func:`save_checkpoint`; reshards params
-    and optimizer states to the current config.  Returns trainer state."""
+    and optimizer states to the current config.  Returns trainer state.
+
+    Every call lands in :data:`RESTORE_LOG` for the
+    ``unverified-restore`` lint rule: ``verified=True`` is stamped by
+    the digest-checking generation loader
+    (:func:`hetu_tpu.resilience.load_latest_generation`) — raw loads
+    that deliberately skip verification must say so with
+    ``verify_exempt=True`` or they fail CI."""
     state = load_split(dirpath)
     model_state = {k: v for k, v in state.items()
                    if not k.startswith("opt.")}
@@ -581,7 +680,12 @@ def load_checkpoint(model, optimizer, dirpath: str) -> Dict[str, Any]:
                 slot: [leaves[i] for i in sorted(leaves)]
                 for slot, leaves in pending_trees.items()}
     ts_path = os.path.join(dirpath, "trainer_state.json")
+    ts = {"step": 0, "extra": {}}
     if os.path.exists(ts_path):
         with open(ts_path) as f:
-            return json.load(f)
-    return {"step": 0, "extra": {}}
+            ts = json.load(f)
+    RESTORE_LOG.append({"dir": os.path.abspath(dirpath),
+                        "verified": bool(verified),
+                        "verify_exempt": bool(verify_exempt),
+                        "step": int(ts.get("step", 0))})
+    return ts

@@ -1,0 +1,621 @@
+"""Silent-failure sentry + durable checkpoint generations (ISSUE 14).
+
+The invariants: an anomalous step (NaN/Inf grads, grad-norm spike,
+relative loss spike) is skipped ON-DEVICE with bitwise-zero residue —
+the loss curve and params of clean steps are bit-for-bit the
+anomaly-free run's, with no recompile and no extra host fetch; the
+policy ladder rewinds to the newest checkpoint *generation* that
+VERIFIES (blake2b manifest), falling back past corrupted
+(``shard_corrupt``) and half-written (``kill_mid_write``) generations;
+and every restore that skips the digest check fails the
+``unverified-restore`` lint rule.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import hetu_tpu as ht
+from hetu_tpu.elastic import FaultTolerantTrainer, TrainBuild, WorkerMonitor
+from hetu_tpu.fault import FaultEvent, FaultPlan
+from hetu_tpu.graph import ctor
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel, llama_config
+from hetu_tpu.parallel import create_mesh
+from hetu_tpu.obs.tracer import SpanTracer, install_tracer
+from hetu_tpu.resilience import (corrupt_generation, list_generations,
+                                 load_latest_generation, save_generation,
+                                 verify_generation)
+from hetu_tpu.utils.checkpoint import (WriterDeathError,
+                                       arm_kill_mid_write,
+                                       disarm_kill_mid_write,
+                                       load_checkpoint, load_split,
+                                       restore_records, save_checkpoint,
+                                       save_split)
+
+# one deterministic batch table for every data-cursor test: cursor c
+# trains on TABLE[c], so "the run that never saw batch c" is exactly
+# the reference a skip must reproduce bit-for-bit
+TABLE = np.random.RandomState(42).randint(0, 64, (64, 8, 16)) \
+    .astype(np.int32)
+
+
+def _single_build(sentry=True, max_grad_norm=None, lr=1e-2):
+    """Single-device implicit-path build (no mesh): graph, model, opt,
+    step(cursor)."""
+    ctor._seed_counter[0] = 123
+    gctx = ht.graph("define_and_run", create_new=True)
+    g = gctx.__enter__()
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_heads=4, max_seq_len=16, sp=False, dropout=0.0)
+    ids = ht.placeholder("int32", (4, 16))
+    labels = ht.placeholder("int32", (4, 16))
+    model = GPTLMHeadModel(cfg)
+    loss = model(ids, labels)
+    opt = ht.optim.AdamOptimizer(lr=lr, sentry=sentry,
+                                 max_grad_norm=max_grad_norm)
+    train_op = opt.minimize(loss)
+
+    def step(cursor):
+        b = TABLE[cursor][:4]
+        out = g.run(loss, [loss, train_op],
+                    {ids: b, labels: np.roll(b, -1, axis=1)})
+        return float(np.asarray(out[0]))
+
+    return g, model, opt, step, \
+        (lambda: gctx.__exit__(None, None, None))
+
+
+def _flat_build_fn(dp, devices, sentry=True, max_grad_norm=None):
+    """dp-mesh flat ZeRO-2 build (the explicit reduce-scatter path)."""
+    ctor._seed_counter[0] = 777
+    mesh = create_mesh({"dp": dp}, devices[:dp])
+    cfg = llama_config(vocab_size=64, hidden_size=32, num_layers=1,
+                       num_heads=4, max_seq_len=16, sp=False)
+    gctx = ht.graph("define_and_run", create_new=True, mesh=mesh)
+    g = gctx.__enter__()
+    ids = ht.parallel_placeholder("int32", (8, 16), pspec=P("dp", None),
+                                  name="ids")
+    labels = ht.parallel_placeholder("int32", (8, 16),
+                                     pspec=P("dp", None), name="labels")
+    model = GPTLMHeadModel(cfg)
+    loss = model(ids, labels)
+    opt = ht.optim.AdamOptimizer(lr=1e-2, zero=2, grad_comm="fp32",
+                                 flat_state=True, sentry=sentry,
+                                 max_grad_norm=max_grad_norm)
+    train_op = opt.minimize(loss)
+
+    def step_fn(cursor):
+        b = TABLE[cursor]
+        out = g.run(loss, [loss, train_op],
+                    {ids: b, labels: np.roll(b, -1, axis=1)})
+        assert g._grad_comm_active, g._grad_comm_fallback
+        return float(np.asarray(out[0]))
+
+    return TrainBuild(graph=g, model=model, optimizer=opt,
+                      step_fn=step_fn,
+                      close=lambda: gctx.__exit__(None, None, None))
+
+
+def _params(model):
+    return {k: np.asarray(v, np.float32)
+            for k, v in model.state_dict().items()}
+
+
+def _bitwise_equal(a, b):
+    return set(a) == set(b) and \
+        all(np.array_equal(a[k], b[k]) for k in a)
+
+
+# ---------------------------------------------------------------------------
+# the on-device sentry: verdicts, skip residue, honesty pins
+# ---------------------------------------------------------------------------
+
+
+def test_sentry_skip_is_bitwise_zero_residue():
+    """A grad_nan injection skips the update ON-DEVICE: losses and
+    final params of the clean steps are bit-for-bit the run that never
+    saw the poisoned batch — and the whole run rides ONE compiled plan
+    (injection is a feed value, never a retrace)."""
+    g, model, opt, step, close = _single_build()
+    losses = [step(0), step(1)]
+    g.inject_numeric_fault("grad_nan")
+    bad = step(2)                      # the poisoned attempt
+    v = opt.sentry.last_verdict()
+    assert v["anomaly"] and v["grad_nonfinite"] and v["consecutive"] == 1
+    assert not v["loss_nonfinite"]     # only the grads were poisoned
+    assert np.isnan(v["grad_norm"])
+    losses.append(step(3))
+    v2 = opt.sentry.last_verdict()
+    assert not v2["anomaly"] and v2["consecutive"] == 0
+    assert v2["grad_norm"] > 0
+    assert len(g._plan_pool) == 1, "sentry/injection caused a retrace"
+    p_chaos = _params(model)
+    close()
+
+    # reference: same sentry-on program, batches 0,1,3 only
+    g2, model2, opt2, step2, close2 = _single_build()
+    ref = [step2(c) for c in (0, 1, 3)]
+    assert ref == losses, "clean-step losses are not bitwise equal"
+    assert _bitwise_equal(p_chaos, _params(model2)), \
+        "skipped step left residue in the params"
+    close2()
+
+
+def test_sentry_zero_extra_host_transfers():
+    """Honesty pin: the verdict rides the existing step outputs — one
+    host read per step alongside the loss fetch, executable called
+    exactly once per attempt, compile count 1."""
+    g, model, opt, step, close = _single_build()
+    reads0 = opt.sentry.host_reads
+    for c in range(3):
+        step(c)
+        opt.sentry.last_verdict()
+    g.inject_numeric_fault("grad_spike")
+    step(3)
+    opt.sentry.last_verdict()
+    assert opt.sentry.host_reads - reads0 == 4     # one per attempt
+    assert len(g._plan_pool) == 1
+    close()
+
+
+def test_sentry_loss_spike_needs_warmup_and_fires():
+    """The relative loss-spike verdict: silent during EMA warmup, fires
+    once the loss jumps past factor * EMA, and the skipped step leaves
+    the params bitwise unchanged."""
+    g, model, opt, step, close = _single_build()
+    step(0)
+    # warmup: a spike injected before the EMA has history must NOT trip
+    g.inject_numeric_fault("loss_spike")
+    step(1)
+    v = opt.sentry.last_verdict()
+    assert not v["loss_spike"], "spike verdict fired during warmup"
+    step(2), step(3)
+    before = _params(model)
+    g.inject_numeric_fault("loss_spike")
+    spiked = step(4)
+    v = opt.sentry.last_verdict()
+    assert v["anomaly"] and v["loss_spike"] and not v["grad_spike"]
+    assert spiked > 4 * opt.sentry.config.loss_spike_factor / 8.0
+    assert _bitwise_equal(before, _params(model)), \
+        "loss-spike step updated the params"
+    close()
+
+
+def test_sentry_flat_zero2_skip_and_step_counter(devices8):
+    """The flat reduce-scatter path: grad_spike verdict from the
+    psum-shared global norm, on-device skip freezes the flat buffers
+    AND the step counter, clean steps bitwise vs the anomaly-free run,
+    one compiled plan throughout."""
+    b = _flat_build_fn(8, devices8, max_grad_norm=1.0)
+    losses = [b.step_fn(0), b.step_fn(1)]
+    assert int(np.asarray(b.optimizer._state["step"])) == 2
+    b.graph.inject_numeric_fault("grad_spike")
+    b.step_fn(2)
+    v = b.optimizer.sentry.last_verdict()
+    assert v["anomaly"] and v["grad_spike"] and not v["grad_nonfinite"]
+    assert v["grad_norm"] > b.optimizer.sentry.config.grad_norm_max
+    assert int(np.asarray(b.optimizer._state["step"])) == 2, \
+        "skip advanced the optimizer step counter"
+    losses.append(b.step_fn(3))
+    assert int(np.asarray(b.optimizer._state["step"])) == 3
+    assert len(b.graph._plan_pool) == 1
+    p_chaos = _params(b.model)
+    b.close()
+
+    ref = _flat_build_fn(8, devices8, max_grad_norm=1.0)
+    ref_losses = [ref.step_fn(c) for c in (0, 1, 3)]
+    assert ref_losses == losses
+    assert _bitwise_equal(p_chaos, _params(ref.model))
+    assert int(np.asarray(ref.optimizer._state["step"])) == 3
+    ref.close()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint generations: manifest, verify, fallback, retention
+# ---------------------------------------------------------------------------
+
+
+def _ck_build():
+    """Tiny single-device model+optimizer for checkpoint-plane tests."""
+    gctx = ht.graph("define_and_run", create_new=True)
+    g = gctx.__enter__()
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_heads=4, max_seq_len=16, sp=False, dropout=0.0)
+    ids = ht.placeholder("int32", (2, 16))
+    labels = ht.placeholder("int32", (2, 16))
+    model = GPTLMHeadModel(cfg)
+    loss = model(ids, labels)
+    opt = ht.optim.AdamOptimizer(lr=1e-2)
+    train_op = opt.minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {ids: rng.randint(0, 64, (2, 16)),
+            labels: rng.randint(0, 64, (2, 16))}
+
+    def step():
+        out = g.run(loss, [loss, train_op], feed)
+        return float(np.asarray(out[0]))
+
+    return g, model, opt, step, \
+        (lambda: gctx.__exit__(None, None, None))
+
+
+def test_generation_verify_detects_corruption_and_staleness(tmp_path):
+    g, model, opt, step, close = _ck_build()
+    step()
+    root = str(tmp_path / "gens")
+    d1 = save_generation(model, opt, root, step=1, keep=4)
+    ok, problems = verify_generation(d1)
+    assert ok, problems
+    # an unmanifested straggler (a stale shard from another save) is
+    # rejected wholesale — the stale-mix hazard the generations close
+    stale = os.path.join(d1, "model_00099-of-00100.safetensors")
+    with open(stale, "wb") as f:
+        f.write(b"junk")
+    ok, problems = verify_generation(d1)
+    assert not ok and any("unmanifested" in p for p in problems)
+    os.remove(stale)
+    assert verify_generation(d1)[0]
+    # flipped bytes -> digest mismatch
+    corrupt_generation(root, step=1)
+    ok, problems = verify_generation(d1)
+    assert not ok and any("digest mismatch" in p for p in problems)
+    close()
+
+
+def test_restore_falls_back_past_corrupted_generation(tmp_path):
+    """shard_corrupt on the newest generation: the verified restore
+    falls back one generation and restores exactly its params."""
+    g, model, opt, step, close = _ck_build()
+    step()
+    root = str(tmp_path / "gens")
+    save_generation(model, opt, root, step=1, keep=4)
+    want = _params(model)
+    step()
+    save_generation(model, opt, root, step=2, keep=4)
+    corrupt_generation(root)          # newest = gen-2
+    info = load_latest_generation(model, opt, root)
+    assert info["generation"] == 1
+    assert [f["generation"] for f in info["fallbacks"]] == [2]
+    assert _bitwise_equal(want, _params(model)), \
+        "fallback restore did not reproduce gen-1's params"
+    close()
+
+
+def test_kill_mid_write_previous_generation_survives(tmp_path):
+    """The kill_mid_write chaos verdict: the writer dies between
+    shards, the partial generation never commits a manifest, and the
+    previous generation still verifies and restores."""
+    g, model, opt, step, close = _ck_build()
+    step()
+    root = str(tmp_path / "gens")
+    save_generation(model, opt, root, step=1, keep=4)
+    want = _params(model)
+    step()
+    arm_kill_mid_write(after_files=1)
+    try:
+        with pytest.raises(WriterDeathError):
+            save_generation(model, opt, root, step=2, keep=4)
+    finally:
+        disarm_kill_mid_write()
+    d2 = os.path.join(root, "gen-2")
+    assert os.path.isdir(d2), "the partial write left nothing at all"
+    assert not os.path.exists(os.path.join(d2, "manifest.json"))
+    ok, problems = verify_generation(d2)
+    assert not ok and "no manifest" in problems[0]
+    assert verify_generation(os.path.join(root, "gen-1"))[0]
+    info = load_latest_generation(model, opt, root)
+    assert info["generation"] == 1
+    assert [f["generation"] for f in info["fallbacks"]] == [2]
+    assert _bitwise_equal(want, _params(model))
+    close()
+
+
+def test_resave_same_step_death_keeps_committed_generation(tmp_path):
+    """A re-save of a step that already has a COMMITTED generation
+    (emergency flush, rewind replay) must not destroy it: if the fresh
+    write dies mid-shard, the displaced generation is restored and
+    still verifies/loads."""
+    g, model, opt, step, close = _ck_build()
+    step()
+    root = str(tmp_path / "gens")
+    save_generation(model, opt, root, step=1, keep=4)
+    want = _params(model)
+    step()
+    arm_kill_mid_write(after_files=1)
+    try:
+        with pytest.raises(WriterDeathError):
+            save_generation(model, opt, root, step=1, keep=4)
+    finally:
+        disarm_kill_mid_write()
+    d1 = os.path.join(root, "gen-1")
+    assert verify_generation(d1)[0], \
+        "failed re-save destroyed the committed generation"
+    info = load_latest_generation(model, opt, root)
+    assert info["generation"] == 1 and not info["fallbacks"]
+    assert _bitwise_equal(want, _params(model))
+    # a SUCCESSFUL re-save retires the old one cleanly
+    step()
+    save_generation(model, opt, root, step=1, keep=4)
+    assert verify_generation(d1)[0]
+    assert not os.path.exists(d1 + ".prev")
+    close()
+
+
+def test_generation_retention_prunes_committed_only(tmp_path):
+    g, model, opt, step, close = _ck_build()
+    step()
+    root = str(tmp_path / "gens")
+    for s in (1, 2, 3, 4):
+        save_generation(model, opt, root, step=s, keep=2)
+    assert list_generations(root) == [3, 4]
+    close()
+
+
+def test_resave_fewer_shards_drops_stale_files(tmp_path):
+    """Satellite regression (the load_split stale-mix hazard): a
+    re-save with fewer shards into the same directory removes the old
+    save's extra shard files, and the restore matches the LATEST save
+    exactly."""
+    d = str(tmp_path / "ck")
+    a = {"w": np.arange(64, dtype=np.float32).reshape(8, 8),
+         "b": np.ones((8,), np.float32)}
+    save_split(a, d, num_shards=4)
+    assert len([f for f in os.listdir(d)
+                if f.endswith(".safetensors")]) == 4
+    b = {"w": -np.arange(64, dtype=np.float32).reshape(8, 8),
+         "b": 3 * np.ones((8,), np.float32)}
+    save_split(b, d, num_shards=2)
+    shard_files = [f for f in os.listdir(d)
+                   if f.endswith(".safetensors")]
+    assert len(shard_files) == 2, \
+        f"stale shards survived the re-save: {sorted(shard_files)}"
+    back = load_split(d)
+    for k in b:
+        np.testing.assert_array_equal(back[k], b[k])
+
+
+def test_background_checkpoint_does_not_starve_heartbeat(tmp_path):
+    """A background checkpoint write must not starve the coordinator
+    heartbeat into a false death -> spurious re-plan (the PR 12
+    refusal-window pin, applied to the writer thread).  The write is
+    made deterministically slow through the chaos write hook (3 shards
+    x 0.3 s >> the 0.4 s TTL)."""
+    from hetu_tpu.rpc.coordinator import CoordinatorClient, \
+        CoordinatorServer
+    from hetu_tpu.utils.checkpoint import safetensors_io
+
+    state = {f"w{i}": np.random.RandomState(i).randn(64, 64)
+             .astype(np.float32) for i in range(3)}
+    with CoordinatorServer(world_size=1, ttl=0.4) as srv:
+        c = CoordinatorClient(srv.address, uid="w0", ttl=0.4)
+        c.connect()
+        stop = c.start_heartbeat_thread(interval=0.05)
+        slow_calls = []
+
+        def slow_hook(fname):
+            slow_calls.append(fname)
+            time.sleep(0.3)
+
+        safetensors_io._WRITE_CHAOS[0] = slow_hook
+        try:
+            from hetu_tpu.utils.checkpoint import save_split_async
+            h = save_split_async(state, str(tmp_path / "bg"),
+                                 num_shards=3)
+            while not h.done():
+                assert not srv.dead_ranks(), \
+                    "background checkpoint write starved the heartbeat"
+                time.sleep(0.05)
+            h.wait(timeout=60)
+        finally:
+            safetensors_io._WRITE_CHAOS[0] = None
+            stop.set()
+        assert len(slow_calls) >= 3, "the slow write never engaged"
+        assert not srv.dead_ranks()
+    back = load_split(str(tmp_path / "bg"))
+    for k in state:
+        np.testing.assert_array_equal(back[k], state[k])
+
+
+# ---------------------------------------------------------------------------
+# the unverified-restore rule
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.lint_graph
+def test_unverified_restore_rule(tmp_path):
+    """Repo-standard rule contract: silent on digest-checked restores
+    (non-vacuously — records present), fires exactly once per raw
+    load, honors verify_exempt, and a raising hook is itself a
+    failure."""
+    from hetu_tpu.analysis import AnalysisContext, run_rules
+    g, model, opt, step, close = _ck_build()
+    step()
+    root = str(tmp_path / "gens")
+    save_generation(model, opt, root, step=1, keep=4)
+    n0 = len(restore_records(root))
+    load_latest_generation(model, opt, root)          # verified
+    load_checkpoint(model, opt, os.path.join(root, "gen-1"))  # raw!
+    recs = restore_records(root)[n0:]
+    assert [r["verified"] for r in recs] == [True, False]
+
+    def hook():
+        return recs
+
+    ctx = AnalysisContext(name="trainer/plan0", meta={"restores": hook})
+    fired = run_rules(ctx, only=["unverified-restore"])
+    assert len(fired) == 1 and fired[0].rule == "unverified-restore"
+    assert fired[0].severity == "error"
+    assert "digest check" in fired[0].message
+    assert "load_latest_generation" in fired[0].hint
+    # the escape hatch: a deliberate raw load says so
+    load_checkpoint(model, opt, os.path.join(root, "gen-1"),
+                    verify_exempt=True)
+    recs = restore_records(root)[n0:]
+    assert len(run_rules(AnalysisContext(name="t", meta={
+        "restores": lambda: recs}), only=["unverified-restore"])) == 1
+    recs2 = [r for r in recs if r["verified"] or r["verify_exempt"]]
+    assert run_rules(AnalysisContext(name="t", meta={
+        "restores": lambda: recs2}), only=["unverified-restore"]) == []
+    # a raising hook loses the audit -> error finding
+    def broken():
+        raise RuntimeError("boom")
+    fired = run_rules(AnalysisContext(name="t",
+                                      meta={"restores": broken}),
+                      only=["unverified-restore"])
+    assert len(fired) == 1 and "audit" in fired[0].message
+    # executables without the meta key are out of scope
+    assert run_rules(AnalysisContext(name="t", meta={}),
+                     only=["unverified-restore"]) == []
+    close()
+
+
+# ---------------------------------------------------------------------------
+# the trainer policy ladder + the ISSUE 14 acceptance drive
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_ladder_skip_rewind_fallback_bitwise(devices8, tmp_path):
+    """Numeric + durability chaos (no process death): grad_nan is
+    skipped, shard_corrupt poisons the newest generation, the
+    loss_spike rewind falls back PAST it, re-run steps replay their
+    pinned data cursors — and the whole committed loss curve plus the
+    final params are bit-for-bit the fault-free run over the same
+    clean-batch sequence."""
+    tracer = SpanTracer()
+    install_tracer(tracer)
+    try:
+        tr = FaultTolerantTrainer(
+            _flat_build_fn, devices8,
+            checkpoint_dir=str(tmp_path / "ck"),
+            checkpoint_every=2, keep_checkpoints=3, rewind_after=2)
+        plan = FaultPlan(events=[
+            FaultEvent(step=2, kind="grad_nan", target=0),
+            FaultEvent(step=6, kind="shard_corrupt", target=0),
+            FaultEvent(step=6, kind="loss_spike", target=0),
+        ])
+        losses = tr.train(8, fault_plan=plan)
+    finally:
+        install_tracer(None)
+    ms = tr.metrics_summary()
+    assert ms["sentry_anomalies"] == 2        # grad_nan + loss_spike
+    assert ms["steps_skipped"] == 2
+    assert ms["rewinds"] == 1
+    assert ms["restore_fallbacks"] == 1, \
+        "restore did not fall back past the corrupted generation"
+    assert tr.recoveries[0]["kind"] == "numeric_rewind"
+    assert tr.recoveries[0]["reason"] == "loss_spike"
+    assert tr.recoveries[0]["resumed_from_step"] == 4   # gen-6 corrupt
+    assert tr.recoveries[0].get("mttr_s", 0) > 0
+    # honesty: one compiled plan, one executable call per attempt, one
+    # verdict host-read per attempt (rides the loss fetch)
+    assert len(tr.build.graph._plan_pool) == 1
+    assert tr.build.optimizer.sentry.host_reads == tr.attempts
+    # every sentry decision is a chaos-track instant
+    names = [e.name for e in tracer.events()]
+    for ev in ("fault", "sentry_skip", "sentry_rewind",
+               "restore_fallback", "recovered"):
+        assert ev in names, f"missing {ev} instant"
+    chaos_tracks = {e.track for e in tracer.events()
+                    if e.name in ("sentry_skip", "sentry_rewind",
+                                  "restore_fallback")}
+    assert chaos_tracks == {"chaos"}
+    # the rule wiring: the trainer's registered plan exposes verified
+    # restore records and the rule stays silent
+    from hetu_tpu.analysis import AnalysisContext, run_rules
+    handles = tr.build.graph.analysis_handles()
+    assert handles and "restores" in handles[0].meta
+    recs = handles[0].meta["restores"]()
+    assert recs and all(r["verified"] for r in recs), "gate is vacuous"
+    assert run_rules(AnalysisContext(name=handles[0].name,
+                                     meta=handles[0].meta),
+                     only=["unverified-restore"]) == []
+    cursors = tr.committed_cursors()
+    p_chaos = _params(tr.build.model)
+    tr.close()
+
+    # the fault-free reference: same program, the committed clean-batch
+    # sequence — bit-for-bit, not allclose
+    ref = _flat_build_fn(8, devices8)
+    ref_losses = [ref.step_fn(c) for c in cursors]
+    assert ref_losses == losses, "committed losses are not bitwise"
+    assert _bitwise_equal(p_chaos, _params(ref.model)), \
+        "chaos run's params diverged from the fault-free run"
+    ref.close()
+
+
+def test_acceptance_mixed_numeric_and_process_faults(devices8,
+                                                     tmp_path):
+    """The ISSUE 14 acceptance drive: grad_nan x2, loss_spike x1,
+    shard_corrupt on the newest generation, one worker death — zero
+    steps lost, the pre-death curve bit-for-bit the fault-free run's,
+    the post-death (dp8 -> dp4) continuation exact to the flat-state
+    contract, restore falls back past the corrupted generation."""
+    mon = WorkerMonitor(4, devices8, ttl=0.3, heartbeat_interval=0.05)
+    tr = FaultTolerantTrainer(
+        _flat_build_fn, devices8, monitor=mon,
+        checkpoint_dir=str(tmp_path / "ck"),
+        checkpoint_every=2, keep_checkpoints=3, rewind_after=2)
+    plan = FaultPlan(events=[
+        FaultEvent(step=2, kind="grad_nan", target=0),
+        FaultEvent(step=3, kind="grad_nan", target=1),
+        FaultEvent(step=6, kind="shard_corrupt", target=0),
+        FaultEvent(step=6, kind="loss_spike", target=0),
+        FaultEvent(step=8, kind="worker_death", target=3),
+    ])
+    STEPS = 10
+    losses = tr.train(STEPS, fault_plan=plan)
+    mon.close()
+    assert len(losses) == STEPS and all(np.isfinite(losses)), \
+        "steps were lost"
+    ms = tr.metrics_summary()
+    assert ms["sentry_anomalies"] == 3
+    assert ms["rewinds"] == 1 and ms["restore_fallbacks"] == 1
+    assert ms["worker_recoveries"] == 1
+    death = tr.recoveries[-1]
+    assert death["kind"] == "worker_death" and death["dp"] == 4
+    assert death["devices"] == 6
+    cursors = tr.committed_cursors()
+    assert len(cursors) == STEPS
+    tr.close()
+
+    ref = _flat_build_fn(8, devices8)
+    ref_losses = [ref.step_fn(c) for c in cursors]
+    ref.close()
+    # pre-death steps (0..7): bit-for-bit; the dp8->dp4 tail continues
+    # to the flat-state cross-dp contract (PR 12's loss_curve gate)
+    assert losses[:8] == ref_losses[:8], \
+        "pre-death curve is not bitwise the fault-free run's"
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-6)
+
+
+def test_emergency_flush_shrinks_rewind_to_zero(devices8, tmp_path):
+    """The fault plane's emergency-flush hook: on a death verdict the
+    trainer flushes the survivor-visible state as an emergency
+    generation BEFORE re-planning, so recovery resumes from the detect
+    step instead of rewinding to the last periodic snapshot.  The
+    flush is a normal generation: digest-verified on restore."""
+    mon = WorkerMonitor(4, devices8, ttl=0.3, heartbeat_interval=0.05)
+    tr = FaultTolerantTrainer(
+        _flat_build_fn, devices8, monitor=mon,
+        checkpoint_dir=str(tmp_path / "ck"),
+        checkpoint_every=4, emergency_flush=True)
+    plan = FaultPlan(events=[FaultEvent(step=3, kind="worker_death",
+                                        target=2)])
+    losses = tr.train(6, fault_plan=plan)
+    mon.close()
+    ms = tr.metrics_summary()
+    assert ms["emergency_flushes"] == 1
+    rec = tr.recoveries[0]
+    assert rec["resumed_from_step"] == 3        # not the step-0 snapshot
+    assert rec["rewound_steps"] == 0
+    cursors = tr.committed_cursors()
+    tr.close()
+    ref = _flat_build_fn(8, devices8)
+    ref_losses = [ref.step_fn(c) for c in cursors]
+    ref.close()
+    assert losses[:3] == ref_losses[:3]
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-6)
