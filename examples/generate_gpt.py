@@ -42,8 +42,20 @@ def serve_demo(state, cfg, args):
     # --trace-out: record the full per-request trace plane and dump a
     # Perfetto-loadable chrome trace after the run (DESIGN.md §15)
     tracer = obs.SpanTracer() if args.trace_out else None
+    # --spec-draft-layers N: speculative decoding behind a truncated
+    # N-layer self-draft proposing --spec-k tokens per step
+    # (DESIGN.md §20) — the temp-0 self-check below still holds
+    # bit-for-bit, only the tokens-per-step cadence changes
+    spec = None
+    if args.spec_draft_layers > 0:
+        from hetu_tpu.models import draft_state_from
+        from hetu_tpu.serving import SpecConfig
+        dstate, dcfg = draft_state_from(state, cfg,
+                                        args.spec_draft_layers)
+        spec = SpecConfig(dstate, dcfg, k=args.spec_k)
     eng = Engine(state, cfg, num_pages=64, page_size=8, max_batch=8,
-                 prefix_cache=not args.no_prefix_cache, tracer=tracer)
+                 prefix_cache=not args.no_prefix_cache, tracer=tracer,
+                 spec=spec)
     n = args.serve_requests
     t0 = time.monotonic()
     reqs = []
@@ -81,6 +93,14 @@ def serve_demo(state, cfg, args):
           f"{int(m['compile_count'])} compiled executable(s), "
           f"{int(m['host_logit_fetches'])} host logit fetches, "
           f"ttft p90 {m['ttft']['p90'] * 1e3:.1f} ms")
+    if spec is not None:
+        print(f"speculative decoding: draft {args.spec_draft_layers} "
+              f"of {cfg.num_layers} layers, k={args.spec_k}; "
+              f"{int(m['spec_proposed'])} proposed / "
+              f"{int(m['spec_accepted'])} accepted "
+              f"(rate {m['spec_accept_rate']:.2f}), "
+              f"{int(m['spec_bonus_tokens'])} bonus tokens, "
+              f"{m['accepted_per_step']:.2f} accepted tokens/step")
     if not args.no_prefix_cache:
         print(f"prefix cache: hit rate "
               f"{m['prefix_cache_hit_rate']:.2f} "
@@ -200,6 +220,13 @@ def main():
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable copy-on-write prefix caching "
                          "(DESIGN.md §13; on by default)")
+    ap.add_argument("--spec-draft-layers", type=int, default=0,
+                    help="with --serve: speculative decoding with a "
+                         "truncated N-layer self-draft (DESIGN.md "
+                         "§20; 0 disables); prints the acceptance "
+                         "rate, temp-0 output stays bit-for-bit")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens proposed per verify burst")
     ap.add_argument("--replicas", type=int, default=1,
                     help="with --serve: route the requests across N "
                          "engine replicas (serving.cluster, DESIGN.md "

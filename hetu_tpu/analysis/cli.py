@@ -260,6 +260,35 @@ def build_gate_executables():
     assert eng.compile_count == 1, "the bucket grid came back"
     names += sorted(f"gate_serving/{k}" for k in eng._compiled)
 
+    # -- speculative serving: the SAME model behind a spec-mode engine
+    # (truncated 1-layer self-draft, k=3) — the unified executable
+    # grows the on-device verify/accept head and registers under
+    # gate_serving@spec/unified; the spec-rewind-leak rule audits the
+    # trace's tap (rewinds asserted non-vacuous so the rule has real
+    # records to chew), and the draft programs join the compile pin ---
+    from hetu_tpu.models import draft_state_from
+    from hetu_tpu.serving import SpecConfig
+    dstate, dcfg = draft_state_from(state, scfg, 1)
+    spclock = [0.0]
+    speng = Engine(state, scfg, num_pages=16, page_size=8, max_batch=4,
+                   chunk_size=8, name="gate_serving@spec",
+                   time_fn=lambda: spclock[0],
+                   spec=SpecConfig(dstate, dcfg, k=3))
+    speng.add_request([1, 2, 3, 4, 5], max_new_tokens=6)
+    speng.add_request([7, 8, 9], max_new_tokens=6)
+    while speng.has_work:
+        speng.step()
+        spclock[0] += 1.0
+    speng.pool.check_invariants(force=True)
+    assert speng.compile_count == 4, \
+        "spec engine = unified + draft prefill/propose/insert, pinned"
+    prop = speng.counters["spec_proposed"].value
+    acc = speng.counters["spec_accepted"].value
+    assert prop > 0, "spec gate trace never speculated"
+    assert acc < prop, \
+        "spec gate trace never rewound — the rewind lint is vacuous"
+    names.append("gate_serving@spec/unified")
+
     # -- serving cluster: a disaggregated 2-replica fleet (1 prefill +
     # 1 decode) over the SAME model — each replica's unified executable
     # registers under its own name (gate_serving@r{i}/unified), the

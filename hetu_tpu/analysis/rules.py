@@ -61,6 +61,13 @@ Rule catalog (DESIGN.md §9 for the rationale of each):
                              (the index serves it to future lookups);
                              writing it corrupts a shared KV history
                              (trash page exempt: padding's sink).
+``spec-rewind-leak``         serving: after a speculative verify
+                             rejected part of a burst, a later step's
+                             attention window reads a rejected
+                             position's STALE KV before the write plan
+                             re-wrote it — the rewind contract
+                             (DESIGN.md §20) silently broken
+                             (``rewind_exempt`` records are skipped).
 ``grad-allgather-under-zero2`` a ZeRO-2 train step regathers gradients:
                              an fp32 gradient all-gather (any plan), or
                              ANY gradient all-gather in a plan that
@@ -976,6 +983,73 @@ def _cow_page_write(ctx: AnalysisContext) -> List[Finding]:
                              "divergent page — shared pages may only "
                              "ever be READ"))
                     break
+    return out
+
+
+@rule("spec-rewind-leak")
+def _spec_rewind_leak(ctx: AnalysisContext) -> List[Finding]:
+    """Speculative-decoding KV-rewind honesty (DESIGN.md §20): when a
+    verify burst is partially rejected, the engine rewinds ``pos`` to
+    the accepted boundary and the rejected positions' KV slots go STALE
+    — they hold K/V of tokens that were never committed.  The contract
+    that keeps temp-0 serving bitwise is that stale slots are always
+    RE-WRITTEN (by the next burst's write plan, at the same page slots)
+    before any attention window can read them.  This rule replays the
+    engine's tap: per request it tracks the valid-KV watermark
+    (advanced by each step's contiguous writes ``[pos, pos+qlen)``,
+    cut back by every ``spec_rewind`` record, reset by ``kv_drop`` —
+    preemption frees the pages outright), and fires when a step's read
+    extent ``ctx`` reaches past what is valid-or-just-rewritten: that
+    attention is consuming rejected-draft KV, which silently corrupts
+    every token after it.  Records flagged ``rewind_exempt`` are
+    skipped (a deliberate replay of foreign tap data)."""
+    if ctx.serving is None:
+        return []
+    out: List[Finding] = []
+    valid: Dict[int, int] = {}
+    for step, rec in enumerate(ctx.serving.get("tap", ())):
+        kind = rec.get("kind")
+        if kind == "spec_rewind":
+            r = int(rec["req"])
+            cut = int(rec["valid_upto"])
+            valid[r] = min(valid.get(r, cut), cut)
+            continue
+        if kind == "kv_drop":
+            valid[int(rec["req"])] = 0
+            continue
+        if kind != "unified" or rec.get("rewind_exempt"):
+            continue
+        for r, pos, qlen, ctx_len in rec.get("reads", ()):
+            r, pos, qlen, ctx_len = (int(r), int(pos), int(qlen),
+                                     int(ctx_len))
+            # first sight: positions [0, pos) predate the tap window
+            # (or were handed off with the request) — trust them
+            v = valid.get(r, pos)
+            if pos <= v:
+                after = max(v, pos + qlen)
+            else:
+                # a write GAP: [v, pos) stays stale, writes past it
+                # cannot bridge the hole
+                after = v
+            if ctx_len > after:
+                out.append(Finding(
+                    rule="", subject=f"unified@{step}/req{r}",
+                    severity="error",
+                    message=f"unified step at tap step {step}: request "
+                            f"{r} reads KV through position "
+                            f"{ctx_len - 1} but positions "
+                            f"[{after}, {ctx_len}) were never "
+                            f"(re)written after the last rewind — the "
+                            f"attention window is consuming "
+                            f"rejected-draft KV",
+                    hint="rewind must land exactly on the accepted "
+                         "boundary (pos = committed tokens with valid "
+                         "KV) so the next verify burst's write plan "
+                         "covers every stale slot before the kernel "
+                         "reads it; check _commit_verify's pos "
+                         "arithmetic and that ctx_lens == pos + q_len "
+                         "for every packed row"))
+            valid[r] = after
     return out
 
 

@@ -4,13 +4,15 @@ from .cnn import BasicBlock, ResNet, SimpleCNN, resnet18, resnet34
 from .ctr import DCN, DeepFM, WDL, ctr_loss
 from .gnn import GCN, DistGCN15D, GCNLayer, SparseGCNLayer, \
     normalize_adjacency
-from .gpt import (GPTConfig, GPTModel, GPTLMHeadModel, llama_config,
-                  LLamaLMHeadModel, LLamaModel)
+from .gpt import (GPTConfig, GPTModel, GPTLMHeadModel, draft_config,
+                  draft_state_from, llama_config, LLamaLMHeadModel,
+                  LLamaModel)
 from .generate import generate
 from .gpt_pipeline import GPTPipelineModel, block_fn
 from .rnn import GRU, LSTM, RNN, RNNLanguageModel
 
 __all__ = ["GPTConfig", "GPTModel", "GPTLMHeadModel", "llama_config",
+           "draft_config", "draft_state_from",
            "LLamaLMHeadModel", "LLamaModel", "GPTPipelineModel", "block_fn",
            "BertConfig", "BertModel", "BertForPreTraining",
            "BertForSequenceClassification",

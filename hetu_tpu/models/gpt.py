@@ -98,6 +98,40 @@ def llama_config(**kw) -> GPTConfig:
     return GPTConfig(**kw)
 
 
+def draft_config(cfg: GPTConfig, num_layers: int) -> GPTConfig:
+    """A shallow draft-model config for speculative decoding
+    (serving/spec.py): identical tokenizer/embedding/head geometry —
+    the draft and target MUST share the vocab so draft proposals are
+    target token ids — with only the layer count reduced."""
+    if not 1 <= num_layers <= cfg.num_layers:
+        raise ValueError(
+            f"draft num_layers must be in [1, {cfg.num_layers}] (the "
+            f"target's layer count), got {num_layers}")
+    import dataclasses
+    return dataclasses.replace(cfg, num_layers=int(num_layers))
+
+
+def draft_state_from(state, cfg: GPTConfig, num_layers: int):
+    """Build a truncated draft ``(state, config)`` from a target
+    checkpoint: the first ``num_layers`` transformer blocks plus the
+    shared embeddings / final norm / lm head.  A self-distilled
+    truncation like this shares the residual-stream geometry with its
+    target, which is what makes its greedy proposals land — any
+    separately-trained model with the same vocab works through the same
+    ``SpecConfig`` entry point."""
+    from .generate import _Params
+    dcfg = draft_config(cfg, num_layers)
+    keep = {}
+    for k, v in state.items():
+        nk = _Params._norm(k)
+        if nk.startswith("h"):
+            idx = nk[1:].split(".", 1)[0]
+            if idx.isdigit() and int(idx) >= num_layers:
+                continue
+        keep[k] = v
+    return keep, dcfg
+
+
 def _norm(config: GPTConfig, name: str):
     if config.norm == "rmsnorm":
         return ParallelRMSNorm(config.hidden_size, sp=config.sp,

@@ -169,20 +169,28 @@ def request_timelines(events: Sequence[Span]
 def timeline_summary(events: Sequence[Span]) -> str:
     """Human-readable per-request lifecycle table (the ``--trace-out``
     demo print): queue wait, prefill chunks, tokens, preemptions,
-    end-to-end latency — all derived from the trace, not the engine."""
+    speculative verify bursts + draft tokens accepted through them
+    (the ``spec_accept`` instants of DESIGN.md §20 — a timeline shows
+    the draft→verify→accept cadence directly), end-to-end latency —
+    all derived from the trace, not the engine."""
     lines = [f"{'req':>4} {'queued_s':>9} {'chunks':>6} {'tokens':>6} "
-             f"{'preempt':>7} {'e2e_s':>8}  timeline"]
+             f"{'preempt':>7} {'verify':>6} {'spec_acc':>8} "
+             f"{'e2e_s':>8}  timeline"]
     for rid, evs in sorted(request_timelines(events).items()):
         queued = sum(e.dur or 0.0 for e in evs
                      if e.ph == "X" and e.name == "queued")
         chunks = sum(1 for e in evs if e.name == "prefill_chunk")
         tokens = sum(1 for e in evs if e.name == "token")
         preempt = sum(1 for e in evs if e.name == "preempt")
+        verify = sum(1 for e in evs if e.name == "verify")
+        spec_acc = sum(int(e.attrs.get("n", 0)) for e in evs
+                       if e.name == "spec_accept")
         t0 = min(e.ts for e in evs)
         t1 = max(e.end_ts for e in evs)
         path = "->".join(e.name for e in evs
                          if e.name in ("enqueue", "admit", "preempt",
                                        "finish"))
         lines.append(f"{rid:>4} {queued:>9.3f} {chunks:>6} {tokens:>6} "
-                     f"{preempt:>7} {t1 - t0:>8.3f}  {path}")
+                     f"{preempt:>7} {verify:>6} {spec_acc:>8} "
+                     f"{t1 - t0:>8.3f}  {path}")
     return "\n".join(lines)

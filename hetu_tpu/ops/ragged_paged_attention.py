@@ -279,3 +279,147 @@ def ragged_paged_attention(q: jax.Array, k_pages: jax.Array,
     return ragged_paged_attention_reference(
         q, k_pages, v_pages, q_lens, cu_q, page_tables, ctx_lens,
         max_q=max_q, softmax_scale=softmax_scale)
+
+
+# ---------------------------------------------------------------------------
+# verify-row sampling head (speculative decoding, DESIGN.md §20)
+# ---------------------------------------------------------------------------
+#
+# A speculative **verify row** is structurally a prefill chunk: the row
+# feeds ``[last committed token, d_1, ..., d_K]`` (K greedy draft
+# proposals) through the unified step, so the kernel above already
+# produces per-position attention for it.  What a verify row needs ON
+# TOP is a per-position accept/reject decision next to the engine's
+# per-row sampler — this head provides it, entirely on device, so the
+# host still fetches only ``[rows]``-shaped int32s per step
+# (``host_logit_fetches`` stays 0).
+#
+# Acceptance rule per in-row verify position j (absolute sequence index
+# of the token it emits is ``ctx - spec_len + j``):
+#
+# * temperature 0: accept ``d_{j+1}`` iff it equals ``argmax(logits_j)``
+#   — the very argmax a non-speculative decode step would commit, so the
+#   longest-prefix accepted tokens plus the first-mismatch bonus token
+#   reproduce the non-speculative greedy sequence EXACTLY (bit-for-bit,
+#   test-pinned);
+# * temperature > 0: leftover-distribution rejection sampling for a
+#   deterministic (greedy) draft, in COUPLED form.  The draft's
+#   proposal distribution is a point mass ``q = δ_d``, so the generic
+#   speculative-sampling accept probability ``min(1, p/q)`` reduces to
+#   ``p(d)`` and the leftover distribution ``norm(max(p - q, 0))``
+#   reduces to ``p`` with ``d`` removed and renormalized.  Instead of
+#   burning two independent draws (an accept coin and a leftover
+#   sample), the head draws ONE categorical sample ``X ~ p`` from the
+#   truncated distribution with the position's own key and accepts iff
+#   ``X == d``: the accept probability is exactly ``p(d)``, and the law
+#   of ``X`` conditioned on rejection (``X != d``) is exactly the
+#   leftover distribution — the same accept/leftover semantics, one
+#   draw.  The payoff of the coupling is replay stability: ``X`` is the
+#   IDENTICAL ``(seed, index)``-keyed draw the per-row sampler makes,
+#   so the emitted token at a given sequence index is the same whether
+#   that index was covered by a verify burst, a plain decode step, or a
+#   replay under different batching/chunking/k — sampled-mode spec
+#   serving reproduces non-speculative sampled serving bit-for-bit,
+#   the same way temperature 0 does.  ``p`` here is the same
+#   temperature/top-k/top-p-truncated distribution the per-row sampler
+#   draws from.
+
+
+def _sampled_draw(logits, temp, top_p, top_k, seed, ctx):
+    """The sort-based keyed categorical draw for ONE sampled row: fp32
+    logits [V], temperature-scaled, top-k/top-p truncated, keyed by
+    ``(seed, ctx)``."""
+    v = logits.shape[0]
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), ctx)
+    lg = logits / jnp.where(temp > 0, temp, 1.0)
+    order = jnp.argsort(-lg)
+    lg_s = lg[order]                                 # descending
+    probs = jax.nn.softmax(lg_s)
+    csum = jnp.cumsum(probs)
+    idxs = jnp.arange(v)
+    # nucleus: drop tokens once the mass BEFORE them reaches top_p (the
+    # smallest prefix whose mass >= top_p always survives; the argmax
+    # token is never cut)
+    cut = (csum - probs > top_p) & (top_p > 0.0) & (top_p < 1.0)
+    cut = cut | ((idxs >= top_k) & (top_k > 0))
+    return order[jax.random.categorical(
+        key, jnp.where(cut, -jnp.inf, lg_s))].astype(jnp.int32)
+
+
+def sample_row(logits, temp, top_p, top_k, seed, ctx):
+    """On-device next-token choice for one row, fp32 logits [V].
+
+    Greedy rows take the jit'd argmax (the very ``jnp.argmax`` solo
+    ``generate()`` runs — bit-for-bit at temperature 0).  Sampled rows
+    draw from temperature-scaled logits with optional top-k truncation
+    and top-p (nucleus) truncation, keyed by ``(seed, ctx)`` — ``ctx``
+    equals the sampled token's index in the sequence, so replays are
+    deterministic regardless of batching/chunking/preemption.  (Moved
+    here from ``serving/decode.py`` so the speculative verify head and
+    the per-row sampler are one implementation — the coupling above is
+    only sound if they draw identically.)"""
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    samp = _sampled_draw(logits, temp, top_p, top_k, seed, ctx)
+    return jnp.where(temp == 0.0, greedy, samp)
+
+
+def sample_rows(logits, temps, top_ps, top_ks, seeds, ctxs):
+    """Batched :func:`sample_row` over ``[N, V]`` logits with one
+    payoff a per-row vmap cannot have: the ENTIRE sort-based sampled
+    path hides behind a single ``lax.cond(any(temps > 0))``.  XLA CPU
+    sorts are slow enough that N unconditional 50k-vocab argsorts
+    dominate a serving step, and the verify head multiplies N by
+    ``spec_k`` — on all-greedy traffic (the common serving case and
+    the temp-0 bitwise gate) this computes N argmaxes and nothing
+    else.  Per-row values are IDENTICAL to :func:`sample_row` either
+    way: a batched ``lax.cond`` under vmap would degrade to a
+    both-branches select, which is why the predicate is batch-global
+    rather than per-row."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sampled(_):
+        return jax.vmap(_sampled_draw)(logits, temps, top_ps, top_ks,
+                                       seeds, ctxs)
+
+    samp = lax.cond(jnp.any(temps > 0.0), sampled,
+                    lambda _: greedy, None)
+    return jnp.where(temps == 0.0, greedy, samp)
+
+
+def speculative_verify_head(vlogits, draft_next, spec_lens, temps,
+                            top_ps, top_ks, seeds, ctx_lens):
+    """Batched accept/reject head over verify rows.
+
+    Args (R = verify rows, K = static max draft length):
+      vlogits    [R, K, V] fp32 — logits at the row's first K query
+                 positions (position j predicts the token the draft
+                 proposed at j+1)
+      draft_next [R, K] i32 — the draft token fed at in-row position
+                 j+1 (i.e. the proposal position j's logits verify)
+      spec_lens  [R] i32 — staged draft count per row (0 = not a verify
+                 row: accepted comes back 0 and the caller's per-row
+                 sampler result stands)
+      temps/top_ps/top_ks/seeds [R] — the row's sampling params
+      ctx_lens   [R] i32 — total context including this step's tokens
+
+    Returns ``(accepted [R] i32, alt [R, K] i32)``: ``accepted`` is the
+    longest-accepted-prefix length (≤ spec_len) and ``alt[r, a]`` is the
+    bonus token to emit when ``accepted < spec_len`` (first rejection);
+    on full acceptance the caller's last-position sample IS the bonus.
+    Each position's choice comes from the ONE row sampler keyed by its
+    absolute sequence index — accept iff the draft matches it — so the
+    emitted tokens are bitwise what non-speculative serving emits.
+    """
+    r, k, v = vlogits.shape
+    # absolute sequence index of the token emitted at verify position j
+    idx = (ctx_lens[:, None] - spec_lens[:, None]
+           + jnp.arange(k)[None, :])                       # [R, K]
+    rep = lambda a: jnp.repeat(a, k)                       # noqa: E731
+    choice = sample_rows(vlogits.reshape(r * k, v), rep(temps),
+                         rep(top_ps), rep(top_ks), rep(seeds),
+                         idx.reshape(-1)).reshape(r, k)
+    accept = choice == draft_next
+    live = jnp.arange(k)[None, :] < spec_lens[:, None]     # [R, K]
+    accepted = jnp.sum(jnp.cumprod((accept & live).astype(jnp.int32),
+                                   axis=1), axis=1)
+    return accepted.astype(jnp.int32), choice.astype(jnp.int32)

@@ -14,9 +14,12 @@ unified ragged step (token-budget packing, chunked prefill, on-device
 temperature/top-k/top-p sampling, the one-executable compile contract),
 §13 for copy-on-write prefix caching (chained page hashing, refcounted
 read-only pages, LRU eviction — on by default, disable with
-``Engine(..., prefix_cache=False)``), and §17 for the cluster plane
+``Engine(..., prefix_cache=False)``), §17 for the cluster plane
 (``serving.cluster.EngineCluster``: prefix-aware routing over N
-replicas, disaggregated prefill/decode, priced KV-page streaming).
+replicas, disaggregated prefill/decode, priced KV-page streaming), and
+§20 for draft-model speculative decoding
+(``Engine(spec=SpecConfig(draft_state, draft_cfg, k=4))``: ragged
+verify rows, on-device accept, temp-0 output still bit-for-bit).
 """
 from .cluster import (ClusterRequest, EngineCluster, LocalPageTransport,
                       PageTransport, Replica, Router)
@@ -25,9 +28,11 @@ from .kv_pool import PagedKVPool, TRASH_PAGE
 from .prefix_cache import CacheEntry, PrefixCache
 from .request import FINISHED, RUNNING, WAITING, Request, RequestQueue
 from .scheduler import Scheduler
+from .spec import SpecConfig, SpecDecoder
 
 __all__ = ["Engine", "PagedKVPool", "TRASH_PAGE", "PrefixCache",
            "CacheEntry", "Request", "RequestQueue", "Scheduler",
            "WAITING", "RUNNING", "FINISHED",
+           "SpecConfig", "SpecDecoder",
            "EngineCluster", "ClusterRequest", "Replica", "Router",
            "PageTransport", "LocalPageTransport"]

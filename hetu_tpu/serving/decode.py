@@ -45,7 +45,9 @@ from ..models.generate import (_act, _lm_head, _moe_mlp, _norm_apply,
                                _Params, _rotary_tables)
 from ..models.gpt import GPTConfig
 from ..ops.paged_attention import paged_attention_reference
-from ..ops.ragged_paged_attention import ragged_paged_attention_pallas
+from ..ops.ragged_paged_attention import (ragged_paged_attention_pallas,
+                                          sample_row, sample_rows,
+                                          speculative_verify_head)
 
 def _params_view(cfg: GPTConfig, params) -> _Params:
     p = _Params.__new__(_Params)
@@ -66,15 +68,38 @@ def _rope_tok(x, cos_g, sin_g):
     return x * c + rot * s
 
 
+def _chunk_slots(max_seqs: int, prefill_rows: int, chunk: int,
+                 spec_k: int):
+    """The multi-token slot layout shared by the region map, the
+    split-attention fallback and the engine's ``cu_q``: a list of
+    ``(row_index, token_start, width)``.  Plain prefill chunk slots
+    come first; in spec mode (``spec_k > 0``) every decode-capable
+    request additionally owns a DEDICATED verify slot of width
+    ``spec_k + 1`` — a verify row is structurally a prefill chunk, but
+    giving it its own narrow slot means verifying k drafts prices
+    ``k + 1`` tokens of compute, not a whole ``chunk``-wide slot, and
+    verify traffic never competes with prompt prefills for slots."""
+    slots = [(max_seqs + r, max_seqs + r * chunk, chunk)
+             for r in range(prefill_rows)]
+    if spec_k:
+        base = max_seqs + prefill_rows * chunk
+        vk = spec_k + 1
+        slots += [(max_seqs + prefill_rows + j, base + j * vk, vk)
+                  for j in range(max_seqs)]
+    return slots
+
+
 def _split_ragged_attention(cfg: GPTConfig, q, kp, vp, q_lens,
                             page_tables, ctx_lens, max_seqs: int,
-                            prefill_rows: int, chunk: int):
+                            prefill_rows: int, chunk: int,
+                            spec_k: int = 0):
     """Off-TPU ragged attention over the structured serving layout.
 
     The flat batch's FIRST ``max_seqs`` tokens are the single-token
     decode slots: they run through :func:`paged_attention_reference` —
     literally the v1 decode math, so temperature-0 decode stays
-    bit-for-bit with solo ``generate()``.  Each chunk slot then runs
+    bit-for-bit with solo ``generate()``.  Each multi-token slot
+    (prefill chunk or — spec mode — verify row) then runs
     gather+masked-dense attention over its own page table with the
     causal in-row mask (query j at absolute position
     ``ctx - q_len + j``).  Padding decode slots attend one trash-page
@@ -106,10 +131,10 @@ def _split_ragged_attention(cfg: GPTConfig, q, kp, vp, q_lens,
     levels.append(maxp)
     levels_arr = jnp.asarray(levels, jnp.int32)
 
-    def make_chunk_attn(npages):
+    def make_chunk_attn(npages, width_q):
         if npages == 0:
             return lambda qc, pt_row, ctx, qlen: jnp.zeros(
-                (chunk, nh, hd), q.dtype)
+                (width_q, nh, hd), q.dtype)
 
         # near-twin of ops.ragged_paged_attention_reference's per-row
         # body, but NOT shared on purpose: this path masks with -inf
@@ -118,63 +143,48 @@ def _split_ragged_attention(cfg: GPTConfig, q, kp, vp, q_lens,
         # DEFAULT_MASK_VALUE for interpret-mode parity
         def attn(qc, pt_row, ctx, qlen):
             width = npages * ps
-            qg = qc.reshape(chunk, kvh, g, hd).astype(jnp.float32)
+            qg = qc.reshape(width_q, kvh, g, hd).astype(jnp.float32)
             k = kp[pt_row[:npages]].reshape(width, kvh, hd)
             v = vp[pt_row[:npages]].reshape(width, kvh, hd)
             s = jnp.einsum("qhgd,khd->qhgk", qg,
                            k.astype(jnp.float32)) * scale
-            qpos = (ctx - qlen) + jnp.arange(chunk)
+            qpos = (ctx - qlen) + jnp.arange(width_q)
             valid = jnp.arange(width)[None, :] <= qpos[:, None]
             s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
             pr = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("qhgk,khd->qhgd", pr, v.astype(jnp.float32))
-            return o.reshape(chunk, nh, hd).astype(q.dtype)
+            return o.reshape(width_q, nh, hd).astype(q.dtype)
 
         return attn
 
-    branches = [make_chunk_attn(npages) for npages in levels]
-    for r in range(prefill_rows):
-        row = max_seqs + r
-        qc = q[max_seqs + r * chunk: max_seqs + (r + 1) * chunk]
+    branch_sets = {}                     # per slot width
+    for row, start, width_q in _chunk_slots(max_seqs, prefill_rows,
+                                            chunk, spec_k):
+        if width_q not in branch_sets:
+            branch_sets[width_q] = [make_chunk_attn(npages, width_q)
+                                    for npages in levels]
+        qc = q[start: start + width_q]
         need = -(-ctx_lens[row] // ps)              # pages ctx spans
         lvl = jnp.searchsorted(levels_arr, need)
         lvl = jnp.where(q_lens[row] > 0, lvl, 0)    # idle -> level 0
-        outs.append(lax.switch(lvl, branches, qc, page_tables[row],
-                               ctx_lens[row], q_lens[row]))
+        outs.append(lax.switch(lvl, branch_sets[width_q], qc,
+                               page_tables[row], ctx_lens[row],
+                               q_lens[row]))
     return jnp.concatenate(outs, axis=0)
 
 
-def _sample_row(logits, temp, top_p, top_k, seed, ctx):
-    """On-device next-token choice for one row, fp32 logits [V].
-
-    Greedy rows take the jit'd argmax (the very ``jnp.argmax`` solo
-    ``generate()`` runs — bit-for-bit at temperature 0).  Sampled rows
-    draw from temperature-scaled logits with optional top-k truncation
-    and top-p (nucleus) truncation, keyed by ``(seed, ctx)`` — ``ctx``
-    equals the sampled token's index in the sequence, so replays are
-    deterministic regardless of batching/chunking/preemption."""
-    v = logits.shape[0]
-    greedy = jnp.argmax(logits).astype(jnp.int32)
-    key = jax.random.fold_in(jax.random.PRNGKey(seed), ctx)
-    lg = logits / jnp.where(temp > 0, temp, 1.0)
-    order = jnp.argsort(-lg)
-    lg_s = lg[order]                                 # descending
-    probs = jax.nn.softmax(lg_s)
-    csum = jnp.cumsum(probs)
-    idxs = jnp.arange(v)
-    # nucleus: drop tokens once the mass BEFORE them reaches top_p (the
-    # smallest prefix whose mass >= top_p always survives; the argmax
-    # token is never cut)
-    cut = (csum - probs > top_p) & (top_p > 0.0) & (top_p < 1.0)
-    cut = cut | ((idxs >= top_k) & (top_k > 0))
-    samp = order[jax.random.categorical(
-        key, jnp.where(cut, -jnp.inf, lg_s))].astype(jnp.int32)
-    return jnp.where(temp == 0.0, greedy, samp)
+# the on-device per-row sampler lives next to the verify head in
+# ops/ragged_paged_attention.py (ONE implementation: the speculative
+# accept rule is "the draft matches this sampler's keyed choice", which
+# is only sound if verify and non-verify rows draw identically); the
+# old name stays importable here
+_sample_row = sample_row
 
 
 def build_unified_step_fn(cfg: GPTConfig, max_seqs: int, chunk: int,
                           prefill_rows: int, max_pages: int,
-                          page_size: int, use_kernel: bool = False):
+                          page_size: int, use_kernel: bool = False,
+                          spec_k: int = 0):
     """Compile THE serving executable: one ragged prefill+decode step.
 
     Token-axis layout (static)::
@@ -199,14 +209,36 @@ def build_unified_step_fn(cfg: GPTConfig, max_seqs: int, chunk: int,
     the end of its accumulated sequence (``pos + q_len == len(tokens)``
     — i.e. the final prefill chunk or a decode step).  ALL shapes are
     fixed: the engine compiles this exactly once.
+
+    ``spec_k > 0`` (speculative serving, DESIGN.md §20) grows BOTH the
+    layout and the signature.  The token axis gains ``max_seqs``
+    dedicated VERIFY slots of ``spec_k + 1`` tokens each (after the
+    prefill chunk slots), so every decode-capable request can verify a
+    draft burst every step — structurally a prefill chunk, but priced
+    at ``k + 1`` tokens of compute instead of a ``chunk``-wide slot,
+    and never competing with prompt prefills for chunk slots.  An
+    extra ``spec_lens [rows] i32`` input after ``seeds`` marks live
+    verify rows (feeding the last committed token plus the drafts),
+    and the outputs gain ``accepted [rows] i32`` — the
+    longest-accepted-prefix length from the on-device verify head
+    (:func:`~hetu_tpu.ops.ragged_paged_attention.speculative_verify_head`).
+    For rows with ``spec_len == 0`` (every decode slot, every plain
+    prefill chunk, every idle verify slot) ``accepted`` is 0 and
+    ``next_tokens`` is computed by the IDENTICAL per-row sampler as
+    the non-speculative build — mixed spec/non-spec traffic shares the
+    one executable.
     """
     if prefill_rows < 1:
         raise ValueError(f"prefill_rows must be >= 1, got {prefill_rows}")
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
+    if spec_k < 0:
+        raise ValueError(f"spec_k must be >= 0, got {spec_k}")
     c = cfg
-    t_tokens = max_seqs + prefill_rows * chunk
-    n_rows = max_seqs + prefill_rows
+    verify_rows = max_seqs if spec_k else 0
+    t_tokens = max_seqs + prefill_rows * chunk \
+        + verify_rows * (spec_k + 1)
+    n_rows = max_seqs + prefill_rows + verify_rows
     max_len = max_pages * page_size
     cdt = jnp.bfloat16 if c.dtype == "bfloat16" else jnp.float32
     cos, sin = (_rotary_tables(c, max_len) if c.position == "rotary"
@@ -221,24 +253,33 @@ def build_unified_step_fn(cfg: GPTConfig, max_seqs: int, chunk: int,
         results are unchanged by the split (bit-for-bit).  ``f_chunk``
         overrides ``f`` for the chunk slots (MoE keeps v1's per-phase
         expert paths: dense per-token mix for decode, dispatched
-        group-GEMM for prefill chunks)."""
+        group-GEMM for prefill chunks).  The spec-mode VERIFY region
+        (``max_seqs`` rows of ``spec_k + 1`` tokens) runs
+        unconditionally like the decode slots: the whole region is a
+        few dozen tokens, cheaper than the per-slot conditional thunks
+        would be, and idle verify tokens are trash-page padding the
+        engine discards."""
         fc = f_chunk or f
         parts = [f(h[:max_seqs])]
-        for r in range(prefill_rows):
-            sl = h[max_seqs + r * chunk: max_seqs + (r + 1) * chunk]
+        for row, start, width in _chunk_slots(max_seqs, prefill_rows,
+                                              chunk, 0)[:prefill_rows]:
+            sl = h[start: start + width]
             zero = jax.eval_shape(fc, sl)
             parts.append(lax.cond(
-                q_lens[max_seqs + r] > 0, fc,
+                q_lens[row] > 0, fc,
                 lambda s, z=zero: jnp.zeros(z.shape, z.dtype), sl))
+        if spec_k:
+            parts.append(f(h[max_seqs + prefill_rows * chunk:]))
         return jnp.concatenate(parts, axis=0)
 
     # pages are donated (the pool replaces them wholesale every call, so
     # XLA scatters in place); seeds is donated so the [rows] int32
     # next-token output can alias it instead of tripping donation-miss
-    @functools.partial(jax.jit, donate_argnums=(12, 13, 14))
-    def run(params, tokens, token_pos, token_page, token_off, q_lens,
-            cu_q, page_tables, ctx_lens, temps, top_ps, top_ks, seeds,
-            k_pages, v_pages):
+    # (spec mode additionally donates spec_lens to back the [rows]
+    # accepted output)
+    def run_impl(params, tokens, token_pos, token_page, token_off,
+                 q_lens, cu_q, page_tables, ctx_lens, temps, top_ps,
+                 top_ks, seeds, spec_lens, k_pages, v_pages):
         p = _params_view(c, params)
         x = p("wte.weight")[tokens].astype(cdt)            # [T, H]
         if c.position == "learned":
@@ -270,11 +311,11 @@ def build_unified_step_fn(cfg: GPTConfig, max_seqs: int, chunk: int,
             if use_kernel:
                 attn = ragged_paged_attention_pallas(
                     q, kp, vp, q_lens, cu_q, page_tables, ctx_lens,
-                    max_q=chunk)
+                    max_q=max(chunk, spec_k + 1))
             else:
                 attn = _split_ragged_attention(
                     c, q, kp, vp, q_lens, page_tables, ctx_lens,
-                    max_seqs, prefill_rows, chunk)
+                    max_seqs, prefill_rows, chunk, spec_k=spec_k)
             attn = attn.reshape(t_tokens, nh * hd).astype(x.dtype)
 
             def out_proj(aa, i=i):
@@ -314,8 +355,61 @@ def build_unified_step_fn(cfg: GPTConfig, max_seqs: int, chunk: int,
         last = jnp.clip(cu_q[:n_rows] + jnp.maximum(q_lens, 1) - 1, 0,
                         t_tokens - 1)
         logits = _lm_head(p, x[last])
-        next_tokens = jax.vmap(_sample_row)(logits, temps, top_ps,
-                                            top_ks, seeds, ctx_lens)
-        return next_tokens, tuple(new_k), tuple(new_v)
+        # batched sampler: the sort-based sampled path runs under ONE
+        # any(temps > 0) branch — all-greedy steps (the temp-0 bitwise
+        # contract's case) never pay a vocab argsort per row
+        next_tokens = sample_rows(logits, temps, top_ps, top_ks,
+                                  seeds, ctx_lens)
+        if spec_k == 0:
+            return next_tokens, tuple(new_k), tuple(new_v)
+        # -- verify head (dedicated verify slots only: decode slots and
+        # prefill chunks never stage drafts).  Verify position j of a
+        # row starting at cu sits at token cu + j and its logits verify
+        # the draft fed at cu + j + 1 (all K windows are computed —
+        # fixed shapes — and masked by spec_lens; a spec_len of 0
+        # yields accepted == 0 and the per-row sample above stands,
+        # which is exactly the non-spec path, bit-for-bit)
+        v0 = max_seqs + prefill_rows             # first verify row
+        starts = cu_q[v0:n_rows]                 # [R = verify_rows]
+        widx = jnp.clip(starts[:, None] + jnp.arange(spec_k)[None, :],
+                        0, t_tokens - 1)                   # [R, K]
+        vlogits = _lm_head(p, x[widx.reshape(-1)]).reshape(
+            verify_rows, spec_k, -1)
+        draft_next = tokens[jnp.clip(widx + 1, 0, t_tokens - 1)]
+        acc_v, alt_v = speculative_verify_head(
+            vlogits, draft_next, spec_lens[v0:], temps[v0:],
+            top_ps[v0:], top_ks[v0:], seeds[v0:], ctx_lens[v0:])
+        # bonus token: first-rejection alternative, or — on full
+        # acceptance — the last-position per-row sample (whose sampling
+        # index ctx_lens[r] is exactly the emitted token's index)
+        spec_v = spec_lens[v0:]
+        bonus_alt = jnp.take_along_axis(
+            alt_v, jnp.minimum(acc_v, spec_k - 1)[:, None], axis=1)[:, 0]
+        verify_next = jnp.where(acc_v < spec_v, bonus_alt,
+                                next_tokens[v0:])
+        next_tokens = jnp.concatenate(
+            [next_tokens[:v0], verify_next])
+        accepted = jnp.concatenate(
+            [jnp.zeros(v0, jnp.int32), acc_v])
+        return next_tokens, accepted, tuple(new_k), tuple(new_v)
+
+    if spec_k == 0:
+        @functools.partial(jax.jit, donate_argnums=(12, 13, 14))
+        def run(params, tokens, token_pos, token_page, token_off,
+                q_lens, cu_q, page_tables, ctx_lens, temps, top_ps,
+                top_ks, seeds, k_pages, v_pages):
+            return run_impl(params, tokens, token_pos, token_page,
+                            token_off, q_lens, cu_q, page_tables,
+                            ctx_lens, temps, top_ps, top_ks, seeds,
+                            None, k_pages, v_pages)
+    else:
+        @functools.partial(jax.jit, donate_argnums=(12, 13, 14, 15))
+        def run(params, tokens, token_pos, token_page, token_off,
+                q_lens, cu_q, page_tables, ctx_lens, temps, top_ps,
+                top_ks, seeds, spec_lens, k_pages, v_pages):
+            return run_impl(params, tokens, token_pos, token_page,
+                            token_off, q_lens, cu_q, page_tables,
+                            ctx_lens, temps, top_ps, top_ks, seeds,
+                            spec_lens, k_pages, v_pages)
 
     return run

@@ -22,6 +22,16 @@ top-p) run ON DEVICE keyed by ``(seed, position)``, so replays are
 deterministic too and the engine only ever fetches ``[rows]`` int32 —
 ``host_logit_fetches`` stays 0 on any traffic mix.
 
+Speculative decoding (``serving/spec.py``, DESIGN.md §20, opt-in via
+``Engine(spec=SpecConfig(...))``): a shallow draft model proposes ``k``
+greedy tokens per decode-ready request each step; the scheduler packs
+them as dedicated ``k + 1``-token ragged VERIFY rows (structurally
+prefill chunks) and the unified executable's on-device accept head
+returns the longest-accepted-prefix length plus a bonus token per row
+— up to ``k + 1`` tokens committed per call, temp-0 output still
+bit-for-bit, ``host_logit_fetches`` still 0, and the draft's three
+fixed-shape programs join the compile-count guard.
+
 Prefix reuse (``serving/prefix_cache.py``, on by default): finished
 requests' fully-written pages enter a chained-hash index; a new request
 whose page-aligned token prefix is cached attaches those pages
@@ -73,6 +83,7 @@ from .kv_pool import TRASH_PAGE, PagedKVPool
 from .prefix_cache import PrefixCache
 from .request import FINISHED, RUNNING, Request, RequestQueue
 from .scheduler import Scheduler
+from .spec import SpecConfig, SpecDecoder
 
 # default Prometheus-style latency bounds (seconds) for ttft/tbt; tests
 # and benches with a synthetic clock pass their own
@@ -91,7 +102,8 @@ class Engine:
                  time_fn: Optional[Callable[[], float]] = None,
                  name: str = "serving", analysis_tap: bool = True,
                  prefix_cache: bool = True, debug: bool = False,
-                 tracer=None, step_fn: Optional[Callable] = None):
+                 tracer=None, step_fn: Optional[Callable] = None,
+                 spec: Optional[SpecConfig] = None):
         self.cfg = cfg
         self.name = name
         # runtime trace plane (hetu_tpu/obs): None follows the ambient
@@ -163,7 +175,14 @@ class Engine:
                           # evictions = cached pages LRU-reclaimed
                           "prefix_cache_hits", "prefix_cache_misses",
                           "prefix_cache_tokens_saved",
-                          "prefix_cache_evictions")}
+                          "prefix_cache_evictions",
+                          # speculative decoding: draft tokens proposed
+                          # / accepted (committed), bonus tokens riding
+                          # verify rows (always present so the cluster
+                          # Prometheus merge sees a uniform schema;
+                          # zero on non-spec engines)
+                          "spec_proposed", "spec_accepted",
+                          "spec_bonus_tokens")}
         self.gauges = {k: make_instrument("gauge", k, m) for k in
                        ("batch_occupancy", "page_utilization",
                         "queue_depth")}
@@ -176,6 +195,19 @@ class Engine:
             "request_latency": make_instrument("histogram",
                                                "request_latency", m),
         }
+        # speculative decoding (serving/spec.py, DESIGN.md §20): a
+        # draft model proposes spec_k greedy tokens per decode-ready
+        # request; the scheduler packs them as verify rows and the
+        # unified executable's on-device accept head returns
+        # accepted_len + a bonus token per row
+        self.spec: Optional[SpecDecoder] = None
+        self.spec_k = 0
+        if spec is not None:
+            self.spec_k = int(spec.k)
+            self.spec = SpecDecoder(spec, cfg, self.scheduler.max_batch,
+                                    self.max_model_len, self.spec_k)
+            self.scheduler.verify_slots = self.scheduler.max_batch
+            self.scheduler.spec_width = self.spec_k + 1
         # THE executable: fixed (max_seqs, chunk, prefill_rows) shapes,
         # compiled exactly once — no bucket grid, no per-request prefill.
         # ``step_fn`` lets N identically-shaped engines (cluster
@@ -186,14 +218,29 @@ class Engine:
             else build_unified_step_fn(
                 cfg, self.scheduler.max_batch, self.scheduler.chunk,
                 self.scheduler.prefill_rows, self.max_pages_per_seq,
-                page_size, use_kernel=self.use_kernel)}
-        # static packed-layout constants
+                page_size, use_kernel=self.use_kernel,
+                spec_k=self.spec_k)}
+        if self.spec is not None:
+            # the draft programs join the jit-cache compile guard: a
+            # silent draft retrace trips compile_count just like a
+            # unified-step retrace would
+            self._compiled.update(self.spec.compiled)
+        # static packed-layout constants: decode slots, prefill chunk
+        # slots, then (spec mode) one (k+1)-wide verify slot per
+        # decode-capable request
         s, r, ck = (self.scheduler.max_batch, self.scheduler.prefill_rows,
                     self.scheduler.chunk)
-        self.n_rows = s + r
-        self.n_tokens = s + r * ck
+        vr = s if self.spec is not None else 0
+        vk = self.spec_k + 1
+        self.n_rows = s + r + vr
+        self.n_tokens = s + r * ck + vr * vk
         cu = np.concatenate([np.arange(s, dtype=np.int32),
                              s + ck * np.arange(r + 1, dtype=np.int32)])
+        if vr:
+            base = s + r * ck
+            cu = np.concatenate([cu[:-1],
+                                 base + vk * np.arange(vr + 1,
+                                                       dtype=np.int32)])
         self._cu_q = cu                       # [rows + 1], layout-fixed
         self._register_for_analysis()
 
@@ -348,11 +395,25 @@ class Engine:
         for req in self.scheduler.admit(self.queue, self.running, now):
             self._start(req)
         live = [r for r in self.running if r.state == RUNNING]
+        if self.spec is not None:
+            self._stage_spec(live)
         kept, evicted = self.scheduler.ensure_decode_pages(live)
         for req in evicted:
             self.running.remove(req)
             self.queue.push(req)
             self.counters["preemptions"].inc()
+            if self.spec is not None:
+                # a preempted request leaves the running set: free its
+                # draft slot (the cache is stale anyway — resuming
+                # re-prefills into a fresh slot).  Releasing, not just
+                # invalidating, keeps slot holders ⊆ running, so the
+                # admit-overtake path can never exhaust the slot pool
+                self.spec.release(req)
+            if self.tap is not None:
+                # the rewind lint's validity tracking: preemption drops
+                # every written KV slot (the pages themselves returned
+                # to the pool)
+                self.tap.append({"kind": "kv_drop", "req": req.req_id})
             t = self._now()
             if tr.enabled:
                 # the running segment ends here; a fresh queued segment
@@ -519,9 +580,12 @@ class Engine:
             self.pool.free(req.pages[req.shared_pages:])
             if self.prefix_cache is not None and req.shared_pages:
                 self.prefix_cache.release(req)
+            if self.spec is not None:
+                self.spec.release(req)
             req.pages = []
             req.shared_pages = 0
             req.cached_tokens = 0
+            req.spec_drafts = []
             req.pos = 0
             req.state = FINISHED          # terminal, but never collected
         self.queue._heap.clear()
@@ -532,13 +596,51 @@ class Engine:
                 self.prefix_cache.check_invariants()
         return [r.req_id for r in victims]
 
+    def _stage_spec(self, live: List[Request]) -> None:
+        """Draft-propose for every decode-ready request that can still
+        profit from speculation (≥ 2 tokens left to emit): ONE batched
+        draft call per engine step, drafts staged on the requests for
+        the scheduler to pack as verify rows."""
+        cands = []
+        k_effs: Dict[int, int] = {}
+        for r in sorted(live, key=lambda r: (r.arrival_time, r.req_id)):
+            if r.state != RUNNING or r.spec_drafts or r.done:
+                continue
+            if len(r.tokens) - r.pos != 1:
+                continue               # mid-prefill: nothing to draft
+            k_eff = min(self.spec_k,
+                        r.max_new_tokens - r.n_generated - 1)
+            if k_eff < 1:
+                continue               # last token: plain decode is it
+            cands.append(r)
+            k_effs[r.req_id] = k_eff
+        if not cands:
+            return
+        tr = self.tracer
+        t0 = self._now()
+        drafts = self.spec.stage(cands, k_effs, tracer=tr, now=t0)
+        dt = self._now() - t0
+        total = 0
+        for r in cands:
+            r.spec_drafts = drafts.get(r.req_id, [])
+            total += len(r.spec_drafts)
+        self.counters["spec_proposed"].inc(total)
+        if tr.enabled and total:
+            tr.complete("draft", t0, dt, track="engine",
+                        requests=len(cands), proposed=total,
+                        k=self.spec_k)
+
     # -- the unified step ----------------------------------------------------
 
     def _pack_arrays(self, rows: List[Tuple[Request, int, int]]):
         """Host-side marshalling of the packed step: flat token arrays +
-        per-row ragged descriptors + per-row sampling params."""
+        per-row ragged descriptors + per-row sampling params.  A verify
+        row's fed tokens are the committed tail plus its staged drafts
+        (``qlen = 1 + spec_len``), written through the SAME trash-page-
+        safe per-token KV write plan as any prefill chunk."""
         t, nr = self.n_tokens, self.n_rows
         ps = self.pool.page_size
+        vbase = self.scheduler.max_batch + self.scheduler.prefill_rows
         tokens = np.zeros(t, np.int32)
         token_pos = np.zeros(t, np.int32)
         token_page = np.full(t, TRASH_PAGE, np.int32)
@@ -551,10 +653,13 @@ class Engine:
         top_ps = np.zeros(nr, np.float32)
         top_ks = np.zeros(nr, np.int32)
         seeds = np.zeros(nr, np.int32)
+        spec_lens = np.zeros(nr, np.int32)
         for req, qlen, row in rows:
             start = int(self._cu_q[row])
             pos = np.arange(req.pos, req.pos + qlen)
-            tokens[start:start + qlen] = req.tokens[req.pos:req.pos + qlen]
+            seq = req.tokens if not (row >= vbase and req.spec_drafts) \
+                else req.tokens + req.spec_drafts
+            tokens[start:start + qlen] = seq[req.pos:req.pos + qlen]
             token_pos[start:start + qlen] = pos
             pages = np.asarray(req.pages, np.int32)
             token_page[start:start + qlen] = pages[pos // ps]
@@ -566,16 +671,36 @@ class Engine:
             top_ps[row] = req.top_p
             top_ks[row] = req.top_k
             seeds[row] = req.seed
+            if row >= vbase and req.spec_drafts:
+                spec_lens[row] = len(req.spec_drafts)
         return (tokens, token_pos, token_page, token_off, q_lens,
-                page_tables, ctx_lens, temps, top_ps, top_ks, seeds)
+                page_tables, ctx_lens, temps, top_ps, top_ks, seeds,
+                spec_lens)
 
     def _run_unified(self, rows: List[Tuple[Request, int, int]]) -> int:
+        s = self.scheduler.max_batch
+        vbase = s + self.scheduler.prefill_rows
+        for req, qlen, row in rows:
+            if row < vbase and req.spec_drafts:
+                # packed outside its verify slot (defensive: with one
+                # dedicated slot per sequence this shouldn't happen) —
+                # this row commits a token the drafts never saw, so
+                # they are stale and dropped before the step
+                req.spec_drafts = []
         (tokens, token_pos, token_page, token_off, q_lens, page_tables,
-         ctx_lens, temps, top_ps, top_ks, seeds) = self._pack_arrays(rows)
+         ctx_lens, temps, top_ps, top_ks, seeds,
+         spec_lens) = self._pack_arrays(rows)
         if self.tap is not None:
             self.tap.append({
                 "kind": "unified",
                 "rows": [(row, req.pos, qlen) for req, qlen, row in rows],
+                # per-request read extent for the spec-rewind-leak lint:
+                # this step WRITES [pos, pos+qlen) and READS [0, ctx) —
+                # a read past the valid-KV watermark (stale slots left
+                # by a rewind, not yet re-written) is a leak
+                "reads": [(req.req_id, req.pos, qlen,
+                           int(ctx_lens[row]))
+                          for req, qlen, row in rows],
                 "page_tables": page_tables.copy(),
                 # refcount snapshot of the read-only cached pages: the
                 # cow-page-write lint flags any live row whose write
@@ -584,14 +709,23 @@ class Engine:
                 "refcounts": {int(pg): self.pool.refcount(pg)
                               for pg in self.pool._cached}})
         t0 = self._now()
-        next_tokens, new_k, new_v = self._compiled["unified"](
-            self.params, jnp.asarray(tokens), jnp.asarray(token_pos),
-            jnp.asarray(token_page), jnp.asarray(token_off),
-            jnp.asarray(q_lens), jnp.asarray(self._cu_q),
-            jnp.asarray(page_tables), jnp.asarray(ctx_lens),
-            jnp.asarray(temps), jnp.asarray(top_ps),
-            jnp.asarray(top_ks), jnp.asarray(seeds),
-            self.pool.k_pages, self.pool.v_pages)
+        args = (self.params, jnp.asarray(tokens), jnp.asarray(token_pos),
+                jnp.asarray(token_page), jnp.asarray(token_off),
+                jnp.asarray(q_lens), jnp.asarray(self._cu_q),
+                jnp.asarray(page_tables), jnp.asarray(ctx_lens),
+                jnp.asarray(temps), jnp.asarray(top_ps),
+                jnp.asarray(top_ks), jnp.asarray(seeds))
+        if self.spec is not None:
+            next_tokens, accepted, new_k, new_v = \
+                self._compiled["unified"](*args,
+                                          jnp.asarray(spec_lens),
+                                          self.pool.k_pages,
+                                          self.pool.v_pages)
+            accs = np.asarray(accepted)         # [rows] int32
+        else:
+            next_tokens, new_k, new_v = self._compiled["unified"](
+                *args, self.pool.k_pages, self.pool.v_pages)
+            accs = None
         self.pool.set_pages(new_k, new_v)
         toks = np.asarray(next_tokens)          # [rows] int32, ever
         dt = self._now() - t0
@@ -607,10 +741,9 @@ class Engine:
                         tokens=int(sum(q for _, q, _ in rows)),
                         **self._predicted_attrs())
         # classify by SLOT, not q_len: a chunk_size=1 prefill chunk is
-        # still a prefill chunk
-        s = self.scheduler.max_batch
+        # still a prefill chunk, and a verify row is neither
         n_decode = sum(1 for _, _, row in rows if row < s)
-        n_chunk = sum(1 for _, _, row in rows if row >= s)
+        n_chunk = sum(1 for _, _, row in rows if s <= row < vbase)
         if n_decode:
             self.counters["decode_steps"].inc()
         self.counters["prefill_chunks"].inc(n_chunk)
@@ -626,26 +759,85 @@ class Engine:
                                 prefill_tokens=pre, pos=req.pos,
                                 budget_slice=qlen,
                                 cached_skip=req.cached_tokens)
+            if row >= vbase and req.spec_drafts:
+                produced += self._commit_verify(
+                    req, int(accs[row]), int(toks[row]), t0, dt)
+                continue
             req.pos += qlen
             if req.pos == len(req.tokens):      # row reached its tip:
                 self._emit(req, int(toks[row]))  # commit the sample
                 produced += 1
-                now = self._now()
-                if tr.enabled:
-                    tr.instant("token", track=f"req {req.req_id}",
-                               ts=now, req=req.req_id,
-                               n=req.n_generated,
-                               decode_slot=bool(row < s))
-                if req.first_token_time is None:
-                    req.first_token_time = now
-                    self.histograms["ttft"].observe(now - req.submit_time)
-                else:
-                    self.histograms["tbt"].observe(
-                        now - (req.last_token_time or now))
-                    self.histograms["tpot"].observe(dt)
-                req.last_token_time = now
+                self._observe_token(req, row < s, dt)
                 self._maybe_finish(req)
         return produced
+
+    def _observe_token(self, req: Request, decode_slot: bool,
+                       dt: float) -> None:
+        """Latency bookkeeping + trace instant for ONE emitted token."""
+        tr = self.tracer
+        now = self._now()
+        if tr.enabled:
+            tr.instant("token", track=f"req {req.req_id}", ts=now,
+                       req=req.req_id, n=req.n_generated,
+                       decode_slot=bool(decode_slot))
+        if req.first_token_time is None:
+            req.first_token_time = now
+            self.histograms["ttft"].observe(now - req.submit_time)
+        else:
+            self.histograms["tbt"].observe(
+                now - (req.last_token_time or now))
+            self.histograms["tpot"].observe(dt)
+        req.last_token_time = now
+
+    def _commit_verify(self, req: Request, accepted: int,
+                       bonus: int, t0: float, dt: float) -> int:
+        """Commit a verify row's outcome: the accepted draft prefix
+        plus the bonus token, capped by ``max_new_tokens``/EOS, then
+        rewind ``pos`` to the accepted boundary.  Rejected positions'
+        KV slots beyond the boundary are STALE — they are re-written by
+        the next burst before anything can read them (the write plan
+        covers every fed position ahead of the attention, and
+        ``ctx_lens`` never reaches past the written extent; the
+        ``spec-rewind-leak`` lint audits exactly this from the tap).
+        Returns the number of requests that emitted (0 or 1)."""
+        drafts = req.spec_drafts
+        spec_len = len(drafts)
+        n0 = len(req.tokens)
+        committed_drafts = 0
+        emitted = 0
+        for i, tok in enumerate(drafts[:accepted] + [bonus]):
+            if req.n_generated >= req.max_new_tokens:
+                break
+            self._emit(req, int(tok))
+            emitted += 1
+            if i < accepted:
+                committed_drafts += 1
+            self._observe_token(req, False, dt)
+            if req.eos_token_id is not None and \
+                    int(tok) == req.eos_token_id:
+                break
+        # rewind: the first spec_len - committed_drafts fed positions
+        # past the boundary hold rejected/stale KV; the next verify
+        # burst (or re-prefill) re-writes them in place
+        req.pos = n0 + committed_drafts
+        req.spec_drafts = []
+        self.counters["spec_accepted"].inc(committed_drafts)
+        if emitted > committed_drafts:
+            self.counters["spec_bonus_tokens"].inc()
+        tr = self.tracer
+        if tr.enabled:
+            tr.complete("verify", t0, dt, track=f"req {req.req_id}",
+                        req=req.req_id, proposed=spec_len,
+                        accepted=accepted, committed=emitted)
+            tr.instant("spec_accept", track=f"req {req.req_id}",
+                       ts=self._now(), req=req.req_id, n=committed_drafts,
+                       bonus=int(emitted > committed_drafts))
+        if self.tap is not None and committed_drafts < spec_len:
+            self.tap.append({"kind": "spec_rewind", "req": req.req_id,
+                             "valid_upto": int(req.pos),
+                             "written_upto": int(n0 + spec_len)})
+        self._maybe_finish(req)
+        return 1 if emitted else 0
 
     # -- sampling / retirement ----------------------------------------------
 
@@ -664,6 +856,9 @@ class Engine:
     def _maybe_finish(self, req: Request) -> None:
         if not req.done:
             return
+        if self.spec is not None:
+            self.spec.release(req)
+            req.spec_drafts = []
         if self.prefix_cache is not None:
             # fully-written pages enter the cache index (refcount 0,
             # LRU-evictable); duplicates and the partial tail are freed;
@@ -711,7 +906,9 @@ class Engine:
         f32 = lambda *s: jax.ShapeDtypeStruct(s, np.float32)  # noqa: E731
         args = (params, i32(t), i32(t), i32(t), i32(t), i32(nr),
                 i32(nr + 1), i32(nr, maxp), i32(nr), f32(nr), f32(nr),
-                i32(nr), i32(nr), pages, pages)
+                i32(nr), i32(nr)) \
+            + ((i32(nr),) if self.spec is not None else ()) \
+            + (pages, pages)
         meta = {
             "kind": "serving_unified",
             "mesh_axes": {},
@@ -807,4 +1004,14 @@ class Engine:
         miss = self.counters["prefix_cache_misses"].value
         out["prefix_cache_hit_rate"] = hits / max(hits + miss, 1.0)
         out["prefix_cache_pages"] = self.pool.cached_pages
+        # speculative decoding: draft hit rate + emitted tokens per
+        # executable call since the last reset (non-spec engines report
+        # rate 0 / plain 1-token-per-emitting-row cadence)
+        prop = self.counters["spec_proposed"].value
+        out["spec_accept_rate"] = \
+            self.counters["spec_accepted"].value / max(prop, 1.0)
+        out["accepted_per_step"] = (
+            (self.counters["spec_accepted"].value +
+             self.counters["spec_bonus_tokens"].value) /
+            max(self.counters["step_calls"].value, 1.0))
         return out

@@ -40,6 +40,14 @@ class Request:
     # cache on the most recent start (metrics / tests).
     shared_pages: int = 0
     cached_tokens: int = 0
+    # speculative decoding (serving/spec.py): greedy draft proposals
+    # staged for the next packed step.  Non-empty only while the engine
+    # runs a spec scheduler mode AND the request is decode-ready; the
+    # scheduler packs ``1 + len(spec_drafts)`` tokens as a verify row
+    # (a chunk slot), and the engine clears the list after the verify
+    # commits (or when the drafts are dropped: preemption, a step with
+    # no free chunk slot, a page squeeze).
+    spec_drafts: List[int] = field(default_factory=list)
     pos: int = 0                 # KV entries committed (next write index)
     state: str = WAITING
     # start of the CURRENT lifecycle segment (queued/running) for the

@@ -45,6 +45,12 @@ class Scheduler:
         self.max_batch = int(max_batch)
         self.chunk = int(chunk)
         self.prefill_rows = int(prefill_rows)
+        # speculative mode (set by the engine): verify_slots dedicated
+        # spec_width-wide rows after the chunk slots — one per
+        # decode-capable request, so verify bursts NEVER compete with
+        # prompt prefills for chunk slots
+        self.verify_slots = 0
+        self.spec_width = 0
         # optional serving.prefix_cache.PrefixCache: admission charges
         # only the UNCACHED suffix against the page budget (and counts
         # refcount-0 cached pages as reclaimable), preemption releases
@@ -54,7 +60,8 @@ class Scheduler:
     @property
     def token_budget(self) -> int:
         """Tokens one packed step can carry (the executable's T)."""
-        return self.max_batch + self.prefill_rows * self.chunk
+        return self.max_batch + self.prefill_rows * self.chunk \
+            + self.verify_slots * self.spec_width
 
     # -- admission -----------------------------------------------------------
 
@@ -136,19 +143,40 @@ class Scheduler:
         Single-token rows (``remaining == 1``) fill slots
         ``[0, max_batch)``; mid-prompt requests fill chunk slots
         ``[max_batch, max_batch + prefill_rows)`` in arrival order with
-        ``q_len = min(remaining, chunk)``.  Requests beyond the chunk
-        slots simply wait — they are still RUNNING and keep their pages,
-        they just don't ride this step."""
+        ``q_len = min(remaining, chunk)`` — EXACTLY as without spec
+        mode: prefill chunks are TTFT-critical and speculation never
+        touches them.  In spec mode each decode-ready request with
+        staged draft proposals instead takes a DEDICATED verify slot
+        (``[max_batch + prefill_rows, max_batch + prefill_rows +
+        verify_slots)``, width ``spec_width``) with ``q_len = 1 +
+        len(spec_drafts)`` — there is one verify slot per sequence
+        slot, so a staged burst always rides and the no-decode-stall
+        guarantee is untouched (an unstaged or shed request still gets
+        its decode slot).  Requests beyond the chunk slots simply wait
+        — they are still RUNNING and keep their pages, they just don't
+        ride this step."""
         live = sorted((r for r in running if r.state == RUNNING),
                       key=lambda r: (r.arrival_time, r.req_id))
         rows: List[Tuple[Request, int, int]] = []
-        slot = 0
-        chunk_row = 0
+        verified = set()
+        vrow = 0
+        vbase = self.max_batch + self.prefill_rows
         for r in live:
             remaining = len(r.tokens) - r.pos
-            if remaining == 1 and slot < self.max_batch:
+            staged = len(r.spec_drafts)
+            if remaining == 1 and staged and vrow < self.verify_slots \
+                    and 1 + staged <= self.spec_width:
+                rows.append((r, 1 + staged, vbase + vrow))
+                vrow += 1
+                verified.add(r.req_id)
+        slot = 0
+        for r in live:
+            remaining = len(r.tokens) - r.pos
+            if remaining == 1 and r.req_id not in verified \
+                    and slot < self.max_batch:
                 rows.append((r, 1, slot))
                 slot += 1
+        chunk_row = 0
         for r in live:
             remaining = len(r.tokens) - r.pos
             if remaining > 1 and chunk_row < self.prefill_rows:
@@ -164,9 +192,15 @@ class Scheduler:
         Perfetto timeline shows exactly how each executable call's
         token budget was split between decode slots and prefill
         chunks."""
+        vbase = self.max_batch + self.prefill_rows
         n_decode = sum(1 for _, _, row in rows if row < self.max_batch)
+        n_verify = sum(1 for _, _, row in rows if row >= vbase)
         return {"decode_slots": n_decode,
-                "chunk_slots": len(rows) - n_decode,
+                "chunk_slots": len(rows) - n_decode - n_verify,
+                "verify_slots": n_verify,
+                "spec_tokens": int(sum(len(r.spec_drafts)
+                                       for r, _, row in rows
+                                       if row >= vbase)),
                 "tokens": int(sum(q for _, q, _ in rows)),
                 "token_budget": self.token_budget,
                 "chunk": self.chunk,
@@ -176,25 +210,37 @@ class Scheduler:
 
     def ensure_decode_pages(self, running: List[Request]
                             ) -> Tuple[List[Request], List[Request]]:
-        """Give every running request a page for its next KV write,
-        evicting latest-arrived requests on exhaustion.  Returns
+        """Give every running request the pages its next KV writes
+        need, evicting latest-arrived requests on exhaustion.  Returns
         (kept, evicted); evicted requests are already reset to WAITING
         with their pages freed.  Mid-prefill requests were granted their
         whole prompt's pages at admission, so only emitted-token growth
-        allocates here."""
+        allocates here — one page per decode step, or up to
+        ``ceil((1 + staged drafts) / page_size)`` for a speculative
+        verify row (its burst writes ``pos .. pos + spec_len``, which
+        may cross a page boundary).  A page squeeze sheds the
+        requester's staged drafts FIRST — degrading a burst to a plain
+        decode is free, while preempting any request costs its whole
+        prefill — and only then falls back to eviction."""
         evicted: List[Request] = []
         kept = sorted(running, key=lambda r: (r.arrival_time, r.req_id))
         for req in list(kept):
             if req in evicted:
                 continue
-            if len(req.pages) * self.pool.page_size >= req.pos + 1:
-                continue               # current page still has room
             while True:
-                got = self.pool.alloc(1)
+                need_tokens = req.pos + 1 + len(req.spec_drafts)
+                have = len(req.pages) * self.pool.page_size
+                if have >= need_tokens:
+                    break              # current pages still have room
+                got = self.pool.alloc(self.pool.pages_for(need_tokens)
+                                      - len(req.pages))
                 if got is not None:
                     req.pages.extend(got)
                     req.peak_pages = max(req.peak_pages, len(req.pages))
                     break
+                if req.spec_drafts:
+                    req.spec_drafts = []   # shed the burst, keep running
+                    continue
                 victims = [r for r in kept
                            if r not in evicted and r is not req]
                 victim = max(victims,
@@ -220,6 +266,7 @@ class Scheduler:
         req.pages = []
         req.shared_pages = 0
         req.cached_tokens = 0
+        req.spec_drafts = []
         req.pos = 0
         req.state = WAITING
         req.n_preemptions += 1

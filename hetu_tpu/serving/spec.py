@@ -1,0 +1,392 @@
+"""Draft-model speculative decoding for the unified serving step.
+
+Per-token decode latency is the serving bottleneck: every emitted token
+costs one full target-model step, no matter how wide the unified
+executable's token budget is.  Speculative decoding breaks the 1:1
+coupling: a small **draft model** proposes ``k`` greedy tokens per
+scheduled request, and the target verifies all of them in ONE unified
+step — a verify row is structurally just a prefill chunk of length
+``k + 1`` (the last committed token plus the proposals), so the ragged
+kernel, the token-budget scheduler, the per-token KV write plan, and
+the paged pool already speak exactly the right shapes.  The target's
+on-device accept head (:mod:`~hetu_tpu.ops.ragged_paged_attention`)
+returns the longest-accepted-prefix length plus a bonus token per row,
+so a verify step emits ``accepted + 1`` tokens for one executable call
+— and the host still fetches only ``[rows]`` int32s
+(``host_logit_fetches`` stays 0).
+
+This module owns the DRAFT half:
+
+* :class:`SpecConfig` — the engine-facing knob: a draft ``state`` +
+  shallow :class:`~hetu_tpu.models.gpt.GPTConfig` (same vocab; build
+  one from a target checkpoint with
+  :func:`hetu_tpu.models.gpt.draft_state_from`) and the proposal
+  length ``k``;
+* :class:`SpecDecoder` — slotted dense KV caches for up to
+  ``max_batch`` concurrently-speculating requests plus exactly THREE
+  jitted programs (all fixed-shape, so the draft joins the engine's
+  compile-count guard):
+
+  - ``draft_prefill``: one ``[1, max_model_len]`` padded causal
+    forward that (re)builds a slot's cache — paid only when a request
+    starts speculating or resumes after preemption/adoption;
+  - ``draft_insert``: splices a prefilled cache into its slot;
+  - ``draft_propose``: ``k`` greedy decode micro-steps batched over
+    ALL speculating slots at once (per-row positions, idle rows write
+    a trash position and are ignored).
+
+**Why the draft never needs a catch-up in steady state.**  A propose
+call warm-feeds the second-to-last committed token, then the last
+committed token, then its own proposals — writing draft KV at
+``[n - 2, n + k - 2]``.  The verify step commits the accepted prefix
+``d_1..d_a`` — EXACTLY the tokens whose draft KV was just written —
+plus a bonus token the draft never saw.  The next propose starts by
+feeding from position ``n + a - 1``, overwriting the stale slots
+before anything reads them (a decode query at position p attends only
+``[0, p]``, and the write lands before the attention).  The warm-up
+feed exists for the one slot this contiguity argument misses: after a
+FULLY accepted burst, ``d_k`` is committed but its KV was never
+written (propose only ever fed ``d_1..d_{k-1}``) — re-feeding the
+committed token rewrites that slot, and is a bit-identical no-op
+whenever the slot was already valid.  Rejected positions are
+overwritten the same way: rewind is free on the draft side for the
+same reason it is free on the target side (DESIGN.md §20).
+
+Determinism: proposals are greedy and every propose/prefill op is
+row-wise (per-slot matmuls, per-slot softmax), so a request's drafts
+do not depend on which other requests share the batch — the engine's
+temperature-0 bitwise contract and the sampled-mode replay determinism
+both survive any traffic mix.  At temperature 0 the drafts cannot
+affect OUTPUT at all (acceptance against the target argmax emits the
+non-speculative sequence whatever the draft says); they only decide
+how many tokens each step commits.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.generate import (_act, _lm_head, _moe_mlp, _norm_apply,
+                               _Params, _rotary_tables, decode_step)
+from ..models.gpt import GPTConfig
+
+
+@dataclass
+class SpecConfig:
+    """Speculative-decoding knob for ``Engine(spec=...)``.
+
+    ``draft_state``/``draft_cfg``: the proposal model — any model with
+    the TARGET's vocab (``models.gpt.draft_state_from`` builds the
+    truncated self-draft).  ``k``: proposals per verify burst — each
+    verify row gets its own dedicated ``k + 1``-wide slot in the token
+    layout (independent of ``chunk_size``), and the engine caps the
+    burst per-request at the remaining emission budget.
+    """
+    draft_state: Dict[str, Any]
+    draft_cfg: GPTConfig
+    k: int = 4
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"spec k must be >= 1, got {self.k}")
+
+
+class SpecDecoder:
+    """Slotted draft-model runtime behind a speculative Engine."""
+
+    def __init__(self, spec: SpecConfig, target_cfg: GPTConfig,
+                 max_batch: int, max_model_len: int, k: int):
+        dcfg = spec.draft_cfg
+        if dcfg.vocab_size != target_cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {dcfg.vocab_size} != target vocab "
+                f"{target_cfg.vocab_size}: proposals must be target "
+                f"token ids")
+        if dcfg.position == "learned" and \
+                max_model_len > dcfg.max_seq_len:
+            raise ValueError(
+                f"draft learned-position table {dcfg.max_seq_len} "
+                f"shorter than max_model_len {max_model_len}")
+        self.cfg = dcfg
+        self.k = int(k)
+        self.params = _Params(spec.draft_state, dcfg).s
+        self.S = int(max_batch)
+        self.Lmax = int(max_model_len)
+        cdt = jnp.bfloat16 if dcfg.dtype == "bfloat16" else jnp.float32
+        self._cdt = cdt
+        kvh, hd = dcfg.kv_heads, dcfg.head_dim
+        # +1 cache row per slot: index Lmax is the TRASH position idle
+        # rows scatter into (the dense-cache analogue of the pool's
+        # trash page).  Layout is [slot, kv_head, position, head_dim] —
+        # position INSIDE head — so the per-micro-step attention
+        # contractions are transpose-free batched GEMMs; the [S, L,
+        # kvh, hd] layout costs a multi-MB cache transpose per
+        # micro-step on CPU, which single-handedly ate the speculative
+        # speedup
+        shape = (self.S, kvh, self.Lmax + 1, hd)
+        self._kc: List[jax.Array] = [jnp.zeros(shape, cdt)
+                                     for _ in range(dcfg.num_layers)]
+        self._vc: List[jax.Array] = [jnp.zeros(shape, cdt)
+                                     for _ in range(dcfg.num_layers)]
+        self._free: List[int] = list(range(self.S - 1, -1, -1))
+        self._slot: Dict[int, int] = {}       # req_id -> slot
+        self._valid: Dict[int, bool] = {}     # draft cache usable?
+        # observability: how often the draft had to re-prefill (starts
+        # + preemption/adoption resumes) and propose-call count
+        self.prefills = 0
+        self.proposals = 0
+        self.compiled: Dict[str, Any] = {
+            "draft_prefill": self._build_prefill(),
+            "draft_propose": self._build_propose(),
+            "draft_insert": self._build_insert(),
+        }
+
+    # -- jitted programs -----------------------------------------------------
+
+    def _build_prefill(self):
+        c, Lmax = self.cfg, self.Lmax
+        cdt = self._cdt
+        cos, sin = (_rotary_tables(c, Lmax) if c.position == "rotary"
+                    else (None, None))
+        kvh, hd = c.kv_heads, c.head_dim
+
+        @jax.jit
+        def prefill(params, tokens):          # tokens [1, Lmax] i32
+            p = _Params.__new__(_Params)
+            p.s, p.cfg = params, c
+            caches = [(jnp.zeros((1, Lmax, kvh, hd), cdt),
+                       jnp.zeros((1, Lmax, kvh, hd), cdt))
+                      for _ in range(c.num_layers)]
+            _, cs = decode_step(c, p, tokens, caches, 0, cos, sin)
+            return tuple(k for k, _ in cs), tuple(v for _, v in cs)
+
+        return prefill
+
+    def _build_insert(self):
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def insert(kcs, vcs, pk, pv, slot):
+            # prefill produces [1, L, kvh, hd]; the slot store is
+            # position-inside-head ([S, kvh, L+1, hd]) — one transpose
+            # here (per resume) saves one per propose micro-step
+            start = (slot, jnp.int32(0), jnp.int32(0), jnp.int32(0))
+            new_k = tuple(
+                lax.dynamic_update_slice(
+                    kc, jnp.swapaxes(k1, 1, 2).astype(kc.dtype), start)
+                for kc, k1 in zip(kcs, pk))
+            new_v = tuple(
+                lax.dynamic_update_slice(
+                    vc, jnp.swapaxes(v1, 1, 2).astype(vc.dtype), start)
+                for vc, v1 in zip(vcs, pv))
+            return new_k, new_v
+
+        return insert
+
+    def _build_propose(self):
+        c, S, Lmax, K = self.cfg, self.S, self.Lmax, self.k
+        cdt = self._cdt
+        cos, sin = (_rotary_tables(c, Lmax + 1)
+                    if c.position == "rotary" else (None, None))
+        hd, nh, kvh = c.head_dim, c.num_heads, c.kv_heads
+        g = nh // kvh
+        scale = hd ** -0.5
+        rows = jnp.arange(S)
+
+        def rope_rows(x, idx):
+            # x [S, h, d]; per-row position gather (generate._rope with
+            # a different position per row)
+            half = x.shape[-1] // 2
+            x1, x2 = x[..., :half], x[..., half:]
+            rot = jnp.concatenate([-x2, x1], axis=-1)
+            cg = cos[idx][:, None, :].astype(x.dtype)
+            sg = sin[idx][:, None, :].astype(x.dtype)
+            return x * cg + rot * sg
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def propose(params, kcs, vcs, pre_tok, last_tok, pre_pos, pos,
+                    active):
+            p = _Params.__new__(_Params)
+            p.s, p.cfg = params, c
+            kcs, vcs = list(kcs), list(vcs)
+            out = []
+            # K + 1 micro-steps: a WARM-UP feed of the second-to-last
+            # committed token at ``pre_pos`` (logits discarded), then
+            # the K proposal steps.  The warm-up re-writes the one
+            # draft-KV slot a fully-accepted burst leaves stale:
+            # propose only ever feeds d_1..d_{K-1}, so d_K's KV is
+            # never written — after full acceptance the next burst's
+            # context would silently hold garbage at position m-2 and
+            # the accept rate would decay with generation length.
+            # Re-feeding a committed token rewrites the identical
+            # value when the slot was already valid, so the warm-up is
+            # a no-op in every other case.
+            cur, cur_pos = pre_tok, pre_pos
+            for step in range(K + 1):
+                x = p("wte.weight")[cur].astype(cdt)           # [S, H]
+                if c.position == "learned":
+                    x = x + p("wpe")[jnp.clip(
+                        cur_pos, 0, c.max_seq_len - 1)].astype(x.dtype)
+                # idle rows (and rows proposed past the model budget)
+                # scatter into the trash position Lmax
+                wpos = jnp.where(active, jnp.minimum(cur_pos, Lmax),
+                                 Lmax)
+                for i in range(c.num_layers):
+                    h = _norm_apply(c, p.layer(i, "ln_1.weight"),
+                                    p.layer(i, "ln_1.bias"), x)
+                    qkv = h @ p.layer(i, "attn.qkv.weight").T
+                    qb = p.layer(i, "attn.qkv.bias")
+                    if qb is not None:
+                        qkv = qkv + qb
+                    qs, ks = nh * hd, kvh * hd
+                    q = qkv[..., :qs].reshape(S, nh, hd)
+                    kk = qkv[..., qs:qs + ks].reshape(S, kvh, hd)
+                    vv = qkv[..., qs + ks:].reshape(S, kvh, hd)
+                    if c.position == "rotary":
+                        ridx = jnp.clip(cur_pos, 0, Lmax)
+                        q = rope_rows(q, ridx)
+                        kk = rope_rows(kk, ridx)
+                    kcs[i] = kcs[i].at[rows, :, wpos].set(kk.astype(cdt))
+                    vcs[i] = vcs[i].at[rows, :, wpos].set(vv.astype(cdt))
+                    qg = q.reshape(S, kvh, g, hd).astype(jnp.float32)
+                    s = jnp.einsum("skgd,skld->skgl", qg,
+                                   kcs[i].astype(jnp.float32)) * scale
+                    mask = jnp.arange(Lmax + 1)[None, :] \
+                        <= cur_pos[:, None]
+                    s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+                    pr = jax.nn.softmax(s, axis=-1)
+                    o = jnp.einsum("skgl,skld->skgd", pr,
+                                   vcs[i].astype(jnp.float32))
+                    o = o.reshape(S, nh * hd).astype(x.dtype)
+                    o = o @ p.layer(i, "attn.out.weight").T
+                    ob = p.layer(i, "attn.out.bias")
+                    if ob is not None:
+                        o = o + ob
+                    x = x + o
+                    h = _norm_apply(c, p.layer(i, "ln_2.weight"),
+                                    p.layer(i, "ln_2.bias"), x)
+                    if c.is_moe_layer(i):
+                        h = _moe_mlp(c, p, i, h[:, None, :])[:, 0]
+                    else:
+                        h = _act(c, h @ p.layer(i, "mlp.up.weight").T +
+                                 (p.layer(i, "mlp.up.bias")
+                                  if p.layer(i, "mlp.up.bias") is not None
+                                  else 0.0))
+                        h = h @ p.layer(i, "mlp.down.weight").T
+                        db = p.layer(i, "mlp.down.bias")
+                        if db is not None:
+                            h = h + db
+                    x = x + h
+                xf = _norm_apply(c, p("ln_f.weight"), p("ln_f.bias"), x)
+                nxt = jnp.argmax(_lm_head(p, xf),
+                                 axis=-1).astype(jnp.int32)
+                if step == 0:              # warm-up: discard, rewind
+                    cur, cur_pos = last_tok, pos
+                else:
+                    out.append(nxt)
+                    cur = nxt
+                    cur_pos = cur_pos + active.astype(jnp.int32)
+            return (jnp.stack(out, axis=1), tuple(kcs), tuple(vcs))
+
+        return propose
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _ensure_slot(self, req):
+        """Assign (or return) the request's draft slot; ``None`` when
+        the slot pool is exhausted — the caller skips the candidate
+        this step rather than crash.  With ``release`` on
+        preemption/finish/abort, holders are always RUNNING requests
+        (≤ max_batch = slot count), so exhaustion is a defensive path,
+        not an expected one."""
+        slot = self._slot.get(req.req_id)
+        if slot is None:
+            if not self._free:
+                return None
+            slot = self._free.pop()
+            self._slot[req.req_id] = slot
+            self._valid[req.req_id] = False
+        return slot
+
+    def release(self, req) -> None:
+        """Request left the engine (finish/abort): free its slot."""
+        slot = self._slot.pop(req.req_id, None)
+        if slot is not None:
+            self._free.append(slot)
+            self._valid.pop(req.req_id, None)
+
+    def stage(self, cands, k_effs: Dict[int, int],
+              tracer=None, now: float = 0.0) -> Dict[int, List[int]]:
+        """Prefill stale slots, then ONE batched propose over every
+        candidate: returns ``{req_id: drafts}`` with each request's
+        drafts truncated to its ``k_eff``.  ``cands`` are decode-ready
+        requests (``len(tokens) - pos == 1``)."""
+        if not cands:
+            return {}
+        staged = []
+        for req in cands:
+            slot = self._ensure_slot(req)
+            if slot is None:
+                continue               # slot pool dry: plain decode
+            staged.append(req)
+            if not self._valid[req.req_id]:
+                n = len(req.tokens)
+                if n > 1:
+                    toks = np.zeros((1, self.Lmax), np.int32)
+                    toks[0, :n - 1] = req.tokens[:n - 1]
+                    t0 = now
+                    pk, pv = self.compiled["draft_prefill"](
+                        self.params, jnp.asarray(toks))
+                    self._kc, self._vc = self.compiled["draft_insert"](
+                        tuple(self._kc), tuple(self._vc), pk, pv,
+                        jnp.int32(slot))
+                    self._kc, self._vc = list(self._kc), list(self._vc)
+                    self.prefills += 1
+                    if tracer is not None and tracer.enabled:
+                        tracer.instant("draft_prefill",
+                                       track=f"req {req.req_id}", ts=t0,
+                                       req=req.req_id, tokens=n - 1)
+                self._valid[req.req_id] = True
+        cands = staged
+        if not cands:
+            return {}
+        pre = np.zeros(self.S, np.int32)
+        last = np.zeros(self.S, np.int32)
+        pre_pos = np.zeros(self.S, np.int32)
+        pos = np.zeros(self.S, np.int32)
+        active = np.zeros(self.S, bool)
+        for req in cands:
+            s = self._slot[req.req_id]
+            pre[s] = req.tokens[-2] if len(req.tokens) > 1 \
+                else req.tokens[-1]
+            last[s] = req.tokens[-1]
+            pre_pos[s] = max(len(req.tokens) - 2, 0)
+            pos[s] = len(req.tokens) - 1
+            active[s] = True
+        drafts, kcs, vcs = self.compiled["draft_propose"](
+            self.params, tuple(self._kc), tuple(self._vc),
+            jnp.asarray(pre), jnp.asarray(last), jnp.asarray(pre_pos),
+            jnp.asarray(pos), jnp.asarray(active))
+        self._kc, self._vc = list(kcs), list(vcs)
+        self.proposals += 1
+        d = np.asarray(drafts)
+        out = {}
+        for req in cands:
+            k_eff = int(k_effs[req.req_id])
+            out[req.req_id] = [int(t) for t in
+                               d[self._slot[req.req_id], :k_eff]]
+        return out
+
+    @property
+    def compile_count(self) -> int:
+        n = 0
+        for fn in self.compiled.values():
+            try:
+                n += int(fn._cache_size())
+            except Exception:
+                n += 1
+        return n

@@ -1,0 +1,477 @@
+"""Draft-model speculative decoding on the unified serving step
+(ISSUE 15).
+
+The strongest gate this repo has — temp-0 serving output bit-for-bit
+equal to solo ``generate()`` — applied to the flashiest feature:
+
+- **temp-0 bitwise** — speculative output equals non-speculative (and
+  therefore solo ``generate()``) under late arrivals, preemption
+  (asserted non-vacuous) and prefix-cache eviction;
+- **sampled-mode determinism** — the coupled leftover-distribution
+  acceptance draws the SAME ``(seed, index)``-keyed choice the per-row
+  sampler draws, so sampled spec output is bitwise the non-spec sampled
+  output across every k / chunk size / batching;
+- **degenerate drafts** — a draft identical to the target accepts
+  everything; a head-negated draft accepts nothing — output identical
+  either way, only the tokens-per-step cadence changes;
+- **compile pin** — spec engine = exactly 4 programs (unified + draft
+  prefill/propose/insert) over an adversarial mixed spec/non-spec
+  trace, ``host_logit_fetches == 0``;
+- **KV-rewind honesty** — the real engine tap satisfies the
+  ``spec-rewind-leak`` rule (rewinds asserted non-vacuous) and the
+  seeded violation fires exactly once;
+- **metrics** — spec counters + derived rates, ``reset_metrics``
+  zeroing, and the cluster-merged Prometheus exposition.
+"""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.models import (GPTConfig, GPTLMHeadModel, draft_config,
+                             draft_state_from)
+from hetu_tpu.models.generate import generate
+from hetu_tpu.serving import Engine, SpecConfig
+
+CFG_KW = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+              max_seq_len=64, sp=False, dropout=0.0)
+
+
+def _build_state(cfg, seed=3):
+    ht.set_seed(seed)
+    with ht.graph("eager", create_new=True):
+        model = GPTLMHeadModel(cfg)
+        model.logits(np.zeros((1, 4), np.int32))
+        state = {k: np.asarray(v) for k, v in model.state_dict().items()}
+    return state
+
+
+def _solo(state, cfg, prompt, n_new):
+    return np.asarray(generate(state, cfg,
+                               np.asarray([prompt], np.int32), n_new,
+                               temperature=0.0))[0, len(prompt):].tolist()
+
+
+def _make_engine(state, cfg, **kw):
+    clock = [0.0]
+    kw.setdefault("time_fn", lambda: clock[0])
+    kw.setdefault("debug", True)
+    eng = Engine(state, cfg, **kw)
+    eng._test_clock = clock
+    return eng
+
+
+def _drain(eng, check=True):
+    guard = 0
+    while eng.has_work:
+        eng.step()
+        eng._test_clock[0] += 1.0
+        guard += 1
+        assert guard < 500, "engine failed to drain"
+        if check:
+            eng.pool.check_invariants()
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    cfg = GPTConfig(position="learned", norm="layernorm",
+                    activation="gelu", **CFG_KW)
+    state = _build_state(cfg, seed=11)
+    dstate, dcfg = draft_state_from(state, cfg, 1)
+    return state, cfg, dstate, dcfg
+
+
+# ---------------------------------------------------------------------------
+# temp-0 bitwise under the adversarial trace
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("prefix_cache", [False, True])
+def test_spec_temp0_bitwise_under_pressure(gpt, prefix_cache):
+    """The acceptance criterion: a tiny pool (forces recompute
+    preemption, asserted non-vacuous; with the cache on, LRU eviction
+    too), staggered arrivals, chunked prefill — speculative output is
+    bit-for-bit the solo generate() run for every request."""
+    state, cfg, dstate, dcfg = gpt
+    prompts = [[5, 17, 2, 9, 33, 12, 8, 1], [1, 1, 4, 44],
+               [3, 2, 1, 9, 6, 5, 4]]
+    want = [_solo(state, cfg, p, 14) for p in prompts]
+    eng = _make_engine(state, cfg, num_pages=6, page_size=8,
+                       max_batch=4, chunk_size=4,
+                       prefix_cache=prefix_cache,
+                       spec=SpecConfig(dstate, dcfg, k=3))
+    reqs = [eng.add_request(p, 14, arrival_time=float(i))
+            for i, p in enumerate(prompts)]
+    _drain(eng)
+    m = eng.metrics_summary()
+    assert m["preemptions"] >= 1, \
+        "trace should exercise eviction; shrink the pool if not"
+    if prefix_cache:
+        assert m["prefix_cache_evictions"] >= 1
+    assert m["spec_accepted"] > 0, "speculation never engaged"
+    assert m["spec_accepted"] < m["spec_proposed"], \
+        "no rejection: the rewind path is untested"
+    assert m["host_logit_fetches"] == 0
+    for r, w in zip(reqs, want):
+        assert r.out_tokens == w
+    assert eng.pool.used_pages == 0
+
+
+def test_spec_matches_nonspec_engine_exactly(gpt):
+    """Spec vs non-spec ENGINE (not just solo generate): identical
+    outputs and identical per-request token values on a mixed trace
+    with a mid-flight arrival."""
+    state, cfg, dstate, dcfg = gpt
+    rng = np.random.RandomState(2)
+    prompts = [[int(t) for t in rng.randint(1, 90, size=n)]
+               for n in (23, 4, 17)]
+    outs = {}
+    for spec in (None, SpecConfig(dstate, dcfg, k=4)):
+        eng = _make_engine(state, cfg, num_pages=24, page_size=8,
+                           max_batch=4, chunk_size=8, spec=spec)
+        reqs = [eng.add_request(p, 8, arrival_time=float(2 * i))
+                for i, p in enumerate(prompts)]
+        _drain(eng)
+        outs[spec is None] = [r.out_tokens for r in reqs]
+    assert outs[True] == outs[False]
+
+
+# ---------------------------------------------------------------------------
+# sampled mode: the coupled acceptance is bitwise with non-spec
+# ---------------------------------------------------------------------------
+
+def test_sampled_mode_bitwise_across_k_chunk_and_batching(gpt):
+    """Sampled verify rows accept iff the draft matches the position's
+    own (seed, index)-keyed choice — the coupled form of
+    leftover-distribution rejection sampling — so sampled spec output
+    is not merely deterministic: it equals non-speculative sampled
+    serving bit-for-bit, for every k, chunk size and batch mix."""
+    state, cfg, dstate, dcfg = gpt
+    prompt = [5, 17, 2, 9, 1]
+    ref = None
+    configs = [(None, dict(chunk_size=8, max_batch=2))]
+    for k in (1, 3):
+        configs += [(SpecConfig(dstate, dcfg, k=k),
+                     dict(chunk_size=4, max_batch=4)),
+                    (SpecConfig(dstate, dcfg, k=k),
+                     dict(chunk_size=8, max_batch=2))]
+    for spec, kw in configs:
+        eng = _make_engine(state, cfg, num_pages=16, page_size=8,
+                           spec=spec, **kw)
+        if kw["max_batch"] == 4:            # mixed greedy/sampled batch
+            eng.add_request([3, 2, 1], 8, arrival_time=0.0)
+        req = eng.add_request(prompt, 8, temperature=0.7, top_p=0.9,
+                              top_k=40, seed=123, arrival_time=0.0)
+        _drain(eng)
+        assert eng.host_logit_fetches == 0
+        if ref is None:
+            ref = list(req.out_tokens)
+        assert list(req.out_tokens) == ref, (spec and spec.k, kw)
+
+
+# ---------------------------------------------------------------------------
+# degenerate drafts
+# ---------------------------------------------------------------------------
+
+def test_all_accepted_draft_equals_target(gpt):
+    """Draft == target: every proposal matches the target argmax, so
+    every burst commits k + 1 tokens — acceptance 100%, output still
+    bitwise, cadence > 1 token per step.  The generation is long
+    enough to chain several fully-accepted bursts: the rate only stays
+    1.0 if the draft cache is seamless across bursts (the propose
+    warm-up re-writes d_K's slot — without it, every full acceptance
+    left one garbage position in the draft context and the rate
+    decayed with length)."""
+    state, cfg, _, _ = gpt
+    eng = _make_engine(state, cfg, num_pages=24, page_size=8,
+                       max_batch=2, chunk_size=8,
+                       spec=SpecConfig(dict(state), cfg, k=4))
+    req = eng.add_request([5, 17, 2, 9], 21, arrival_time=0.0)
+    _drain(eng)
+    m = eng.metrics_summary()
+    assert req.out_tokens == _solo(state, cfg, [5, 17, 2, 9], 21)
+    assert m["spec_accepted"] == m["spec_proposed"] > 0
+    assert m["spec_accept_rate"] == 1.0
+    assert m["accepted_per_step"] > 1.0
+
+
+def test_all_rejected_draft_still_bitwise(gpt):
+    """A head-negated draft proposes the target's argMIN: every
+    proposal rejects, every verify emits exactly the bonus token — the
+    degenerate 1-token-per-step cadence with UNCHANGED output."""
+    state, cfg, _, _ = gpt
+    head = [k for k in state if "lm_head" in k][0]
+    neg = dict(state)
+    neg[head] = -np.asarray(state[head])
+    eng = _make_engine(state, cfg, num_pages=24, page_size=8,
+                       max_batch=2, chunk_size=8,
+                       spec=SpecConfig(neg, cfg, k=4))
+    req = eng.add_request([5, 17, 2, 9], 9, arrival_time=0.0)
+    _drain(eng)
+    m = eng.metrics_summary()
+    assert req.out_tokens == _solo(state, cfg, [5, 17, 2, 9], 9)
+    assert m["spec_accepted"] == 0 and m["spec_proposed"] > 0
+    # every token except the first (emitted by the prompt's prefill
+    # chunk, before speculation engages) and the last (remaining
+    # budget 1: a plain decode, nothing left to draft) is a bonus
+    assert m["spec_bonus_tokens"] == len(req.out_tokens) - 2
+
+
+def test_eos_mid_burst_and_max_new_cap(gpt):
+    """Commit caps inside one verify burst: an accepted draft equal to
+    eos finishes the request mid-burst (later accepted tokens are
+    discarded), and max_new_tokens truncates a burst that would
+    overshoot."""
+    state, cfg, _, _ = gpt
+    prompt = [5, 17, 2, 9]
+    w6 = _solo(state, cfg, prompt, 6)
+    eng = _make_engine(state, cfg, num_pages=24, page_size=8,
+                       max_batch=2, chunk_size=8,
+                       spec=SpecConfig(dict(state), cfg, k=4))
+    req = eng.add_request(prompt, 6, eos_token_id=w6[2],
+                          arrival_time=0.0)
+    _drain(eng)
+    assert req.out_tokens == w6[:3]
+    eng = _make_engine(state, cfg, num_pages=24, page_size=8,
+                       max_batch=2, chunk_size=8,
+                       spec=SpecConfig(dict(state), cfg, k=4))
+    req = eng.add_request(prompt, 2, arrival_time=0.0)
+    _drain(eng)
+    assert req.out_tokens == w6[:2]
+
+
+# ---------------------------------------------------------------------------
+# compile pin + host fetches (CI)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint_graph
+def test_spec_compile_count_pinned_mixed_trace(gpt):
+    """Over an adversarial mixed spec/non-spec trace (greedy + sampled
+    requests, short + long prompts, late arrivals, preemption) the spec
+    engine compiles EXACTLY 4 programs — the unified step plus the
+    draft prefill/propose/insert — read from the real jit caches, so a
+    silent retrace in either model trips this."""
+    state, cfg, dstate, dcfg = gpt
+    rng = np.random.RandomState(5)
+    eng = _make_engine(state, cfg, num_pages=9, page_size=8,
+                       max_batch=4, chunk_size=8,
+                       spec=SpecConfig(dstate, dcfg, k=3))
+    for i in range(9):
+        n = int(rng.randint(2, 30))
+        pr = [int(t) for t in rng.randint(1, 90, size=n)]
+        eng.add_request(pr, int(rng.randint(2, 8)),
+                        temperature=0.5 if i % 3 == 0 else 0.0,
+                        top_p=0.9 if i % 3 == 0 else 0.0,
+                        seed=i, arrival_time=float(i))
+    _drain(eng)
+    m = eng.metrics_summary()
+    assert m["preemptions"] >= 1              # trace is adversarial
+    assert m["spec_accepted"] > 0             # speculation engaged
+    assert eng.compile_count == 4
+    for key in ("unified", "draft_prefill", "draft_propose",
+                "draft_insert"):
+        fn = eng._compiled[key]
+        if hasattr(fn, "_cache_size"):
+            assert fn._cache_size() == 1, key
+    assert m["host_logit_fetches"] == 0
+    assert len(eng.finished) == 9
+
+
+def test_preempted_speculating_request_resumes_drafting(gpt):
+    """Preemption invalidates the draft cache; on re-admission the
+    request re-prefills the draft and keeps speculating — a second
+    draft_prefill for the same request, with output unchanged.  The
+    preemption is applied through the engine's own eviction mechanics
+    mid-speculation (a pool race rarely lands one exactly there)."""
+    state, cfg, dstate, dcfg = gpt
+    prompt = [5, 17, 2, 9]
+    want = _solo(state, cfg, prompt, 16)
+    eng = _make_engine(state, cfg, num_pages=16, page_size=8,
+                       max_batch=2, chunk_size=8,
+                       spec=SpecConfig(dstate, dcfg, k=3))
+    req = eng.add_request(prompt, 16, arrival_time=0.0)
+    while req.n_generated < 6:          # actively speculating by now
+        eng.step()
+        eng._test_clock[0] += 1.0
+    assert eng.spec.prefills >= 1
+    assert eng.spec._valid.get(req.req_id)
+    # what Engine.step does for an evicted request, applied directly
+    eng.scheduler.preempt(req)
+    eng.spec.release(req)
+    assert req.req_id not in eng.spec._slot   # slot really freed
+    eng.running.remove(req)
+    eng.queue.push(req)
+    eng.counters["preemptions"].inc()
+    if eng.tap is not None:
+        eng.tap.append({"kind": "kv_drop", "req": req.req_id})
+    pre = eng.spec.prefills
+    _drain(eng)
+    assert req.n_preemptions == 1
+    assert eng.spec.prefills == pre + 1, \
+        "resumed request never re-prefilled its draft cache"
+    assert req.out_tokens == want
+
+
+def test_page_squeeze_sheds_drafts_before_eviction():
+    """A speculative burst that needs an extra page must never fund it
+    by evicting another request: shedding the drafts is free (the
+    request degrades to a plain decode this step), eviction costs a
+    whole re-prefill.  Regression for the review finding where the
+    shed branch was unreachable whenever a victim existed."""
+    from hetu_tpu.serving import PagedKVPool, Request, Scheduler
+    from hetu_tpu.serving.request import RUNNING
+    pool = PagedKVPool(num_layers=1, num_pages=4, page_size=4,
+                       kv_heads=1, head_dim=4)
+    sched = Scheduler(pool, max_batch=2, chunk=4, prefill_rows=1)
+    sched.verify_slots, sched.spec_width = 2, 4
+    pa = pool.alloc(2)
+    pb = pool.alloc(1)                  # free list now empty
+    a = Request(req_id=0, prompt=[1] * 7, max_new_tokens=8,
+                arrival_time=0.0)
+    a.tokens = [1] * 8
+    a.pos = 7                           # decode fits its 2 pages...
+    a.pages = pa
+    a.spec_drafts = [2, 3, 4]           # ...the burst needs a third
+    b = Request(req_id=1, prompt=[1] * 3, max_new_tokens=4,
+                arrival_time=1.0)
+    b.tokens = [1] * 4
+    b.pos = 3
+    b.pages = pb
+    a.state = b.state = RUNNING
+    kept, evicted = sched.ensure_decode_pages([a, b])
+    assert evicted == []                # nobody paid for the burst
+    assert a.spec_drafts == []          # the burst was shed instead
+    assert kept == [a, b]
+    assert a.pages == pa and b.pages == pb
+
+
+# ---------------------------------------------------------------------------
+# KV-rewind honesty: the lint on real and seeded taps
+# ---------------------------------------------------------------------------
+
+def test_spec_rewind_leak_rule_clean_on_real_trace(gpt):
+    """The real engine tap — with non-vacuous rewinds — satisfies the
+    spec-rewind-leak contract, and the cow/trash rules still hold on
+    verify-row write plans that cross page boundaries."""
+    from hetu_tpu.analysis.rules import AnalysisContext, run_rules
+    state, cfg, dstate, dcfg = gpt
+    eng = _make_engine(state, cfg, num_pages=24, page_size=4,
+                       max_batch=4, chunk_size=8,
+                       spec=SpecConfig(dstate, dcfg, k=6))
+    rng = np.random.RandomState(3)
+    reqs = [eng.add_request(
+        [int(t) for t in rng.randint(1, 90, size=7)], 12,
+        arrival_time=0.0) for _ in range(3)]
+    _drain(eng)
+    for r in reqs:
+        assert r.out_tokens == _solo(state, cfg, r.prompt, 12)
+    tap = list(eng.tap)
+    assert any(rec.get("kind") == "spec_rewind" for rec in tap), \
+        "no rewind in the trace: the rule run is vacuous"
+    ctx = AnalysisContext(
+        name="t_spec", serving={"pool": eng.pool, "tap": tap})
+    assert not run_rules(ctx, only=["spec-rewind-leak"])
+    assert not run_rules(ctx, only=["trash-page-write"])
+    assert not run_rules(ctx, only=["cow-page-write"])
+
+
+def test_spec_rewind_leak_rule_fires_once_per_seed():
+    """Seeded violation: a read past the rewound watermark before the
+    re-write fires exactly once; the exempt record, a boundary-exact
+    rewrite, and a kv_drop reset all stay silent."""
+    from hetu_tpu.analysis.rules import AnalysisContext, run_rules
+    tap = [
+        {"kind": "unified", "reads": [(7, 0, 8, 8)]},
+        {"kind": "spec_rewind", "req": 7, "valid_upto": 5,
+         "written_upto": 8},
+        # gap: resumes at 6 leaving stale position 5 in the window
+        {"kind": "unified", "reads": [(7, 6, 2, 8)]},
+    ]
+    fired = run_rules(AnalysisContext(name="t", serving={"tap": tap}),
+                      only=["spec-rewind-leak"])
+    assert len(fired) == 1
+    assert fired[0].severity == "error" and "req7" in fired[0].subject
+    assert "rejected-draft KV" in fired[0].message
+    assert fired[0].hint
+    # exemption: the offending record flagged rewind_exempt
+    tap_ex = [tap[0], tap[1], dict(tap[2], rewind_exempt=True)]
+    assert not run_rules(
+        AnalysisContext(name="t2", serving={"tap": tap_ex}),
+        only=["spec-rewind-leak"])
+    # clean: the next burst re-writes from the boundary exactly
+    tap_ok = [tap[0], tap[1],
+              {"kind": "unified", "reads": [(7, 5, 3, 8)]}]
+    assert not run_rules(
+        AnalysisContext(name="t3", serving={"tap": tap_ok}),
+        only=["spec-rewind-leak"])
+    # preemption (kv_drop) resets the watermark: re-prefill from 0
+    tap_drop = [tap[0], tap[1], {"kind": "kv_drop", "req": 7},
+                {"kind": "unified", "reads": [(7, 0, 4, 4)]}]
+    assert not run_rules(
+        AnalysisContext(name="t4", serving={"tap": tap_drop}),
+        only=["spec-rewind-leak"])
+
+
+# ---------------------------------------------------------------------------
+# metrics: counters, reset, cluster-merged exposition
+# ---------------------------------------------------------------------------
+
+def test_spec_metrics_reset_and_prometheus(gpt):
+    state, cfg, dstate, dcfg = gpt
+    eng = _make_engine(state, cfg, num_pages=16, page_size=8,
+                       max_batch=2, chunk_size=8,
+                       spec=SpecConfig(dstate, dcfg, k=3))
+    eng.add_request([5, 17, 2, 9], 8, arrival_time=0.0)
+    _drain(eng)
+    m = eng.metrics_summary()
+    assert m["spec_proposed"] > 0
+    assert 0.0 <= m["spec_accept_rate"] <= 1.0
+    assert m["accepted_per_step"] > 0
+    text = eng.metrics_text()
+    for name in ("spec_proposed", "spec_accepted", "spec_bonus_tokens"):
+        assert name in text
+    eng.reset_metrics()
+    m = eng.metrics_summary()
+    assert m["spec_proposed"] == 0 and m["spec_accepted"] == 0
+    assert m["spec_bonus_tokens"] == 0
+    assert m["spec_accept_rate"] == 0.0 and m["accepted_per_step"] == 0.0
+
+
+def test_spec_counters_in_cluster_merged_exposition(gpt):
+    """The PR 11 cluster plane passes spec straight through: counters
+    sum in metrics_summary and appear per replica in the merged
+    Prometheus exposition; reset zeroes the merged view too."""
+    from hetu_tpu.serving import EngineCluster
+    state, cfg, dstate, dcfg = gpt
+    clock = [0.0]
+    cl = EngineCluster(state, cfg, num_replicas=2, name="spec_cl_t",
+                       num_pages=16, page_size=8, max_batch=4,
+                       chunk_size=8, time_fn=lambda: clock[0],
+                       ttl=3600.0, spec=SpecConfig(dstate, dcfg, k=3))
+    try:
+        r1 = cl.add_request([5, 17, 2, 9, 1, 4, 8], max_new_tokens=6)
+        r2 = cl.add_request([3, 2, 1, 9], max_new_tokens=6)
+        guard = 0
+        while cl.has_work:
+            cl.step()
+            clock[0] += 1.0
+            guard += 1
+            assert guard < 200
+        for r, n in ((r1, 7), (r2, 4)):
+            assert r.out_tokens == _solo(state, cfg, r.prompt, 6)
+        ms = cl.metrics_summary()
+        assert ms["spec_proposed"] > 0
+        text = cl.metrics_text()
+        assert "spec_proposed" in text and 'replica="r0"' in text
+        for rep in cl.replicas:
+            rep.engine.reset_metrics()
+            # the engine-level view zeroes...
+            assert rep.engine.metrics_summary()["spec_proposed"] == 0
+        # ...the merged exposition now reports per-replica zeros...
+        for line in cl.metrics_text().splitlines():
+            if line.startswith("spec_proposed{"):
+                assert line.rstrip().endswith(" 0")
+        # ...and the CLUSTER sum banks the pre-reset epoch (PR 11's
+        # reset-robust contract: a replica reset never loses history)
+        assert cl.metrics_summary()["spec_proposed"] == \
+            ms["spec_proposed"]
+    finally:
+        cl.close()
