@@ -763,6 +763,17 @@ def bench_serving_microbench() -> dict:
     ``spec_temp0_bitwise`` (outputs bit-for-bit the non-speculative
     run's) and ``spec_beats_nonspec_tok_s``.
 
+    ISSUE 16 adds an **mla section**: the same geometry with a
+    low-rank kv projection converted to weight-absorbed latent KV
+    (``models.gpt.mla_state_from``), served from compressed latent
+    pages — full-head vs latent vs latent+int8 page quantization on
+    the same mixed trace.  Records KV bytes/token and bytes/req, max
+    concurrent 544-token requests at a fixed HBM budget, tok/s, TTFT
+    p50/p90, the logit max-abs-delta vs full-head, and the acceptance
+    booleans ``mla_kv_bytes_reduced`` / ``mla_more_concurrent_requests``
+    / ``mla_accuracy_within_tolerance`` /
+    ``mla_temp0_bitwise_vs_solo``.
+
     ISSUE 9 adds the **trace plane microbench**: tracer overhead on
     warm short replays (no tracer vs disabled SpanTracer vs tracing
     on, paired back-to-back rounds, median per-round delta; the
@@ -1058,6 +1069,124 @@ def bench_serving_microbench() -> dict:
         "      int(sp_m['host_logit_fetches']) == 0,\n"
         "}\n"
         "\n"
+        "# -- MLA compressed latent KV (ISSUE 16): the same geometry\n"
+        "# with a LOW-RANK kv projection (joint rank <= LAT), so the\n"
+        "# SVD re-factoring in mla_state_from is EXACT and the logit\n"
+        "# delta vs full-head is pure fp accumulation noise -- that is\n"
+        "# the documented tolerance below, not a model-quality claim.\n"
+        "# Learned positions so the int8 page-quant leg applies too.\n"
+        "# All three engines run the SAME mixed trace; temp-0 latent\n"
+        "# serving must be bitwise vs the latent solo generate().\n"
+        "from hetu_tpu.models.gpt import mla_state_from\n"
+        "from hetu_tpu.models.generate import (decode_step, _Params,\n"
+        "                                      _lm_head)\n"
+        "import jax.numpy as jnp\n"
+        "LAT, MLA_TOL = 64, 2e-4\n"
+        "cfg_fh = GPTConfig(vocab_size=V, hidden_size=H, num_layers=L,\n"
+        "                   num_heads=NH, num_kv_heads=NKV,\n"
+        "                   max_seq_len=2048, sp=False, dropout=0.0,\n"
+        "                   position='learned', norm='rmsnorm',\n"
+        "                   activation='silu', tie_embeddings=True)\n"
+        "state_fh = dict(state)\n"
+        "state_fh['wpe'] = w(2048, H)\n"
+        "qs = NH * hd\n"
+        "for i in range(L):\n"
+        "    u = (rng.randn(2 * NKV * hd, LAT) * 0.1).astype(np.float32)\n"
+        "    a = (rng.randn(LAT, H) * 0.2).astype(np.float32)\n"
+        "    qkv = state_fh[f'h{i}.attn.qkv.weight'].copy()\n"
+        "    qkv[qs:] = u @ a\n"
+        "    state_fh[f'h{i}.attn.qkv.weight'] = qkv\n"
+        "mstate, mcfg = mla_state_from(state_fh, cfg_fh,\n"
+        "                              kv_latent_dim=LAT)\n"
+        "# logit fidelity on a fixed probe batch, full-head vs absorbed\n"
+        "probe = jnp.asarray(rng.randint(1, V, size=(2, 128)), jnp.int32)\n"
+        "pf = _Params(state_fh, cfg_fh)\n"
+        "cch = [(jnp.zeros((2, 128, NKV, hd), jnp.float32),\n"
+        "        jnp.zeros((2, 128, NKV, hd), jnp.float32))\n"
+        "       for _ in range(L)]\n"
+        "_, _, hid_f = decode_step(cfg_fh, pf, probe, cch, 0, None,\n"
+        "                          None, return_hidden=True)\n"
+        "pm = _Params(mstate, mcfg)\n"
+        "mch = [(jnp.zeros((2, 128, 1, LAT), jnp.float32),\n"
+        "        jnp.zeros((2, 128, 1, 0), jnp.float32))\n"
+        "       for _ in range(L)]\n"
+        "_, _, hid_m = decode_step(mcfg, pm, probe, mch, 0, None, None,\n"
+        "                          return_hidden=True)\n"
+        "mla_delta = float(jnp.max(jnp.abs(\n"
+        "    _lm_head(pf, hid_f) - _lm_head(pm, hid_m))))\n"
+        "def mla_trace(st, cf, quant=None):\n"
+        "    e = Engine(st, cf, num_pages=24, page_size=128,\n"
+        "               max_batch=8, max_model_len=smax + new,\n"
+        "               chunk_size=128, prefill_rows=2,\n"
+        "               page_quant=quant)\n"
+        "    rs = [e.add_request(p, new, arrival_time=0.0)\n"
+        "          for p in prompts]\n"
+        "    e.run()                      # warm (compile)\n"
+        "    first = [list(r.out_tokens) for r in rs]\n"
+        "    wall = float('inf')\n"
+        "    for _ in range(3):\n"
+        "        e.reset_metrics()\n"
+        "        t0 = time.perf_counter()\n"
+        "        rs = [e.add_request(p, new, arrival_time=0.0)\n"
+        "              for p in prompts]\n"
+        "        e.run()\n"
+        "        wall = min(wall, time.perf_counter() - t0)\n"
+        "    outs = [list(r.out_tokens) for r in rs]\n"
+        "    assert outs == first         # replay (cache-warm) == cold\n"
+        "    pb = [r.peak_pages * e.pool.page_bytes for r in rs]\n"
+        "    return e, outs, wall, e.metrics_summary(), pb\n"
+        "fh_e, fh_out, fh_wall, fh_m, fh_b = mla_trace(state_fh, cfg_fh)\n"
+        "lt_e, lt_out, lt_wall, lt_m, lt_b = mla_trace(mstate, mcfg)\n"
+        "q8_e, q8_out, q8_wall, q8_m, q8_b = mla_trace(mstate, mcfg,\n"
+        "                                              quant='int8')\n"
+        "lt_solo = [np.asarray(generate(mstate, mcfg,\n"
+        "                               np.asarray([p], np.int32),\n"
+        "                               new))[0, len(p):].tolist()\n"
+        "           for p in prompts]\n"
+        "# concurrency at a FIXED HBM budget (the full-head pool's 24\n"
+        "# pages), analytic from shapes like every KV accounting here:\n"
+        "# smaller pages => more pages in budget => more 544-token\n"
+        "# (512 prompt + 32 new) requests resident at once\n"
+        "mla_budget = 24 * fh_e.pool.page_bytes\n"
+        "def mla_conc(e):\n"
+        "    pages = mla_budget // e.pool.page_bytes\n"
+        "    per = -(-(512 + new) // e.pool.page_size)\n"
+        "    return int(max(pages - 1, 0) // per)   # -1: trash page\n"
+        "def mla_leg(e, wall, m, pb):\n"
+        "    return {\n"
+        "      'kv_bytes_per_token': int(e.pool.kv_bytes_per_token),\n"
+        "      'page_bytes': int(e.pool.page_bytes),\n"
+        "      'kv_bytes_per_req_mean': int(np.mean(pb)),\n"
+        "      'max_concurrent_at_fixed_hbm': mla_conc(e),\n"
+        "      'tokens_per_sec': round(n_tok / wall, 1),\n"
+        "      'wall_s': round(wall, 2),\n"
+        "      'ttft_p50_ms': round(m['ttft']['p50'] * 1e3, 1),\n"
+        "      'ttft_p90_ms': round(m['ttft']['p90'] * 1e3, 1),\n"
+        "      'compile_count': int(m['compile_count']),\n"
+        "      'executable_calls': int(m['executable_calls']),\n"
+        "      'host_logit_fetches': int(m['host_logit_fetches'])}\n"
+        "mla = {\n"
+        "  'trace': {'prompt_lens': lens, 'max_new_tokens': new,\n"
+        "            'kv_latent_dim': LAT, 'rope_dim': 0,\n"
+        "            'witness': 'low-rank kv (joint rank <= latent '\n"
+        "                       'dim), so conversion is exact and the '\n"
+        "                       'logit delta is fp noise'},\n"
+        "  'full_head': mla_leg(fh_e, fh_wall, fh_m, fh_b),\n"
+        "  'latent': mla_leg(lt_e, lt_wall, lt_m, lt_b),\n"
+        "  'latent_int8': mla_leg(q8_e, q8_wall, q8_m, q8_b),\n"
+        "  'logit_max_abs_delta_vs_full_head': mla_delta,\n"
+        "  'logit_tolerance': MLA_TOL,\n"
+        "  # the ISSUE 16 acceptance gates, recorded as booleans\n"
+        "  'mla_kv_bytes_reduced':\n"
+        "      2 * lt_e.pool.kv_bytes_per_token\n"
+        "      <= fh_e.pool.kv_bytes_per_token,\n"
+        "  'mla_more_concurrent_requests':\n"
+        "      mla_conc(lt_e) >= 2 * mla_conc(fh_e),\n"
+        "  'mla_accuracy_within_tolerance': mla_delta <= MLA_TOL,\n"
+        "  'mla_temp0_bitwise_vs_solo': lt_out == lt_solo,\n"
+        "  'mla_matches_full_head_tokens': lt_out == fh_out,\n"
+        "}\n"
+        "\n"
         "e_cold, m_cold, wall_cold = shared_trace(False)\n"
         "e_hit, m_hit, wall_hit = shared_trace(True)\n"
         "# headline + prefix-cache numbers are all in the can: the obs\n"
@@ -1133,6 +1262,7 @@ def bench_serving_microbench() -> dict:
         "    'host_logit_fetches': int(m['host_logit_fetches'])},\n"
         "  'prefix_cache': shared,\n"
         "  'spec_decode': spec_decode,\n"
+        "  'mla': mla,\n"
         "  'obs': obs_res,\n"
         "}\n"
         "res['kv_bytes_ratio_dense_vs_paged'] = round(\n"

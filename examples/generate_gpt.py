@@ -227,6 +227,13 @@ def main():
                          "rate, temp-0 output stays bit-for-bit")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens proposed per verify burst")
+    ap.add_argument("--kv-latent-dim", type=int, default=0,
+                    help="convert the restored checkpoint to MLA "
+                         "compressed latent KV (DESIGN.md §21) before "
+                         "decoding/serving; pages shrink to one "
+                         "[latent_dim] stream per token.  Exact when "
+                         ">= the joint kv rank (<= hidden size); "
+                         "0 disables")
     ap.add_argument("--replicas", type=int, default=1,
                     help="with --serve: route the requests across N "
                          "engine replicas (serving.cluster, DESIGN.md "
@@ -279,6 +286,18 @@ def main():
         ts = load_checkpoint(model2, None, ckpt, verify_exempt=True)
         print(f"restored checkpoint at step {ts['step']}")
         state = {k: np.asarray(v) for k, v in model2.state_dict().items()}
+
+    if args.kv_latent_dim > 0:
+        # weight-absorbed MLA conversion (DESIGN.md §21): everything
+        # below — solo decode, the serving demo, the cluster demo —
+        # runs on compressed latent KV pages from here on
+        from hetu_tpu.models.gpt import mla_state_from
+        full = 2 * cfg.kv_heads * cfg.head_dim
+        state, cfg = mla_state_from(state, cfg,
+                                    kv_latent_dim=args.kv_latent_dim)
+        print(f"MLA conversion: {full} -> {args.kv_latent_dim} KV "
+              f"floats per token per layer "
+              f"({full / args.kv_latent_dim:.1f}x smaller pages)")
 
     prompt = np.array([[3, 7, 1, 12, 3, 7]], np.int32)
     out = np.asarray(models.generate(state, cfg, prompt, 10,

@@ -260,6 +260,36 @@ def build_gate_executables():
     assert eng.compile_count == 1, "the bucket grid came back"
     names += sorted(f"gate_serving/{k}" for k in eng._compiled)
 
+    # -- MLA latent serving: the SAME checkpoint converted to the
+    # weight-absorbed latent-KV schema (models.gpt.mla_state_from) on a
+    # latent-layout pool — the standing pool lints (trash-page-write,
+    # cow-page-write via the shared-header cache hit below) now audit
+    # compressed pages, and analysis/memory classifies the asymmetric
+    # latent k/v page shapes as kv-page operands ---------------------
+    from hetu_tpu.models.gpt import mla_state_from
+    mstate, mcfg = mla_state_from(state, scfg, kv_latent_dim=12)
+    mclock = [0.0]
+    meng = Engine(mstate, mcfg, num_pages=16, page_size=8, max_batch=4,
+                  chunk_size=4, name="gate_serving@mla",
+                  time_fn=lambda: mclock[0])
+    header = list(range(1, 10))          # one full cached page at ps=8
+    meng.add_request(header + [11, 12], max_new_tokens=4)
+    while meng.has_work:
+        meng.step()
+        mclock[0] += 1.0
+    meng.add_request(header + [21, 22], max_new_tokens=4)
+    while meng.has_work:
+        meng.step()
+        mclock[0] += 1.0
+    meng.pool.check_invariants(force=True)
+    assert meng.pool.is_latent, "MLA gate engine built a full-head pool"
+    assert meng.compile_count == 1, \
+        "the latent path retraced the unified executable"
+    assert meng.counters["prefix_cache_hits"].value >= 1, \
+        "MLA gate trace never hit the prefix cache — the cow-page " \
+        "lint would be vacuous over latent pages"
+    names.append("gate_serving@mla/unified")
+
     # -- speculative serving: the SAME model behind a spec-mode engine
     # (truncated 1-layer self-draft, k=3) — the unified executable
     # grows the on-device verify/accept head and registers under

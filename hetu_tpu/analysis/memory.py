@@ -389,12 +389,23 @@ def _leaf_bytes(leaf) -> int:
 
 
 def _kv_page_shapes(serving) -> set:
-    """Page-array shapes of the serving pool (kv-page classification)."""
+    """Page-array shapes of the serving pool (kv-page classification).
+
+    Read from the pool's live arrays (``page_array_shapes``), not its
+    constructor attrs: the MLA latent layout stores a compressed
+    ``[.., 1, latent_dim]`` stream (k) next to a rope/scale sidecar (v)
+    whose shapes differ from ``(num_pages, page_size, kv_heads,
+    head_dim)`` — and from each other."""
     shapes = set()
     pool = (serving or {}).get("pool")
     if pool is not None:
-        shapes.add((int(pool.num_pages), int(pool.page_size),
-                    int(pool.kv_heads), int(pool.head_dim)))
+        try:
+            k_shapes, v_shapes = pool.page_array_shapes()
+            for s in (*k_shapes, *v_shapes):
+                shapes.add(tuple(int(d) for d in s))
+        except AttributeError:      # foreign pool object: attr fallback
+            shapes.add((int(pool.num_pages), int(pool.page_size),
+                        int(pool.kv_heads), int(pool.head_dim)))
     return shapes
 
 

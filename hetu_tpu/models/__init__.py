@@ -6,13 +6,14 @@ from .gnn import GCN, DistGCN15D, GCNLayer, SparseGCNLayer, \
     normalize_adjacency
 from .gpt import (GPTConfig, GPTModel, GPTLMHeadModel, draft_config,
                   draft_state_from, llama_config, LLamaLMHeadModel,
-                  LLamaModel)
+                  LLamaModel, mla_config, mla_state_from)
 from .generate import generate
 from .gpt_pipeline import GPTPipelineModel, block_fn
 from .rnn import GRU, LSTM, RNN, RNNLanguageModel
 
 __all__ = ["GPTConfig", "GPTModel", "GPTLMHeadModel", "llama_config",
-           "draft_config", "draft_state_from",
+           "draft_config", "draft_state_from", "mla_config",
+           "mla_state_from",
            "LLamaLMHeadModel", "LLamaModel", "GPTPipelineModel", "block_fn",
            "BertConfig", "BertModel", "BertForPreTraining",
            "BertForSequenceClassification",

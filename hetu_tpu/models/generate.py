@@ -55,7 +55,9 @@ def _act(cfg: GPTConfig, h):
 
 
 def _rotary_tables(cfg: GPTConfig, max_len: int):
-    d = cfg.head_dim
+    # MLA rotates only the decoupled rope slice (width cfg.rope_dim);
+    # full-head rotates the whole head
+    d = cfg.rope_dim if cfg.is_mla else cfg.head_dim
     inv = 1.0 / (10000.0 ** (np.arange(0, d, 2, dtype=np.float32) / d))
     ang = np.outer(np.arange(max_len, dtype=np.float32), inv)
     emb = np.concatenate([ang, ang], axis=-1)
@@ -139,6 +141,66 @@ def _attn_step(cfg: GPTConfig, p: _Params, i: int, x, k_cache, v_cache,
     if ob is not None:
         out = out + ob
     return out, k_cache, v_cache
+
+
+def _mla_attn_step(cfg: GPTConfig, p: _Params, i: int, x, c_cache, r_cache,
+                   pos, cos, sin):
+    """MLA twin of :func:`_attn_step` over LATENT caches: ``c_cache``
+    [b, max_len, 1, d_c] holds the shared compressed KV stream,
+    ``r_cache`` [b, max_len, 1, d_r] the decoupled rotated key (width 0
+    for learned positions).  Weight absorption (FlashMLA-ETAP): scores
+    are ``(q_nope @ k_up) . c`` per query head and the attention output
+    stays latent until one ``v_up`` einsum per QUERY token — no cached
+    token is ever decompressed.  The serving unified step mirrors these
+    contractions exactly; that alignment is the temp-0 bitwise
+    contract."""
+    b, s_new, _ = x.shape
+    c = cfg
+    hd, nh = c.head_dim, c.num_heads
+    d_c, d_r = c.kv_latent_dim, c.rope_dim
+    q = x @ p.layer(i, "attn.q.weight").T
+    qb = p.layer(i, "attn.q.bias")
+    if qb is not None:
+        q = q + qb
+    q = q.reshape(b, s_new, nh, hd + d_r)
+    kv = x @ p.layer(i, "attn.kv_a.weight").T
+    kvb = p.layer(i, "attn.kv_a.bias")
+    if kvb is not None:
+        kv = kv + kvb
+    c_kv = kv[..., :d_c]                                  # [b, s, d_c]
+    k_up = p.layer(i, "attn.k_up.weight")                 # [nh, hd, d_c]
+    v_up = p.layer(i, "attn.v_up.weight")
+    q_abs = jnp.einsum("bshd,hdc->bshc", q[..., :hd].astype(jnp.float32),
+                       k_up.astype(jnp.float32))
+    c_cache = lax.dynamic_update_slice(
+        c_cache, c_kv[:, :, None, :].astype(c_cache.dtype), (0, pos, 0, 0))
+    if d_r:
+        idx = pos + jnp.arange(s_new)
+        q_rope = _rope(q[..., hd:], cos[idx], sin[idx])
+        k_rope = _rope(kv[..., d_c:][:, :, None, :], cos[idx], sin[idx])
+        r_cache = lax.dynamic_update_slice(
+            r_cache, k_rope.astype(r_cache.dtype), (0, pos, 0, 0))
+        q_cat = jnp.concatenate([q_abs, q_rope.astype(jnp.float32)], -1)
+        k_cat = jnp.concatenate([c_cache, r_cache], -1)[:, :, 0]
+    else:
+        q_cat, k_cat = q_abs, c_cache[:, :, 0]            # [b, L, d_c]
+    L = c_cache.shape[1]
+    scores = jnp.einsum("bshc,bkc->bhsk", q_cat,
+                        k_cat.astype(jnp.float32)) / math.sqrt(hd + d_r)
+    kpos = jnp.arange(L)[None, None, None, :]
+    qpos = (pos + jnp.arange(s_new))[None, None, :, None]
+    scores = jnp.where(kpos <= qpos, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o_lat = jnp.einsum("bhsk,bkc->bshc", probs,
+                       c_cache[:, :, 0].astype(jnp.float32))
+    attn = jnp.einsum("bshc,hdc->bshd", o_lat,
+                      v_up.astype(jnp.float32)).astype(x.dtype)
+    attn = attn.reshape(b, s_new, nh * hd)
+    out = attn @ p.layer(i, "attn.out.weight").T
+    ob = p.layer(i, "attn.out.bias")
+    if ob is not None:
+        out = out + ob
+    return out, c_cache, r_cache
 
 
 def _moe_params(p: _Params, i: int):
@@ -239,8 +301,9 @@ def _forward(cfg: GPTConfig, p: _Params, ids, caches, pos, cos, sin,
         k_cache, v_cache = caches[i]
         h = _norm_apply(c, p.layer(i, "ln_1.weight"),
                         p.layer(i, "ln_1.bias"), x)
-        a, k_cache, v_cache = _attn_step(c, p, i, h, k_cache, v_cache,
-                                         pos, cos, sin)
+        step = _mla_attn_step if c.is_mla else _attn_step
+        a, k_cache, v_cache = step(c, p, i, h, k_cache, v_cache,
+                                   pos, cos, sin)
         x = x + a
         h = _norm_apply(c, p.layer(i, "ln_2.weight"),
                         p.layer(i, "ln_2.bias"), x)
@@ -337,8 +400,14 @@ def _build_decode_fn(cfg: GPTConfig, b: int, s0: int, max_new_tokens: int,
     def run(params, prompt_ids, key0):
         p = _Params.__new__(_Params)
         p.s, p.cfg = params, cfg
-        caches = [(jnp.zeros((b, max_len, cfg.kv_heads, cfg.head_dim), cdt),
-                   jnp.zeros((b, max_len, cfg.kv_heads, cfg.head_dim), cdt))
+        if cfg.is_mla:
+            # one shared latent stream + (optional) decoupled rope key —
+            # mirrors the paged pool's latent k/v page shapes
+            shapes = ((b, max_len, 1, cfg.kv_latent_dim),
+                      (b, max_len, 1, cfg.rope_dim))
+        else:
+            shapes = ((b, max_len, cfg.kv_heads, cfg.head_dim),) * 2
+        caches = [(jnp.zeros(shapes[0], cdt), jnp.zeros(shapes[1], cdt))
                   for _ in range(cfg.num_layers)]
         logits, cs = decode_step(cfg, p, prompt_ids, caches, 0, cos, sin)
         key, sub = jax.random.split(key0)

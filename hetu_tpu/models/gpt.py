@@ -60,6 +60,14 @@ class GPTConfig:
     moe_every: int = 1
     moe_aux_coef: float = 0.01
     ep_axis: Optional[str] = None   # expert-parallel mesh axis
+    # MLA (multi-head latent attention, FlashMLA-ETAP arxiv 2506.01969):
+    # when set, the decode/serving stack stores ONE [T, kv_latent_dim]
+    # compressed KV stream per layer instead of [T, kv_heads, head_dim]
+    # k + v, and attention runs weight-absorbed against the latent.
+    # kv_rope_dim is the decoupled-RoPE key width (rotary configs only;
+    # None -> head_dim); learned-position configs carry no rope stream.
+    kv_latent_dim: Optional[int] = None
+    kv_rope_dim: Optional[int] = None
 
     def __post_init__(self):
         assert self.hidden_size % self.num_heads == 0, \
@@ -67,6 +75,15 @@ class GPTConfig:
         kv = self.num_kv_heads or self.num_heads
         assert self.num_heads % kv == 0, \
             f"num_heads {self.num_heads} not divisible by kv_heads {kv}"
+        if self.kv_latent_dim is not None:
+            assert self.kv_latent_dim >= 1, \
+                f"kv_latent_dim must be >= 1, got {self.kv_latent_dim}"
+            if self.position == "rotary":
+                r = self.rope_dim
+                assert r > 0 and r % 2 == 0, \
+                    f"MLA decoupled rope dim must be positive even, got {r}"
+        elif self.kv_rope_dim is not None:
+            raise ValueError("kv_rope_dim requires kv_latent_dim (MLA mode)")
 
     @property
     def head_dim(self) -> int:
@@ -75,6 +92,19 @@ class GPTConfig:
     @property
     def kv_heads(self) -> int:
         return self.num_kv_heads or self.num_heads
+
+    @property
+    def is_mla(self) -> bool:
+        return self.kv_latent_dim is not None
+
+    @property
+    def rope_dim(self) -> int:
+        """Decoupled-RoPE key width d_r: 0 for non-MLA and for
+        learned-position MLA (no positional content in the cache)."""
+        if self.kv_latent_dim is None or self.position != "rotary":
+            return 0
+        return self.kv_rope_dim if self.kv_rope_dim is not None \
+            else self.head_dim
 
     def is_moe_layer(self, layer_idx: int) -> bool:
         """Single source of truth for MoE placement — used by both the
@@ -132,6 +162,97 @@ def draft_state_from(state, cfg: GPTConfig, num_layers: int):
     return keep, dcfg
 
 
+def mla_config(cfg: GPTConfig, kv_latent_dim: int,
+               kv_rope_dim: Optional[int] = None) -> GPTConfig:
+    """The MLA twin of a full-head config: identical everywhere except
+    the cache layout fields (decode-cache keys treat these as part of
+    the config identity, so full-head and latent executables never
+    collide)."""
+    import dataclasses
+    return dataclasses.replace(cfg, kv_latent_dim=int(kv_latent_dim),
+                               kv_rope_dim=kv_rope_dim)
+
+
+def mla_state_from(state, cfg: GPTConfig, kv_latent_dim: int,
+                   kv_rope_dim: Optional[int] = None, seed: int = 0):
+    """Convert a full-head checkpoint into an MLA ``(state, config)``.
+
+    Per layer, the fused ``attn.qkv`` projection is split and re-factored
+    into the weight-absorbed MLA schema:
+
+    - ``attn.q.weight``  [nh*(hd+d_r), H] — per-head ``[q_nope | q_rope]``
+      rows; the nope rows are the source query projection verbatim.
+    - ``attn.kv_a.weight`` [d_c+d_r, H] — shared latent down-projection
+      (plus the decoupled rope key rows when d_r > 0).
+    - ``attn.k_up.weight`` / ``attn.v_up.weight`` [nh, hd, d_c] — the
+      up-projections that decode ABSORBS into q / out (FlashMLA-ETAP):
+      ``score_h = (q_h @ k_up_h) . c`` and ``out_h = (probs @ C) @
+      v_up_h.T``, so no cached token is ever decompressed.
+
+    The factorization is the truncated SVD of the stacked per-head
+    ``[W_k; W_v]`` — EXACT (up to fp rounding) whenever that stack has
+    rank <= d_c, which is how the bench accuracy gate builds its
+    equivalence witness.  Learned-position configs convert losslessly;
+    rotary sources are approximate by construction (full-head rope
+    content cannot live in a position-free latent — the decoupled rope
+    rows are freshly initialized) and are gated by measured accuracy,
+    not bitwise claims.  K/V projection biases are least-squares-folded
+    into ``kv_a.bias`` (exact when they lie in the latent column span).
+    """
+    from .generate import _Params
+    d_c = int(kv_latent_dim)
+    ncfg = mla_config(cfg, d_c, kv_rope_dim)
+    d_r = ncfg.rope_dim
+    nh, kvh, hd, H = (cfg.num_heads, cfg.kv_heads, cfg.head_dim,
+                      cfg.hidden_size)
+    g = nh // kvh
+    q_size, kv_size = nh * hd, kvh * hd
+    rng = np.random.RandomState(seed)
+    flat = {_Params._norm(k): v for k, v in state.items()}
+    out = {k: v for k, v in flat.items()
+           if ".attn.qkv." not in k}
+    for i in range(cfg.num_layers):
+        w = np.asarray(flat[f"h{i}.attn.qkv.weight"], np.float32)
+        b = flat.get(f"h{i}.attn.qkv.bias")
+        b = None if b is None else np.asarray(b, np.float32)
+        wq, wk, wv = (w[:q_size], w[q_size:q_size + kv_size],
+                      w[q_size + kv_size:])
+        # -- latent factorization: [W_k; W_v] = U @ (S Vt), keep d_c --
+        m = np.concatenate([wk, wv], axis=0)          # [2*kv_size, H]
+        u, s, vt = np.linalg.svd(m, full_matrices=False)
+        r = min(d_c, s.shape[0])
+        kv_a = np.zeros((d_c + d_r, H), np.float32)
+        kv_a[:r] = s[:r, None] * vt[:r]
+        up = np.zeros((2 * kv_size, d_c), np.float32)
+        up[:, :r] = u[:, :r]
+        k_up = up[:kv_size].reshape(kvh, hd, d_c)
+        v_up = up[kv_size:].reshape(kvh, hd, d_c)
+        # GQA: expand kv-head up-projections to query heads so decode
+        # absorbs per query head against the single shared latent
+        k_up = np.repeat(k_up, g, axis=0)
+        v_up = np.repeat(v_up, g, axis=0)
+        # -- query: source nope rows + fresh decoupled-rope rows --
+        q_w = np.zeros((nh, hd + d_r, H), np.float32)
+        q_w[:, :hd] = wq.reshape(nh, hd, H)
+        if d_r:
+            q_w[:, hd:] = rng.normal(
+                0.0, cfg.init_std, (nh, d_r, H)).astype(np.float32)
+            kv_a[d_c:] = rng.normal(
+                0.0, cfg.init_std, (d_r, H)).astype(np.float32)
+        out[f"h{i}.attn.q.weight"] = q_w.reshape(nh * (hd + d_r), H)
+        out[f"h{i}.attn.kv_a.weight"] = kv_a
+        out[f"h{i}.attn.k_up.weight"] = k_up
+        out[f"h{i}.attn.v_up.weight"] = v_up
+        if b is not None:
+            q_b = np.zeros((nh, hd + d_r), np.float32)
+            q_b[:, :hd] = b[:q_size].reshape(nh, hd)
+            out[f"h{i}.attn.q.bias"] = q_b.reshape(-1)
+            kv_b = np.zeros((d_c + d_r,), np.float32)
+            kv_b[:d_c] = up.T @ b[q_size:]   # least-squares fold
+            out[f"h{i}.attn.kv_a.bias"] = kv_b
+    return out, ncfg
+
+
 def _norm(config: GPTConfig, name: str):
     if config.norm == "rmsnorm":
         return ParallelRMSNorm(config.hidden_size, sp=config.sp,
@@ -152,6 +273,10 @@ class ParallelAttentionBlock(Module):
         super().__init__()
         self.config = config
         c = config
+        if c.kv_latent_dim is not None:
+            raise NotImplementedError(
+                "MLA (kv_latent_dim) is a decode/serving cache layout; "
+                "train full-head and convert with models.gpt.mla_state_from")
         q_size = c.num_heads * c.head_dim
         kv_size = c.kv_heads * c.head_dim
         self.qkv = ColumnParallelLinear(

@@ -77,6 +77,50 @@ def dequantize_4bit(packed, absmax, shape, quant_type: str = "nf4",
     return vals.reshape(-1)[:n].reshape(shape).astype(dtype)
 
 
+def quantize_rows(x, quant: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-ROW absmax quantize of ``[..., d]`` vectors — blockwise
+    quantization with ``blocksize == d`` and the block axis kept in
+    place, so a paged KV pool can store one scale per cached token
+    (``serving`` latent-page quantization).  Bitwise identical to the
+    flat :func:`quantize_int8` / :func:`quantize_4bit` math.
+
+    Returns ``(codes, absmax)``: codes are int8 ``[..., d]`` for
+    ``"int8"`` or packed uint8 ``[..., d//2]`` for ``"nf4"`` (d must be
+    even); absmax is float32 ``[..., 1]``."""
+    x = jnp.asarray(x, jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax, 1.0)
+    if quant == "int8":
+        q = jnp.clip(jnp.round(x / scale * 127.0), -127, 127)
+        return q.astype(jnp.int8), absmax
+    if quant in ("nf4", "fp4"):
+        if x.shape[-1] % 2:
+            raise ValueError(f"4-bit rows need even width, got "
+                             f"{x.shape[-1]}")
+        code = jnp.asarray(_CODES[quant])
+        idx = jnp.argmin(jnp.abs((x / scale)[..., None] - code),
+                         axis=-1).astype(jnp.uint8)
+        packed = (idx[..., 0::2] << 4) | idx[..., 1::2]
+        return packed, absmax
+    raise ValueError(f"unknown row quant {quant!r}")
+
+
+def dequantize_rows(codes, absmax, quant: str, d: int,
+                    dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of :func:`quantize_rows`: codes ``[..., w]`` + absmax
+    ``[..., 1]`` -> ``[..., d]``."""
+    scale = jnp.where(absmax > 0, absmax, 1.0).astype(jnp.float32)
+    if quant == "int8":
+        return (codes.astype(jnp.float32) / 127.0 * scale).astype(dtype)
+    if quant in ("nf4", "fp4"):
+        code = jnp.asarray(_CODES[quant])
+        hi = (codes >> 4).astype(jnp.int32)
+        lo = (codes & 0xF).astype(jnp.int32)
+        idx = jnp.stack([hi, lo], axis=-1).reshape(*codes.shape[:-1], d)
+        return (code[idx] * scale).astype(dtype)
+    raise ValueError(f"unknown row quant {quant!r}")
+
+
 def quantize_int8(x, blocksize: int = 256
                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Blockwise symmetric int8 absmax quantize -> (int8 codes, absmax)."""

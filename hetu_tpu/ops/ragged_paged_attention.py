@@ -282,6 +282,323 @@ def ragged_paged_attention(q: jax.Array, k_pages: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# MLA latent path (FlashMLA-ETAP, arxiv 2506.01969; DESIGN.md §21)
+# ---------------------------------------------------------------------------
+#
+# The latent variants run attention directly against ONE compressed KV
+# stream per layer: ``c_pages [P, ps, 1, d_c]`` (or int8/packed-nf4
+# codes plus a per-token absmax sidecar) and an optional decoupled-rope
+# key stream ``r_pages [P, ps, 1, d_r]``.  The query side arrives
+# ALREADY weight-absorbed — ``q [*, nh, d_c + d_r]`` is
+# ``concat(q_nope @ k_up, rope(q_rope))`` per head — so scores are MQA
+# dot products in latent space and the attention output STAYS latent
+# (``[*, nh, d_c]``); the caller applies the ``v_up`` fold per query
+# token.  No cached token is ever decompressed.
+
+
+def _dequant_latent(codes, scales, quant, latent_dim):
+    """fp32 view of a gathered latent window: identity cast when
+    ``quant`` is None, else per-token absmax dequant (codes ``[..., w]``
+    + scales ``[..., 1]`` -> ``[..., latent_dim]``)."""
+    if quant is None:
+        return codes.astype(jnp.float32)
+    from .quantization import dequantize_rows
+    return dequantize_rows(codes, scales, quant, latent_dim)
+
+
+def _check_latent_shapes(q, c_pages, r_pages, quant, latent_dim):
+    nh, dq = q.shape[-2], q.shape[-1]
+    p_, ps, one, wc = c_pages.shape
+    if one != 1:
+        raise ValueError(f"latent c_pages carry ONE shared stream, got "
+                         f"{c_pages.shape}")
+    d_c = int(latent_dim) if latent_dim is not None else wc
+    if quant == "nf4":
+        if wc * 2 != d_c:
+            raise ValueError(f"nf4 codes width {wc} != latent_dim/2 "
+                             f"({d_c})")
+    elif wc != d_c:
+        raise ValueError(f"c_pages width {wc} != latent_dim {d_c}")
+    d_r = 0
+    if r_pages is not None and r_pages.shape[-1] > 0:
+        if r_pages.shape[:2] != (p_, ps) or r_pages.shape[2] != 1:
+            raise ValueError(f"r_pages {r_pages.shape} incompatible with "
+                             f"c_pages {c_pages.shape}")
+        d_r = r_pages.shape[-1]
+    if dq != d_c + d_r:
+        raise ValueError(f"absorbed q width {dq} != d_c + d_r "
+                         f"({d_c}+{d_r})")
+    return nh, ps, d_c, d_r
+
+
+def latent_paged_attention_reference(q: jax.Array, c_pages: jax.Array,
+                                     r_pages: Optional[jax.Array],
+                                     page_tables: jax.Array,
+                                     seq_lens: jax.Array, *,
+                                     softmax_scale: float,
+                                     scale_pages: Optional[jax.Array] = None,
+                                     quant: Optional[str] = None,
+                                     latent_dim: Optional[int] = None
+                                     ) -> jax.Array:
+    """Decode-slot oracle over latent pages: absorbed ``q [B, nh,
+    d_c+d_r]`` (one token per request), ``seq_lens`` counting the token
+    just written -> latent output ``[B, nh, d_c]``.  Mirrors
+    ``paged_attention_reference``'s gather + ``-inf`` masking so the
+    serving step stays bitwise vs the solo MLA oracle."""
+    nh, ps, d_c, d_r = _check_latent_shapes(q, c_pages, r_pages, quant,
+                                            latent_dim)
+    b = q.shape[0]
+    maxp = page_tables.shape[1]
+    kk = maxp * ps
+    with jax.named_scope("latent_paged_attention"):
+        c = c_pages[page_tables].reshape(b, kk, c_pages.shape[-1])
+        sc = None if scale_pages is None else \
+            scale_pages[page_tables].reshape(b, kk, 1)
+        cd = _dequant_latent(c, sc, quant, d_c)        # [B, kk, d_c]
+        if d_r:
+            r = r_pages[page_tables].reshape(b, kk, d_r)
+            k = jnp.concatenate([cd, r.astype(jnp.float32)], -1)
+        else:
+            k = cd
+        s = jnp.einsum("bhc,bkc->bhk", q.astype(jnp.float32),
+                       k) * softmax_scale
+        valid = (jnp.arange(kk)[None] < seq_lens[:, None])[:, None, :]
+        s = jnp.where(valid, s, -jnp.inf)
+        pr = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhk,bkc->bhc", pr, cd)      # latent, fp32
+
+
+def latent_ragged_paged_attention_reference(
+        q: jax.Array, c_pages: jax.Array, r_pages: Optional[jax.Array],
+        q_lens: jax.Array, cu_q: jax.Array, page_tables: jax.Array,
+        ctx_lens: jax.Array, *, max_q: int, softmax_scale: float,
+        scale_pages: Optional[jax.Array] = None,
+        quant: Optional[str] = None,
+        latent_dim: Optional[int] = None) -> jax.Array:
+    """Latent twin of :func:`ragged_paged_attention_reference` (same
+    ragged contract, ``DEFAULT_MASK_VALUE`` masking — the kernel
+    oracle): absorbed ``q [T, nh, d_c+d_r]`` -> latent ``[T, nh,
+    d_c]``."""
+    nh, ps, d_c, d_r = _check_latent_shapes(q, c_pages, r_pages, quant,
+                                            latent_dim)
+    t = q.shape[0]
+    s_rows = q_lens.shape[0]
+    maxp = page_tables.shape[1]
+    kk = maxp * ps
+    kv_pos = jnp.arange(kk)
+    qp = jnp.pad(q, ((0, max_q), (0, 0), (0, 0)))
+    out = jnp.zeros((t + max_q, nh, d_c), jnp.float32)
+    with jax.named_scope("latent_ragged_paged_attention"):
+        for i in range(s_rows):
+            start, qlen, ctx = cu_q[i], q_lens[i], ctx_lens[i]
+            qi = lax.dynamic_slice(
+                qp, (start, 0, 0),
+                (max_q, nh, d_c + d_r)).astype(jnp.float32)
+            c = c_pages[page_tables[i]].reshape(kk, c_pages.shape[-1])
+            sc = None if scale_pages is None else \
+                scale_pages[page_tables[i]].reshape(kk, 1)
+            cd = _dequant_latent(c, sc, quant, d_c)    # [kk, d_c]
+            if d_r:
+                r = r_pages[page_tables[i]].reshape(kk, d_r)
+                k = jnp.concatenate([cd, r.astype(jnp.float32)], -1)
+            else:
+                k = cd
+            s = jnp.einsum("qhc,kc->qhk", qi, k) * softmax_scale
+            qpos = (ctx - qlen) + jnp.arange(max_q)
+            valid = kv_pos[None, :] <= qpos[:, None]
+            s = jnp.where(valid[:, None, :], s, DEFAULT_MASK_VALUE)
+            pr = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("qhk,kc->qhc", pr, cd)
+            rowv = jnp.arange(max_q) < qlen
+            cur = lax.dynamic_slice(out, (start, 0, 0), (max_q, nh, d_c))
+            out = lax.dynamic_update_slice(
+                out, jnp.where(rowv[:, None, None], o, cur),
+                (start, 0, 0))
+    return out[:t]
+
+
+def _make_latent_kernel(scale: float, ps: int, maxp: int, max_q: int,
+                        gp: int, d_c: int, quant: Optional[str],
+                        has_rope: bool, has_scales: bool,
+                        has_code: bool = False):
+    """Latent twin of :func:`_ragged_kernel`: grid ``(S, maxp)`` (one
+    shared KV stream, so no kv-head grid dim), q/out blocks span the
+    padded token axis, c/r/scale blocks are one physical page each via
+    the prefetched page table; online softmax in VMEM scratch."""
+
+    def kernel(ql_ref, cu_ref, pt_ref, cl_ref, q_ref, c_ref, *rest):
+        n = 0
+        r_ref = rest[n] if has_rope else None
+        n += int(has_rope)
+        s_ref = rest[n] if has_scales else None
+        n += int(has_scales)
+        code_ref = rest[n] if has_code else None
+        n += int(has_code)
+        o_ref, m_scr, l_scr, acc_scr = rest[n:n + 4]
+        i = pl.program_id(0)
+        p = pl.program_id(1)
+        qlen = ql_ref[i]
+        start = cu_ref[i]
+        ctx = cl_ref[i]
+        mqg = max_q * gp
+
+        @pl.when(p == 0)
+        def _init():
+            m_scr[...] = jnp.full_like(m_scr, DEFAULT_MASK_VALUE)
+            l_scr[...] = jnp.zeros_like(l_scr)
+            acc_scr[...] = jnp.zeros_like(acc_scr)
+
+        @pl.when(jnp.logical_and(qlen > 0, p * ps < ctx))
+        def _page():
+            q = q_ref[pl.ds(start, max_q)].astype(jnp.float32)
+            q2 = q.reshape(mqg, q.shape[-1])           # [mqg, d_c+d_r]
+            raw = c_ref[0, :, 0, :]                    # [ps, w]
+            if quant is None:
+                c = raw.astype(jnp.float32)
+            else:
+                sc = s_ref[0, :, 0, :].astype(jnp.float32)     # [ps, 1]
+                sc = jnp.where(sc > 0, sc, 1.0)
+                if quant == "int8":
+                    c = raw.astype(jnp.float32) / 127.0 * sc
+                else:                                  # packed 4-bit
+                    hi = (raw >> 4).astype(jnp.int32)
+                    lo = (raw & 0xF).astype(jnp.int32)
+                    idx = jnp.stack([hi, lo], axis=-1).reshape(ps, d_c)
+                    c = code_ref[...][idx] * sc
+            if has_rope:
+                k = jnp.concatenate(
+                    [c, r_ref[0, :, 0, :].astype(jnp.float32)], -1)
+            else:
+                k = c
+            s = lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+            row_q = lax.broadcasted_iota(jnp.int32, (mqg, ps), 0) // gp
+            cols = p * ps + lax.broadcasted_iota(jnp.int32, (mqg, ps), 1)
+            qpos = (ctx - qlen) + row_q
+            s = jnp.where(cols <= qpos, s, DEFAULT_MASK_VALUE)
+            m_prev = m_scr[:, 0]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+            alpha = jnp.exp(m_prev - m_cur)
+            pexp = jnp.exp(s - m_cur[:, None])
+            l_cur = l_scr[:, 0] * alpha + jnp.sum(pexp, axis=1)
+            acc_scr[...] = acc_scr[...] * alpha[:, None] + lax.dot_general(
+                pexp, c, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[...] = jnp.broadcast_to(m_cur[:, None], m_scr.shape)
+            l_scr[...] = jnp.broadcast_to(l_cur[:, None], l_scr.shape)
+
+        @pl.when(p == maxp - 1)
+        def _finalize():
+            l = l_scr[:, 0]
+            l = jnp.where(l == 0.0, 1.0, l)
+            o = (acc_scr[...] / l[:, None]).reshape(max_q, gp, d_c)
+            prev = o_ref[pl.ds(start, max_q)]
+            rowv = lax.broadcasted_iota(jnp.int32, (max_q, 1, 1), 0) < qlen
+            o_ref[pl.ds(start, max_q)] = jnp.where(
+                rowv, o.astype(o_ref.dtype), prev)
+
+    return kernel
+
+
+def latent_ragged_paged_attention_pallas(
+        q: jax.Array, c_pages: jax.Array, r_pages: Optional[jax.Array],
+        q_lens: jax.Array, cu_q: jax.Array, page_tables: jax.Array,
+        ctx_lens: jax.Array, *, max_q: int, softmax_scale: float,
+        scale_pages: Optional[jax.Array] = None,
+        quant: Optional[str] = None, latent_dim: Optional[int] = None,
+        interpret: Optional[bool] = None) -> jax.Array:
+    """Pallas latent ragged paged attention (same contract as
+    :func:`latent_ragged_paged_attention_reference`)."""
+    nh, ps, d_c, d_r = _check_latent_shapes(q, c_pages, r_pages, quant,
+                                            latent_dim)
+    t = q.shape[0]
+    s_rows = q_lens.shape[0]
+    maxp = page_tables.shape[1]
+    if interpret is None:
+        interpret = not _on_tpu()
+    gp = max(SUBLANES, ((nh + SUBLANES - 1) // SUBLANES) * SUBLANES)
+    t_pad = t + max_q
+    qg = jnp.pad(q, ((0, max_q), (0, gp - nh), (0, 0)))
+    has_rope, has_scales = d_r > 0, scale_pages is not None
+    if quant is not None and not has_scales:
+        raise ValueError("quantized latent pages need scale_pages")
+    has_code = quant in ("nf4", "fp4")
+    kernel = _make_latent_kernel(float(softmax_scale), ps, maxp,
+                                 int(max_q), gp, d_c, quant, has_rope,
+                                 has_scales, has_code)
+    in_specs = [
+        pl.BlockSpec((t_pad, gp, d_c + d_r),
+                     lambda i, p, ql, cu, pt, cl: (0, 0, 0)),
+        pl.BlockSpec((1, ps, 1, c_pages.shape[-1]),
+                     lambda i, p, ql, cu, pt, cl: (pt[i, p], 0, 0, 0)),
+    ]
+    operands = [qg, c_pages]
+    if has_rope:
+        in_specs.append(pl.BlockSpec(
+            (1, ps, 1, d_r),
+            lambda i, p, ql, cu, pt, cl: (pt[i, p], 0, 0, 0)))
+        operands.append(r_pages)
+    if has_scales:
+        in_specs.append(pl.BlockSpec(
+            (1, ps, 1, 1),
+            lambda i, p, ql, cu, pt, cl: (pt[i, p], 0, 0, 0)))
+        operands.append(scale_pages)
+    if has_code:
+        from .quantization import _CODES
+        in_specs.append(pl.BlockSpec(
+            (16,), lambda i, p, ql, cu, pt, cl: (0,)))
+        operands.append(jnp.asarray(_CODES[quant]))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(s_rows, maxp),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((t_pad, gp, d_c),
+                               lambda i, p, ql, cu, pt, cl: (0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((max_q * gp, LANES), jnp.float32),
+            pltpu.VMEM((max_q * gp, LANES), jnp.float32),
+            pltpu.VMEM((max_q * gp, d_c), jnp.float32),
+        ],
+    )
+    with jax.named_scope("latent_ragged_paged_attention"):
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((t_pad, gp, d_c), jnp.float32),
+            interpret=interpret,
+        )(q_lens.astype(jnp.int32), cu_q.astype(jnp.int32),
+          page_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
+          *operands)
+    return out[:t, :nh, :]
+
+
+def latent_ragged_paged_attention(
+        q: jax.Array, c_pages: jax.Array, r_pages: Optional[jax.Array],
+        q_lens: jax.Array, cu_q: jax.Array, page_tables: jax.Array,
+        ctx_lens: jax.Array, *, max_q: int, softmax_scale: float,
+        scale_pages: Optional[jax.Array] = None,
+        quant: Optional[str] = None, latent_dim: Optional[int] = None,
+        use_kernel: Optional[bool] = None) -> jax.Array:
+    """Dispatching entry point for the latent path (kernel on TPU,
+    gather-dense oracle elsewhere)."""
+    if use_kernel is None:
+        use_kernel = _on_tpu()
+    if use_kernel:
+        try:
+            return latent_ragged_paged_attention_pallas(
+                q, c_pages, r_pages, q_lens, cu_q, page_tables, ctx_lens,
+                max_q=max_q, softmax_scale=softmax_scale,
+                scale_pages=scale_pages, quant=quant,
+                latent_dim=latent_dim)
+        except Exception:
+            pass
+    return latent_ragged_paged_attention_reference(
+        q, c_pages, r_pages, q_lens, cu_q, page_tables, ctx_lens,
+        max_q=max_q, softmax_scale=softmax_scale, scale_pages=scale_pages,
+        quant=quant, latent_dim=latent_dim)
+
+
+# ---------------------------------------------------------------------------
 # verify-row sampling head (speculative decoding, DESIGN.md §20)
 # ---------------------------------------------------------------------------
 #

@@ -28,7 +28,7 @@ request landed where it did next to the per-replica engine rows.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -54,12 +54,15 @@ def match_pages_from_hashes(hashes: Sequence[int],
 
 
 def digest_match_pages(tokens: Sequence[int], page_size: int,
-                       digest: Dict[int, int]) -> int:
+                       digest: Dict[int, int],
+                       layout: Sequence[int] = ()) -> int:
     """:func:`match_pages_from_hashes` over freshly-hashed ``tokens``
     (the router hashes once per placement and probes every replica
-    with the same list)."""
-    return match_pages_from_hashes(token_chain_hashes(tokens, page_size),
-                                   digest)
+    with the same list).  ``layout`` must be the replica pool's
+    ``layout_tag`` — digests are ROOT-salted by layout, so unsalted
+    hashes never match a live digest."""
+    return match_pages_from_hashes(
+        token_chain_hashes(tokens, page_size, layout=layout), digest)
 
 
 class Router:
@@ -108,11 +111,22 @@ class Router:
             reason = "random"
         else:
             if self.policy == "prefix":
-                page_size = cands[0].engine.pool.page_size
-                hashes = token_chain_hashes(creq.prompt, page_size)
+                # hash once per distinct (page_size, layout): a mixed
+                # fleet — latent next to full-head replicas, or mixed
+                # quantization — probes each replica with hashes salted
+                # for ITS layout, so a cross-layout digest can never
+                # produce a phantom prefix hit
+                groups: Dict[Tuple[Any, ...], List[Any]] = {}
                 for r in cands:
-                    matches[r.idx] = match_pages_from_hashes(
-                        hashes, r.digest())
+                    pool = r.engine.pool
+                    groups.setdefault(
+                        (pool.page_size, pool.layout_tag), []).append(r)
+                for (page_size, tag), rs in groups.items():
+                    hashes = token_chain_hashes(creq.prompt, page_size,
+                                                layout=tag)
+                    for r in rs:
+                        matches[r.idx] = match_pages_from_hashes(
+                            hashes, r.digest())
             best_depth = max(matches.values()) if matches else 0
             if best_depth > 0:
                 top = [r for r in cands if matches[r.idx] == best_depth]

@@ -92,7 +92,11 @@ class LocalPageTransport(PageTransport):
         k = [np.asarray(p[idx]) for p in src_pool.k_pages]
         v = [np.asarray(p[idx]) for p in src_pool.v_pages]
         return {"k": k, "v": v, "n_pages": len(idx),
-                "payload_bytes": len(idx) * src_pool.page_bytes}
+                # page_bytes derives from kv_pool.page_shape_bytes, so
+                # a latent/quantized pool's smaller pages are priced at
+                # their true wire size automatically
+                "payload_bytes": len(idx) * src_pool.page_bytes,
+                "layout": src_pool.layout_tag}
 
     def inject(self, dst_pool: PagedKVPool, staged: Dict[str, Any],
                dst_pages: Sequence[int], src_replica: int = -1,
@@ -111,6 +115,16 @@ class LocalPageTransport(PageTransport):
             raise ValueError(
                 f"staged {staged['n_pages']} pages but got "
                 f"{int(idx.shape[0])} destination pages")
+        src_layout = staged.get("layout")
+        if src_layout is not None and \
+                src_layout != dst_pool.layout_tag:
+            # bit-exactness is the handoff contract: page bytes from a
+            # different layout (latent vs full-head, other quant/
+            # geometry) are not the destination's KV, even when shapes
+            # happen to broadcast
+            raise ValueError(
+                f"page layout mismatch: staged {src_layout} vs "
+                f"destination pool {dst_pool.layout_tag}")
         t0 = time.perf_counter()
         new_k = tuple(p.at[idx].set(jnp.asarray(s))
                       for p, s in zip(dst_pool.k_pages, staged["k"]))

@@ -45,7 +45,11 @@ from ..models.generate import (_act, _lm_head, _moe_mlp, _norm_apply,
                                _Params, _rotary_tables)
 from ..models.gpt import GPTConfig
 from ..ops.paged_attention import paged_attention_reference
-from ..ops.ragged_paged_attention import (ragged_paged_attention_pallas,
+from ..ops.quantization import quantize_rows
+from ..ops.ragged_paged_attention import (_dequant_latent,
+                                          latent_paged_attention_reference,
+                                          latent_ragged_paged_attention_pallas,
+                                          ragged_paged_attention_pallas,
                                           sample_row, sample_rows,
                                           speculative_verify_head)
 
@@ -173,6 +177,80 @@ def _split_ragged_attention(cfg: GPTConfig, q, kp, vp, q_lens,
     return jnp.concatenate(outs, axis=0)
 
 
+def _split_latent_ragged_attention(cfg: GPTConfig, q_cat, cp, rp, q_lens,
+                                   page_tables, ctx_lens, max_seqs: int,
+                                   prefill_rows: int, chunk: int,
+                                   spec_k: int = 0, scale_pages=None,
+                                   quant=None):
+    """Latent (MLA) twin of :func:`_split_ragged_attention`: absorbed
+    ``q_cat [T, nh, d_c+d_r]`` against the single latent stream ``cp``
+    (+ optional rope stream ``rp`` / absmax sidecar ``scale_pages``),
+    returning the LATENT attention output ``[T, nh, d_c]`` fp32 — the
+    caller applies the ``v_up`` fold.  Decode slots run
+    :func:`latent_paged_attention_reference` and chunk/verify slots run
+    the same pow2 page-window ``lax.switch`` with ``-inf`` masking, so
+    temp-0 latent serving stays bit-for-bit with the solo MLA oracle
+    (``models.generate._mla_attn_step``)."""
+    c = cfg
+    hd, nh = c.head_dim, c.num_heads
+    d_c, d_r = c.kv_latent_dim, c.rope_dim
+    maxp = page_tables.shape[1]
+    ps = cp.shape[1]
+    scale = (hd + d_r) ** -0.5
+    outs = [latent_paged_attention_reference(
+        q_cat[:max_seqs], cp, rp, page_tables[:max_seqs],
+        jnp.maximum(ctx_lens[:max_seqs], 1), softmax_scale=scale,
+        scale_pages=scale_pages, quant=quant, latent_dim=d_c)]
+    levels = [0]
+    n = 1
+    while n < maxp:
+        levels.append(n)
+        n *= 2
+    levels.append(maxp)
+    levels_arr = jnp.asarray(levels, jnp.int32)
+
+    def make_chunk_attn(npages, width_q):
+        if npages == 0:
+            return lambda qc, pt_row, ctx, qlen: jnp.zeros(
+                (width_q, nh, d_c), jnp.float32)
+
+        def attn(qc, pt_row, ctx, qlen):
+            width = npages * ps
+            qf = qc.astype(jnp.float32)
+            cw = cp[pt_row[:npages]].reshape(width, cp.shape[-1])
+            sw = None if scale_pages is None else \
+                scale_pages[pt_row[:npages]].reshape(width, 1)
+            cd = _dequant_latent(cw, sw, quant, d_c)   # [width, d_c]
+            if d_r:
+                r = rp[pt_row[:npages]].reshape(width, d_r)
+                k = jnp.concatenate([cd, r.astype(jnp.float32)], -1)
+            else:
+                k = cd
+            s = jnp.einsum("qhc,kc->qhk", qf, k) * scale
+            qpos = (ctx - qlen) + jnp.arange(width_q)
+            valid = jnp.arange(width)[None, :] <= qpos[:, None]
+            s = jnp.where(valid[:, None, :], s, -jnp.inf)
+            pr = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("qhk,kc->qhc", pr, cd)
+
+        return attn
+
+    branch_sets = {}
+    for row, start, width_q in _chunk_slots(max_seqs, prefill_rows,
+                                            chunk, spec_k):
+        if width_q not in branch_sets:
+            branch_sets[width_q] = [make_chunk_attn(npages, width_q)
+                                    for npages in levels]
+        qc = q_cat[start: start + width_q]
+        need = -(-ctx_lens[row] // ps)
+        lvl = jnp.searchsorted(levels_arr, need)
+        lvl = jnp.where(q_lens[row] > 0, lvl, 0)
+        outs.append(lax.switch(lvl, branch_sets[width_q], qc,
+                               page_tables[row], ctx_lens[row],
+                               q_lens[row]))
+    return jnp.concatenate(outs, axis=0)
+
+
 # the on-device per-row sampler lives next to the verify head in
 # ops/ragged_paged_attention.py (ONE implementation: the speculative
 # accept rule is "the draft matches this sampler's keyed choice", which
@@ -184,7 +262,7 @@ _sample_row = sample_row
 def build_unified_step_fn(cfg: GPTConfig, max_seqs: int, chunk: int,
                           prefill_rows: int, max_pages: int,
                           page_size: int, use_kernel: bool = False,
-                          spec_k: int = 0):
+                          spec_k: int = 0, page_quant=None):
     """Compile THE serving executable: one ragged prefill+decode step.
 
     Token-axis layout (static)::
@@ -235,6 +313,9 @@ def build_unified_step_fn(cfg: GPTConfig, max_seqs: int, chunk: int,
     if spec_k < 0:
         raise ValueError(f"spec_k must be >= 0, got {spec_k}")
     c = cfg
+    if page_quant is not None and (not c.is_mla or c.rope_dim):
+        raise ValueError("page_quant requires the latent (MLA) layout "
+                         "with rope_dim == 0")
     verify_rows = max_seqs if spec_k else 0
     t_tokens = max_seqs + prefill_rows * chunk \
         + verify_rows * (spec_k + 1)
@@ -289,34 +370,105 @@ def build_unified_step_fn(cfg: GPTConfig, max_seqs: int, chunk: int,
             h = _norm_apply(c, p.layer(i, "ln_1.weight"),
                             p.layer(i, "ln_1.bias"), x)
 
-            def qkv_proj(hh, i=i):
-                out = hh @ p.layer(i, "attn.qkv.weight").T
-                qb = p.layer(i, "attn.qkv.bias")
-                return out + qb if qb is not None else out
+            if c.is_mla:
+                d_c, d_r = c.kv_latent_dim, c.rope_dim
 
-            qkv = region_map(qkv_proj, h, q_lens)
-            q_size, kv_size = nh * hd, nkv * hd
-            q = qkv[..., :q_size].reshape(t_tokens, nh, hd)
-            k = qkv[..., q_size:q_size + kv_size].reshape(t_tokens, nkv,
-                                                          hd)
-            v = qkv[..., q_size + kv_size:].reshape(t_tokens, nkv, hd)
-            if c.position == "rotary":
-                q = _rope_tok(q, cos[token_pos], sin[token_pos])
-                k = _rope_tok(k, cos[token_pos], sin[token_pos])
-            with jax.named_scope("kv_page_scatter"):
-                kp = k_pages[i].at[token_page, token_off].set(
-                    k.astype(cdt))
-                vp = v_pages[i].at[token_page, token_off].set(
-                    v.astype(cdt))
-            if use_kernel:
-                attn = ragged_paged_attention_pallas(
-                    q, kp, vp, q_lens, cu_q, page_tables, ctx_lens,
-                    max_q=max(chunk, spec_k + 1))
+                def q_proj(hh, i=i):
+                    out = hh @ p.layer(i, "attn.q.weight").T
+                    qb = p.layer(i, "attn.q.bias")
+                    return out + qb if qb is not None else out
+
+                def kv_proj(hh, i=i):
+                    out = hh @ p.layer(i, "attn.kv_a.weight").T
+                    kb = p.layer(i, "attn.kv_a.bias")
+                    return out + kb if kb is not None else out
+
+                qh = region_map(q_proj, h, q_lens).reshape(
+                    t_tokens, nh, hd + d_r)
+                kv = region_map(kv_proj, h, q_lens)    # [T, d_c + d_r]
+                c_kv = kv[..., :d_c]
+                k_up = p.layer(i, "attn.k_up.weight")  # [nh, hd, d_c]
+                v_up = p.layer(i, "attn.v_up.weight")
+                # FlashMLA-ETAP absorption: fold W_UK into q so scores
+                # are MQA dot products against the latent stream
+                q_abs = jnp.einsum("thd,hdc->thc",
+                                   qh[..., :hd].astype(jnp.float32),
+                                   k_up.astype(jnp.float32))
+                if d_r:
+                    q_rope = _rope_tok(qh[..., hd:], cos[token_pos],
+                                       sin[token_pos])
+                    k_rope = _rope_tok(kv[..., d_c:][:, None, :],
+                                       cos[token_pos],
+                                       sin[token_pos])[:, 0]
+                    q_cat = jnp.concatenate(
+                        [q_abs, q_rope.astype(jnp.float32)], -1)
+                else:
+                    q_cat = q_abs
+                with jax.named_scope("kv_page_scatter"):
+                    if page_quant:
+                        codes, am = quantize_rows(c_kv, page_quant)
+                        kp = k_pages[i].at[token_page, token_off].set(
+                            codes[:, None, :])
+                        vp = v_pages[i].at[token_page, token_off].set(
+                            am[:, None, :])
+                    else:
+                        kp = k_pages[i].at[token_page, token_off].set(
+                            c_kv[:, None, :].astype(cdt))
+                        if d_r:
+                            vp = v_pages[i].at[
+                                token_page, token_off].set(
+                                k_rope[:, None, :].astype(cdt))
+                        else:
+                            vp = v_pages[i]        # width-0 rope stream
+                rp = None if (page_quant or not d_r) else vp
+                sp = vp if page_quant else None
+                if use_kernel:
+                    o_lat = latent_ragged_paged_attention_pallas(
+                        q_cat, kp, rp, q_lens, cu_q, page_tables,
+                        ctx_lens, max_q=max(chunk, spec_k + 1),
+                        softmax_scale=(hd + d_r) ** -0.5,
+                        scale_pages=sp, quant=page_quant,
+                        latent_dim=d_c)
+                else:
+                    o_lat = _split_latent_ragged_attention(
+                        c, q_cat, kp, rp, q_lens, page_tables, ctx_lens,
+                        max_seqs, prefill_rows, chunk, spec_k=spec_k,
+                        scale_pages=sp, quant=page_quant)
+                # the W_UV fold: one up-projection per QUERY token —
+                # cached tokens are never decompressed
+                attn = jnp.einsum("thc,hdc->thd", o_lat,
+                                  v_up.astype(jnp.float32))
+                attn = attn.reshape(t_tokens, nh * hd).astype(x.dtype)
             else:
-                attn = _split_ragged_attention(
-                    c, q, kp, vp, q_lens, page_tables, ctx_lens,
-                    max_seqs, prefill_rows, chunk, spec_k=spec_k)
-            attn = attn.reshape(t_tokens, nh * hd).astype(x.dtype)
+                def qkv_proj(hh, i=i):
+                    out = hh @ p.layer(i, "attn.qkv.weight").T
+                    qb = p.layer(i, "attn.qkv.bias")
+                    return out + qb if qb is not None else out
+
+                qkv = region_map(qkv_proj, h, q_lens)
+                q_size, kv_size = nh * hd, nkv * hd
+                q = qkv[..., :q_size].reshape(t_tokens, nh, hd)
+                k = qkv[..., q_size:q_size + kv_size].reshape(
+                    t_tokens, nkv, hd)
+                v = qkv[..., q_size + kv_size:].reshape(t_tokens, nkv,
+                                                        hd)
+                if c.position == "rotary":
+                    q = _rope_tok(q, cos[token_pos], sin[token_pos])
+                    k = _rope_tok(k, cos[token_pos], sin[token_pos])
+                with jax.named_scope("kv_page_scatter"):
+                    kp = k_pages[i].at[token_page, token_off].set(
+                        k.astype(cdt))
+                    vp = v_pages[i].at[token_page, token_off].set(
+                        v.astype(cdt))
+                if use_kernel:
+                    attn = ragged_paged_attention_pallas(
+                        q, kp, vp, q_lens, cu_q, page_tables, ctx_lens,
+                        max_q=max(chunk, spec_k + 1))
+                else:
+                    attn = _split_ragged_attention(
+                        c, q, kp, vp, q_lens, page_tables, ctx_lens,
+                        max_seqs, prefill_rows, chunk, spec_k=spec_k)
+                attn = attn.reshape(t_tokens, nh * hd).astype(x.dtype)
 
             def out_proj(aa, i=i):
                 out = aa @ p.layer(i, "attn.out.weight").T

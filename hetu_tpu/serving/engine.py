@@ -103,7 +103,8 @@ class Engine:
                  name: str = "serving", analysis_tap: bool = True,
                  prefix_cache: bool = True, debug: bool = False,
                  tracer=None, step_fn: Optional[Callable] = None,
-                 spec: Optional[SpecConfig] = None):
+                 spec: Optional[SpecConfig] = None,
+                 page_quant: Optional[str] = None):
         self.cfg = cfg
         self.name = name
         # runtime trace plane (hetu_tpu/obs): None follows the ambient
@@ -133,9 +134,21 @@ class Engine:
         self.max_pages_per_seq = -(-self.max_model_len // page_size)
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
         self.debug = bool(debug)
+        # MLA latent layout (DESIGN.md §21): pages hold ONE compressed
+        # [latent_dim] stream per token (plus the shared rope stream /
+        # quant-scale sidecar) instead of kv_heads x head_dim — the
+        # whole serving stack above the pool is layout-generic
+        if page_quant is not None and not cfg.is_mla:
+            raise ValueError("page_quant requires an MLA config "
+                             "(kv_latent_dim set)")
+        self.page_quant = page_quant
         self.pool = PagedKVPool(cfg.num_layers, num_pages, page_size,
                                 cfg.kv_heads, cfg.head_dim, dtype,
-                                mesh=mesh, debug=debug)
+                                mesh=mesh, debug=debug,
+                                latent_dim=cfg.kv_latent_dim,
+                                rope_dim=cfg.rope_dim if cfg.is_mla
+                                else 0,
+                                quant=page_quant)
         # copy-on-write prefix reuse: finished requests' full pages are
         # indexed by chained token hash; _start attaches the longest
         # cached prefix so prefill skips straight to the cached boundary
@@ -185,7 +198,16 @@ class Engine:
                           "spec_bonus_tokens")}
         self.gauges = {k: make_instrument("gauge", k, m) for k in
                        ("batch_occupancy", "page_utilization",
-                        "queue_depth")}
+                        "queue_depth",
+                        # KV footprint (satellite of DESIGN.md §21):
+                        # bytes of page storage per cached token —
+                        # static per layout — and bytes held by
+                        # currently-allocated pages; both derive from
+                        # kv_pool.page_shape_bytes so the lint /
+                        # transport / metrics planes can never disagree
+                        "kv_bytes_per_token", "kv_bytes_in_use")}
+        self.gauges["kv_bytes_per_token"].set(
+            self.pool.kv_bytes_per_token)
         lb = list(latency_buckets if latency_buckets is not None
                   else DEFAULT_LATENCY_BUCKETS)
         self.histograms = {
@@ -219,7 +241,7 @@ class Engine:
                 cfg, self.scheduler.max_batch, self.scheduler.chunk,
                 self.scheduler.prefill_rows, self.max_pages_per_seq,
                 page_size, use_kernel=self.use_kernel,
-                spec_k=self.spec_k)}
+                spec_k=self.spec_k, page_quant=page_quant)}
         if self.spec is not None:
             # the draft programs join the jit-cache compile guard: a
             # silent draft retrace trips compile_count just like a
@@ -442,6 +464,11 @@ class Engine:
             len(self.running) / self.scheduler.max_batch)
         self.gauges["page_utilization"].set(self.pool.utilization)
         self.gauges["queue_depth"].set(len(self.queue))
+        self.gauges["kv_bytes_per_token"].set(
+            self.pool.kv_bytes_per_token)
+        self.gauges["kv_bytes_in_use"].set(
+            (self.pool.num_usable - self.pool.free_pages)
+            * self.pool.page_bytes)
         return produced
 
     def run(self, max_steps: Optional[int] = None
@@ -900,7 +927,10 @@ class Engine:
             if not hasattr(a, "aval") else jax.ShapeDtypeStruct(a.shape,
                                                                 a.dtype)
         params = jax.tree_util.tree_map(sds, self.params)
-        pages = tuple(sds(p) for p in self.pool.k_pages)
+        # k and v page stacks differ in shape (and dtype) under the MLA
+        # latent layout — build each spec from its own arrays
+        k_pages = tuple(sds(p) for p in self.pool.k_pages)
+        v_pages = tuple(sds(p) for p in self.pool.v_pages)
         t, nr, maxp = self.n_tokens, self.n_rows, self.max_pages_per_seq
         i32 = lambda *s: jax.ShapeDtypeStruct(s, np.int32)  # noqa: E731
         f32 = lambda *s: jax.ShapeDtypeStruct(s, np.float32)  # noqa: E731
@@ -908,7 +938,7 @@ class Engine:
                 i32(nr + 1), i32(nr, maxp), i32(nr), f32(nr), f32(nr),
                 i32(nr), i32(nr)) \
             + ((i32(nr),) if self.spec is not None else ()) \
-            + (pages, pages)
+            + (k_pages, v_pages)
         meta = {
             "kind": "serving_unified",
             "mesh_axes": {},
@@ -987,6 +1017,11 @@ class Engine:
                     if getattr(inst, "buckets", None) else {}
                 d[k] = make_instrument(inst.__class__.__name__.lower(),
                                        k, True, **kw)
+        if self.gauges["kv_bytes_per_token"].__class__.__name__ \
+                != "_NullInstrument":
+            # layout-static: re-seed rather than read 0 until a step
+            self.gauges["kv_bytes_per_token"].set(
+                self.pool.kv_bytes_per_token)
 
     def metrics_summary(self) -> Dict[str, Any]:
         out = {k: c.value for k, c in self.counters.items()}

@@ -37,42 +37,109 @@ import jax.numpy as jnp
 
 TRASH_PAGE = 0
 
+# page_quant codes for the layout tag (order is part of the tag)
+_QUANT_CODES = {None: 0, "int8": 1, "nf4": 2}
+
+
+def page_shape_bytes(shape: Sequence[int], dtype) -> int:
+    """Bytes ONE page of a per-layer page array ``[P, ps, h, w]``
+    occupies (i.e. everything but the leading page axis).  The single
+    source of truth for KV page sizing: ``PagedKVPool.page_bytes``,
+    ``PageTransport`` handoff pricing, engine metrics, and the
+    ``analysis/memory.py`` pool predictor all derive from it, so a
+    latent (MLA) pool and a full-head pool can never disagree about
+    what a page costs."""
+    n = 1
+    for d in shape[1:]:
+        n *= int(d)
+    return n * jnp.dtype(dtype).itemsize
+
 
 class PagedKVPool:
-    """Free-list page allocator over per-layer k/v page arrays."""
+    """Free-list page allocator over per-layer k/v page arrays.
+
+    Two layouts share every allocator/bookkeeping path:
+
+    - **full-head** (default): k and v pages are both
+      ``[P, ps, kv_heads, head_dim]``.
+    - **latent** (MLA, ``latent_dim`` set): k_pages hold ONE compressed
+      stream ``[P, ps, 1, latent_dim]`` and v_pages carry the decoupled
+      rotated key ``[P, ps, 1, rope_dim]`` (width 0 for learned
+      positions).  With ``quant`` set (int8/nf4, learned-position MLA
+      only), k_pages store codes (int8, or packed uint8 at
+      ``latent_dim // 2``) and v_pages become the per-token fp32 absmax
+      sidecar ``[P, ps, 1, 1]``.
+
+    Page-table math, the allocator, CoW refcounts, and the prefix cache
+    never look inside a page, so they compose with any layout; only
+    ``page_bytes`` / ``layout_tag`` observe the difference.
+    """
 
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
                  kv_heads: int, head_dim: int, dtype=jnp.float32,
-                 mesh=None, kv_axis: str = "tp", debug: bool = False):
+                 mesh=None, kv_axis: str = "tp", debug: bool = False,
+                 latent_dim: Optional[int] = None, rope_dim: int = 0,
+                 quant: Optional[str] = None):
         if num_pages < 2:
             raise ValueError(f"num_pages must be >= 2 (page 0 is the "
                              f"reserved trash page), got {num_pages}")
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if quant is not None:
+            if quant not in ("int8", "nf4"):
+                raise ValueError(f"page quant must be int8|nf4, "
+                                 f"got {quant!r}")
+            if latent_dim is None or rope_dim:
+                raise ValueError("page quantization requires the latent "
+                                 "(MLA) layout with rope_dim == 0 — the "
+                                 "v-page slot carries the absmax sidecar")
+            if quant == "nf4" and latent_dim % 2:
+                raise ValueError(f"nf4 pages need even latent_dim, got "
+                                 f"{latent_dim}")
         self.num_layers = int(num_layers)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.kv_heads = int(kv_heads)
         self.head_dim = int(head_dim)
         self.dtype = jnp.dtype(dtype)
-        shape = (num_pages, page_size, kv_heads, head_dim)
+        self.latent_dim = None if latent_dim is None else int(latent_dim)
+        self.rope_dim = int(rope_dim)
+        self.quant = quant
+        if latent_dim is not None:
+            if quant == "int8":
+                k_shape = (num_pages, page_size, 1, self.latent_dim)
+                k_dtype = jnp.dtype(jnp.int8)
+            elif quant == "nf4":
+                k_shape = (num_pages, page_size, 1, self.latent_dim // 2)
+                k_dtype = jnp.dtype(jnp.uint8)
+            else:
+                k_shape = (num_pages, page_size, 1, self.latent_dim)
+                k_dtype = self.dtype
+            # rope stream, or the per-token absmax sidecar when quantized
+            v_w = 1 if quant else self.rope_dim
+            v_shape = (num_pages, page_size, 1, v_w)
+            v_dtype = jnp.dtype(jnp.float32) if quant else self.dtype
+        else:
+            k_shape = v_shape = (num_pages, page_size, kv_heads, head_dim)
+            k_dtype = v_dtype = self.dtype
         self.sharding = None
         if mesh is not None and kv_axis in getattr(mesh, "axis_names", ()):
             from jax.sharding import NamedSharding, PartitionSpec as P
             tp = mesh.shape[kv_axis]
-            if kv_heads % tp == 0:
+            # the latent stream has no head axis to split — replicate
+            if latent_dim is None and kv_heads % tp == 0:
                 self.sharding = NamedSharding(
                     mesh, P(None, None, kv_axis, None))
 
-        def make():
-            z = jnp.zeros(shape, self.dtype)
+        def make(shape, dt):
+            z = jnp.zeros(shape, dt)
             return jax.device_put(z, self.sharding) if self.sharding \
                 else z
 
         self.k_pages: Tuple[jax.Array, ...] = tuple(
-            make() for _ in range(num_layers))
+            make(k_shape, k_dtype) for _ in range(num_layers))
         self.v_pages: Tuple[jax.Array, ...] = tuple(
-            make() for _ in range(num_layers))
+            make(v_shape, v_dtype) for _ in range(num_layers))
         # LIFO free list: recently-freed pages are re-issued first (their
         # HBM is hot); page 0 reserved
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
@@ -230,11 +297,45 @@ class PagedKVPool:
     # -- accounting ----------------------------------------------------------
 
     @property
+    def is_latent(self) -> bool:
+        return self.latent_dim is not None
+
+    def page_array_shapes(self) -> Tuple[Tuple[Tuple[int, ...], ...],
+                                         Tuple[Tuple[int, ...], ...]]:
+        """Actual per-layer (k, v) page-array shapes — what the jitted
+        executables see, and what ``analysis/memory.py`` classifies as
+        kv-page operands.  Derived from the live arrays, never from the
+        constructor attrs, so it is correct for every layout."""
+        return (tuple(tuple(p.shape) for p in self.k_pages),
+                tuple(tuple(p.shape) for p in self.v_pages))
+
+    @property
     def page_bytes(self) -> int:
-        """HBM bytes one page holds across k+v and all layers."""
-        per = (self.page_size * self.kv_heads * self.head_dim *
-               self.dtype.itemsize)
-        return 2 * self.num_layers * per
+        """HBM bytes one page holds across k+v and all layers, summed
+        from the ACTUAL page arrays via :func:`page_shape_bytes` (the
+        one shared helper — transport pricing and metrics read this
+        property, so they can never disagree with the real layout)."""
+        return sum(page_shape_bytes(p.shape, p.dtype)
+                   for p in self.k_pages) + \
+            sum(page_shape_bytes(p.shape, p.dtype) for p in self.v_pages)
+
+    @property
+    def kv_bytes_per_token(self) -> int:
+        """KV bytes ONE cached token costs across all layers (page
+        bytes amortized over the page's token slots)."""
+        return self.page_bytes // self.page_size
+
+    @property
+    def layout_tag(self) -> Tuple[int, ...]:
+        """Compact int tuple identifying the page LAYOUT (not contents):
+        two pools agree on this iff a page extracted from one can be
+        injected into the other and read back identically.  Salted into
+        the prefix-cache digest so a latent replica and a full-head
+        replica can never cross-match in the router."""
+        if self.is_latent:
+            return (1, self.latent_dim, self.rope_dim,
+                    _QUANT_CODES[self.quant], self.dtype.itemsize)
+        return (0, self.kv_heads, self.head_dim, 0, self.dtype.itemsize)
 
     def set_pages(self, k_pages, v_pages) -> None:
         """Install updated page arrays (the jitted executables return new

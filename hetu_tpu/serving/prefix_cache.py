@@ -89,18 +89,26 @@ def chain_hash(parent_hash: int, page_tokens: Sequence[int]) -> int:
 
 
 def token_chain_hashes(tokens: Sequence[int], page_size: int,
-                       max_pages: Optional[int] = None) -> List[int]:
+                       max_pages: Optional[int] = None,
+                       layout: Sequence[int] = ()) -> List[int]:
     """The chain hashes of every FULL page prefix of ``tokens`` (at most
     ``max_pages``; default caps at ``(len - 1) // page_size`` exactly
     like :meth:`PrefixCache.match` — the final prompt token must always
     run).  ``result[i]`` keys the prefix ``tokens[:(i+1)*page_size]``;
-    the router probes replica digests with these."""
+    the router probes replica digests with these.
+
+    ``layout`` salts the chain ROOT (``PagedKVPool.layout_tag``): the
+    hashes stay a pure function of token content WITHIN a layout, but a
+    latent-KV replica and a full-head replica (or two different page
+    layouts generally) can never cross-match — their cached page BYTES
+    are incompatible even when the token prefixes agree.  Empty layout
+    keeps the raw unsalted chain."""
     ps = int(page_size)
     n = max(0, len(tokens) - 1) // ps
     if max_pages is not None:
         n = min(n, int(max_pages))
     out: List[int] = []
-    h = ROOT_HASH
+    h = chain_hash(ROOT_HASH, layout) if len(layout) else ROOT_HASH
     for i in range(n):
         h = chain_hash(h, tokens[i * ps:(i + 1) * ps])
         out.append(h)
@@ -162,11 +170,19 @@ class PrefixCache:
         hash is ``chain_hash``".  Entries are computed parents-first
         (sorted by depth), so each hash extends its parent's in O(1);
         the whole export is O(cached pages) — tens to hundreds of
-        entries, cheap enough to refresh per routing sync."""
+        entries, cheap enough to refresh per routing sync.
+
+        The chain ROOT is salted with the pool's ``layout_tag``
+        (matching ``token_chain_hashes(..., layout=pool.layout_tag)``):
+        digests from replicas with different KV page layouts — latent
+        vs full-head, different quantization, different head geometry —
+        share no keys, so the router can never place a request on a
+        replica whose cached page bytes it could not actually reuse."""
         hashes: Dict[int, int] = {}        # eid -> chain hash
         out: Dict[int, int] = {}
+        root = chain_hash(ROOT_HASH, self.pool.layout_tag)
         for e in sorted(self._index.values(), key=lambda e: e.depth):
-            parent_h = ROOT_HASH if e.parent == ROOT \
+            parent_h = root if e.parent == ROOT \
                 else hashes[e.parent]
             h = chain_hash(parent_h, e.tokens)
             hashes[e.eid] = h

@@ -129,10 +129,18 @@ class SpecDecoder:
         # kvh, hd] layout costs a multi-MB cache transpose per
         # micro-step on CPU, which single-handedly ate the speculative
         # speedup
-        shape = (self.S, kvh, self.Lmax + 1, hd)
-        self._kc: List[jax.Array] = [jnp.zeros(shape, cdt)
+        if dcfg.is_mla:
+            # MLA draft (e.g. a self-draft truncated from an
+            # MLA-converted target): _kc holds the single compressed
+            # latent stream, _vc the shared rope stream (width 0 for
+            # learned positions) — same slot/position layout
+            k_shape = (self.S, 1, self.Lmax + 1, dcfg.kv_latent_dim)
+            v_shape = (self.S, 1, self.Lmax + 1, dcfg.rope_dim)
+        else:
+            k_shape = v_shape = (self.S, kvh, self.Lmax + 1, hd)
+        self._kc: List[jax.Array] = [jnp.zeros(k_shape, cdt)
                                      for _ in range(dcfg.num_layers)]
-        self._vc: List[jax.Array] = [jnp.zeros(shape, cdt)
+        self._vc: List[jax.Array] = [jnp.zeros(v_shape, cdt)
                                      for _ in range(dcfg.num_layers)]
         self._free: List[int] = list(range(self.S - 1, -1, -1))
         self._slot: Dict[int, int] = {}       # req_id -> slot
@@ -156,12 +164,18 @@ class SpecDecoder:
                     else (None, None))
         kvh, hd = c.kv_heads, c.head_dim
 
+        if c.is_mla:
+            shapes = ((1, Lmax, 1, c.kv_latent_dim),
+                      (1, Lmax, 1, c.rope_dim))
+        else:
+            shapes = ((1, Lmax, kvh, hd),) * 2
+
         @jax.jit
         def prefill(params, tokens):          # tokens [1, Lmax] i32
             p = _Params.__new__(_Params)
             p.s, p.cfg = params, c
-            caches = [(jnp.zeros((1, Lmax, kvh, hd), cdt),
-                       jnp.zeros((1, Lmax, kvh, hd), cdt))
+            caches = [(jnp.zeros(shapes[0], cdt),
+                       jnp.zeros(shapes[1], cdt))
                       for _ in range(c.num_layers)]
             _, cs = decode_step(c, p, tokens, caches, 0, cos, sin)
             return tuple(k for k, _ in cs), tuple(v for _, v in cs)
@@ -194,7 +208,9 @@ class SpecDecoder:
                     if c.position == "rotary" else (None, None))
         hd, nh, kvh = c.head_dim, c.num_heads, c.kv_heads
         g = nh // kvh
-        scale = hd ** -0.5
+        d_c = c.kv_latent_dim if c.is_mla else 0
+        d_r = c.rope_dim if c.is_mla else 0
+        scale = ((hd + d_r) if c.is_mla else hd) ** -0.5
         rows = jnp.arange(S)
 
         def rope_rows(x, idx):
@@ -238,30 +254,84 @@ class SpecDecoder:
                 for i in range(c.num_layers):
                     h = _norm_apply(c, p.layer(i, "ln_1.weight"),
                                     p.layer(i, "ln_1.bias"), x)
-                    qkv = h @ p.layer(i, "attn.qkv.weight").T
-                    qb = p.layer(i, "attn.qkv.bias")
-                    if qb is not None:
-                        qkv = qkv + qb
-                    qs, ks = nh * hd, kvh * hd
-                    q = qkv[..., :qs].reshape(S, nh, hd)
-                    kk = qkv[..., qs:qs + ks].reshape(S, kvh, hd)
-                    vv = qkv[..., qs + ks:].reshape(S, kvh, hd)
-                    if c.position == "rotary":
-                        ridx = jnp.clip(cur_pos, 0, Lmax)
-                        q = rope_rows(q, ridx)
-                        kk = rope_rows(kk, ridx)
-                    kcs[i] = kcs[i].at[rows, :, wpos].set(kk.astype(cdt))
-                    vcs[i] = vcs[i].at[rows, :, wpos].set(vv.astype(cdt))
-                    qg = q.reshape(S, kvh, g, hd).astype(jnp.float32)
-                    s = jnp.einsum("skgd,skld->skgl", qg,
-                                   kcs[i].astype(jnp.float32)) * scale
-                    mask = jnp.arange(Lmax + 1)[None, :] \
-                        <= cur_pos[:, None]
-                    s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
-                    pr = jax.nn.softmax(s, axis=-1)
-                    o = jnp.einsum("skgl,skld->skgd", pr,
-                                   vcs[i].astype(jnp.float32))
-                    o = o.reshape(S, nh * hd).astype(x.dtype)
+                    if c.is_mla:
+                        # weight-absorbed latent path (DESIGN.md §21):
+                        # q folded through k_up scores straight against
+                        # the latent cache; the output stays latent
+                        # until the per-row v_up fold — same
+                        # contractions as the unified step's decode
+                        # slots (drafts are greedy + row-wise either
+                        # way, so batching never leaks between slots)
+                        q = h @ p.layer(i, "attn.q.weight").T
+                        qb = p.layer(i, "attn.q.bias")
+                        if qb is not None:
+                            q = q + qb
+                        q = q.reshape(S, nh, hd + d_r)
+                        kv = h @ p.layer(i, "attn.kv_a.weight").T
+                        kb = p.layer(i, "attn.kv_a.bias")
+                        if kb is not None:
+                            kv = kv + kb
+                        c_kv = kv[..., :d_c]
+                        k_up = p.layer(i, "attn.k_up.weight")
+                        v_up = p.layer(i, "attn.v_up.weight")
+                        q_cat = jnp.einsum(
+                            "shd,hdc->shc",
+                            q[..., :hd].astype(jnp.float32),
+                            k_up.astype(jnp.float32))
+                        k_rope = None
+                        if d_r:
+                            ridx = jnp.clip(cur_pos, 0, Lmax)
+                            q_rope = rope_rows(q[..., hd:], ridx)
+                            k_rope = rope_rows(kv[:, None, d_c:],
+                                               ridx)[:, 0]
+                            q_cat = jnp.concatenate(
+                                [q_cat, q_rope.astype(jnp.float32)], -1)
+                        kcs[i] = kcs[i].at[rows, 0, wpos].set(
+                            c_kv.astype(cdt))
+                        if d_r:
+                            vcs[i] = vcs[i].at[rows, 0, wpos].set(
+                                k_rope.astype(cdt))
+                        lat = kcs[i][:, 0].astype(jnp.float32)
+                        kall = lat if not d_r else jnp.concatenate(
+                            [lat, vcs[i][:, 0].astype(jnp.float32)], -1)
+                        s = jnp.einsum("shc,slc->shl", q_cat,
+                                       kall) * scale
+                        mask = jnp.arange(Lmax + 1)[None, :] \
+                            <= cur_pos[:, None]
+                        s = jnp.where(mask[:, None, :], s, -jnp.inf)
+                        pr = jax.nn.softmax(s, axis=-1)
+                        o_lat = jnp.einsum("shl,slc->shc", pr, lat)
+                        o = jnp.einsum("shc,hdc->shd", o_lat,
+                                       v_up.astype(jnp.float32))
+                        o = o.reshape(S, nh * hd).astype(x.dtype)
+                    else:
+                        qkv = h @ p.layer(i, "attn.qkv.weight").T
+                        qb = p.layer(i, "attn.qkv.bias")
+                        if qb is not None:
+                            qkv = qkv + qb
+                        qs, ks = nh * hd, kvh * hd
+                        q = qkv[..., :qs].reshape(S, nh, hd)
+                        kk = qkv[..., qs:qs + ks].reshape(S, kvh, hd)
+                        vv = qkv[..., qs + ks:].reshape(S, kvh, hd)
+                        if c.position == "rotary":
+                            ridx = jnp.clip(cur_pos, 0, Lmax)
+                            q = rope_rows(q, ridx)
+                            kk = rope_rows(kk, ridx)
+                        kcs[i] = kcs[i].at[rows, :, wpos].set(
+                            kk.astype(cdt))
+                        vcs[i] = vcs[i].at[rows, :, wpos].set(
+                            vv.astype(cdt))
+                        qg = q.reshape(S, kvh, g, hd).astype(jnp.float32)
+                        s = jnp.einsum("skgd,skld->skgl", qg,
+                                       kcs[i].astype(jnp.float32)) * scale
+                        mask = jnp.arange(Lmax + 1)[None, :] \
+                            <= cur_pos[:, None]
+                        s = jnp.where(mask[:, None, None, :], s,
+                                      -jnp.inf)
+                        pr = jax.nn.softmax(s, axis=-1)
+                        o = jnp.einsum("skgl,skld->skgd", pr,
+                                       vcs[i].astype(jnp.float32))
+                        o = o.reshape(S, nh * hd).astype(x.dtype)
                     o = o @ p.layer(i, "attn.out.weight").T
                     ob = p.layer(i, "attn.out.bias")
                     if ob is not None:

@@ -111,17 +111,23 @@ def test_digest_matches_chain_hashes(model_state, shared_fn):
     _drain(cl)
     digest = cl.replicas[0].digest()
     assert digest, "finished request populated no cache"
-    ps = cl.replicas[0].engine.pool.page_size
+    pool = cl.replicas[0].engine.pool
+    ps, tag = pool.page_size, pool.layout_tag
     # the full prompt pages are cached: a same-header request matches
-    got = digest_match_pages(header + [77, 78, 79], ps, digest)
+    got = digest_match_pages(header + [77, 78, 79], ps, digest,
+                             layout=tag)
     assert got == 3
     # chain property: equal hashes imply equal prefixes, so a diverged
     # FIRST page kills every deeper match even if later pages agree
     diverged = [50] + header[1:] + [77]
-    assert digest_match_pages(diverged, ps, digest) == 0
+    assert digest_match_pages(diverged, ps, digest, layout=tag) == 0
     # and the hash helper agrees with the digest's own stamps
-    hs = token_chain_hashes(header + [77], ps)
+    hs = token_chain_hashes(header + [77], ps, layout=tag)
     assert [digest.get(h) for h in hs] == [1, 2, 3]
+    # layout-salted ROOT: unsalted hashes (and any OTHER layout's
+    # hashes) share no keys with this digest — a latent replica and a
+    # full-head replica can never cross-match in the router
+    assert digest_match_pages(header + [77], ps, digest) == 0
     cl.close()
 
 
