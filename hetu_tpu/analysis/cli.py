@@ -346,6 +346,40 @@ def build_gate_executables():
     for r in cl.replicas:
         r.engine.pool.check_invariants(force=True)
     cl.close()
+
+    # -- SLO traffic plane: an engine with the host-RAM tier for cold
+    # prefix-cache pages — a warmed cache is forcibly swept to host,
+    # then a same-header request refetches through the priced
+    # device↔host path (host-offload-unpriced audits the records the
+    # host_offload meta exposes; both directions asserted non-vacuous
+    # so the rule has real evicts AND refetches to chew) --------------
+    hclock = [0.0]
+    heng = Engine(state, scfg, num_pages=16, page_size=8, max_batch=4,
+                  chunk_size=4, name="gate_serving@slo",
+                  time_fn=lambda: hclock[0], prefix_cache=True,
+                  host_tier=True)
+    header = list(range(1, 18))          # two full cached pages at ps=8
+    heng.add_request(header + [21, 22], max_new_tokens=4,
+                     slo_class="interactive")
+    while heng.has_work:
+        heng.step()
+        hclock[0] += 1.0
+    heng.prefix_cache.evict(16)          # cold sweep -> host staging
+    heng.add_request(header + [31, 32], max_new_tokens=4,
+                     slo_class="batch")
+    while heng.has_work:
+        heng.step()
+        hclock[0] += 1.0
+    heng.pool.check_invariants(force=True)
+    heng.prefix_cache.check_invariants()
+    assert heng.host_tier.evictions >= 2, \
+        "host-tier gate trace evicted nothing — the rule is vacuous"
+    assert heng.host_tier.hits >= 2, \
+        "host-tier gate trace never refetched — the refetch half of " \
+        "the rule is vacuous"
+    assert all(r["predicted_s"] > 0 for r in heng.host_tier.records), \
+        "host-tier move lost its alpha-beta pricing"
+    names.append("gate_serving@slo/unified")
     return names + [f"gate_serving@r{i}/unified" for i in range(2)]
 
 

@@ -37,6 +37,17 @@ Rule catalog (DESIGN.md §9 for the rationale of each):
                              payload bytes and an alpha-beta predicted
                              time, so the disaggregation design stays
                              priced before hardware exists.
+``host-offload-unpriced``    serving: a host-RAM tier page move (cold
+                             prefix-cache evict, or its refetch back to
+                             device) whose record carries no priced
+                             edge claim, or whose byte accounting
+                             disagrees (edge payload vs record payload
+                             vs pages x page_bytes) — the host tier is
+                             wire traffic exactly like the
+                             disaggregation handoff and must stay
+                             priced before hardware exists.  Records
+                             flagged ``host_offload_exempt`` are
+                             skipped.
 ``unfenced-handoff``         serving cluster: a cross-replica page move
                              or a mid-flight request adoption lacking
                              an epoch/fence token — without one, a
@@ -826,6 +837,75 @@ def _kv_handoff_unpriced(ctx: AnalysisContext) -> List[Finding]:
                  "alpha-beta formulas the planner and step-time linter "
                  "use); a handoff the analysis plane cannot price "
                  "cannot be gated before hardware"))
+    return out
+
+
+@rule("host-offload-unpriced")
+def _host_offload_unpriced(ctx: AnalysisContext) -> List[Finding]:
+    """Host-RAM tier contract (the sibling of ``kv-handoff-unpriced``
+    for the device↔host edge): every cold-page evict to host staging
+    and every refetch back into the pool must carry a priced edge claim
+    whose byte accounting is self-consistent — edge payload == record
+    payload == pages x page_bytes — plus alpha-beta predicted seconds
+    through the shared ``collective_time`` formulas.  MLA-latent and
+    quantized pools price at their true (smaller) ``page_bytes``, so a
+    mismatch means the tier moved bytes the analysis plane cannot see.
+    Executables with no ``host_offload`` meta (engines without a host
+    tier) are out of scope; records flagged ``host_offload_exempt``
+    are skipped."""
+    if "host_offload" not in (ctx.meta or {}):
+        return []
+    records, lost = _call_meta_records(ctx.meta, "host_offload")
+    if lost:
+        return [Finding(
+            rule="", subject="host_offload", severity="error",
+            message="host_offload record hook raised — the host-tier "
+                    "accounting is lost, which is itself a gate "
+                    "failure")]
+    out: List[Finding] = []
+    for i, rec in enumerate(records or ()):
+        if rec.get("host_offload_exempt"):
+            continue
+        edge = rec.get("edge") or {}
+        payload = int(rec.get("payload_bytes", 0) or 0)
+        pages = int(rec.get("pages", 0) or 0)
+        page_bytes = int(rec.get("page_bytes", 0) or 0)
+        problems = []
+        if not edge:
+            problems.append("no edge claim")
+        else:
+            if int(edge.get("payload_bytes", 0) or 0) != payload:
+                problems.append(
+                    f"edge claims {edge.get('payload_bytes')} B but the "
+                    f"move carried {payload} B")
+            if not edge.get("kind"):
+                problems.append("edge has no collective kind")
+        if pages > 0 and page_bytes > 0 \
+                and payload != pages * page_bytes:
+            problems.append(
+                f"{pages} pages x {page_bytes} B/page = "
+                f"{pages * page_bytes} B but the record claims "
+                f"{payload} B — the tier moved bytes the claim "
+                f"does not cover")
+        if payload <= 0 and pages > 0:
+            problems.append("pages moved with zero payload bytes")
+        pred = rec.get("predicted_s")
+        if pred is None or float(pred) <= 0.0:
+            problems.append("no alpha-beta predicted time")
+        if not problems:
+            continue
+        out.append(Finding(
+            rule="",
+            subject=f"host_offload@{i}:{rec.get('dir', '?')}",
+            severity="error",
+            message=f"host-tier page move #{i} "
+                    f"({rec.get('dir', '?')}, {pages} pages) is "
+                    f"unpriced: " + "; ".join(problems),
+            hint="route host-tier moves through HostTier._price (it "
+                 "claims a CommEdge-shaped dict tagged host_offload "
+                 "and prices via planner.cost_model.collective_time — "
+                 "the SAME formulas the handoff wire uses); flag "
+                 "genuinely free moves host_offload_exempt"))
     return out
 
 

@@ -172,10 +172,12 @@ def timeline_summary(events: Sequence[Span]) -> str:
     speculative verify bursts + draft tokens accepted through them
     (the ``spec_accept`` instants of DESIGN.md §20 — a timeline shows
     the draft→verify→accept cadence directly), end-to-end latency —
-    all derived from the trace, not the engine."""
-    lines = [f"{'req':>4} {'queued_s':>9} {'chunks':>6} {'tokens':>6} "
-             f"{'preempt':>7} {'verify':>6} {'spec_acc':>8} "
-             f"{'e2e_s':>8}  timeline"]
+    all derived from the trace, not the engine.  The ``class`` column
+    is the request's SLO class, read from its ``enqueue`` instant
+    (DESIGN.md §22) — ``-`` for traces predating the traffic plane."""
+    lines = [f"{'req':>4} {'class':>11} {'queued_s':>9} {'chunks':>6} "
+             f"{'tokens':>6} {'preempt':>7} {'verify':>6} "
+             f"{'spec_acc':>8} {'e2e_s':>8}  timeline"]
     for rid, evs in sorted(request_timelines(events).items()):
         queued = sum(e.dur or 0.0 for e in evs
                      if e.ph == "X" and e.name == "queued")
@@ -185,12 +187,15 @@ def timeline_summary(events: Sequence[Span]) -> str:
         verify = sum(1 for e in evs if e.name == "verify")
         spec_acc = sum(int(e.attrs.get("n", 0)) for e in evs
                        if e.name == "spec_accept")
+        slo = next((e.attrs["slo_class"] for e in evs
+                    if e.name == "enqueue" and "slo_class" in e.attrs),
+                   "-")
         t0 = min(e.ts for e in evs)
         t1 = max(e.end_ts for e in evs)
         path = "->".join(e.name for e in evs
                          if e.name in ("enqueue", "admit", "preempt",
                                        "finish"))
-        lines.append(f"{rid:>4} {queued:>9.3f} {chunks:>6} {tokens:>6} "
-                     f"{preempt:>7} {verify:>6} {spec_acc:>8} "
-                     f"{t1 - t0:>8.3f}  {path}")
+        lines.append(f"{rid:>4} {slo:>11} {queued:>9.3f} {chunks:>6} "
+                     f"{tokens:>6} {preempt:>7} {verify:>6} "
+                     f"{spec_acc:>8} {t1 - t0:>8.3f}  {path}")
     return "\n".join(lines)

@@ -61,7 +61,6 @@ tracer instant, so one Perfetto trace shows fail → detect → recover.
 """
 from __future__ import annotations
 
-import heapq
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
@@ -70,6 +69,8 @@ from ...fault.backoff import RetryPolicy
 from ...obs.tracer import PrefixedTracer, get_tracer
 from ...utils.metrics import make_instrument, merge_prometheus_texts
 from ..engine import Engine
+from ..slo.backlog import ClassBacklog
+from ..slo.classes import SLO_CLASSES, class_rank
 from .replica import DECODE, PREFILL, UNIFIED, Replica
 from .router import Router
 from .transport import LocalPageTransport, PageTransport
@@ -93,6 +94,9 @@ class ClusterRequest:
     eos_token_id: Optional[int] = None
     arrival_time: float = 0.0
     submit_time: float = 0.0
+    # SLO class (serving.slo.classes): policy-only — decides who
+    # waits, sheds and scales, never what a surviving request computes
+    slo_class: str = "standard"
 
     # runtime
     out_tokens: List[int] = field(default_factory=list)
@@ -112,6 +116,10 @@ class ClusterRequest:
     @property
     def done(self) -> bool:
         return self.finish_time is not None
+
+    @property
+    def rank(self) -> int:
+        return class_rank(self.slo_class)
 
     @property
     def first_token_time(self) -> Optional[float]:
@@ -146,7 +154,8 @@ class EngineCluster:
                  metrics: bool = True, step_fn=None,
                  chaos=None, retry: Optional[RetryPolicy] = None,
                  request_deadline: Optional[float] = None,
-                 max_backlog: Optional[int] = None, **engine_kw):
+                 max_backlog: Optional[int] = None,
+                 autoscaler=None, **engine_kw):
         if mode not in MODES:
             raise ValueError(f"unknown mode {mode!r}; have {MODES}")
         if num_replicas < 1:
@@ -176,6 +185,10 @@ class EngineCluster:
             else float(request_deadline)
         self.max_backlog = None if max_backlog is None \
             else int(max_backlog)
+        # SLO traffic plane: the autoscaler (serving.slo.Autoscaler)
+        # rides the existing drain/kill/readmit lifecycle — its hook
+        # runs right after the health sweep each step
+        self.autoscaler = autoscaler
         follow = _FollowTracer(self)
         self.transport = transport if transport is not None \
             else LocalPageTransport()
@@ -232,7 +245,9 @@ class EngineCluster:
                              time_fn=self._time)
         self._next_id = 0
         self.steps = 0
-        self._backlog: List = []                      # heap
+        # class-aware front door: rank-major service, FIFO within a
+        # class, shed pressure falls lowest-class-first
+        self._backlog = ClassBacklog()
         self._pending_handoffs: List[Dict[str, Any]] = []
         # (replica idx, engine req id) -> (creq, stage, fence epoch):
         # live ownership, stamped with the epoch it was placed under
@@ -270,9 +285,23 @@ class EngineCluster:
                           "replica_deaths", "handoff_retries",
                           "handoffs_restaged", "requests_shed",
                           "stale_completions_dropped",
-                          "duplicate_deliveries_dropped", "readmits")}
+                          "duplicate_deliveries_dropped", "readmits",
+                          # SLO traffic plane (DESIGN.md §22): per-class
+                          # sheds, the inversion detector (a shed or
+                          # placement that favored a lower class —
+                          # always 0 by construction, asserted in the
+                          # bench), autoscaler actions
+                          *(f"shed_{c}" for c in SLO_CLASSES),
+                          "class_inversions", "scale_ups",
+                          "scale_downs")}
         self.histograms = {k: make_instrument("histogram", k, m) for k in
-                           ("ttft", "tbt", "request_latency")}
+                           ("ttft", "tbt", "request_latency",
+                            # per-class latency tails: the SLO targets
+                            # are per class, so the evidence must be too
+                            *(f"ttft_{c}" for c in SLO_CLASSES),
+                            *(f"tbt_{c}" for c in SLO_CLASSES))}
+        self.gauges = {"replicas_active":
+                       make_instrument("gauge", "replicas_active", m)}
 
     # -- tracer --------------------------------------------------------------
 
@@ -286,11 +315,12 @@ class EngineCluster:
                     temperature: float = 0.0, top_k: int = 0,
                     top_p: float = 0.0, seed: int = 0,
                     eos_token_id: Optional[int] = None,
-                    arrival_time: Optional[float] = None
-                    ) -> ClusterRequest:
+                    arrival_time: Optional[float] = None,
+                    slo_class: str = "standard") -> ClusterRequest:
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("empty prompt")
+        class_rank(slo_class)          # validate at the front door
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         # fail at the front door, not on a replica mid-route: every
@@ -315,22 +345,31 @@ class EngineCluster:
             top_p=float(top_p), seed=int(seed),
             eos_token_id=eos_token_id,
             arrival_time=now if arrival_time is None
-            else float(arrival_time))
+            else float(arrival_time), slo_class=slo_class)
         creq.submit_time = max(now, creq.arrival_time)
         self._next_id += 1
         self.requests[creq.req_id] = creq
         if self.max_backlog is not None \
                 and len(self._backlog) >= self.max_backlog:
             # bounded backlog: graceful degradation instead of
-            # unbounded queue growth — the rejection is retriable
-            self._shed(creq, "backlog_full", now)
-            return creq
-        heapq.heappush(self._backlog,
-                       (creq.arrival_time, creq.req_id, creq))
+            # unbounded queue growth — the rejection is retriable.
+            # Class-aware: an arrival that STRICTLY outranks the
+            # worst queued entry displaces it (batch sheds before
+            # interactive is turned away); same-class pressure keeps
+            # the old shed-the-arrival FIFO behavior
+            victim = self._backlog.shed_candidate()
+            if victim is not None and victim.rank > creq.rank:
+                self._backlog.remove(victim)
+                self._shed(victim, "displaced", now)
+            else:
+                self._shed(creq, "backlog_full", now)
+                return creq
+        self._backlog.push(creq)
         tr = self.tracer
         if tr.enabled:
             tr.instant("enqueue", track="router", ts=creq.submit_time,
                        req=creq.req_id, prompt_tokens=len(prompt),
+                       slo_class=creq.slo_class,
                        backlog=len(self._backlog))
         return creq
 
@@ -345,10 +384,26 @@ class EngineCluster:
         creq.finish_time = now
         self.shed[creq.req_id] = creq
         self.counters["requests_shed"].inc()
+        self.counters[f"shed_{creq.slo_class}"].inc()
+        # inversion detector: shedding this class while a LOWER class
+        # sits in the backlog equally sheddable means the shed policy
+        # inverted the SLO order — by construction (shed_candidate /
+        # expired_head scan lowest-class-first) this never fires, and
+        # the slo bench asserts the counter stays 0
+        for _arr, _rid, q in self._backlog:
+            if q.rank <= creq.rank:
+                continue
+            if reason != "backpressured_past_deadline" \
+                    or (self.request_deadline is not None
+                        and q.arrival_time <= now
+                        and now - q.submit_time > self.request_deadline):
+                self.counters["class_inversions"].inc()
+                break
         tr = self.tracer
         if tr.enabled:
             tr.instant("shed", track="router", ts=now, req=creq.req_id,
                        reason=reason, retriable=True,
+                       slo_class=creq.slo_class,
                        backlog=len(self._backlog))
 
     # -- loop ----------------------------------------------------------------
@@ -369,6 +424,14 @@ class EngineCluster:
         if self.chaos is not None:
             self.chaos.on_step(self, self.steps, now)
         self._check_health()
+        if self.autoscaler is not None:
+            # after the health sweep: the controller must see death
+            # verdicts (a drain target that died mid-drain is already
+            # handled capacity, not a second kill)
+            self.autoscaler.on_step(self, self.steps, now)
+        self.gauges["replicas_active"].set(
+            sum(1 for r in self.replicas
+                if r.alive and r.serving and not r.draining))
         self._sync_counters()
         self._route_ready(now)
         self._process_handoffs(now)
@@ -441,8 +504,7 @@ class EngineCluster:
                 creq.stage = ""
                 creq.token_times = []
                 self.counters["reroutes"].inc()
-                heapq.heappush(self._backlog,
-                               (creq.arrival_time, creq.req_id, creq))
+                self._backlog.push(creq)
 
     # -- routing -------------------------------------------------------------
 
@@ -457,20 +519,28 @@ class EngineCluster:
         return list(self.replicas)
 
     def _route_ready(self, now: float) -> None:
-        while self._backlog and self._backlog[0][0] <= now:
-            _arr, _rid, creq = self._backlog[0]
+        while True:
+            # rank-major head: an arrived interactive request always
+            # routes before an arrived batch one (FIFO within a class)
+            creq = self._backlog.peek_ready(now)
+            if creq is None:
+                break
             rep = self.router.place(creq, self._prefill_pool())
             if rep is None:
-                # whole fleet backpressured.  Past the deadline the
-                # request is shed (retriable rejection) — bounded wait,
-                # graceful degradation; inside it, FIFO holds
-                if self.request_deadline is not None \
-                        and now - creq.submit_time > self.request_deadline:
-                    heapq.heappop(self._backlog)
-                    self._shed(creq, "backpressured_past_deadline", now)
+                # whole fleet backpressured (placement failure is
+                # fleet-wide, not request-specific — a lower class
+                # could not place either).  Past the deadline requests
+                # shed lowest-class-first (batch before interactive),
+                # bounded wait, graceful degradation
+                victim = self._backlog.expired_head(
+                    now, self.request_deadline)
+                if victim is not None:
+                    self._backlog.remove(victim)
+                    self._shed(victim, "backpressured_past_deadline",
+                               now)
                     continue
                 break
-            heapq.heappop(self._backlog)
+            self._backlog.remove(creq)
             self._submit(creq, rep, now)
 
     def _submit(self, creq: ClusterRequest, rep: Replica,
@@ -502,7 +572,7 @@ class EngineCluster:
             creq.prompt, mnt, temperature=creq.temperature,
             top_k=creq.top_k, top_p=creq.top_p, seed=creq.seed,
             eos_token_id=creq.eos_token_id, arrival_time=now,
-            stream_cb=cb)
+            stream_cb=cb, slo_class=creq.slo_class)
         creq.replica = rep.idx
         creq.stage = stage
         if stage == "prefill":
@@ -567,8 +637,7 @@ class EngineCluster:
         creq.token_times = []
         creq.n_reroutes += 1
         self.counters["reroutes"].inc()
-        heapq.heappush(self._backlog,
-                       (creq.arrival_time, creq.req_id, creq))
+        self._backlog.push(creq)
         tr = self.tracer
         if tr.enabled:
             tr.instant("handoff_degraded", track="router", ts=now,
@@ -715,7 +784,8 @@ class EngineCluster:
             pages=pages, pos=pos, temperature=creq.temperature,
             top_k=creq.top_k, top_p=creq.top_p, seed=creq.seed,
             eos_token_id=creq.eos_token_id, arrival_time=now,
-            stream_cb=self._final_cb(creq, rep.idx, fence))
+            stream_cb=self._final_cb(creq, rep.idx, fence),
+            slo_class=creq.slo_class)
         self._injected.add((creq.req_id, h["epoch"]))
         self._adoptions.append({"req_id": creq.req_id,
                                 "epoch": h["epoch"], "dst": rep.idx,
@@ -786,10 +856,12 @@ class EngineCluster:
         self.finished[creq.req_id] = creq
         self.counters["requests_completed"].inc()
         if creq.token_times:
-            self.histograms["ttft"].observe(
-                creq.token_times[0] - creq.submit_time)
+            ttft = creq.token_times[0] - creq.submit_time
+            self.histograms["ttft"].observe(ttft)
+            self.histograms[f"ttft_{creq.slo_class}"].observe(ttft)
             for a, b in zip(creq.token_times, creq.token_times[1:]):
                 self.histograms["tbt"].observe(b - a)
+                self.histograms[f"tbt_{creq.slo_class}"].observe(b - a)
         self.histograms["request_latency"].observe(
             creq.finish_time - creq.submit_time)
         tr = self.tracer
@@ -889,14 +961,19 @@ class EngineCluster:
         for k in ("replica_deaths", "handoff_retries",
                   "handoffs_restaged", "requests_shed",
                   "stale_completions_dropped",
-                  "duplicate_deliveries_dropped", "readmits"):
+                  "duplicate_deliveries_dropped", "readmits",
+                  # SLO traffic plane (DESIGN.md §22)
+                  *(f"shed_{c}" for c in SLO_CLASSES),
+                  "class_inversions", "scale_ups", "scale_downs"):
             out[k] = self.counters[k].value
         out["requests_rerouted"] = self.counters["reroutes"].value
+        out["replicas_active"] = self.gauges["replicas_active"].value
         for k, h in self.histograms.items():
             out[k] = h.summary()
         out["replicas"] = len(self.replicas)
         out["alive_replicas"] = sum(1 for r in self.replicas if r.alive)
         out["backlog"] = len(self._backlog)
+        out["backlog_by_class"] = self._backlog.depth_by_class()
         out["pending_handoffs"] = len(self._pending_handoffs)
         out["shed"] = len(self.shed)
         out["per_replica"] = {
@@ -926,6 +1003,7 @@ class EngineCluster:
         insts: Dict[str, Any] = {}
         insts.update(self.counters)
         insts.update(self.histograms)
+        insts.update(self.gauges)
         texts = {f"r{r.idx}": r.engine.metrics_text()
                  for r in self.replicas}
         texts["router"] = render_prometheus(insts)

@@ -58,6 +58,10 @@ class Replica:
         # real crash
         self.alive = True
         self.serving = True
+        # autoscaler drain intent: a draining replica serves what it
+        # already owns but takes no new placements (router skips it);
+        # once empty the controller fences it through kill()
+        self.draining = False
         self._digest = None      # (cache version, digest) memo
         if client is not None:
             self.rank = client.connect()
@@ -96,7 +100,7 @@ class Replica:
         return len(self.engine.queue) + len(self.engine.running)
 
     def _all_requests(self) -> List:
-        out = [r for _, _, r in self.engine.queue._heap]
+        out = list(self.engine.queue.requests())
         out.extend(r for r in self.engine.running if r.state == RUNNING)
         return out
 
@@ -141,6 +145,7 @@ class Replica:
         verdict resets."""
         self.serving = True
         self.alive = True
+        self.draining = False
         self.slow_until = 0.0
         self.resume_heartbeat()
 

@@ -89,6 +89,8 @@ class Router:
         for r in replicas:
             if not r.alive:
                 continue
+            if getattr(r, "draining", False):
+                continue       # autoscaler drain: no new placements
             if self.max_queue_depth is not None \
                     and r.queue_depth() >= self.max_queue_depth:
                 continue

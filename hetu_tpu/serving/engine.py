@@ -104,7 +104,8 @@ class Engine:
                  prefix_cache: bool = True, debug: bool = False,
                  tracer=None, step_fn: Optional[Callable] = None,
                  spec: Optional[SpecConfig] = None,
-                 page_quant: Optional[str] = None):
+                 page_quant: Optional[str] = None,
+                 host_tier=None):
         self.cfg = cfg
         self.name = name
         # runtime trace plane (hetu_tpu/obs): None follows the ambient
@@ -195,7 +196,17 @@ class Engine:
                           # Prometheus merge sees a uniform schema;
                           # zero on non-spec engines)
                           "spec_proposed", "spec_accepted",
-                          "spec_bonus_tokens")}
+                          "spec_bonus_tokens",
+                          # SLO traffic plane (serving/slo): per-class
+                          # admission/preemption counts and the host
+                          # KV tier's page moves — always present (zero
+                          # without a host tier / on default-class
+                          # traffic) so the cluster merge stays uniform
+                          "admitted_interactive", "admitted_standard",
+                          "admitted_batch", "preempted_interactive",
+                          "preempted_standard", "preempted_batch",
+                          "host_evictions", "host_hits",
+                          "host_refetch_bytes")}
         self.gauges = {k: make_instrument("gauge", k, m) for k in
                        ("batch_occupancy", "page_utilization",
                         "queue_depth",
@@ -205,7 +216,9 @@ class Engine:
                         # currently-allocated pages; both derive from
                         # kv_pool.page_shape_bytes so the lint /
                         # transport / metrics planes can never disagree
-                        "kv_bytes_per_token", "kv_bytes_in_use")}
+                        "kv_bytes_per_token", "kv_bytes_in_use",
+                        # live host-tier page count (0 without one)
+                        "host_pages")}
         self.gauges["kv_bytes_per_token"].set(
             self.pool.kv_bytes_per_token)
         lb = list(latency_buckets if latency_buckets is not None
@@ -217,6 +230,24 @@ class Engine:
             "request_latency": make_instrument("histogram",
                                                "request_latency", m),
         }
+        # host-RAM tier for cold prefix-cache pages (serving/slo,
+        # DESIGN.md §22): pass a HostTier instance, True (defaults),
+        # or an int page capacity.  Evicted refcount-0 cached pages
+        # stage to host instead of dropping; a chain-hash hit refetches
+        # them bit-exact through PageTransport.inject, priced.
+        self.host_tier = None
+        if host_tier:
+            if self.prefix_cache is None:
+                raise ValueError("host_tier requires prefix_cache=True")
+            from .slo.host_tier import HostTier
+            ht = host_tier if isinstance(host_tier, HostTier) else (
+                HostTier() if host_tier is True
+                else HostTier(int(host_tier)))
+            ht.bind(self.pool, self.prefix_cache,
+                    counters=self.counters, gauges=self.gauges,
+                    tracer_fn=lambda: self.tracer,
+                    time_fn=self._time_fn)
+            self.host_tier = ht
         # speculative decoding (serving/spec.py, DESIGN.md §20): a
         # draft model proposes spec_k greedy tokens per decode-ready
         # request; the scheduler packs them as verify rows and the
@@ -273,7 +304,8 @@ class Engine:
                     top_p: float = 0.0, seed: int = 0,
                     eos_token_id: Optional[int] = None,
                     arrival_time: Optional[float] = None,
-                    stream_cb: Optional[Callable] = None) -> Request:
+                    stream_cb: Optional[Callable] = None,
+                    slo_class: str = "standard") -> Request:
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -295,7 +327,8 @@ class Engine:
                       top_p=float(top_p), seed=int(seed),
                       eos_token_id=eos_token_id,
                       arrival_time=now if arrival_time is None
-                      else float(arrival_time), stream_cb=stream_cb)
+                      else float(arrival_time), stream_cb=stream_cb,
+                      slo_class=slo_class)
         req.submit_time = max(now, req.arrival_time)
         req.trace_t0 = req.submit_time      # queued segment opens here
         self._next_id += 1
@@ -306,6 +339,7 @@ class Engine:
                        ts=req.submit_time, req=req.req_id,
                        prompt_tokens=len(prompt),
                        max_new_tokens=int(max_new_tokens),
+                       slo_class=req.slo_class,
                        queue_depth=len(self.queue))
         return req
 
@@ -316,7 +350,8 @@ class Engine:
                       top_k: int = 0, top_p: float = 0.0, seed: int = 0,
                       eos_token_id: Optional[int] = None,
                       arrival_time: Optional[float] = None,
-                      stream_cb: Optional[Callable] = None) -> Request:
+                      stream_cb: Optional[Callable] = None,
+                      slo_class: str = "standard") -> Request:
         """Admit a MID-FLIGHT request: ``generated`` tokens already
         sampled elsewhere and (optionally) ``pages`` in THIS engine's
         pool already holding KV for positions ``[0, pos)`` — the
@@ -367,7 +402,8 @@ class Engine:
                       top_p=float(top_p), seed=int(seed),
                       eos_token_id=eos_token_id,
                       arrival_time=now if arrival_time is None
-                      else float(arrival_time), stream_cb=stream_cb)
+                      else float(arrival_time), stream_cb=stream_cb,
+                      slo_class=slo_class)
         req.tokens = prompt + generated
         req.out_tokens = list(generated)
         req.pages = pages
@@ -424,6 +460,7 @@ class Engine:
             self.running.remove(req)
             self.queue.push(req)
             self.counters["preemptions"].inc()
+            self.counters[f"preempted_{req.slo_class}"].inc()
             if self.spec is not None:
                 # a preempted request leaves the running set: free its
                 # draft slot (the cache is stale anyway — resuming
@@ -469,6 +506,8 @@ class Engine:
         self.gauges["kv_bytes_in_use"].set(
             (self.pool.num_usable - self.pool.free_pages)
             * self.pool.page_bytes)
+        if self.host_tier is not None:
+            self.gauges["host_pages"].set(self.host_tier.host_pages)
         return produced
 
     def run(self, max_steps: Optional[int] = None
@@ -530,6 +569,13 @@ class Engine:
         looked_up = self.prefix_cache is not None and req.pos == 0 \
             and not req.pages
         if looked_up:
+            if self.host_tier is not None:
+                # extend the device-cache match with host-tier pages
+                # FIRST: restored pages join the index, so the acquire
+                # below attaches the deeper chain through the normal
+                # copy-on-write path (a dry pool simply stops the
+                # restore — the suffix recomputes like any miss)
+                self.host_tier.refetch(req.tokens)
             entries = self.prefix_cache.acquire(req)
             if entries:
                 req.pages = [e.page for e in entries]
@@ -573,6 +619,7 @@ class Engine:
         req.pages = req.pages + pages
         req.peak_pages = max(req.peak_pages, len(req.pages))
         req.state = RUNNING
+        self.counters[f"admitted_{req.slo_class}"].inc()
         self.running.append(req)
         t = self._now()
         if tr.enabled:
@@ -601,7 +648,7 @@ class Engine:
         fenced cluster replica — its re-routed work already lives on
         survivors, so whatever this engine still holds is stale by
         definition.  Returns the aborted engine request ids."""
-        victims = [r for _, _, r in self.queue._heap]
+        victims = list(self.queue.requests())
         victims.extend(self.running)
         for req in victims:
             self.pool.free(req.pages[req.shared_pages:])
@@ -615,7 +662,7 @@ class Engine:
             req.spec_drafts = []
             req.pos = 0
             req.state = FINISHED          # terminal, but never collected
-        self.queue._heap.clear()
+        self.queue.clear()
         self.running.clear()
         if self.debug:
             self.pool.check_invariants()
@@ -955,6 +1002,11 @@ class Engine:
                                 "page_size": self.pool.page_size,
                                 "tap": list(self.tap or ())},
         }
+        if self.host_tier is not None:
+            # host-tier page-move records for the host-offload-unpriced
+            # rule; engines without a host tier stay out of scope
+            meta["host_offload"] = \
+                lambda: list(self.host_tier.records)
         if self.pool.sharding is None:
             # per-edge claim: the single-device serving path predicts
             # ZERO comm edges — any emitted collective is unexplained

@@ -150,6 +150,10 @@ class PagedKVPool:
         # invoked by alloc() when the free list can't cover a request:
         # fn(n_short) reclaims up to n_short cached pages (LRU sweep)
         self._reclaim: Optional[Callable[[int], int]] = None
+        # times a reclaim hook CLAIMED more/fewer pages than actually
+        # landed on the free list (alloc verifies the delta; a lying
+        # hook falls through to preemption instead of IndexError)
+        self.reclaim_shortfalls = 0
         # O(num_pages) invariant rebuilds are opt-in: tests/engines set
         # debug=True (or pass force=) — bench/production paths skip them
         self.debug = bool(debug)
@@ -181,11 +185,22 @@ class PagedKVPool:
         can't satisfy the request — the scheduler's eviction signal.
         When a reclaim hook is installed (the prefix cache's LRU sweep),
         a dry free list triggers it BEFORE giving up: cached refcount-0
-        pages are recycled ahead of recompute preemption."""
+        pages are recycled ahead of recompute preemption.
+
+        The hook's CLAIMED count is never trusted: only pages that
+        actually landed on the free list satisfy the request, so a
+        lying/partial sweep degrades to a clean ``None`` (the caller's
+        preemption path) instead of a short grant.  A mismatch between
+        claim and delivery is recorded in ``reclaim_shortfalls`` —
+        it means the reclaim hook's accounting is broken."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free) and self._reclaim is not None:
-            self._reclaim(n - len(self._free))
+            before = len(self._free)
+            claimed = self._reclaim(n - before)
+            delivered = len(self._free) - before
+            if claimed is not None and int(claimed) != delivered:
+                self.reclaim_shortfalls += 1
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]

@@ -140,6 +140,14 @@ class PrefixCache:
         self._attached: Dict[int, List[CacheEntry]] = {}
         self._next_id = 0
         self._tick = 0
+        # host-tier hook (serving/slo/host_tier.py): called with
+        # (entry, chain_hash) just BEFORE an evicted page returns to
+        # the free list — the page is still cached (read-only) at that
+        # moment, so the hook can stage its bytes to host RAM.  Leaf-
+        # first eviction guarantees the entry's parent chain is still
+        # indexed when the hook runs, which is what makes the chain
+        # hash computable at all.
+        self.on_evict = None
 
     # -- introspection -------------------------------------------------------
 
@@ -296,12 +304,57 @@ class PrefixCache:
             freed += 1
         return freed
 
+    def chain_hash_of(self, e: CacheEntry) -> int:
+        """The entry's layout-salted content chain hash — the same key
+        :meth:`digest` exports and :func:`token_chain_hashes` computes
+        router-side.  Walks the parent links (all still indexed while
+        ``e`` is), so it is usable right up to the moment of
+        eviction."""
+        chain: List[Tuple[int, ...]] = []
+        cur: Optional[CacheEntry] = e
+        while cur is not None:
+            chain.append(cur.tokens)
+            cur = self._by_id.get(cur.parent) if cur.parent != ROOT \
+                else None
+        h = chain_hash(ROOT_HASH, self.pool.layout_tag)
+        for tokens in reversed(chain):
+            h = chain_hash(h, tokens)
+        return h
+
     def _remove(self, e: CacheEntry) -> None:
+        if self.on_evict is not None:
+            # stage BEFORE the index/page bookkeeping: the page is
+            # still read-only cached and the parent chain still hashes
+            self.on_evict(e, self.chain_hash_of(e))
         del self._index[(e.parent, e.tokens)]
         del self._by_id[e.eid]
         if e.parent != ROOT:
             self._by_id[e.parent].children -= 1
         self.pool.uncache_page(e.page)
+
+    # -- host-tier restore ---------------------------------------------------
+
+    def restore(self, parent: int, tokens: Sequence[int], page: int,
+                depth: int) -> CacheEntry:
+        """Re-insert a page refetched from the host tier: ``page`` is
+        freshly allocated and already holds the injected bytes; it
+        becomes a refcount-0 cached entry under ``parent`` exactly as
+        if :meth:`on_finish` had inserted it.  The caller guarantees
+        the key is absent (it probed :meth:`match` first)."""
+        key = (parent, tuple(tokens))
+        if key in self._index:
+            raise ValueError(f"restore of already-cached page at "
+                             f"depth {depth}")
+        self.pool.cache_page(page)
+        self._tick += 1
+        e = CacheEntry(eid=self._next_id, parent=parent, tokens=key[1],
+                       page=page, depth=depth, last_use=self._tick)
+        self._next_id += 1
+        self._index[key] = e
+        self._by_id[e.eid] = e
+        if parent != ROOT:
+            self._by_id[parent].children += 1
+        return e
 
     def clear(self) -> None:
         """Evict everything evictable (attached entries survive — live

@@ -1,9 +1,11 @@
-"""Serving request lifecycle + arrival-ordered admission queue."""
+"""Serving request lifecycle + class-then-arrival admission queue."""
 from __future__ import annotations
 
 import heapq
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional
+from typing import Callable, Iterator, List, Optional
+
+from .slo.classes import SLO_CLASSES, class_rank
 
 WAITING = "waiting"
 RUNNING = "running"
@@ -29,6 +31,9 @@ class Request:
     eos_token_id: Optional[int] = None
     arrival_time: float = 0.0
     stream_cb: Optional[Callable] = None
+    # SLO class (serving/slo/classes.py): pure POLICY — decides who
+    # waits/sheds/preempts, never what a surviving request computes
+    slo_class: str = "standard"
 
     # runtime state
     tokens: List[int] = field(default_factory=list)
@@ -66,6 +71,13 @@ class Request:
     def __post_init__(self):
         if not self.tokens:
             self.tokens = list(self.prompt)
+        class_rank(self.slo_class)   # validate eagerly (raises on typo)
+
+    @property
+    def rank(self) -> int:
+        """Priority rank (0 = most urgent) — the leading sort key of
+        every scheduler ordering decision."""
+        return class_rank(self.slo_class)
 
     @property
     def prompt_len(self) -> int:
@@ -84,32 +96,55 @@ class Request:
 
 
 class RequestQueue:
-    """Arrival-time-ordered waiting queue.
+    """Class-ranked, arrival-time-ordered waiting queue.
 
-    ``pop_ready(now)`` only releases requests whose ``arrival_time`` has
-    passed — staggered arrivals for benchmarks/tests without threads.
-    Ties break on ``req_id`` (submission order), NOT insertion order, so
-    a request pushed BACK (didn't fit / preempted) keeps its place ahead
-    of same-arrival-time peers — no overtaking, no starvation of
-    evicted work.
+    One arrival-ordered heap PER SLO class; ``pop_ready(now)`` scans
+    classes in rank order and releases the first request whose
+    ``arrival_time`` has passed — an interactive request that has
+    arrived always pops before any standard/batch one, but a FUTURE
+    interactive arrival never blocks an already-arrived lower class
+    (the gate is per heap, not global).  Within a class, ties break on
+    ``req_id`` (submission order), NOT insertion order, so a request
+    pushed BACK (didn't fit / preempted) keeps its place ahead of
+    same-arrival-time peers — no overtaking, starvation-free within
+    the class.
     """
 
     def __init__(self):
-        self._heap = []
+        self._heaps = {c: [] for c in SLO_CLASSES}
 
     def push(self, req: Request) -> None:
-        heapq.heappush(self._heap, (req.arrival_time, req.req_id, req))
+        heapq.heappush(self._heaps[req.slo_class],
+                       (req.arrival_time, req.req_id, req))
 
     def pop_ready(self, now: float) -> Optional[Request]:
-        if self._heap and self._heap[0][0] <= now:
-            return heapq.heappop(self._heap)[2]
+        for c in SLO_CLASSES:        # rank order: interactive first
+            heap = self._heaps[c]
+            if heap and heap[0][0] <= now:
+                return heapq.heappop(heap)[2]
         return None
 
     def next_arrival(self) -> Optional[float]:
-        return self._heap[0][0] if self._heap else None
+        heads = [h[0][0] for h in self._heaps.values() if h]
+        return min(heads) if heads else None
+
+    def requests(self) -> Iterator[Request]:
+        """All queued requests, rank-major (heap order within a class
+        — NOT sorted by arrival; callers that care must sort)."""
+        for c in SLO_CLASSES:
+            for _, _, req in self._heaps[c]:
+                yield req
+
+    def clear(self) -> None:
+        for heap in self._heaps.values():
+            heap.clear()
+
+    def depth_by_class(self) -> dict:
+        """Queue depth per class — an autoscaler/router signal."""
+        return {c: len(h) for c, h in self._heaps.items()}
 
     def __len__(self) -> int:
-        return len(self._heap)
+        return sum(len(h) for h in self._heaps.values())
 
     def __bool__(self) -> bool:
-        return bool(self._heap)
+        return any(self._heaps.values())

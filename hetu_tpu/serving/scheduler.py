@@ -142,7 +142,8 @@ class Scheduler:
 
         Single-token rows (``remaining == 1``) fill slots
         ``[0, max_batch)``; mid-prompt requests fill chunk slots
-        ``[max_batch, max_batch + prefill_rows)`` in arrival order with
+        ``[max_batch, max_batch + prefill_rows)`` in class-then-arrival
+        order (interactive prefills ride before batch ones) with
         ``q_len = min(remaining, chunk)`` — EXACTLY as without spec
         mode: prefill chunks are TTFT-critical and speculation never
         touches them.  In spec mode each decode-ready request with
@@ -156,7 +157,7 @@ class Scheduler:
         — they are still RUNNING and keep their pages, they just don't
         ride this step."""
         live = sorted((r for r in running if r.state == RUNNING),
-                      key=lambda r: (r.arrival_time, r.req_id))
+                      key=lambda r: (r.rank, r.arrival_time, r.req_id))
         rows: List[Tuple[Request, int, int]] = []
         verified = set()
         vrow = 0
@@ -211,7 +212,8 @@ class Scheduler:
     def ensure_decode_pages(self, running: List[Request]
                             ) -> Tuple[List[Request], List[Request]]:
         """Give every running request the pages its next KV writes
-        need, evicting latest-arrived requests on exhaustion.  Returns
+        need, evicting lowest-class latest-arrived requests on
+        exhaustion.  Returns
         (kept, evicted); evicted requests are already reset to WAITING
         with their pages freed.  Mid-prefill requests were granted their
         whole prompt's pages at admission, so only emitted-token growth
@@ -223,7 +225,8 @@ class Scheduler:
         decode is free, while preempting any request costs its whole
         prefill — and only then falls back to eviction."""
         evicted: List[Request] = []
-        kept = sorted(running, key=lambda r: (r.arrival_time, r.req_id))
+        kept = sorted(running,
+                      key=lambda r: (r.rank, r.arrival_time, r.req_id))
         for req in list(kept):
             if req in evicted:
                 continue
@@ -241,11 +244,16 @@ class Scheduler:
                 if req.spec_drafts:
                     req.spec_drafts = []   # shed the burst, keep running
                     continue
-                victims = [r for r in kept
-                           if r not in evicted and r is not req]
+                # lowest class first, then latest arrival: a batch
+                # straggler is always evicted before any interactive
+                # request loses its prefill.  The requester ITSELF is a
+                # candidate — a batch request squeezing for a decode
+                # page must self-preempt rather than take a page from a
+                # higher class (that would be an SLO-class inversion)
+                victims = [r for r in kept if r not in evicted]
                 victim = max(victims,
-                             key=lambda r: (r.arrival_time, r.req_id)) \
-                    if victims else req
+                             key=lambda r: (r.rank, r.arrival_time,
+                                            r.req_id))
                 self.preempt(victim)
                 evicted.append(victim)
                 if victim is req:

@@ -1,0 +1,11 @@
+"""SLO-driven traffic plane (DESIGN.md §22): priority classes over the
+scheduler/router, a replica autoscaler riding the existing
+register/readmit/drain lifecycle, and a host-RAM tier for cold
+prefix-cache pages."""
+from .autoscaler import Autoscaler
+from .backlog import ClassBacklog
+from .classes import CLASS_RANK, DEFAULT_TARGETS, SLO_CLASSES, class_rank
+from .host_tier import HostTier
+
+__all__ = ["Autoscaler", "ClassBacklog", "CLASS_RANK",
+           "DEFAULT_TARGETS", "SLO_CLASSES", "class_rank", "HostTier"]

@@ -351,6 +351,63 @@ class TestSeededViolations:
         ctx4 = AnalysisContext(name="t_handoff4", meta={})
         assert not run_rules(ctx4, only=["kv-handoff-unpriced"])
 
+    def test_host_offload_unpriced_fires_once_per_seed(self):
+        """Host-tier contract (ISSUE 17): a device↔host page move whose
+        record lacks the priced edge claim — or whose byte accounting
+        disagrees with pages x page_bytes — fires exactly once; a
+        fully-priced record (what HostTier._price writes) is silent,
+        ``host_offload_exempt`` records are skipped, and executables
+        without host_offload meta are out of scope."""
+        priced = {"dir": "evict", "pages": 1, "payload_bytes": 2048,
+                  "page_bytes": 2048, "chain_hash": 7,
+                  "edge": {"kind": "ppermute", "payload_bytes": 2048,
+                           "count": 1, "tag": "host_offload"},
+                  "predicted_s": 1.1e-6, "wall_s": 0.0}
+        # seed 1: no predicted time at all
+        bad = dict(priced, dir="refetch", predicted_s=None)
+        ctx = AnalysisContext(name="t_host",
+                              meta={"host_offload": [priced, bad]})
+        fired = run_rules(ctx, only=["host-offload-unpriced"])
+        assert len(fired) == 1 and fired[0].severity == "error"
+        assert "host_offload@1" in fired[0].subject
+        assert "refetch" in fired[0].subject
+        # seed 2: record payload disagrees with pages x page_bytes —
+        # the tier moved bytes the claim does not cover (a quantized
+        # pool priced at the full-precision page size, say)
+        lying = dict(priced, payload_bytes=4096,
+                     edge=dict(priced["edge"], payload_bytes=4096))
+        ctx2 = AnalysisContext(name="t_host2",
+                               meta={"host_offload": [lying]})
+        fired2 = run_rules(ctx2, only=["host-offload-unpriced"])
+        assert len(fired2) == 1 and "2048" in fired2[0].message
+        # seed 3: edge payload disagrees with the record's
+        ctx3 = AnalysisContext(
+            name="t_host3",
+            meta={"host_offload":
+                  [dict(priced, edge=dict(priced["edge"],
+                                          payload_bytes=1))]})
+        fired3 = run_rules(ctx3, only=["host-offload-unpriced"])
+        assert len(fired3) == 1 and "1 B" in fired3[0].message
+        # exemptions: a priced record, an exempt bad record, a callable
+        # hook, a raising hook (accounting lost = error), and no meta
+        ctx4 = AnalysisContext(
+            name="t_host4",
+            meta={"host_offload":
+                  [priced, dict(bad, host_offload_exempt=True)]})
+        assert not run_rules(ctx4, only=["host-offload-unpriced"])
+        ctx5 = AnalysisContext(name="t_host5",
+                               meta={"host_offload": lambda: [priced]})
+        assert not run_rules(ctx5, only=["host-offload-unpriced"])
+
+        def boom():
+            raise RuntimeError("accounting lost")
+        ctx6 = AnalysisContext(name="t_host6",
+                               meta={"host_offload": boom})
+        fired6 = run_rules(ctx6, only=["host-offload-unpriced"])
+        assert len(fired6) == 1 and "lost" in fired6[0].message
+        ctx7 = AnalysisContext(name="t_host7", meta={})
+        assert not run_rules(ctx7, only=["host-offload-unpriced"])
+
     def test_cow_page_write_fires_once_per_seed(self):
         """Copy-on-write contract: a unified-step tap record whose KV
         write plan targets a CACHED page (in the refcount snapshot —

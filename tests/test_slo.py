@@ -1,0 +1,514 @@
+"""SLO-driven traffic plane (ISSUE 17): priority classes, replica
+autoscaling, and the host-RAM tier for cold KV pages.
+
+Contracts covered:
+
+- **class-aware scheduling** — rank-major service at the engine queue
+  and the cluster front door, preemption victims lowest-class-first
+  (asserted NON-vacuous: batch requests really are preempted under
+  page pressure while interactive ones never are), FIFO within a
+  class, and temperature-0 outputs bit-for-bit the solo ``generate()``
+  regardless of class (class is policy, never computation);
+- **shed order** — a full backlog displaces batch before turning away
+  interactive; deadline sheds scan lowest-class-first; the
+  ``class_inversions`` detector stays 0 throughout;
+- **autoscaler** — scale-down drains through the router (no new
+  placements) then fences via the EXISTING ``kill_replica`` path;
+  scale-up readmits; co-completing requests are bitwise vs a static
+  fleet; a chaos crash landing on the drain target mid-drain is
+  absorbed without a double-drain;
+- **host tier** — evict→refetch round-trips bit-for-bit vs a
+  never-evicted engine for learned-MLA, rotary-MLA and int8-quantized
+  page layouts, with both directions priced;
+- **partial reclaim** (satellite): a lying reclaim hook degrades to a
+  clean ``alloc() -> None`` (the preemption path), never a short
+  grant, and the shortfall is counted.
+"""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.fault import ChaosController, FaultEvent, FaultPlan, \
+    check_cluster_invariants
+from hetu_tpu.models import GPTConfig, GPTLMHeadModel
+from hetu_tpu.models.generate import generate
+from hetu_tpu.models.gpt import mla_state_from
+from hetu_tpu.serving import Engine, EngineCluster
+from hetu_tpu.serving.kv_pool import PagedKVPool
+from hetu_tpu.serving.request import Request, RequestQueue
+from hetu_tpu.serving.slo import (Autoscaler, ClassBacklog, SLO_CLASSES,
+                                  class_rank)
+
+CFG_KW = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+              max_seq_len=64, sp=False, dropout=0.0)
+
+# one packed-step shape for the whole module -> one compiled program
+# (engines and clusters below share it via step_fn)
+SHAPE_KW = dict(page_size=8, max_batch=4, chunk_size=8, prefill_rows=1,
+                max_model_len=56)
+
+
+@pytest.fixture(scope="module")
+def model_state():
+    cfg = GPTConfig(**CFG_KW)
+    ht.set_seed(3)
+    with ht.graph("eager", create_new=True):
+        model = GPTLMHeadModel(cfg)
+        model.logits(np.zeros((1, 4), np.int32))
+        state = {k: np.asarray(v) for k, v in model.state_dict().items()}
+    return state, cfg
+
+
+@pytest.fixture(scope="module")
+def shared_fn():
+    from hetu_tpu.serving.decode import build_unified_step_fn
+    cfg = GPTConfig(**CFG_KW)
+    return build_unified_step_fn(
+        cfg, SHAPE_KW["max_batch"], SHAPE_KW["chunk_size"],
+        SHAPE_KW["prefill_rows"],
+        -(-SHAPE_KW["max_model_len"] // SHAPE_KW["page_size"]),
+        SHAPE_KW["page_size"], use_kernel=False)
+
+
+def _solo(state, cfg, prompt, n_new):
+    return np.asarray(generate(state, cfg,
+                               np.asarray([prompt], np.int32), n_new,
+                               temperature=0.0))[0, len(prompt):].tolist()
+
+
+def _make_engine(state, cfg, **kw):
+    clock = [0.0]
+    kw.setdefault("time_fn", lambda: clock[0])
+    kw.setdefault("debug", True)
+    for k, v in SHAPE_KW.items():
+        kw.setdefault(k, v)
+    eng = Engine(state, cfg, **kw)
+    eng._test_clock = clock
+    return eng
+
+
+def _make_cluster(state, cfg, fn=None, **kw):
+    clock = [0.0]
+    kw.setdefault("time_fn", lambda: clock[0])
+    kw.setdefault("num_pages", 12)
+    for k, v in SHAPE_KW.items():
+        kw.setdefault(k, v)
+    kw.setdefault("debug", True)
+    kw.setdefault("ttl", 3600.0)
+    # in-process fleet: death verdicts come from the serving flag, not
+    # heartbeat TTL — kill_replica fences on the NEXT health sweep
+    kw.setdefault("coordinator", False)
+    cl = EngineCluster(state, cfg, step_fn=fn, **kw)
+    cl._test_clock = clock
+    return cl
+
+
+def _drain(obj, limit=500, invariants=False):
+    n = 0
+    while obj.has_work:
+        obj.step()
+        obj._test_clock[0] += 1.0
+        if invariants:
+            check_cluster_invariants(obj)
+        n += 1
+        assert n < limit, "did not drain"
+    return n
+
+
+# ---------------------------------------------------------------------------
+# units: classes, queue, backlog
+# ---------------------------------------------------------------------------
+
+
+def test_class_rank_and_validation():
+    assert [class_rank(c) for c in SLO_CLASSES] == [0, 1, 2]
+    with pytest.raises(ValueError):
+        class_rank("platinum")
+    with pytest.raises(ValueError):
+        Request(req_id=0, prompt=[1], max_new_tokens=1,
+                slo_class="platinum")
+
+
+def test_request_queue_rank_major_with_per_class_arrival_gate():
+    q = RequestQueue()
+    mk = (lambda rid, c, t: Request(req_id=rid, prompt=[1],
+                                    max_new_tokens=1, slo_class=c,
+                                    arrival_time=t))
+    q.push(mk(0, "batch", 0.0))
+    q.push(mk(1, "interactive", 5.0))       # future
+    q.push(mk(2, "standard", 0.0))
+    # a FUTURE interactive must not gate an arrived lower class
+    assert q.pop_ready(1.0).req_id == 2
+    assert q.pop_ready(1.0).req_id == 0
+    assert q.pop_ready(1.0) is None
+    # once arrived, interactive outranks anything
+    q.push(mk(3, "batch", 0.0))
+    assert q.pop_ready(6.0).req_id == 1
+    assert q.depth_by_class() == {"interactive": 0, "standard": 0,
+                                  "batch": 1}
+
+
+def test_class_backlog_shed_candidate_and_expired_head():
+    class _C:
+        def __init__(self, rid, c, arr):
+            self.req_id, self.slo_class = rid, c
+            self.arrival_time = self.submit_time = arr
+    b = ClassBacklog()
+    for rid, c, arr in ((0, "interactive", 0.0), (1, "batch", 0.0),
+                        (2, "batch", 2.0), (3, "standard", 1.0)):
+        b.push(_C(rid, c, arr))
+    assert len(b) == 4 and bool(b)
+    # iteration: rank-major 3-tuples (the chaos invariants' shape)
+    assert [rid for _a, rid, _c in b] == [0, 3, 1, 2]
+    # displacement victim: LATEST arrival of the LOWEST class
+    assert b.shed_candidate().req_id == 2
+    # deadline scan: lowest class first, arrival-gated
+    assert b.expired_head(10.0, None) is None
+    assert b.expired_head(10.0, 5.0).req_id == 1      # batch before std
+    b.remove(b.shed_candidate())
+    b.remove(b.expired_head(10.0, 5.0))
+    assert b.expired_head(10.0, 5.0).req_id == 3      # std before inter
+    assert b.depth_by_class() == {"interactive": 1, "standard": 1,
+                                  "batch": 0}
+    # heads are rank-major among ARRIVED entries only
+    assert b.peek_ready(0.5).req_id == 0
+
+
+# ---------------------------------------------------------------------------
+# class-aware packing + preemption order (non-vacuous, bitwise)
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_victims_lowest_class_first_bitwise(model_state,
+                                                       shared_fn):
+    """Page pressure on a mixed-class batch: the pool runs dry during
+    decode growth and ONLY batch requests are preempted (asserted
+    non-vacuous) — interactive requests keep their prefills, and every
+    surviving output is still bit-for-bit solo ``generate()`` (class
+    decides who waits, never what anyone computes)."""
+    state, cfg = model_state
+    # 8 usable pages = exactly the four 2-page prefills; the first
+    # decode-growth past pos 16 MUST evict someone
+    eng = _make_engine(state, cfg, num_pages=9, name="slo_preempt",
+                       step_fn=shared_fn)
+    classes = ["interactive", "batch", "interactive", "batch"]
+    prompts, reqs = {}, []
+    for i, c in enumerate(classes):
+        p = [int(t) for t in range(2 + i, 14 + i)]    # 12 tokens: 2 pages
+        r = eng.add_request(p, max_new_tokens=8, slo_class=c)
+        prompts[r.req_id] = p
+        reqs.append(r)
+    _drain(eng)
+    # pressure was real and fell class-ordered
+    assert eng.counters["preempted_batch"].value >= 1, \
+        "no batch preemption — the class-order claim is vacuous"
+    assert eng.counters["preempted_interactive"].value == 0
+    assert eng.counters["admitted_interactive"].value >= 2
+    for r in reqs:
+        assert eng.finished[r.req_id].out_tokens == \
+            _solo(state, cfg, prompts[r.req_id], 8), r.req_id
+    eng.pool.check_invariants(force=True)
+
+
+# ---------------------------------------------------------------------------
+# shed order at the cluster front door
+# ---------------------------------------------------------------------------
+
+
+def test_shed_order_displacement_and_deadline(model_state, shared_fn):
+    state, cfg = model_state
+    cl = _make_cluster(state, cfg, shared_fn, num_replicas=1,
+                       name="slo_shed", max_backlog=2,
+                       max_queue_depth=1, request_deadline=5.0)
+    # fill the bounded backlog with future batch arrivals
+    for _ in range(3):
+        cl.add_request([5, 6, 7], 3, arrival_time=100.0,
+                       slo_class="batch")
+    assert cl.counters["shed_batch"].value == 1        # backlog_full
+    # an interactive arrival DISPLACES a queued batch entry
+    r = cl.add_request([8, 9, 10], 3, arrival_time=100.0,
+                       slo_class="interactive")
+    assert not r.rejected
+    assert cl.counters["shed_batch"].value == 2
+    assert cl.shed and all(c.slo_class == "batch"
+                           for c in cl.shed.values())
+    assert cl._backlog.depth_by_class() == \
+        {"interactive": 1, "standard": 0, "batch": 1}
+    # a same-class arrival does NOT displace (FIFO keeps holding)
+    r2 = cl.add_request([11, 12], 3, arrival_time=100.0,
+                        slo_class="batch")
+    assert r2.rejected and r2.reject_reason == "backlog_full"
+    # deadline expiry under total backpressure: the single replica is
+    # saturated by an interactive long-runner, so the queued batch
+    # entry sheds past the deadline while interactive routes
+    cl._test_clock[0] = 100.0
+    _drain(cl)
+    assert cl.counters["class_inversions"].value == 0
+    assert cl.counters["shed_interactive"].value == 0
+    ms = cl.metrics_summary()
+    assert ms["shed_batch"] == ms["cluster_shed_batch"] == 3.0
+    cl.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler: bitwise vs static fleet, drain lifecycle, chaos overlay
+# ---------------------------------------------------------------------------
+
+
+def _mixed_trace(rng, n):
+    out = []
+    for i in range(n):
+        size = int(rng.randint(4, 12))
+        cls = SLO_CLASSES[int(rng.randint(3))]
+        out.append(([int(t) for t in rng.randint(1, 90, size=size)],
+                    cls, float(i)))
+    return out
+
+
+def test_autoscale_up_down_bitwise_vs_static_fleet(model_state,
+                                                   shared_fn):
+    """The autoscaler drains a replica on an idle fleet, readmits it
+    under backlog pressure (both asserted non-vacuous), and the
+    requests' outputs are token-for-token what the SAME trace produces
+    on a static always-2-replica fleet — scaling is placement policy,
+    never computation."""
+    state, cfg = model_state
+    rng = np.random.RandomState(11)
+    trace = _mixed_trace(rng, 8)
+    NEW = 6
+
+    def run(autoscaler, idle_steps):
+        cl = _make_cluster(state, cfg, shared_fn, num_replicas=2,
+                           name="slo_auto", policy="load",
+                           max_queue_depth=2, autoscaler=autoscaler)
+        for _ in range(idle_steps):        # idle window: scale-down bait
+            cl.step()
+            cl._test_clock[0] += 1.0
+        t0 = cl._test_clock[0]
+        reqs = []
+        for p, cls, arr in trace:
+            reqs.append(cl.add_request(p, NEW, arrival_time=t0 + arr,
+                                       slo_class=cls))
+        _drain(cl, invariants=True)
+        out = {r.req_id - reqs[0].req_id: list(r.out_tokens)
+               for r in reqs}
+        ms = cl.metrics_summary()
+        cl.close()
+        return out, ms
+
+    auto = Autoscaler(min_replicas=1, backlog_high=4, backlog_low=0,
+                      hysteresis_steps=2, cooldown_steps=3,
+                      ttft_target=None)
+    managed, ms = run(auto, idle_steps=10)
+    static, ms_static = run(None, idle_steps=10)
+    assert managed == static, "autoscaling changed a request's tokens"
+    assert ms["scale_downs"] >= 1, "no scale-down — test is vacuous"
+    assert ms["scale_ups"] >= 1, "no scale-up — test is vacuous"
+    assert ms["class_inversions"] == 0
+    assert ms_static["scale_ups"] == ms_static["scale_downs"] == 0
+    assert auto.scale_up_events == ms["scale_ups"]
+
+
+def test_chaos_death_during_scale_down_no_double_drain(model_state,
+                                                       shared_fn):
+    """Composition with the fault plane: the chaos plan crashes the
+    exact replica the autoscaler is draining, mid-drain.  The death
+    sweep re-routes its work (nothing lost, outputs fault-free), the
+    controller clears its drain intent WITHOUT a second kill, and the
+    scale-down is counted exactly once."""
+    state, cfg = model_state
+    prompts = [[int(t) for t in range(3 + i, 13 + i)] for i in range(3)]
+    NEW = 8
+    want = {}
+    for i, p in enumerate(prompts):
+        want[i] = _solo(state, cfg, p, NEW)
+
+    plan = FaultPlan(events=[FaultEvent(step=4, kind="crash",
+                                        target=1)])
+    auto = Autoscaler(min_replicas=1, backlog_high=99, backlog_low=99,
+                      hysteresis_steps=2, cooldown_steps=50,
+                      ttft_target=None)
+    cl = _make_cluster(state, cfg, shared_fn, num_replicas=2,
+                       name="slo_chaos", policy="load",
+                       chaos=ChaosController(plan), autoscaler=auto)
+    reqs = [cl.add_request(p, NEW, arrival_time=0.0)
+            for p in prompts]
+    # let the drain intent land, then verify chaos hits the victim
+    for _ in range(3):
+        cl.step()
+        cl._test_clock[0] += 1.0
+    assert cl.replicas[1].draining, "drain intent never landed"
+    assert cl.replicas[1].engine.has_work, "victim idle — crash would " \
+        "not land mid-drain"
+    _drain(cl, invariants=True)
+    assert set(cl.finished) == {r.req_id for r in reqs}
+    for i, r in enumerate(reqs):
+        assert r.out_tokens == want[i], i
+    ms = cl.metrics_summary()
+    assert ms["replica_deaths"] == 1
+    assert ms["scale_downs"] == 1, "double-drain (or lost drain)"
+    assert ms["readmits"] == 0
+    assert not cl.replicas[1].draining
+    assert not cl.replicas[1].alive
+    cl.close()
+
+
+# ---------------------------------------------------------------------------
+# host tier: evict -> refetch bitwise across layouts
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mla_states(model_state):
+    state, cfg = model_state
+    lstate, lcfg = mla_state_from(state, cfg, kv_latent_dim=16)
+    rcfg_base = GPTConfig(position="rotary", norm="rmsnorm",
+                          activation="swiglu", **CFG_KW)
+    ht.set_seed(7)
+    with ht.graph("eager", create_new=True):
+        rmodel = GPTLMHeadModel(rcfg_base)
+        rmodel.logits(np.zeros((1, 4), np.int32))
+        rstate_base = {k: np.asarray(v)
+                       for k, v in rmodel.state_dict().items()}
+    rstate, rcfg = mla_state_from(rstate_base, rcfg_base,
+                                  kv_latent_dim=16, kv_rope_dim=4)
+    return {"mla": (lstate, lcfg, None),
+            "mla_rot": (rstate, rcfg, None),
+            "int8": (lstate, lcfg, "int8")}
+
+
+@pytest.mark.parametrize("layout", ["mla", "mla_rot", "int8"])
+def test_host_tier_evict_refetch_bitwise(mla_states, layout):
+    """The memory-hierarchy contract: a cold sweep pushes cached pages
+    to host staging, a same-header request pulls them back through the
+    priced transport, and the output is bit-for-bit a never-evicted
+    run's — for latent, rotary-latent and int8-quantized page layouts
+    (each prices at its true page_bytes)."""
+    state, cfg, quant = mla_states[layout]
+    header = list(range(1, 18))            # two full pages at ps=8
+    tails = ([21, 22], [31, 32])
+
+    def run(evict):
+        eng = _make_engine(state, cfg, num_pages=16,
+                           name=f"slo_host_{layout}_{int(evict)}",
+                           host_tier=True, page_quant=quant)
+        outs = []
+        for tail in tails:
+            r = eng.add_request(header + tail, max_new_tokens=5)
+            _drain(eng)
+            outs.append(list(eng.finished[r.req_id].out_tokens))
+            if evict:
+                # the cold sweep: every refcount-0 cached page -> host
+                eng.prefix_cache.evict(16)
+                assert eng.pool.cached_pages == 0
+        eng.pool.check_invariants(force=True)
+        eng.prefix_cache.check_invariants()
+        return eng, outs
+
+    eng, evicted_outs = run(evict=True)
+    _, warm_outs = run(evict=False)
+    assert evicted_outs == warm_outs, \
+        "host-tier round-trip changed tokens"
+    assert eng.host_tier.evictions >= 2, "sweep staged nothing"
+    assert eng.host_tier.hits >= 2, "second request never refetched"
+    assert eng.counters["host_hits"].value == eng.host_tier.hits
+    assert eng.counters["prefix_cache_hits"].value >= 1, \
+        "refetch did not re-enter the cache index"
+    # both directions priced, byte accounting exact at THIS layout's
+    # page_bytes (latent/quant pages are smaller than full-head)
+    recs = eng.host_tier.records
+    assert {r["dir"] for r in recs} == {"evict", "refetch"}
+    for r in recs:
+        assert r["payload_bytes"] == r["pages"] * eng.pool.page_bytes
+        assert r["edge"]["tag"] == "host_offload"
+        assert r["predicted_s"] > 0
+    assert eng.gauges["host_pages"].value == eng.host_tier.host_pages
+
+
+def test_host_tier_metrics_and_reset_robustness(model_state, shared_fn):
+    """Host counters are always-present (uniform cluster merge) and the
+    tier survives ``reset_metrics`` — instruments are looked up by key
+    at use time, so post-reset evictions still count."""
+    state, cfg = model_state
+    eng = _make_engine(state, cfg, num_pages=16, name="slo_host_reset",
+                       step_fn=shared_fn, host_tier=True)
+    txt = eng.metrics_text()
+    for key in ("host_evictions", "host_hits", "host_refetch_bytes",
+                "host_pages"):
+        assert key in txt, key
+    header = list(range(1, 18))
+    eng.add_request(header + [21, 22], max_new_tokens=4)
+    _drain(eng)
+    eng.reset_metrics()
+    eng.prefix_cache.evict(16)
+    assert eng.counters["host_evictions"].value >= 2, \
+        "post-reset instruments lost the host tier"
+    eng.add_request(header + [31, 32], max_new_tokens=4)
+    _drain(eng)
+    assert eng.counters["host_hits"].value >= 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: partial reclaim degrades cleanly
+# ---------------------------------------------------------------------------
+
+
+def test_alloc_partial_reclaim_falls_through_to_none():
+    """A reclaim hook that CLAIMS more than it delivers: ``alloc``
+    trusts only the free list — clean ``None`` (the caller's preemption
+    signal), no short grant, no exception — and counts the shortfall."""
+    pool = PagedKVPool(num_layers=1, num_pages=4, page_size=8,
+                       kv_heads=1, head_dim=4)
+    got = pool.alloc(3)                     # usable = 3 (trash page 0)
+    assert got is not None and len(got) == 3
+
+    lies = []
+
+    def lying_sweep(n):
+        lies.append(n)
+        return n                            # claims n, delivers 0
+
+    pool.set_reclaim(lying_sweep)
+    assert pool.alloc(2) is None
+    assert lies == [2]
+    assert pool.reclaim_shortfalls == 1
+    pool.check_invariants()
+    # a TRUTHFUL partial sweep is also a shortfall-free None
+    pool.free(got[:1])
+
+    def honest_partial(n):
+        return 0                            # delivers nothing, says so
+
+    pool.set_reclaim(honest_partial)
+    assert pool.alloc(3) is None
+    assert pool.reclaim_shortfalls == 1     # honesty is not a shortfall
+    assert pool.alloc(1) is not None        # free list still coherent
+    pool.check_invariants()
+
+
+def test_engine_survives_lying_reclaim_via_preemption(model_state,
+                                                      shared_fn):
+    """End-to-end satellite check: with the cache's sweep replaced by a
+    liar, page pressure falls through to recompute preemption and the
+    outputs stay bitwise — the engine never sees a short grant."""
+    state, cfg = model_state
+    # prefix_cache off: with it on, the (lying) reclaim hook is the
+    # ONLY route from cached pages back to the free list and the pool
+    # would starve forever — here preemption itself frees pages, so the
+    # engine makes progress while the liar is still consulted on every
+    # shortfall
+    eng = _make_engine(state, cfg, num_pages=9, name="slo_lying",
+                       step_fn=shared_fn, prefix_cache=False)
+    eng.pool.set_reclaim(lambda n: n)       # claims n, delivers 0
+    prompts = {}
+    for i in range(4):
+        p = [int(t) for t in range(2 + i, 14 + i)]
+        r = eng.add_request(p, max_new_tokens=8)
+        prompts[r.req_id] = p
+    _drain(eng)
+    assert eng.pool.reclaim_shortfalls >= 1, "liar never consulted"
+    assert eng.counters["preemptions"].value >= 1, \
+        "no preemption — the fall-through claim is vacuous"
+    for rid, p in prompts.items():
+        assert eng.finished[rid].out_tokens == _solo(state, cfg, p, 8)
+    eng.pool.check_invariants(force=True)
