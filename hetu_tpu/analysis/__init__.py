@@ -39,6 +39,9 @@ from .cost import (CommCost, CostEntry, CostReport, cost_walk,
                    xla_cost_stats)
 from .edges import (CommEdge, EdgeMatch, grad_comm_edges, makes_edge_claim,
                     match_edges, predict_edges)
+from .events import ALL_KINDS, Event, collect_events, kind_counts
+from .protocol import (ExploreConfig, ExploreResult, Violation, explore,
+                       fuzz_trace, machine_summary, replay)
 from .jaxpr_walk import (collect_collectives, compute_dtype_histogram,
                          donation_candidates, iter_eqns,
                          unreduced_scalar_outputs)
@@ -47,8 +50,9 @@ from .memory import (MemoryBuffer, MemoryReport, has_remat_region,
                      predict_memory, xla_memory_stats)
 from .report import (AnalysisReport, CollectiveRecord, ExecutableReport,
                      Finding, load_baseline, save_baseline)
-from .rules import (DEFAULT_OPTIONS, RULES, AnalysisContext, ParamInfo,
-                    rule, run_rules)
+from .rules import (DEFAULT_OPTIONS, RULES, TRACE_RULE_EVENT_KINDS,
+                    AnalysisContext, ParamInfo, _protocol_replay, rule,
+                    run_rules)
 
 __all__ = [
     "AnalysisContext", "AnalysisReport", "CollectiveRecord", "CommEdge",
@@ -64,6 +68,10 @@ __all__ = [
     "predicted_cost_stats", "CommCost", "CostEntry", "CostReport",
     "cost_walk", "dot_general_flops", "predict_cost", "price_edges",
     "xla_cost_stats",
+    # serving-protocol verifier (DESIGN.md §23)
+    "ALL_KINDS", "Event", "ExploreConfig", "ExploreResult",
+    "TRACE_RULE_EVENT_KINDS", "Violation", "collect_events", "explore",
+    "fuzz_trace", "kind_counts", "machine_summary", "replay",
 ]
 
 
@@ -185,6 +193,21 @@ def analyze_handle(handle: ExecutableHandle, compile: bool = False,
         rep.meta["memory"] = ctx.memory
     if ctx.cost is not None:
         rep.meta["cost"] = ctx.cost
+    # serving-protocol coverage: every executable gets a section (train
+    # gates pin an EMPTY stream — uniform baseline keys, and a train
+    # plan that suddenly emits serving events is itself a finding-worthy
+    # surprise the event count will surface).  The violation count here
+    # is the lifecycle machines' verdict over the live trace; the
+    # per-violation findings already ride in rep.findings via the four
+    # lifecycle rules.
+    events, lost = collect_events(ctx)
+    rep.meta["protocol"] = {
+        "events": len(events),
+        "kinds": kind_counts(events),
+        "violations": len(_protocol_replay(ctx)),
+        "lost_hooks": sorted(lost),
+        "machines": machine_summary(events),
+    }
     return rep
 
 

@@ -49,6 +49,14 @@ emits; then:
   Same contract as ``--memory``: always computed and gated, the flag
   only controls the text section, ``--format json`` always carries the
   ``cost`` dict.
+* ``--protocol``: print the serving-protocol verifier section per
+  executable (normalized event-stream size, observed kind vocabulary,
+  lifecycle-machine coverage, violation count — DESIGN.md §23).  Like
+  ``--memory``/``--cost`` the numbers are always computed and gated
+  (the baseline pins per-executable protocol coverage); the flag only
+  controls the text section, ``--format json`` always carries the
+  ``protocol`` dict.  Lifecycle findings carry the violating event
+  subtrace, printed by ``--explain``.
 * ``--hbm-budget``: device HBM budget in GiB for the ``oom-risk`` rule
   (default: the rule's v5p budget).
 
@@ -426,7 +434,15 @@ def explain_report(report, out=sys.stdout, memory: bool = False,
             continue
         for f in rep.findings:
             print(f"  ! {f}", file=out)
-            if f.hint:
+            if not f.hint:
+                continue
+            if "\n" in f.hint:
+                # lifecycle findings carry the violating event subtrace
+                # (protocol.Violation.format_subtrace) — print it as a
+                # block, not jammed onto one "fix:" line
+                for ln in f.hint.splitlines():
+                    print(f"    {ln}", file=out)
+            else:
                 print(f"    fix: {f.hint}", file=out)
 
 
@@ -454,11 +470,35 @@ def cost_section(report, out=sys.stdout) -> None:
         print(f"  {name}: {co.summary()}", file=out)
 
 
+def protocol_section(report, out=sys.stdout) -> None:
+    """--protocol: the serving-protocol verifier per executable — the
+    normalized event stream's size and kind vocabulary, the lifecycle
+    machines' coverage, and the violation count (DESIGN.md §23)."""
+    print("\nserving-protocol verifier (analysis/protocol):", file=out)
+    for name, rep in sorted(report.executables.items()):
+        p = rep.meta.get("protocol")
+        if p is None:
+            print(f"  {name}: (protocol pass unavailable)", file=out)
+            continue
+        m = p.get("machines", {})
+        lost = f", LOST hooks {p['lost_hooks']}" \
+            if p.get("lost_hooks") else ""
+        print(f"  {name}: {p['events']} events / "
+              f"{len(p.get('kinds', {}))} kinds, machines saw "
+              f"{m.get('pages', 0)} pages / {m.get('requests', 0)} "
+              f"requests / {m.get('replicas', 0)} replicas, "
+              f"{p['violations']} violations{lost}", file=out)
+        if p.get("kinds"):
+            ks = ", ".join(f"{k} x{v}"
+                           for k, v in sorted(p["kinds"].items()))
+            print(f"    kinds: {ks}", file=out)
+
+
 def run_gate(baseline_path: str = BASELINE_DEFAULT,
              tolerance: float = 0.1, update: bool = False,
              as_json: bool = False, compile: bool = True,
              explain: bool = False, memory: bool = False,
-             cost: bool = False,
+             cost: bool = False, protocol: bool = False,
              hbm_budget_gib: float = None, out=sys.stdout) -> int:
     """Build, analyze, gate.  Returns the process exit code
     (0 clean / 1 findings / 2 baseline missing)."""
@@ -555,6 +595,8 @@ def run_gate(baseline_path: str = BASELINE_DEFAULT,
             memory_section(report, out=out)
         if cost:
             cost_section(report, out=out)
+        if protocol:
+            protocol_section(report, out=out)
     if explain:
         explain_report(report, out=out, memory=memory, cost=cost)
     if update:
@@ -609,6 +651,11 @@ def main(argv=None) -> int:
                          "roofline verdict, comm time, XLA cost_analysis"
                          " deltas; with --explain, the attribution "
                          "table)")
+    ap.add_argument("--protocol", action="store_true",
+                    help="print the serving-protocol verifier section "
+                         "(event stream size, kind vocabulary, machine "
+                         "coverage, lifecycle violations; --explain "
+                         "prints each violation's event subtrace)")
     ap.add_argument("--hbm-budget", type=float, default=None,
                     metavar="GIB",
                     help="device HBM budget in GiB for the oom-risk "
@@ -628,6 +675,7 @@ def main(argv=None) -> int:
                     explain=args.explain,
                     memory=args.memory,
                     cost=args.cost,
+                    protocol=args.protocol,
                     hbm_budget_gib=args.hbm_budget)
 
 

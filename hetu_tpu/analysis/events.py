@@ -1,0 +1,442 @@
+"""One typed event stream for the serving protocol (DESIGN.md §23).
+
+Every record plane the serving stack produces — the unified-step tap,
+``PagedKVPool`` allocator ops, ``PrefixCache`` sharing, page-transport
+extract/inject, ``HostTier`` stage/refetch, cluster fencing / adoption
+/ shedding, speculative rewinds, chaos instants — historically carried
+its own private dict shape, and every trace lint re-parsed its own
+plane.  This module is the single normalization point: adapters turn
+each raw plane into :class:`Event` records with a canonical ``kind``
+vocabulary, and :func:`collect_events` merges an executable's planes
+into ONE ordered stream (ordered by the process-global protocol
+sequence every producer stamps at record time — see
+``serving.kv_pool.protocol_seq``).  The lifecycle state machines
+(``analysis.protocol``) and every trace-replay rule (``analysis.rules``)
+consume ONLY this stream, so a new subsystem plugs into the verifier by
+emitting events, not by teaching each rule a new dict shape.
+
+Event kinds are plain strings (``"page.alloc"``, ``"req.adopt"``,
+``"fence.bump"``, ...) so producers in ``hetu_tpu.serving`` can log
+them without importing the analysis package (no import cycle); the
+canonical vocabulary lives here as constants.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+# Mirrors serving.kv_pool.TRASH_PAGE (kept as a literal so this module
+# stays import-light; the pool asserts the value at construction).
+TRASH_PAGE = 0
+
+# -- canonical event vocabulary ----------------------------------------------
+
+# page plane (allocator + host tier + wire)
+PAGE_ALLOC = "page.alloc"
+PAGE_FREE = "page.free"
+PAGE_CACHE = "page.cache"
+PAGE_SHARE = "page.share"
+PAGE_UNSHARE = "page.unshare"
+PAGE_UNCACHE = "page.uncache"
+PAGE_WRITE = "page.write"        # KV scatter into a page (from the tap)
+POOL_RESET = "pool.reset"
+HOST_STAGE = "host.stage"        # cold page staged to host RAM
+HOST_REFETCH = "host.refetch"    # staged page injected back on device
+
+# request plane
+REQ_QUEUED = "req.queued"
+REQ_ADMIT = "req.admit"
+REQ_WRITE = "req.write"          # one packed row's KV write claim
+REQ_PREEMPT = "req.preempt"      # recompute-style eviction (kv_drop)
+REQ_REWIND = "req.rewind"        # speculative verify rejection
+REQ_STAGE = "req.stage"          # disaggregated handoff staged
+REQ_ADOPT = "req.adopt"          # mid-flight adoption on a replica
+REQ_FINISH = "req.finish"
+REQ_SHED = "req.shed"
+
+# fence plane
+FENCE_BUMP = "fence.bump"
+FENCE_COMPLETE = "fence.complete"
+FENCE_STALE_DROP = "fence.stale_drop"
+
+# wire plane
+WIRE_EXTRACT = "wire.extract"
+WIRE_INJECT = "wire.inject"
+
+# fault plane
+CHAOS_INJECT = "chaos.inject"
+
+ALL_KINDS = (
+    PAGE_ALLOC, PAGE_FREE, PAGE_CACHE, PAGE_SHARE, PAGE_UNSHARE,
+    PAGE_UNCACHE, PAGE_WRITE, POOL_RESET, HOST_STAGE, HOST_REFETCH,
+    REQ_QUEUED, REQ_ADMIT, REQ_WRITE, REQ_PREEMPT, REQ_REWIND,
+    REQ_STAGE, REQ_ADOPT, REQ_FINISH, REQ_SHED,
+    FENCE_BUMP, FENCE_COMPLETE, FENCE_STALE_DROP,
+    WIRE_EXTRACT, WIRE_INJECT, CHAOS_INJECT,
+)
+
+
+@dataclass(frozen=True)
+class Event:
+    """One protocol event.
+
+    ``kind``
+        Canonical vocabulary entry (one of :data:`ALL_KINDS`).
+    ``key``
+        The protocol subject: a page id (``"p3"``), a request id
+        (``"req:7"`` / ``"creq:7"`` — engine-local and cluster request
+        id spaces are distinct and kept apart), a replica index
+        (``"r1"``), or a host-store chain hash.
+    ``step``
+        Position in the normalized stream (assigned by
+        :func:`normalize`; -1 before normalization).
+    ``epoch``
+        Fence/staging epoch when the plane carries one, else ``None``.
+    ``attrs``
+        Plane-specific payload (raw record, row/pos/qlen, refcount
+        snapshot, ...).
+    ``provenance``
+        file:line-style pointer into the SOURCE plane
+        (``"tap[3].rows[1]"``, ``"pool[42]"``) so a violation names the
+        exact record that broke the protocol.
+    ``seq``
+        Process-global protocol ordinal stamped at record time; the
+        merge key across planes (-1 = unknown, keeps stream-local
+        order).
+    """
+    kind: str
+    key: Any
+    step: int = -1
+    epoch: Optional[int] = None
+    attrs: Mapping[str, Any] = field(default_factory=dict)
+    provenance: str = ""
+    seq: int = -1
+
+    def with_step(self, step: int) -> "Event":
+        object.__setattr__(self, "step", step)
+        return self
+
+
+def _ev(kind, key, seq=-1, epoch=None, provenance="", **attrs) -> Event:
+    return Event(kind=kind, key=key, seq=seq, epoch=epoch,
+                 attrs=attrs, provenance=provenance)
+
+
+# -- adapters: one per record plane ------------------------------------------
+
+def events_from_pool_log(log: Iterable, source: str = "pool"
+                         ) -> List[Event]:
+    """``PagedKVPool.event_log`` entries ``(seq, op, pages)`` →
+    page-plane events (one per page; ``reset`` stays a single event)."""
+    out: List[Event] = []
+    op_kind = {"alloc": PAGE_ALLOC, "free": PAGE_FREE,
+               "cache": PAGE_CACHE, "share": PAGE_SHARE,
+               "unshare": PAGE_UNSHARE, "uncache": PAGE_UNCACHE}
+    for i, entry in enumerate(log or ()):
+        seq, op, pages = entry
+        prov = f"{source}[{i}]"
+        if op == "reset":
+            out.append(_ev(POOL_RESET, source, seq=seq, provenance=prov))
+            continue
+        kind = op_kind.get(op)
+        if kind is None:
+            continue
+        if isinstance(pages, (int, np.integer)):
+            pages = (pages,)
+        for pg in pages:
+            out.append(_ev(kind, f"p{int(pg)}", seq=seq,
+                           provenance=prov, page=int(pg)))
+    return out
+
+
+def _page_write_events(step: int, row, pos: int, qlen: int, pt,
+                       page_size: int, refs, seq: int, src: str
+                       ) -> List[Event]:
+    """Expand one packed row's write plan into per-page-span
+    :data:`PAGE_WRITE` events (consecutive tokens hitting the same page
+    collapse into one event; ``t0``/``pos0`` locate the first token of
+    the span for message parity with the historical per-token scan)."""
+    out: List[Event] = []
+    last_pg = None
+    for t in range(int(qlen)):
+        pg = int(pt[int(row), (int(pos) + t) // page_size])
+        if pg == last_pg:
+            continue
+        last_pg = pg
+        rc = None
+        if refs is not None and pg in refs:
+            rc = int(refs[pg])
+        out.append(_ev(PAGE_WRITE, f"p{pg}", seq=seq,
+                       provenance=f"tap[{step}].rows[{int(row)}]",
+                       page=pg, row=int(row), pos0=int(pos) + t,
+                       tap_step=step, refcount=rc, src=src))
+    return out
+
+
+def events_from_tap(tap: Iterable[Mapping], page_size: int = 1
+                    ) -> List[Event]:
+    """The engine's unified-step tap → request-plane write/preempt/
+    rewind events plus per-page :data:`PAGE_WRITE` events.  Order is
+    the tap's own order (the deque is append-ordered); each record's
+    stamped ``seq`` rides onto every event it expands to, so the
+    cross-plane merge keeps writes where they happened."""
+    out: List[Event] = []
+    ps = max(int(page_size), 1)
+    for step, rec in enumerate(tap or ()):
+        kind = rec.get("kind")
+        seq = int(rec.get("seq", -1))
+        if kind == "kv_drop":
+            out.append(_ev(REQ_PREEMPT, f"req:{int(rec['req'])}",
+                           seq=seq, provenance=f"tap[{step}]",
+                           tap_step=step))
+            continue
+        if kind == "spec_rewind":
+            out.append(_ev(REQ_REWIND, f"req:{int(rec['req'])}",
+                           seq=seq, provenance=f"tap[{step}]",
+                           tap_step=step,
+                           valid_upto=int(rec["valid_upto"]),
+                           written_upto=int(rec.get("written_upto", 0))))
+            continue
+        if kind == "unified":
+            refs = rec.get("refcounts") or None
+            pt = rec.get("page_tables")
+            pt = None if pt is None else np.asarray(pt)
+            exempt = bool(rec.get("rewind_exempt"))
+            for r, pos, qlen, ctx_len in rec.get("reads", ()):
+                out.append(_ev(
+                    REQ_WRITE, f"req:{int(r)}", seq=seq,
+                    provenance=f"tap[{step}]", tap_step=step,
+                    pos=int(pos), qlen=int(qlen), ctx_len=int(ctx_len),
+                    rewind_exempt=exempt))
+            if pt is not None:
+                for row, pos, qlen in rec.get("rows", ()):
+                    out.extend(_page_write_events(
+                        step, row, int(pos), int(qlen), pt, ps, refs,
+                        seq, "unified"))
+            continue
+        if kind == "prefill":
+            for pg in rec.get("pages", ()):
+                out.append(_ev(PAGE_WRITE, f"p{int(pg)}", seq=seq,
+                               provenance=f"tap[{step}]",
+                               page=int(pg), tap_step=step,
+                               refcount=None, src="prefill"))
+            continue
+        # legacy decode record: one write per live row at its cursor
+        pt = np.asarray(rec.get("page_tables"))
+        pos = np.asarray(rec.get("pos"))
+        n_live = int(rec.get("n_live", 0))
+        for i in range(min(n_live, pt.shape[0] if pt.ndim else 0)):
+            pg = int(pt[i, int(pos[i]) // ps])
+            out.append(_ev(PAGE_WRITE, f"p{pg}", seq=seq,
+                           provenance=f"tap[{step}].row[{i}]",
+                           page=pg, row=i, pos0=int(pos[i]),
+                           tap_step=step, refcount=None, src="decode"))
+    return out
+
+
+def events_from_handoff_records(records: Iterable[Mapping]
+                                ) -> List[Event]:
+    """Transport ``inject`` records (the priced cross-replica /
+    host↔device wire) → :data:`WIRE_INJECT` events; the raw record
+    rides in ``attrs['record']`` for the pricing rules."""
+    out: List[Event] = []
+    for i, rec in enumerate(records or ()):
+        epoch = rec.get("epoch")
+        if isinstance(epoch, bool) or not isinstance(epoch, int):
+            epoch = None
+        out.append(_ev(
+            WIRE_INJECT,
+            f"r{rec.get('src', '?')}->r{rec.get('dst', '?')}",
+            seq=int(rec.get("seq", -1)), epoch=epoch,
+            provenance=f"kv_handoff[{i}]", record=dict(rec), index=i,
+            pages=rec.get("dst_pages")))
+    return out
+
+
+def events_from_extract_log(log: Iterable, source: str = "wire"
+                            ) -> List[Event]:
+    """Transport ``extract_log`` entries ``(seq, pages)`` →
+    :data:`WIRE_EXTRACT` events (a read of live pages into the host
+    staging buffer — the pages must be allocated or cached)."""
+    out: List[Event] = []
+    for i, entry in enumerate(log or ()):
+        seq, pages = entry
+        out.append(_ev(WIRE_EXTRACT, source, seq=int(seq),
+                       provenance=f"{source}.extract[{i}]",
+                       pages=tuple(int(p) for p in pages)))
+    return out
+
+
+def events_from_host_records(records: Iterable[Mapping]
+                             ) -> List[Event]:
+    """``HostTier.records`` (dir evict|refetch) → host-plane events
+    keyed by the layout-salted chain hash."""
+    out: List[Event] = []
+    for i, rec in enumerate(records or ()):
+        kind = HOST_STAGE if rec.get("dir") == "evict" else HOST_REFETCH
+        out.append(_ev(kind, f"h{rec.get('chain_hash', '?')}",
+                       seq=int(rec.get("seq", -1)),
+                       provenance=f"host_offload[{i}]",
+                       record=dict(rec), index=i,
+                       page=rec.get("page")))
+    return out
+
+
+def events_from_adoptions(records: Iterable[Mapping]) -> List[Event]:
+    """Cluster ``_adoptions`` entries → :data:`REQ_ADOPT` events in the
+    CLUSTER request-id namespace (``creq:<id>``), carrying the staging
+    epoch and the destination's fence epoch at adoption time."""
+    out: List[Event] = []
+    for i, rec in enumerate(records or ()):
+        epoch = rec.get("epoch")
+        if isinstance(epoch, bool) or not isinstance(epoch, int):
+            epoch = None
+        out.append(_ev(
+            REQ_ADOPT, f"creq:{rec.get('req_id', '?')}",
+            seq=int(rec.get("seq", -1)), epoch=epoch,
+            provenance=f"adoptions[{i}]", record=dict(rec), index=i,
+            dst=rec.get("dst"), fence_epoch=rec.get("fence_epoch")))
+    return out
+
+
+def events_from_protocol_log(log: Iterable[Mapping],
+                             source: str = "protocol") -> List[Event]:
+    """Generic adapter for the ``protocol_log`` lists the engine and
+    cluster append to: each entry is ``{"ev": <kind>, "key": <subject>,
+    "seq": <ordinal>, ...attrs}`` with ``ev`` already canonical."""
+    out: List[Event] = []
+    for i, rec in enumerate(log or ()):
+        attrs = {k: v for k, v in rec.items()
+                 if k not in ("ev", "key", "seq", "epoch")}
+        epoch = rec.get("epoch")
+        if isinstance(epoch, bool) or not isinstance(epoch, int):
+            epoch = None
+        out.append(Event(kind=rec["ev"], key=rec.get("key"),
+                         seq=int(rec.get("seq", -1)), epoch=epoch,
+                         attrs=attrs, provenance=f"{source}[{i}]"))
+    return out
+
+
+def events_from_chaos(injected: Iterable[Mapping]) -> List[Event]:
+    """``ChaosController.injected`` audit entries → chaos instants."""
+    out: List[Event] = []
+    for i, rec in enumerate(injected or ()):
+        out.append(_ev(CHAOS_INJECT,
+                       f"chaos:{rec.get('kind', '?')}",
+                       seq=int(rec.get("seq", -1)),
+                       provenance=f"chaos[{i}]", record=dict(rec)))
+    return out
+
+
+# -- the merged stream --------------------------------------------------------
+
+def normalize(*streams: List[Event]) -> List[Event]:
+    """Merge per-plane event lists into ONE ordered stream.  Each
+    stream is internally ordered; across streams the process-global
+    ``seq`` stamped at record time is the merge key.  Events without a
+    seq (hand-built traces, pre-protocol records) inherit their
+    stream-local predecessor's seq, so they stay put relative to their
+    neighbours.  Stream ``step`` ordinals are assigned here."""
+    tagged: List[Tuple[int, int, int, Event]] = []
+    for si, stream in enumerate(streams):
+        last = -1
+        for j, e in enumerate(stream or ()):
+            seq = e.seq if e.seq >= 0 else last
+            last = seq
+            tagged.append((seq, si, j, e))
+    tagged.sort(key=lambda t: (t[0], t[1], t[2]))
+    out = []
+    for step, (_, _, _, e) in enumerate(tagged):
+        out.append(e.with_step(step))
+    return out
+
+
+def _resolve(meta, key):
+    """Resolve a meta record hook exactly like the rules do: ``None``
+    + lost=True when the hook raised (the accounting itself is lost)."""
+    records = (meta or {}).get(key)
+    if callable(records):
+        try:
+            records = records()
+        except Exception:
+            return None, True
+    return records, False
+
+
+def collect_events(ctx) -> Tuple[List[Event], List[str]]:
+    """Build an executable's full normalized protocol stream from its
+    analysis context: pool event log + unified tap (``ctx.serving``),
+    engine/cluster protocol logs, transport extract log, and the
+    ``kv_handoff`` / ``adoptions`` / ``host_offload`` meta hooks.
+    Returns ``(events, lost_hooks)`` where ``lost_hooks`` names meta
+    hooks that raised.  Memoized on the context object — the four
+    lifecycle rules and the report section share one build."""
+    cached = getattr(ctx, "_protocol_events", None)
+    if cached is not None:
+        return cached
+    streams: List[List[Event]] = []
+    lost: List[str] = []
+    serving = getattr(ctx, "serving", None) or {}
+    pool = serving.get("pool")
+    pool_log = serving.get("pool_log")
+    if pool_log is None and pool is not None:
+        pool_log = getattr(pool, "event_log", None)
+    if pool_log:
+        streams.append(events_from_pool_log(pool_log))
+    ps = serving.get("page_size") or getattr(pool, "page_size", 1) or 1
+    if serving.get("tap"):
+        streams.append(events_from_tap(serving["tap"], page_size=ps))
+    if serving.get("protocol"):
+        streams.append(events_from_protocol_log(serving["protocol"],
+                                                source="engine"))
+    if serving.get("extract_log"):
+        streams.append(events_from_extract_log(serving["extract_log"]))
+    meta = getattr(ctx, "meta", None) or {}
+    for key, adapter in (("kv_handoff", events_from_handoff_records),
+                         ("host_offload", events_from_host_records),
+                         ("adoptions", events_from_adoptions)):
+        if key not in meta:
+            continue
+        records, hook_lost = _resolve(meta, key)
+        if hook_lost:
+            lost.append(key)
+            continue
+        if records:
+            streams.append(adapter(records))
+    if "extract_log" in meta:
+        # the transport's extract log, attached only to the replica
+        # whose pool the extracts read (page ids are pool-local)
+        records, hook_lost = _resolve(meta, "extract_log")
+        if hook_lost:
+            lost.append("extract_log")
+        elif records:
+            streams.append(events_from_extract_log(records))
+    if "protocol" in meta:
+        records, hook_lost = _resolve(meta, "protocol")
+        if hook_lost:
+            lost.append("protocol")
+        elif records:
+            streams.append(events_from_protocol_log(records,
+                                                    source="cluster"))
+    if "chaos" in meta:
+        records, hook_lost = _resolve(meta, "chaos")
+        if hook_lost:
+            lost.append("chaos")
+        elif records:
+            streams.append(events_from_chaos(records))
+    events = normalize(*streams)
+    result = (events, lost)
+    try:
+        ctx._protocol_events = result
+    except Exception:
+        pass
+    return result
+
+
+def kind_counts(events: Iterable[Event]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for e in events:
+        out[e.kind] = out.get(e.kind, 0) + 1
+    return dict(sorted(out.items()))

@@ -1,0 +1,1263 @@
+"""Serving-protocol verifier: lifecycle state machines + an exhaustive
+interleaving explorer over the typed event stream (DESIGN.md §23).
+
+Three state machines own the protocol invariants every serving
+guarantee rests on:
+
+``PageMachine``
+    free → allocated → cached → host-staged → free; the trash page is
+    immutable; refcount conservation (every share has an unshare,
+    terminal refcounts zero).
+``RequestMachine``
+    queued → running → preempted/handoff-staged → adopted →
+    finished | shed; no double-adopt of one staging epoch; no
+    post-finish writes; finished and shed are mutually terminal.
+``FenceMachine``
+    per-replica fencing epochs are monotone; no completion and no
+    adoption is accepted under a stale epoch.
+
+:func:`replay` runs all three over any normalized event stream
+(``analysis.events``) and returns :class:`Violation` records with
+file:line-style provenance into the source plane plus the per-subject
+event subtrace (what ``--explain`` prints).  The same predicates back
+the runtime invariant checkers: ``PagedKVPool.check_invariants``,
+``PrefixCache.check_invariants`` and ``fault.check_cluster_invariants``
+all delegate to the ``*_problems`` snapshot functions here — one
+implementation, asserted at runtime AND replayed over traces.
+
+:func:`explore` is a bounded model checker for the control plane: a
+small abstract model of the cluster (replicas, pools, prefix sharing,
+host tier, disaggregated handoffs, fencing, chaos verdicts, drains)
+executes EVERY interleaving of the nondeterministic choices the
+scheduler/router/chaos/autoscaler make, asserting the state machines
+in every reachable state.  Small bounds suffice for this bug class:
+the known interaction bugs (phantom reclaim pages, drain-vs-inflight
+handoff) all manifest with 2 replicas, ≤4 requests and ≤8 pages —
+they are ordering bugs, not scale bugs.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import events as ev
+from .events import Event
+
+# rule names (registered in analysis.rules; shared so the explorer and
+# the mutation tests name the same vocabulary)
+RULE_PAGE = "page-lifecycle-violation"
+RULE_REQUEST = "request-lifecycle-violation"
+RULE_FENCE = "fence-regression"
+RULE_REFCOUNT = "refcount-leak"
+
+
+@dataclass
+class Violation:
+    """One protocol violation: which rule, which subject, what broke,
+    where in the source plane, and the subject's event subtrace."""
+    rule: str
+    subject: str
+    message: str
+    provenance: str = ""
+    subtrace: List[Event] = field(default_factory=list)
+
+    def format_subtrace(self, limit: int = 8) -> str:
+        lines = [f"  {e.step:>5}  {e.kind:<14} {e.key} "
+                 f"[{e.provenance}]"
+                 + (f" epoch={e.epoch}" if e.epoch is not None else "")
+                 for e in self.subtrace[-limit:]]
+        return "violating event subtrace (last "\
+            f"{min(limit, len(self.subtrace))} of "\
+            f"{len(self.subtrace)} events for {self.subject}):\n"\
+            + "\n".join(lines)
+
+
+# -- snapshot predicates (the ONE implementation the runtime checkers
+# -- and the machines share) --------------------------------------------------
+
+def page_partition_problems(num_pages: int, free_list, allocated,
+                            cached, trash: int = ev.TRASH_PAGE
+                            ) -> List[str]:
+    """Allocator bookkeeping invariants: free/allocated/cached PARTITION
+    the usable pages (pairwise disjoint, nothing leaked or invented),
+    trash page never issued, cached refcounts non-negative.  Message
+    strings are the contract (tests pin them)."""
+    problems: List[str] = []
+    free = set(free_list)
+    allocated = set(allocated)
+    cached_map = dict(cached)
+    cached_set = set(cached_map)
+    if len(free) != len(list(free_list)):
+        problems.append("free list holds duplicates")
+    if free & allocated:
+        problems.append("page both free and allocated")
+    if free & cached_set:
+        problems.append("page both free and cached")
+    if allocated & cached_set:
+        problems.append("page both allocated and cached")
+    if free | allocated | cached_set != set(range(1, num_pages)):
+        problems.append("pages leaked or invented")
+    if trash in free or trash in allocated:
+        problems.append("reserved trash page was issued")
+    if trash in cached_set:
+        problems.append("trash page entered the cache")
+    if any(rc < 0 for rc in cached_map.values()):
+        problems.append("negative cached-page refcount")
+    return problems
+
+
+_ROOT = -1                        # prefix_cache.ROOT
+
+
+def cache_index_problems(cache, pool) -> List[str]:
+    """Prefix-cache bookkeeping invariants (the logic formerly inlined
+    in ``PrefixCache.check_invariants``, messages preserved): index and
+    id map agree, refcounts non-negative, parent refcounts dominate
+    children's, child counts exact, per-page refcounts mirror the
+    pool's cached partition, attached references accounted."""
+    problems: List[str] = []
+    if len(cache._index) != len(cache._by_id):
+        problems.append("cache index and id map disagree")
+    per_page_refs: Dict[int, int] = {}
+    children: Dict[int, int] = {}
+    for e in cache._index.values():
+        if cache._by_id.get(e.eid) is not e:
+            problems.append(f"entry {e.eid} missing from the id map")
+        if e.refs < 0:
+            problems.append(f"negative refcount on entry {e.eid}")
+        per_page_refs[e.page] = e.refs
+        if e.parent != _ROOT:
+            parent = cache._by_id.get(e.parent)
+            if parent is None:
+                problems.append(f"entry {e.eid} orphaned: parent "
+                                f"{e.parent} evicted")
+                continue
+            if parent.depth != e.depth - 1:
+                problems.append(f"entry {e.eid} at depth {e.depth} "
+                                f"does not extend its parent at depth "
+                                f"{parent.depth}")
+            if parent.refs < e.refs:
+                problems.append("child page outlives its parent's "
+                                "sharers")
+            children[e.parent] = children.get(e.parent, 0) + 1
+    for e in cache._index.values():
+        if e.children != children.get(e.eid, 0):
+            problems.append(f"entry {e.eid} claims {e.children} "
+                            f"children, counted "
+                            f"{children.get(e.eid, 0)}")
+    # the pool's cached partition and the index agree page-for-page
+    if per_page_refs != dict(pool._cached):
+        problems.append("cache index and pool cached-page partition "
+                        "diverged")
+    attached_refs: Dict[int, int] = {}
+    for entries in cache._attached.values():
+        for e in entries:
+            attached_refs[e.eid] = attached_refs.get(e.eid, 0) + 1
+    for e in cache._index.values():
+        if e.refs != attached_refs.get(e.eid, 0):
+            problems.append(f"entry {e.eid} refcount {e.refs} != "
+                            f"attached references")
+    return problems
+
+
+def cluster_problems(cluster) -> List[str]:
+    """Cluster request-accounting invariants (the logic formerly
+    inlined in ``fault.check_cluster_invariants``, messages preserved):
+    every request lives in exactly one home (backlog / live / finished
+    / shed), finished and shed are disjoint, token budgets hold."""
+    problems: List[str] = []
+    backlog_ids = {rid for _, rid, _ in cluster._backlog}
+    placed_ids = {creq.req_id
+                  for (creq, _stage, _epoch) in cluster._placed.values()}
+    handoff_ids = {h["creq"].req_id for h in cluster._pending_handoffs
+                   if not h.get("redelivery")}
+    finished_ids = set(cluster.finished)
+    shed_ids = set(cluster.shed)
+    if finished_ids & shed_ids:
+        problems.append(f"requests both finished and shed: "
+                        f"{finished_ids & shed_ids}")
+    for rid, creq in cluster.requests.items():
+        homes = [rid in backlog_ids,
+                 rid in finished_ids,
+                 rid in shed_ids,
+                 rid in placed_ids or rid in handoff_ids]
+        if sum(bool(h) for h in homes) != 1:
+            problems.append(
+                f"request {rid} accounting broken: backlog={homes[0]} "
+                f"finished={homes[1]} shed={homes[2]} live={homes[3]} "
+                f"(stage={creq.stage!r}, "
+                f"pending={creq.handoff_pending})")
+        if len(creq.out_tokens) > creq.max_new_tokens:
+            problems.append(f"request {rid} overran its budget "
+                            f"(duplicated tokens?)")
+    return problems
+
+
+# -- lifecycle state machines -------------------------------------------------
+
+_FREE, _ALLOCATED, _CACHED = "free", "allocated", "cached"
+
+
+class _MachineBase:
+    """Shared violation plumbing: first violation per subject poisons
+    the subject (state force-syncs to the event's implied post-state),
+    so one corrupted transition reports exactly once instead of
+    cascading — the mutation tests pin this exactly-once contract."""
+
+    def __init__(self):
+        self.violations: List[Violation] = []
+        self._poisoned: Set[Any] = set()
+        self._trace: Dict[Any, List[Event]] = {}
+
+    def _note(self, e: Event) -> None:
+        self._trace.setdefault(e.key, []).append(e)
+
+    def _violate(self, rule: str, e: Event, message: str) -> None:
+        if e.key in self._poisoned:
+            return
+        self._poisoned.add(e.key)
+        self.violations.append(Violation(
+            rule=rule, subject=str(e.key), message=message,
+            provenance=e.provenance,
+            subtrace=list(self._trace.get(e.key, ()))))
+
+
+class PageMachine(_MachineBase):
+    """free → allocated → cached → host-staged → free, trash immutable,
+    refcount conservation.  Pages materialize lazily: the first event
+    naming a page seeds it FREE (pool logs are complete from
+    construction, so the first touch is always an alloc)."""
+
+    def __init__(self):
+        super().__init__()
+        self.state: Dict[str, str] = {}
+        self.sharers: Dict[str, int] = {}
+        self.host: Set[Any] = set()
+        self.pages_seen: Set[str] = set()
+
+    def _st(self, key: str) -> str:
+        return self.state.get(key, _FREE)
+
+    def apply(self, e: Event) -> None:
+        k = e.kind
+        if k in (ev.PAGE_ALLOC, ev.PAGE_FREE, ev.PAGE_CACHE,
+                 ev.PAGE_SHARE, ev.PAGE_UNSHARE, ev.PAGE_UNCACHE):
+            self._note(e)
+            self.pages_seen.add(e.key)
+            if e.attrs.get("page") == ev.TRASH_PAGE:
+                self._violate(RULE_PAGE, e,
+                              f"{k} touched the reserved trash page — "
+                              f"it is immutable and never issued")
+                return
+        if k == ev.POOL_RESET:
+            self.state.clear()
+            self.sharers.clear()
+            return
+        if k == ev.PAGE_ALLOC:
+            if self._st(e.key) != _FREE:
+                self._violate(RULE_PAGE, e,
+                              f"alloc of page {e.key} while "
+                              f"{self._st(e.key)} — only a free page "
+                              f"may be issued")
+            self.state[e.key] = _ALLOCATED
+        elif k == ev.PAGE_FREE:
+            if self._st(e.key) != _ALLOCATED:
+                self._violate(RULE_PAGE, e,
+                              f"free of page {e.key} while "
+                              f"{self._st(e.key)} — only an allocated "
+                              f"page returns to the free list")
+            self.state[e.key] = _FREE
+        elif k == ev.PAGE_CACHE:
+            if self._st(e.key) != _ALLOCATED:
+                self._violate(RULE_PAGE, e,
+                              f"cache of page {e.key} while "
+                              f"{self._st(e.key)} — only an allocated "
+                              f"page enters the cache")
+            self.state[e.key] = _CACHED
+            self.sharers[e.key] = 0
+        elif k == ev.PAGE_SHARE:
+            if self._st(e.key) != _CACHED:
+                self._violate(RULE_PAGE, e,
+                              f"share of page {e.key} while "
+                              f"{self._st(e.key)} — only a cached page "
+                              f"is shareable")
+                self.state[e.key] = _CACHED
+                self.sharers.setdefault(e.key, 0)
+            self.sharers[e.key] = self.sharers.get(e.key, 0) + 1
+        elif k == ev.PAGE_UNSHARE:
+            if self._st(e.key) != _CACHED \
+                    or self.sharers.get(e.key, 0) < 1:
+                self._violate(RULE_REFCOUNT, e,
+                              f"unshare of page {e.key} without a "
+                              f"matching share — the refcount went "
+                              f"negative")
+                self.sharers[e.key] = 0
+            else:
+                self.sharers[e.key] -= 1
+        elif k == ev.PAGE_UNCACHE:
+            if self._st(e.key) != _CACHED:
+                self._violate(RULE_PAGE, e,
+                              f"uncache of page {e.key} while "
+                              f"{self._st(e.key)}")
+            elif self.sharers.get(e.key, 0) != 0:
+                self._violate(RULE_REFCOUNT, e,
+                              f"uncache of page {e.key} with "
+                              f"{self.sharers[e.key]} live sharers — "
+                              f"a share was never unshared")
+            self.state[e.key] = _FREE
+            self.sharers.pop(e.key, None)
+        elif k == ev.HOST_STAGE:
+            self._note(e)
+            page = e.attrs.get("page")
+            pkey = None if page is None else f"p{int(page)}"
+            if pkey is not None and self._st(pkey) != _CACHED:
+                self._violate(RULE_PAGE, e,
+                              f"host-stage of page {pkey} while "
+                              f"{self._st(pkey)} — only a cached page "
+                              f"is staged to host (evict path)")
+            self.host.add(e.key)
+        elif k == ev.HOST_REFETCH:
+            self._note(e)
+            if e.key not in self.host:
+                self._violate(RULE_PAGE, e,
+                              f"host-refetch of {e.key} that was never "
+                              f"staged to host")
+            self.host.discard(e.key)
+        elif k == ev.WIRE_EXTRACT:
+            for pg in e.attrs.get("pages", ()):
+                pkey = f"p{int(pg)}"
+                self._note(Event(kind=k, key=pkey, step=e.step,
+                                 attrs=e.attrs,
+                                 provenance=e.provenance))
+                if int(pg) == ev.TRASH_PAGE:
+                    continue          # padding slot reads are benign
+                if self._st(pkey) == _FREE and pkey in self.pages_seen:
+                    self._violate(RULE_PAGE, Event(
+                        kind=k, key=pkey, step=e.step, attrs=e.attrs,
+                        provenance=e.provenance),
+                        f"wire extract read page {pkey} while free — "
+                        f"staging a reclaimed page ships garbage KV")
+
+    def finish(self, skip: Optional[Set[str]] = None) -> None:
+        """Terminal refcount conservation: every share was unshared."""
+        skip = skip or set()
+        for key, n in sorted(self.sharers.items()):
+            if n > 0 and key not in self._poisoned and key not in skip:
+                self._poisoned.add(key)
+                self.violations.append(Violation(
+                    rule=RULE_REFCOUNT, subject=str(key),
+                    message=f"page {key} ends the trace with "
+                            f"{n} live sharers — a share was never "
+                            f"unshared (terminal refcounts must be "
+                            f"zero)",
+                    provenance="terminal",
+                    subtrace=list(self._trace.get(key, ()))))
+
+    def consistency_problems(self, num_pages: Optional[int] = None
+                             ) -> List[str]:
+        """The machine's state projected through the SAME snapshot
+        predicate the live pool asserts."""
+        free, allocated, cached = set(), set(), {}
+        for key, st in self.state.items():
+            pg = int(key[1:])
+            if st == _FREE:
+                free.add(pg)
+            elif st == _ALLOCATED:
+                allocated.add(pg)
+            else:
+                cached[pg] = self.sharers.get(key, 0)
+        if num_pages is None:
+            return page_partition_problems(
+                max(free | allocated | set(cached), default=0) + 1,
+                free | (set(range(1, max(free | allocated
+                                         | set(cached), default=0) + 1))
+                        - allocated - set(cached)),
+                allocated, cached)
+        tracked = free | allocated | set(cached)
+        free |= set(range(1, num_pages)) - tracked
+        return page_partition_problems(num_pages, free, allocated,
+                                       cached)
+
+
+_QUEUED, _RUNNING, _PREEMPTED = "queued", "running", "preempted"
+_STAGED, _FINISHED, _SHED = "handoff-staged", "finished", "shed"
+
+
+class RequestMachine(_MachineBase):
+    """queued → running → preempted/handoff-staged → adopted →
+    finished | shed.  Keys are namespaced (``req:<id>`` engine-local,
+    ``creq:<id>`` cluster) so the two id spaces never collide.  The
+    tap is a bounded window, so an unknown request's first write is
+    trusted (like the rewind lint's first-sight rule); terminal-state
+    violations (post-finish writes, double adopt, shed-after-finish)
+    never false-positive under truncation."""
+
+    def __init__(self):
+        super().__init__()
+        self.state: Dict[str, str] = {}
+        self.adopted: Set[Tuple[str, Any]] = set()
+
+    def _terminal(self, key) -> Optional[str]:
+        st = self.state.get(key)
+        return st if st in (_FINISHED, _SHED) else None
+
+    def apply(self, e: Event) -> None:
+        k = e.kind
+        if k not in (ev.REQ_QUEUED, ev.REQ_ADMIT, ev.REQ_WRITE,
+                     ev.REQ_PREEMPT, ev.REQ_REWIND, ev.REQ_STAGE,
+                     ev.REQ_ADOPT, ev.REQ_FINISH, ev.REQ_SHED):
+            return
+        self._note(e)
+        term = self._terminal(e.key)
+        if k == ev.REQ_QUEUED:
+            if term:
+                self._violate(RULE_REQUEST, e,
+                              f"request {e.key} re-queued after "
+                              f"{term} — terminal states are terminal")
+            self.state[e.key] = _QUEUED
+        elif k == ev.REQ_ADMIT:
+            if term:
+                self._violate(RULE_REQUEST, e,
+                              f"request {e.key} admitted after {term}")
+            self.state[e.key] = _RUNNING
+        elif k == ev.REQ_WRITE:
+            if term:
+                self._violate(RULE_REQUEST, e,
+                              f"request {e.key} wrote KV at tap step "
+                              f"{e.attrs.get('tap_step', '?')} AFTER "
+                              f"{term} — post-finish writes corrupt "
+                              f"pages the pool already reissued")
+            self.state.setdefault(e.key, _RUNNING)
+        elif k == ev.REQ_PREEMPT:
+            if term:
+                self._violate(RULE_REQUEST, e,
+                              f"request {e.key} preempted after {term}")
+            self.state[e.key] = _PREEMPTED
+        elif k == ev.REQ_REWIND:
+            if term:
+                self._violate(RULE_REQUEST, e,
+                              f"request {e.key} rewound after {term}")
+        elif k == ev.REQ_STAGE:
+            if term:
+                self._violate(RULE_REQUEST, e,
+                              f"request {e.key} handoff-staged after "
+                              f"{term}")
+            self.state[e.key] = _STAGED
+        elif k == ev.REQ_ADOPT:
+            akey = (e.key, e.epoch)
+            if akey in self.adopted:
+                self._violate(RULE_REQUEST, e,
+                              f"request {e.key} adopted TWICE under "
+                              f"staging epoch {e.epoch} — the "
+                              f"(request id, epoch) dedup failed and "
+                              f"tokens will double-deliver")
+            elif term:
+                self._violate(RULE_REQUEST, e,
+                              f"request {e.key} adopted after {term}")
+            self.adopted.add(akey)
+            self.state[e.key] = _RUNNING
+        elif k == ev.REQ_FINISH:
+            if term:
+                self._violate(RULE_REQUEST, e,
+                              f"request {e.key} finished after {term} "
+                              f"— a completion delivered twice")
+            self.state[e.key] = _FINISHED
+        elif k == ev.REQ_SHED:
+            if term:
+                self._violate(RULE_REQUEST, e,
+                              f"request {e.key} shed after {term} — "
+                              f"shed and finished are mutually "
+                              f"terminal")
+            self.state[e.key] = _SHED
+
+
+class FenceMachine(_MachineBase):
+    """Per-replica fencing epochs are monotone; no stale-epoch
+    completion or adoption is accepted.  Keys are replica indices."""
+
+    def __init__(self):
+        super().__init__()
+        self.epoch: Dict[Any, int] = {}
+
+    def apply(self, e: Event) -> None:
+        k = e.kind
+        if k == ev.FENCE_BUMP:
+            self._note(e)
+            rep = e.key
+            new = e.epoch
+            if new is None:
+                new = self.epoch.get(rep, 0) + 1
+            if new <= self.epoch.get(rep, -1):
+                self._violate(RULE_FENCE, e,
+                              f"fence epoch of replica {rep} moved "
+                              f"{self.epoch[rep]} -> {new} — epochs "
+                              f"are monotone; a regressed fence "
+                              f"un-quarantines a zombie")
+            self.epoch[rep] = new if new is not None else \
+                self.epoch.get(rep, 0) + 1
+        elif k == ev.FENCE_COMPLETE:
+            self._note(e)
+            rep = e.attrs.get("replica", e.key)
+            cur = self.epoch.get(rep)
+            if cur is not None and e.epoch is not None \
+                    and e.epoch != cur:
+                self._violate(RULE_FENCE, e,
+                              f"completion accepted on replica {rep} "
+                              f"under epoch {e.epoch} but the fence is "
+                              f"at {cur} — a fenced (stale) completion "
+                              f"must be dropped, never accepted")
+            if cur is None and e.epoch is not None:
+                self.epoch[rep] = e.epoch
+        elif k == ev.FENCE_STALE_DROP:
+            self._note(e)
+        elif k == ev.REQ_ADOPT:
+            rep = e.attrs.get("dst")
+            fe = e.attrs.get("fence_epoch")
+            if rep is None or fe is None:
+                return
+            key = f"r{rep}"
+            self._note(Event(kind=k, key=key, step=e.step,
+                             epoch=e.epoch, attrs=e.attrs,
+                             provenance=e.provenance))
+            cur = self.epoch.get(key)
+            if cur is not None and fe != cur:
+                self._violate(RULE_FENCE, Event(
+                    kind=k, key=key, step=e.step, epoch=e.epoch,
+                    attrs=e.attrs, provenance=e.provenance),
+                    f"adoption on replica {key} stamped fence epoch "
+                    f"{fe} but the fence is at {cur} — the landing "
+                    f"rode a stale epoch past the death sweep")
+            if cur is None:
+                self.epoch[key] = fe
+
+
+def replay(events: Iterable[Event],
+           strict_terminal: bool = True,
+           terminal_skip: Optional[Set[str]] = None
+           ) -> List[Violation]:
+    """Run all three lifecycle machines over one normalized stream and
+    return every violation, provenance-stamped, in stream order."""
+    pages, reqs, fences = PageMachine(), RequestMachine(), FenceMachine()
+    for e in events:
+        pages.apply(e)
+        reqs.apply(e)
+        fences.apply(e)
+    if strict_terminal:
+        pages.finish(skip=terminal_skip)
+    return pages.violations + reqs.violations + fences.violations
+
+
+def machine_summary(events: Sequence[Event]) -> Dict[str, Any]:
+    """Coverage summary for the report's ``protocol`` section."""
+    pages, reqs, fences = PageMachine(), RequestMachine(), FenceMachine()
+    for e in events:
+        pages.apply(e)
+        reqs.apply(e)
+        fences.apply(e)
+    return {"pages": len(pages.pages_seen),
+            "requests": len(reqs.state),
+            "replicas": len(fences.epoch)}
+
+
+# -- the bounded interleaving explorer ---------------------------------------
+
+@dataclass
+class ExploreConfig:
+    """Bounds for the exhaustive model check.  Defaults exhaust in
+    seconds and still cover every known interaction-bug shape (ordering
+    bugs need two replicas and a handful of requests/pages, not
+    scale).  ``max_depth`` is a recursion safety net far above the
+    longest possible action sequence; ``max_interleavings`` caps the
+    DISTINCT STATES expanded (the path count itself is recovered by
+    memoized counting and may legitimately be astronomically larger)."""
+    n_replicas: int = 2
+    n_requests: int = 2
+    pages_per_replica: int = 2
+    tokens_per_request: int = 2
+    prefix_families: int = 1     # distinct shared-prefix chain hashes
+    max_crashes: int = 1
+    max_chaos: int = 1           # wire drops
+    max_sheds: int = 1
+    max_preempts: int = 1
+    max_evicts: int = 2          # host-tier stagings
+    max_drains: int = 1          # autoscaler scale-down attempts
+    # symmetry reduction: replicas are interchangeable, so letting
+    # chaos kill ONE fixed replica and the autoscaler drain the OTHER
+    # covers the same interaction shapes at a fraction of the states
+    crash_targets: Tuple[int, ...] = (0,)
+    drain_targets: Tuple[int, ...] = (1,)
+    max_depth: int = 64
+    max_interleavings: int = 400_000
+
+
+@dataclass
+class ExploreResult:
+    interleavings: int
+    states: int
+    max_depth: int
+    events_checked: int
+    violations: List[Violation]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+class _Model:
+    """Abstract control-plane model.  State is plain dicts/tuples with
+    an explicit :meth:`clone`; every action emits protocol events that
+    feed the three machines incrementally.  ``bug`` re-introduces a
+    specific interaction bug so tests can assert the explorer FINDS
+    this bug class:
+
+    ``double_adopt``    skip the (req, epoch) idempotency dedup
+    ``stale_accept``    accept a fenced (zombie) completion
+    ``drain_inflight``  drain-idle check ignores in-flight handoffs
+                        (the real autoscaler bug this PR fixes)
+    ``free_shared``     preemption frees shared pages instead of
+                        unsharing them
+    """
+
+    def __init__(self, cfg: ExploreConfig, bug: Optional[str] = None):
+        self.cfg = cfg
+        self.bug = bug
+        self.reps = {r: {"alive": True, "fence": 0, "draining": False}
+                     for r in range(cfg.n_replicas)}
+        # page key -> state per replica pool: "free"/"alloc"/"cached"
+        self.pages = {r: {p: _FREE
+                          for p in range(1, cfg.pages_per_replica + 1)}
+                      for r in range(cfg.n_replicas)}
+        self.sharers = {r: {} for r in range(cfg.n_replicas)}
+        # cached chain hash -> page, per replica (one shared prefix)
+        self.cached_hash = {r: {} for r in range(cfg.n_replicas)}
+        self.host = {r: set() for r in range(cfg.n_replicas)}
+        self.reqs = {q: {"state": _QUEUED, "rep": None, "pages": (),
+                         "shared": (), "done": 0, "epoch": None}
+                     for q in range(cfg.n_requests)}
+        self.handoffs: List[Dict[str, Any]] = []
+        self.injected: Set[Tuple[int, int]] = set()
+        self.stage_seq = 0
+        self.crashes = 0
+        self.chaos = 0
+        self.sheds = 0
+        self.preempts = 0
+        self.evicts = 0
+        self.drains = 0
+        self.zombie_finishes: List[Tuple[int, int, int]] = []
+
+    def clone(self) -> "_Model":
+        m = _Model.__new__(_Model)
+        m.cfg, m.bug = self.cfg, self.bug
+        m.reps = {r: dict(v) for r, v in self.reps.items()}
+        m.pages = {r: dict(v) for r, v in self.pages.items()}
+        m.sharers = {r: dict(v) for r, v in self.sharers.items()}
+        m.cached_hash = {r: dict(v)
+                         for r, v in self.cached_hash.items()}
+        m.host = {r: set(v) for r, v in self.host.items()}
+        m.reqs = {q: dict(v) for q, v in self.reqs.items()}
+        m.handoffs = [dict(h) for h in self.handoffs]
+        m.injected = set(self.injected)
+        m.stage_seq = self.stage_seq
+        m.crashes, m.chaos = self.crashes, self.chaos
+        m.sheds, m.preempts = self.sheds, self.preempts
+        m.evicts, m.drains = self.evicts, self.drains
+        m.zombie_finishes = list(self.zombie_finishes)
+        return m
+
+    def fingerprint(self) -> Tuple:
+        """Complete state identity: two models with equal fingerprints
+        have identical enabled-action sets and identical subtrees, so
+        the explorer may share their subtree path counts.  Every field
+        that gates an action (including the capped counters) MUST be
+        here or the memoized counts go wrong."""
+        return (
+            tuple(sorted((r, v["alive"], v["fence"], v["draining"])
+                         for r, v in self.reps.items())),
+            tuple((r, tuple(sorted(self.pages[r].items())),
+                   tuple(sorted(self.sharers[r].items())),
+                   tuple(sorted(self.cached_hash[r].items())),
+                   tuple(sorted(self.host[r])))
+                  for r in sorted(self.pages)),
+            tuple((q, v["state"], v["rep"], v["pages"], v["shared"],
+                   v["done"], v["epoch"], v.get("zombie_rep"),
+                   v.get("zombie_pages"), v.get("zombie_shared"))
+                  for q, v in sorted(self.reqs.items())),
+            tuple(sorted((h["req"], h["epoch"], h["state"], h["src"],
+                          h.get("dst"), h.get("dst_pages") or (),
+                          h.get("stale_fence"))
+                         for h in self.handoffs)),
+            tuple(sorted(self.injected)),
+            tuple(sorted(self.zombie_finishes)),
+            (self.stage_seq, self.crashes, self.chaos, self.sheds,
+             self.preempts, self.evicts, self.drains),
+        )
+
+    # -- page helpers (emit pool-plane events) ---------------------------
+
+    def _pkey(self, rep: int, pg: int) -> str:
+        return f"r{rep}:p{pg}"
+
+    def _alloc(self, rep: int, n: int, emit) -> Optional[List[int]]:
+        free = [p for p, st in sorted(self.pages[rep].items())
+                if st == _FREE]
+        if len(free) < n:
+            return None
+        got = free[:n]
+        for pg in got:
+            self.pages[rep][pg] = _ALLOCATED
+            emit(ev.PAGE_ALLOC, self._pkey(rep, pg), page=pg)
+        return got
+
+    def _free(self, rep: int, pages, emit) -> None:
+        for pg in pages:
+            self.pages[rep][pg] = _FREE
+            emit(ev.PAGE_FREE, self._pkey(rep, pg), page=pg)
+
+    def _unshare(self, rep: int, pages, emit) -> None:
+        for pg in pages:
+            if self.bug == "free_shared":
+                # the seeded bug: shared prefix pages go back to the
+                # free list while the cache index still serves them
+                self.pages[rep][pg] = _FREE
+                emit(ev.PAGE_FREE, self._pkey(rep, pg), page=pg)
+                continue
+            self.sharers[rep][pg] -= 1
+            emit(ev.PAGE_UNSHARE, self._pkey(rep, pg), page=pg)
+
+    def _drain_busy(self, r: int) -> bool:
+        """The autoscaler's drain-idle check.  The FIXED check counts a
+        chaos-delayed in-flight handoff whose reserved destination is
+        this replica as work; ``bug='drain_inflight'`` reproduces the
+        pre-fix check that missed it."""
+        busy = any(rv["rep"] == r and rv["state"] == _RUNNING
+                   for rv in self.reqs.values())
+        if self.bug != "drain_inflight":
+            busy = busy or any(h["state"] == "inflight"
+                               and h["dst"] == r
+                               for h in self.handoffs)
+        return busy
+
+    # -- enabled actions --------------------------------------------------
+
+    def actions(self) -> List[Tuple]:
+        cfg = self.cfg
+        acts: List[Tuple] = []
+        live = [r for r, v in self.reps.items()
+                if v["alive"] and not v["draining"]]
+        for q, v in self.reqs.items():
+            if v["state"] in (_QUEUED, _PREEMPTED):
+                for r in live:
+                    if any(st == _FREE
+                           for st in self.pages[r].values()):
+                        acts.append(("admit", q, r))
+                if self.sheds < cfg.max_sheds:
+                    acts.append(("shed", q))
+            elif v["state"] == _RUNNING:
+                acts.append(("work", q))
+                if self.preempts < cfg.max_preempts:
+                    acts.append(("preempt", q))
+                if v["done"] == 0 and not self.handoffs \
+                        and v["epoch"] is not None:
+                    acts.append(("stage", q))
+        for r in self.reps:
+            v = self.reps[r]
+            if v["alive"]:
+                if self.evicts < cfg.max_evicts:
+                    for h, pg in sorted(self.cached_hash[r].items()):
+                        if self.sharers[r].get(pg, 0) == 0:
+                            acts.append(("evict", r, h))
+                for h in sorted(self.host[r]):
+                    if any(st == _FREE
+                           for st in self.pages[r].values()):
+                        acts.append(("refetch", r, h))
+                if self.crashes < cfg.max_crashes \
+                        and r in cfg.crash_targets:
+                    acts.append(("crash", r))
+                if not v["draining"] \
+                        and r in cfg.drain_targets \
+                        and self.drains < cfg.max_drains \
+                        and sum(1 for x in self.reps.values()
+                                if x["alive"]
+                                and not x["draining"]) > 1:
+                    acts.append(("drain", r))
+            if v["draining"] and not self._drain_busy(r):
+                # gated on the idle check so a busy drain is never a
+                # no-op transition (it would blow up the tree);
+                # bug='drain_inflight' weakens the check itself
+                acts.append(("finish_drain", r))
+            if not v["alive"]:
+                acts.append(("readmit", r))
+        for i, h in enumerate(self.handoffs):
+            if h["state"] == "staged":
+                for r in live:
+                    if r != h["src"] and any(
+                            st == _FREE
+                            for st in self.pages[r].values()):
+                        acts.append(("send", i, r))
+            elif h["state"] == "inflight":
+                acts.append(("land", i))
+                if self.chaos < cfg.max_chaos:
+                    acts.append(("drop_wire", i))
+            elif h["state"] == "landed":
+                if self.chaos < cfg.max_chaos:
+                    acts.append(("dup_deliver", i))
+        for zi, (q, r, epoch) in enumerate(self.zombie_finishes):
+            acts.append(("zombie_finish", zi))
+        return acts
+
+    # -- apply one action, emitting events --------------------------------
+
+    def apply(self, act: Tuple, emit) -> None:
+        name = act[0]
+        if name == "admit":
+            _, q, r = act
+            v = self.reqs[q]
+            got = self._alloc(r, 1, emit)
+            if got is None:
+                return
+            shared = ()
+            hkey = f"h{q % self.cfg.prefix_families}"
+            pg = self.cached_hash[r].get(hkey)
+            if pg is not None:
+                self.sharers[r][pg] = self.sharers[r].get(pg, 0) + 1
+                emit(ev.PAGE_SHARE, self._pkey(r, pg), page=pg)
+                shared = (pg,)
+            v.update(state=_RUNNING, rep=r, pages=tuple(got),
+                     shared=shared, epoch=self.reps[r]["fence"])
+            emit(ev.REQ_ADMIT, f"req:{q}")
+        elif name == "work":
+            _, q = act
+            v = self.reqs[q]
+            r = v["rep"]
+            emit(ev.REQ_WRITE, f"req:{q}", pos=v["done"], qlen=1,
+                 ctx_len=v["done"] + 1)
+            v["done"] += 1
+            if v["done"] < self.cfg.tokens_per_request:
+                return
+            if v["epoch"] != self.reps[r]["fence"] \
+                    and not (self.bug == "stale_accept"):
+                # placement from a fenced epoch: drop, requeue
+                emit(ev.FENCE_STALE_DROP, f"r{r}",
+                     epoch=v["epoch"])
+                self._finish_pages(q, cache=False, emit=emit)
+                v.update(state=_QUEUED, rep=None, done=0, epoch=None)
+                return
+            emit(ev.FENCE_COMPLETE, f"r{r}", epoch=v["epoch"],
+                 replica=f"r{r}")
+            self._finish_pages(q, cache=True, emit=emit)
+            v["state"] = _FINISHED
+            emit(ev.REQ_FINISH, f"req:{q}")
+        elif name == "preempt":
+            _, q = act
+            self.preempts += 1
+            v = self.reqs[q]
+            r = v["rep"]
+            emit(ev.REQ_PREEMPT, f"req:{q}")
+            self._free(r, v["pages"], emit)
+            self._unshare(r, v["shared"], emit)
+            v.update(state=_PREEMPTED, rep=None, pages=(), shared=(),
+                     done=0, epoch=None)
+        elif name == "shed":
+            _, q = act
+            self.sheds += 1
+            self.reqs[q]["state"] = _SHED
+            emit(ev.REQ_SHED, f"req:{q}")
+        elif name == "evict":
+            _, r, h = act
+            self.evicts += 1
+            pg = self.cached_hash[r].pop(h)
+            emit(ev.HOST_STAGE, f"hh:{r}:{h}", page=None,
+                 model_page=pg)
+            self.host[r].add(h)
+            self.pages[r][pg] = _FREE
+            self.sharers[r].pop(pg, None)
+            emit(ev.PAGE_UNCACHE, self._pkey(r, pg), page=pg)
+        elif name == "refetch":
+            _, r, h = act
+            got = self._alloc(r, 1, emit)
+            if got is None:
+                return
+            emit(ev.WIRE_INJECT, f"host->r{r}", epoch=0)
+            self.host[r].discard(h)
+            emit(ev.HOST_REFETCH, f"hh:{r}:{h}")
+            self.pages[r][got[0]] = _CACHED
+            self.sharers[r][got[0]] = 0
+            self.cached_hash[r][h] = got[0]
+            emit(ev.PAGE_CACHE, self._pkey(r, got[0]), page=got[0])
+        elif name == "stage":
+            _, q = act
+            v = self.reqs[q]
+            r = v["rep"]
+            self.stage_seq += 1
+            emit(ev.WIRE_EXTRACT, f"r{r}",
+                 pages=tuple())       # model pages are per-replica keys
+            emit(ev.REQ_STAGE, f"req:{q}", epoch=self.stage_seq)
+            self._free(r, v["pages"], emit)
+            self._unshare(r, v["shared"], emit)
+            self.handoffs.append({"req": q, "epoch": self.stage_seq,
+                                  "src": r, "state": "staged",
+                                  "dst": None, "dst_pages": None})
+            v.update(state=_STAGED, rep=None, pages=(), shared=())
+        elif name == "send":
+            _, i, r = act
+            h = self.handoffs[i]
+            got = self._alloc(r, 1, emit)
+            if got is None:
+                return
+            h.update(state="inflight", dst=r, dst_pages=tuple(got))
+        elif name == "drop_wire":
+            _, i = act
+            self.chaos += 1
+            h = self.handoffs[i]
+            emit(ev.CHAOS_INJECT, "chaos:drop")
+            self._free(h["dst"], h["dst_pages"], emit)
+            h.update(state="staged", dst=None, dst_pages=None)
+        elif name == "land":
+            _, i = act
+            h = self.handoffs[i]
+            q, r = h["req"], h["dst"]
+            key = (q, h["epoch"])
+            if key in self.injected and self.bug != "double_adopt":
+                self._free(r, h["dst_pages"], emit)
+                h.update(state="done", dst=None, dst_pages=None)
+                return
+            if not self.reps[r]["alive"]:
+                # destination fenced while in flight: restage
+                self._free(r, h["dst_pages"], emit)
+                self.stage_seq += 1
+                h.update(state="staged", dst=None, dst_pages=None,
+                         epoch=self.stage_seq)
+                return
+            fence = self.reps[r]["fence"]
+            emit(ev.WIRE_INJECT, f"r{h['src']}->r{r}",
+                 epoch=h["epoch"])
+            emit(ev.REQ_ADOPT, f"req:{q}", epoch=h["epoch"], dst=r,
+                 fence_epoch=h["stale_fence"]
+                 if "stale_fence" in h else fence)
+            self.injected.add(key)
+            self.reqs[q].update(state=_RUNNING, rep=r,
+                                pages=h["dst_pages"], shared=(),
+                                epoch=fence if "stale_fence" not in h
+                                else h["stale_fence"])
+            h.update(state="landed", dst_pages=None)
+        elif name == "dup_deliver":
+            _, i = act
+            self.chaos += 1
+            h = self.handoffs[i]
+            emit(ev.CHAOS_INJECT, "chaos:dup")
+            if self.bug == "double_adopt":
+                q = h["req"]
+                live = [r for r, v in self.reps.items() if v["alive"]]
+                r = live[0]
+                emit(ev.REQ_ADOPT, f"req:{q}", epoch=h["epoch"],
+                     dst=r, fence_epoch=self.reps[r]["fence"])
+            h["state"] = "done"
+        elif name == "crash":
+            _, r = act
+            self.crashes += 1
+            v = self.reps[r]
+            v["alive"] = False
+            v["draining"] = False
+            v["fence"] += 1
+            emit(ev.CHAOS_INJECT, "chaos:crash")
+            emit(ev.FENCE_BUMP, f"r{r}", epoch=v["fence"])
+            for q, rv in self.reqs.items():
+                if rv["rep"] == r and rv["state"] == _RUNNING:
+                    # re-route: the zombie copy may still complete and
+                    # must be dropped by the fence, never accepted
+                    self.zombie_finishes.append((q, r, v["fence"] - 1))
+                    emit(ev.REQ_QUEUED, f"req:{q}")
+                    rv.update(state=_QUEUED, rep=None, done=0,
+                              epoch=None)
+                    # pages stay leaked in the dead pool until readmit
+                    rv["zombie_pages"] = rv["pages"]
+                    rv["zombie_shared"] = rv["shared"]
+                    rv["zombie_rep"] = r
+                    rv.update(pages=(), shared=())
+        elif name == "zombie_finish":
+            _, zi = act
+            q, r, epoch = self.zombie_finishes.pop(zi)
+            if self.bug == "stale_accept":
+                emit(ev.FENCE_COMPLETE, f"r{r}", epoch=epoch,
+                     replica=f"r{r}")
+            else:
+                emit(ev.FENCE_STALE_DROP, f"r{r}", epoch=epoch)
+        elif name == "readmit":
+            _, r = act
+            v = self.reps[r]
+            # abort_all: the zombie's leaked pages return to the pool
+            for q, rv in self.reqs.items():
+                if rv.get("zombie_rep") == r:
+                    self._free(r, rv.pop("zombie_pages", ()), emit)
+                    self._unshare(r, rv.pop("zombie_shared", ()),
+                                  emit)
+                    rv.pop("zombie_rep", None)
+            v["alive"] = True
+        elif name == "drain":
+            _, r = act
+            self.drains += 1
+            self.reps[r]["draining"] = True
+            emit(ev.CHAOS_INJECT, "chaos:drain")
+        elif name == "finish_drain":
+            _, r = act
+            v = self.reps[r]
+            if self._drain_busy(r):
+                return
+            v["draining"] = False
+            v["alive"] = False
+            v["fence"] += 1
+            emit(ev.FENCE_BUMP, f"r{r}", epoch=v["fence"])
+            if self.bug == "drain_inflight":
+                # the bug: the in-flight handoff still lands on the
+                # fenced replica, stamped with the pre-drain epoch
+                for h in self.handoffs:
+                    if h["state"] == "inflight" and h["dst"] == r:
+                        h["stale_fence"] = v["fence"] - 1
+                        self.reps[r]["alive"] = True  # lands anyway
+
+    def _finish_pages(self, q: int, cache: bool, emit) -> None:
+        v = self.reqs[q]
+        r = v["rep"]
+        pages = list(v["pages"])
+        hkey = f"h{q % self.cfg.prefix_families}"
+        if cache and pages and hkey not in self.cached_hash[r] \
+                and hkey not in self.host[r]:
+            pg = pages.pop(0)
+            self.pages[r][pg] = _CACHED
+            self.sharers[r][pg] = 0
+            self.cached_hash[r][hkey] = pg
+            emit(ev.PAGE_CACHE, self._pkey(r, pg), page=pg)
+        self._free(r, pages, emit)
+        self._unshare(r, v["shared"], emit)
+        v.update(pages=(), shared=())
+
+    def done(self) -> bool:
+        return all(v["state"] in (_FINISHED, _SHED)
+                   for v in self.reqs.values())
+
+    def terminal_skip(self) -> Set[str]:
+        """Pages living in a dead (quarantined) pool at trace end are
+        exempt from the terminal-refcount check — the pool is fenced,
+        not leaked; readmission reclaims it."""
+        skip: Set[str] = set()
+        for r, v in self.reps.items():
+            if not v["alive"]:
+                skip.update(self._pkey(r, p) for p in self.pages[r])
+        return skip
+
+
+def _machines_from_model(model: "_Model"
+                         ) -> Tuple[PageMachine, RequestMachine,
+                                    FenceMachine]:
+    """Seed the three lifecycle machines with a model state's exact
+    protocol view.  Sound because the machines' view is a projection of
+    the model's fingerprint: equal fingerprints give equal machine
+    seeds, so a transition's verdict depends only on (state, action) —
+    the fact that lets :func:`explore` check every transition of the
+    state DAG exactly once instead of once per path through it."""
+    pages = PageMachine()
+    for r, pool in model.pages.items():
+        for pg, st in pool.items():
+            key = model._pkey(r, pg)
+            pages.state[key] = st
+            pages.pages_seen.add(key)
+        for pg, n in model.sharers[r].items():
+            pages.sharers[model._pkey(r, pg)] = n
+        for h in model.host[r]:
+            pages.host.add(f"hh:{r}:{h}")
+    reqs = RequestMachine()
+    for q, v in model.reqs.items():
+        reqs.state[f"req:{q}"] = v["state"]
+    for q, epoch in model.injected:
+        reqs.adopted.add((f"req:{q}", epoch))
+    fences = FenceMachine()
+    for r, v in model.reps.items():
+        fences.epoch[f"r{r}"] = v["fence"]
+    return pages, reqs, fences
+
+
+class _StopSearch(Exception):
+    pass
+
+
+def explore(cfg: Optional[ExploreConfig] = None,
+            bug: Optional[str] = None,
+            stop_at_first: bool = True) -> ExploreResult:
+    """Exhaustive model check of the bounded control plane.
+
+    The reachable state graph is a DAG (every potentially-cyclic action
+    — crash/readmit, preempt/readmit, evict/refetch, drain — increments
+    a capped counter that is part of the state fingerprint), so the
+    explorer walks it once: every reachable state is expanded once and
+    every transition's emitted events are checked by lifecycle machines
+    seeded from the parent state (:func:`_machines_from_model`);
+    terminal states additionally get the refcount-conservation check.
+    The number of INTERLEAVINGS (root-to-leaf paths — what a naive
+    per-path DFS would enumerate one by one) is recovered exactly by
+    memoized path counting over the same DAG, so the reported
+    ``interleavings`` is the true exhaustive count even when it is
+    orders of magnitude beyond what per-path enumeration could visit.
+    On the clean model (``bug=None``) zero violations is the
+    contract."""
+    cfg = cfg or ExploreConfig()
+    root = _Model(cfg, bug=bug)
+    stats = {"max_depth": 0, "checked": 0, "transitions": 0,
+             "cutoffs": 0}
+    memo: Dict[Tuple, int] = {}        # fingerprint -> leaf-path count
+    seen: Set[Tuple] = set()
+    found: List[Violation] = []
+
+    def check(parent: "_Model", events: List[Event],
+              terminal: Optional["_Model"] = None) -> None:
+        pages, reqs, fences = _machines_from_model(parent)
+        for e in events:
+            stats["checked"] += 1
+            pages.apply(e)
+            reqs.apply(e)
+            fences.apply(e)
+        if terminal is not None:
+            pages.finish(skip=terminal.terminal_skip())
+        vs = pages.violations + reqs.violations + fences.violations
+        if vs:
+            found.extend(vs)
+            if stop_at_first:
+                raise _StopSearch
+
+    def dfs(model: "_Model", depth: int) -> int:
+        stats["max_depth"] = max(stats["max_depth"], depth)
+        fp = model.fingerprint()
+        hit = memo.get(fp)
+        if hit is not None:
+            return hit
+        seen.add(fp)
+        if len(seen) > cfg.max_interleavings:
+            raise _StopSearch         # state-count safety net
+        acts = model.actions()
+        if not acts or model.done():
+            check(model, [], terminal=model if model.done() else None)
+            memo[fp] = 1
+            return 1
+        if depth >= cfg.max_depth:
+            # pure recursion safety net (paths are bounded by the
+            # action caps, far below max_depth); NOT memoized so the
+            # counts stay exact if it ever triggers
+            stats["cutoffs"] += 1
+            return 1
+        total = 0
+        for act in acts:
+            child = model.clone()
+            events: List[Event] = []
+
+            def emit(kind, key, epoch=None, _act=act, _d=depth,
+                     **attrs):
+                events.append(Event(
+                    kind=kind, key=key, step=len(events), epoch=epoch,
+                    attrs=attrs,
+                    provenance=f"explore:{_act[0]}@d{_d}",
+                    seq=len(events) + 1))
+
+            child.apply(act, emit)
+            stats["transitions"] += 1
+            check(model, events)
+            total += dfs(child, depth + 1)
+        memo[fp] = total
+        return total
+
+    try:
+        n_paths = dfs(root, 0)
+    except _StopSearch:
+        n_paths = 0                   # aborted at first violation
+    # dedupe violations (shared subjects across sibling transitions)
+    uniq: Dict[Tuple[str, str, str], Violation] = {}
+    for v in found:
+        uniq.setdefault((v.rule, v.subject, v.message), v)
+    return ExploreResult(interleavings=n_paths,
+                         states=len(seen),
+                         max_depth=stats["max_depth"],
+                         events_checked=stats["checked"],
+                         violations=list(uniq.values()))
+
+
+def fuzz_trace(seed: int = 0, n_events: int = 300,
+               cfg: Optional[ExploreConfig] = None,
+               bug: Optional[str] = None) -> List[Event]:
+    """One seeded random walk through the model: a reproducible
+    ~``n_events``-event chaos trace (admissions, preemptions, handoffs,
+    crashes, drains, host-tier churn).  The clean walk replays with
+    zero violations; the mutation tests corrupt single events in it."""
+    cfg = cfg or ExploreConfig(max_crashes=2, max_chaos=2,
+                               max_sheds=2, max_preempts=3,
+                               n_requests=4,
+                               pages_per_replica=4,
+                               max_depth=10 ** 9)
+    rng = random.Random(seed)
+    model = _Model(cfg, bug=bug)
+    out: List[Event] = []
+
+    def emit(kind, key, epoch=None, **attrs):
+        out.append(Event(kind=kind, key=key, step=len(out),
+                         epoch=epoch, attrs=attrs,
+                         provenance=f"fuzz[{len(out)}]",
+                         seq=len(out)))
+
+    guard = 0
+    while len(out) < n_events and guard < 50 * n_events:
+        guard += 1
+        if model.done():
+            # recycle: admit a FRESH batch of request ids so a long
+            # trace keeps exercising the full lifecycle (terminal
+            # states are terminal — a finished id never re-queues),
+            # and reset the chaos budgets for the new era.  All
+            # handoffs are settled at this point (an active one keeps
+            # its request non-terminal), so clearing them re-arms the
+            # staging path
+            base = max(model.reqs) + 1
+            for j in range(model.cfg.n_requests):
+                q = base + j
+                model.reqs[q] = {"state": _QUEUED, "rep": None,
+                                 "pages": (), "shared": (),
+                                 "done": 0, "epoch": None}
+                emit(ev.REQ_QUEUED, f"req:{q}")
+            model.handoffs = []
+            model.preempts = model.sheds = 0
+            model.crashes = model.chaos = 0
+            model.evicts = model.drains = 0
+            continue
+        acts = model.actions()
+        if not acts:
+            # mid-era starvation (chaos budgets spent, pools pinned):
+            # refresh the budgets and retry; a walk that is still
+            # starved is genuinely wedged, so stop
+            model.preempts = model.sheds = 0
+            model.crashes = model.chaos = 0
+            model.evicts = model.drains = 0
+            acts = model.actions()
+            if not acts:
+                break
+        model.apply(acts[rng.randrange(len(acts))], emit)
+    # settle: exhaust the chaos budgets (progress actions only) and run
+    # the last era to completion so every in-flight share closes —
+    # terminal refcount conservation must hold on the clean walk
+    model.crashes = cfg.max_crashes
+    model.chaos = cfg.max_chaos
+    model.preempts = cfg.max_preempts
+    model.sheds = cfg.max_sheds
+    model.drains = cfg.max_drains
+    guard = 0
+    while not model.done() and guard < 50 * n_events:
+        guard += 1
+        acts = model.actions()
+        if not acts:
+            model.evicts = 0      # un-pin a saturated pool
+            acts = model.actions()
+            if not acts:
+                break
+        model.apply(acts[rng.randrange(len(acts))], emit)
+    # close the trace: revive dead pools so terminal refcounts settle
+    for r, v in model.reps.items():
+        if not v["alive"]:
+            model.apply(("readmit", r), emit)
+    skip = model.terminal_skip()
+    assert not skip
+    return out

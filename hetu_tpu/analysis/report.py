@@ -123,6 +123,14 @@ class ExecutableReport:
         # reviewable evidence for a re-freeze
         if "cost" in self.meta:
             d["cost"] = self.meta["cost"].to_dict()
+        # serving-protocol coverage (analysis/events + protocol): the
+        # baseline pins the normalized event-stream size, the observed
+        # kind vocabulary and the lifecycle-violation count (0 on a
+        # clean tree) so an executable cannot silently stop emitting
+        # protocol events — a lost stream turns the lifecycle rules
+        # vacuously green, which is the regression class this pins
+        if "protocol" in self.meta:
+            d["protocol"] = dict(self.meta["protocol"])
         if records:
             d["records"] = [r.to_dict() for r in self.records]
         return d
@@ -164,11 +172,16 @@ class AnalysisReport:
                     if cov["total"] else 100.0
                 cov_s = (f", edges explain {cov['explained']}/"
                          f"{cov['total']} ({pct:.0f}%)")
+            prot = rep.meta.get("protocol")
+            prot_s = ""
+            if prot:
+                prot_s = (f", {prot['events']} protocol events/"
+                          f"{prot['violations']} violations")
             lines.append(
                 f"{name}: {sum(counts.values())} collectives {counts}, "
                 f"{rep.total_payload_bytes} payload B, "
                 f"{rep.total_wire_bytes:.0f} wire B/rank, "
-                f"{len(rep.findings)} findings{cov_s}")
+                f"{len(rep.findings)} findings{cov_s}{prot_s}")
             for f in rep.findings:
                 lines.append(f"  - {f}")
         return "\n".join(lines)
@@ -287,6 +300,41 @@ class AnalysisReport:
                                 f"{b:.0f} -> {g:.0f} "
                                 f"(> {tolerance:.0%} tolerance; "
                                 f"{got_t.bound}-bound)")
+            # serving-protocol coverage: violations may not grow (the
+            # tree is clean — any lifecycle violation is a regression),
+            # the observed event-kind vocabulary may not lose kinds
+            # (an adapter silently dropping a plane un-checks it), and
+            # the stream may not shrink beyond the tolerance (stopping
+            # to measure IS the regression, as with the gates above)
+            want_p = base.get("protocol")
+            got_p = rep.meta.get("protocol")
+            if want_p:
+                if got_p is None:
+                    problems.append(
+                        f"{name}: baseline records protocol coverage "
+                        f"but the report has none (event stream lost?)")
+                else:
+                    w_v = int(want_p.get("violations", 0))
+                    g_v = int(got_p.get("violations", 0))
+                    if g_v > w_v:
+                        problems.append(
+                            f"{name}: protocol violations regressed "
+                            f"{w_v} -> {g_v}")
+                    missing = sorted(set(want_p.get("kinds", {}))
+                                     - set(got_p.get("kinds", {})))
+                    if missing:
+                        problems.append(
+                            f"{name}: protocol event kinds vanished "
+                            f"from the stream: {missing} (adapter or "
+                            f"producer lost?)")
+                    w_e = float(want_p.get("events", 0))
+                    g_e = float(got_p.get("events", 0))
+                    if g_e < w_e * (1.0 - tolerance) and w_e - g_e > 1:
+                        problems.append(
+                            f"{name}: protocol event stream shrank "
+                            f"{w_e:.0f} -> {g_e:.0f} events "
+                            f"(> {tolerance:.0%} tolerance — protocol "
+                            f"coverage drop)")
             for field, value in (("payload_bytes", rep.total_payload_bytes),
                                  ("wire_bytes", rep.total_wire_bytes)):
                 b = float(base.get(field, 0))

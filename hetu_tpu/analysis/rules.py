@@ -123,6 +123,38 @@ Rule catalog (DESIGN.md §9 for the rationale of each):
                              these bytes (generalizes
                              ``replicated-large-param`` from params to
                              the state that usually dwarfs them).
+``page-lifecycle-violation`` serving protocol (DESIGN.md §23): a
+                             page-plane event breaks the page lifecycle
+                             state machine (free→allocated→cached→
+                             host-staged→free, trash page immutable) —
+                             double-free, alloc of a non-free page,
+                             free of a cached/shared page, host-stage
+                             of a page that was never cached, write to
+                             a freed page...
+``request-lifecycle-violation`` serving protocol: a request-plane event
+                             breaks the request lifecycle (queued→
+                             running→preempted/handoff-staged→adopted→
+                             finished|shed) — double-adopt, adoption of
+                             a request never staged, KV write or
+                             re-queue after finish/shed...
+``fence-regression``         serving protocol: a replica's fence epoch
+                             moved BACKWARDS, or a completion/adoption
+                             stamped with a stale epoch was accepted
+                             past the death sweep — the exact shape
+                             that double-delivers tokens after a crash.
+``refcount-leak``            serving protocol: prefix-cache sharer
+                             accounting broke — unshare below zero,
+                             uncache with live sharers; over COMPLETE
+                             traces (the explorer / fuzz gate) also
+                             terminal page-conservation failures.
+
+The four ``serving protocol`` rules replay the normalized event stream
+(``analysis.events.collect_events``) through the lifecycle state
+machines in ``analysis.protocol``; their findings carry the violating
+event subtrace in ``hint`` (printed by the CLI's ``--explain``).
+:data:`TRACE_RULE_EVENT_KINDS` maps every trace-replay rule to the
+event kinds it inspects, so the vacuity meta-test can prove each rule
+actually sees events of those kinds in the gate executables' traces.
 
 Thresholds live in :data:`DEFAULT_OPTIONS` and are overridable per
 context (tests seed violations with tiny thresholds).
@@ -134,8 +166,11 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import events as pe
 from .jaxpr_walk import (compute_dtype_histogram, donation_candidates,
                          unreduced_scalar_outputs)
+from .protocol import (RULE_FENCE, RULE_PAGE, RULE_REFCOUNT,
+                       RULE_REQUEST, replay)
 from .report import CollectiveRecord, Finding
 
 LOW_PRECISION = {"bfloat16", "float16", "int8", "uint8", "float8_e4m3fn",
@@ -791,18 +826,19 @@ def _kv_handoff_unpriced(ctx: AnalysisContext) -> List[Finding]:
     CPU-honest cluster design is that the page stream is priced BEFORE
     TPU hardware exists, so an unpriced move fails CI.  Executables
     with no ``kv_handoff`` meta (everything but cluster decode
-    replicas) are out of scope."""
+    replicas) are out of scope.  Re-based on the unified event stream:
+    the adapter carries each raw record on its ``wire.inject`` event."""
     if "kv_handoff" not in (ctx.meta or {}):
         return []
-    records, lost = _call_meta_records(ctx.meta, "kv_handoff")
-    if lost:
+    events, lost = pe.collect_events(ctx)
+    if "kv_handoff" in lost:
         return [Finding(
             rule="", subject="kv_handoff", severity="error",
             message="kv_handoff record hook raised — the handoff "
                     "accounting is lost, which is itself a gate "
                     "failure")]
     out: List[Finding] = []
-    for i, rec in enumerate(records or ()):
+    for i, rec in _plane_records(events, pe.WIRE_INJECT, "kv_handoff"):
         edge = rec.get("edge") or {}
         payload = int(rec.get("payload_bytes", 0) or 0)
         problems = []
@@ -852,18 +888,21 @@ def _host_offload_unpriced(ctx: AnalysisContext) -> List[Finding]:
     mismatch means the tier moved bytes the analysis plane cannot see.
     Executables with no ``host_offload`` meta (engines without a host
     tier) are out of scope; records flagged ``host_offload_exempt``
-    are skipped."""
+    are skipped.  Re-based on the unified event stream: each move rides
+    in on its ``host.stage`` / ``host.refetch`` event."""
     if "host_offload" not in (ctx.meta or {}):
         return []
-    records, lost = _call_meta_records(ctx.meta, "host_offload")
-    if lost:
+    events, lost = pe.collect_events(ctx)
+    if "host_offload" in lost:
         return [Finding(
             rule="", subject="host_offload", severity="error",
             message="host_offload record hook raised — the host-tier "
                     "accounting is lost, which is itself a gate "
                     "failure")]
     out: List[Finding] = []
-    for i, rec in enumerate(records or ()):
+    for i, rec in _plane_records(events,
+                                 (pe.HOST_STAGE, pe.HOST_REFETCH),
+                                 "host_offload"):
         if rec.get("host_offload_exempt"):
             continue
         edge = rec.get("edge") or {}
@@ -921,6 +960,20 @@ def _call_meta_records(meta, key: str):
     return records, False
 
 
+def _plane_records(events, kinds, plane: str):
+    """Pull one plane's raw records back out of the unified event
+    stream: events of the given kind(s) whose adapter attached the
+    record (matched by provenance prefix so e.g. the handoff wire's
+    ``wire.inject`` events never mix with another plane's), yielded in
+    original record order."""
+    if isinstance(kinds, str):
+        kinds = (kinds,)
+    got = [(e.attrs["index"], e.attrs["record"]) for e in events
+           if e.kind in kinds and "record" in e.attrs
+           and e.provenance.startswith(plane + "[")]
+    return sorted(got, key=lambda t: t[0])
+
+
 @rule("unfenced-handoff")
 def _unfenced_handoff(ctx: AnalysisContext) -> List[Finding]:
     """Fencing contract of the fault plane (DESIGN.md §18): every
@@ -933,24 +986,29 @@ def _unfenced_handoff(ctx: AnalysisContext) -> List[Finding]:
     any of those races it duplicates work, so it fails CI.  Records
     flagged ``fence_exempt`` (the monolithic-degrade path: a local
     re-prefill that never crosses pools) are exempt; executables with
-    neither ``kv_handoff`` nor ``adoptions`` meta are out of scope."""
+    neither ``kv_handoff`` nor ``adoptions`` meta are out of scope.
+    Re-based on the unified event stream (``wire.inject`` /
+    ``req.adopt`` events carry the raw records)."""
     meta = ctx.meta or {}
     if "kv_handoff" not in meta and "adoptions" not in meta:
         return []
+    events, lost_hooks = pe.collect_events(ctx)
     out: List[Finding] = []
-    for key, what in (("kv_handoff", "cross-replica KV-page move"),
-                      ("adoptions", "mid-flight request adoption")):
+    for key, kinds, what in (
+            ("kv_handoff", pe.WIRE_INJECT,
+             "cross-replica KV-page move"),
+            ("adoptions", pe.REQ_ADOPT,
+             "mid-flight request adoption")):
         if key not in meta:
             continue
-        records, lost = _call_meta_records(meta, key)
-        if lost:
+        if key in lost_hooks:
             out.append(Finding(
                 rule="", subject=key, severity="error",
                 message=f"{key} record hook raised — the fencing "
                         "accounting is lost, which is itself a gate "
                         "failure"))
             continue
-        for i, rec in enumerate(records or ()):
+        for i, rec in _plane_records(events, kinds, key):
             if rec.get("fence_exempt"):
                 continue
             epoch = rec.get("epoch")
@@ -1027,42 +1085,41 @@ def _cow_page_write(ctx: AnalysisContext) -> List[Finding]:
     refcount 1 = cached with zero live sharers — the index still serves
     it to future lookups); a violation means a request's scatter is
     destroying KV history the cache (and possibly other live requests,
-    refcount > 1) will read."""
+    refcount > 1) will read.  Re-based on the unified event stream: the
+    tap adapter expands each row's write plan into per-page-span
+    ``page.write`` events carrying the refcount snapshot, so this rule
+    is a filter over one vocabulary instead of a private tap parser."""
     if ctx.serving is None:
         return []
     from ..serving.kv_pool import TRASH_PAGE
-    pool = ctx.serving.get("pool")
-    ps = pool.page_size if pool is not None else \
-        ctx.serving.get("page_size", 1)
+    events, _lost = pe.collect_events(ctx)
     out: List[Finding] = []
-    for step, rec in enumerate(ctx.serving.get("tap", ())):
-        if rec.get("kind") != "unified":
+    flagged = set()                  # one finding per (step, row)
+    for e in events:
+        if e.kind != pe.PAGE_WRITE or e.attrs.get("src") != "unified":
             continue
-        refs = rec.get("refcounts")
-        if not refs:
+        pg = int(e.attrs["page"])
+        rc = e.attrs.get("refcount")
+        step, row = e.attrs.get("tap_step"), e.attrs.get("row")
+        if pg == TRASH_PAGE or rc is None or (step, row) in flagged:
             continue
-        pt = np.asarray(rec.get("page_tables"))
-        for row, pos, qlen in rec.get("rows", ()):
-            for t in range(int(qlen)):
-                pg = int(pt[int(row), (int(pos) + t) // ps])
-                if pg != TRASH_PAGE and pg in refs:
-                    out.append(Finding(
-                        rule="", subject=f"unified@{step}/row{row}",
-                        severity="error",
-                        message=f"unified step at tap step {step}: row "
-                                f"{row}'s KV write plan (pos "
-                                f"{int(pos) + t}) targets page {pg} "
-                                f"with refcount {int(refs[pg])} — a "
-                                f"read-only prefix-cache page; the "
-                                f"write corrupts KV history the cache "
-                                f"(and any live sharer) reads",
-                        hint="copy-on-write: start the request's write "
-                             "cursor at the cached boundary (pos = "
-                             "shared_pages * page_size) and allocate a "
-                             "fresh page for the first partial/"
-                             "divergent page — shared pages may only "
-                             "ever be READ"))
-                    break
+        flagged.add((step, row))
+        out.append(Finding(
+            rule="", subject=f"unified@{step}/row{row}",
+            severity="error", source=e.provenance,
+            message=f"unified step at tap step {step}: row "
+                    f"{row}'s KV write plan (pos "
+                    f"{int(e.attrs['pos0'])}) targets page {pg} "
+                    f"with refcount {int(rc)} — a "
+                    f"read-only prefix-cache page; the "
+                    f"write corrupts KV history the cache "
+                    f"(and any live sharer) reads",
+            hint="copy-on-write: start the request's write "
+                 "cursor at the cached boundary (pos = "
+                 "shared_pages * page_size) and allocate a "
+                 "fresh page for the first partial/"
+                 "divergent page — shared pages may only "
+                 "ever be READ"))
     return out
 
 
@@ -1082,54 +1139,60 @@ def _spec_rewind_leak(ctx: AnalysisContext) -> List[Finding]:
     extent ``ctx`` reaches past what is valid-or-just-rewritten: that
     attention is consuming rejected-draft KV, which silently corrupts
     every token after it.  Records flagged ``rewind_exempt`` are
-    skipped (a deliberate replay of foreign tap data)."""
+    skipped (a deliberate replay of foreign tap data).  Re-based on the
+    event stream: the tap adapter emits ``req.rewind`` / ``req.preempt``
+    / ``req.write`` events in tap order, so the watermark replay is a
+    fold over three event kinds instead of a private tap parser."""
     if ctx.serving is None:
         return []
+    events, _lost = pe.collect_events(ctx)
     out: List[Finding] = []
     valid: Dict[int, int] = {}
-    for step, rec in enumerate(ctx.serving.get("tap", ())):
-        kind = rec.get("kind")
-        if kind == "spec_rewind":
-            r = int(rec["req"])
-            cut = int(rec["valid_upto"])
+    for e in events:
+        if not e.provenance.startswith("tap["):
+            continue
+        if e.kind == pe.REQ_REWIND:
+            r = int(str(e.key).rsplit(":", 1)[1])
+            cut = int(e.attrs["valid_upto"])
             valid[r] = min(valid.get(r, cut), cut)
             continue
-        if kind == "kv_drop":
-            valid[int(rec["req"])] = 0
+        if e.kind == pe.REQ_PREEMPT:
+            valid[int(str(e.key).rsplit(":", 1)[1])] = 0
             continue
-        if kind != "unified" or rec.get("rewind_exempt"):
+        if e.kind != pe.REQ_WRITE or e.attrs.get("rewind_exempt"):
             continue
-        for r, pos, qlen, ctx_len in rec.get("reads", ()):
-            r, pos, qlen, ctx_len = (int(r), int(pos), int(qlen),
-                                     int(ctx_len))
-            # first sight: positions [0, pos) predate the tap window
-            # (or were handed off with the request) — trust them
-            v = valid.get(r, pos)
-            if pos <= v:
-                after = max(v, pos + qlen)
-            else:
-                # a write GAP: [v, pos) stays stale, writes past it
-                # cannot bridge the hole
-                after = v
-            if ctx_len > after:
-                out.append(Finding(
-                    rule="", subject=f"unified@{step}/req{r}",
-                    severity="error",
-                    message=f"unified step at tap step {step}: request "
-                            f"{r} reads KV through position "
-                            f"{ctx_len - 1} but positions "
-                            f"[{after}, {ctx_len}) were never "
-                            f"(re)written after the last rewind — the "
-                            f"attention window is consuming "
-                            f"rejected-draft KV",
-                    hint="rewind must land exactly on the accepted "
-                         "boundary (pos = committed tokens with valid "
-                         "KV) so the next verify burst's write plan "
-                         "covers every stale slot before the kernel "
-                         "reads it; check _commit_verify's pos "
-                         "arithmetic and that ctx_lens == pos + q_len "
-                         "for every packed row"))
-            valid[r] = after
+        r = int(str(e.key).rsplit(":", 1)[1])
+        step = e.attrs["tap_step"]
+        pos, qlen, ctx_len = (int(e.attrs["pos"]), int(e.attrs["qlen"]),
+                              int(e.attrs["ctx_len"]))
+        # first sight: positions [0, pos) predate the tap window
+        # (or were handed off with the request) — trust them
+        v = valid.get(r, pos)
+        if pos <= v:
+            after = max(v, pos + qlen)
+        else:
+            # a write GAP: [v, pos) stays stale, writes past it
+            # cannot bridge the hole
+            after = v
+        if ctx_len > after:
+            out.append(Finding(
+                rule="", subject=f"unified@{step}/req{r}",
+                severity="error", source=e.provenance,
+                message=f"unified step at tap step {step}: request "
+                        f"{r} reads KV through position "
+                        f"{ctx_len - 1} but positions "
+                        f"[{after}, {ctx_len}) were never "
+                        f"(re)written after the last rewind — the "
+                        f"attention window is consuming "
+                        f"rejected-draft KV",
+                hint="rewind must land exactly on the accepted "
+                     "boundary (pos = committed tokens with valid "
+                     "KV) so the next verify burst's write plan "
+                     "covers every stale slot before the kernel "
+                     "reads it; check _commit_verify's pos "
+                     "arithmetic and that ctx_lens == pos + q_len "
+                     "for every packed row"))
+        valid[r] = after
     return out
 
 
@@ -1154,45 +1217,136 @@ def _trash_page_write(ctx: AnalysisContext) -> List[Finding]:
                 message="reserved trash page 0 is marked allocated — a "
                         "live request is scatter-writing the padding "
                         "sink"))
-    ps = pool.page_size if pool is not None else \
-        ctx.serving.get("page_size", 1)
-    for step, rec in enumerate(ctx.serving.get("tap", ())):
-        if rec.get("kind") == "unified":
-            # ragged packed step: each live row writes q_len tokens at
-            # positions [pos, pos + q_len) through its page table — none
-            # of those slots may resolve to the trash page
-            pt = np.asarray(rec.get("page_tables"))
-            for row, pos, qlen in rec.get("rows", ()):
-                for t in range(int(qlen)):
-                    if pt[int(row), (int(pos) + t) // ps] == TRASH_PAGE:
-                        out.append(Finding(
-                            rule="", subject=f"unified@{step}/row{row}",
-                            severity="error",
-                            message=f"unified step at tap step {step}: "
-                                    f"LIVE row {row} (pos {int(pos) + t})"
-                                    f" scatter-writes page 0 outside the"
-                                    f" padding path — its KV history is "
-                                    f"being destroyed"))
-                        break
+    # tap scan, re-based on the event stream: the tap adapter expands
+    # every write plan (unified rows, prefill page lists, legacy decode
+    # cursors) into ``page.write`` events tagged with their source, so
+    # the trash-page check is one filter over ``page == 0``
+    events, _lost = pe.collect_events(ctx)
+    flagged = set()              # fire-once per (src, step, row)
+    for e in events:
+        if e.kind != pe.PAGE_WRITE or int(e.attrs["page"]) != TRASH_PAGE:
             continue
-        if rec.get("kind") == "prefill":
-            if TRASH_PAGE in rec.get("pages", ()):
-                out.append(Finding(
-                    rule="", subject=f"prefill@{step}", severity="error",
-                    message=f"prefill at tap step {step} was handed page "
-                            f"0 — its prompt KV overwrites the padding "
-                            f"sink"))
+        src = e.attrs.get("src")
+        step, row = e.attrs.get("tap_step"), e.attrs.get("row")
+        if (src, step, row) in flagged:
             continue
-        pt = np.asarray(rec.get("page_tables"))
-        pos = np.asarray(rec.get("pos"))
-        n_live = int(rec.get("n_live", 0))
-        for i in range(min(n_live, pt.shape[0] if pt.ndim else 0)):
-            if pt[i, int(pos[i]) // ps] == TRASH_PAGE:
-                out.append(Finding(
-                    rule="", subject=f"decode@{step}/row{i}",
-                    severity="error",
-                    message=f"decode at tap step {step}: LIVE row {i} "
-                            f"(pos {int(pos[i])}) scatter-writes page 0 "
-                            f"outside the padding path — its KV history "
-                            f"is being destroyed"))
+        flagged.add((src, step, row))
+        if src == "unified":
+            out.append(Finding(
+                rule="", subject=f"unified@{step}/row{row}",
+                severity="error", source=e.provenance,
+                message=f"unified step at tap step {step}: "
+                        f"LIVE row {row} (pos {int(e.attrs['pos0'])})"
+                        f" scatter-writes page 0 outside the"
+                        f" padding path — its KV history is "
+                        f"being destroyed"))
+        elif src == "prefill":
+            out.append(Finding(
+                rule="", subject=f"prefill@{step}", severity="error",
+                source=e.provenance,
+                message=f"prefill at tap step {step} was handed page "
+                        f"0 — its prompt KV overwrites the padding "
+                        f"sink"))
+        elif src == "decode":
+            out.append(Finding(
+                rule="", subject=f"decode@{step}/row{row}",
+                severity="error", source=e.provenance,
+                message=f"decode at tap step {step}: LIVE row {row} "
+                        f"(pos {int(e.attrs['pos0'])}) scatter-writes "
+                        f"page 0 outside the padding path — its KV "
+                        f"history is being destroyed"))
     return out
+
+
+# ---------------------------------------------------------------------------
+# serving-protocol lifecycle rules (DESIGN.md §23)
+# ---------------------------------------------------------------------------
+
+
+def _protocol_replay(ctx: AnalysisContext):
+    """Run the three lifecycle machines over the executable's normalized
+    event stream ONCE (memoized on the context — the four lifecycle
+    rules share one replay, like they share one ``collect_events``).
+
+    ``strict_terminal=False``: a live executable's trace ends mid-flight
+    (requests still decoding, pages legitimately allocated), so terminal
+    page-conservation is NOT enforced here — that check belongs to
+    COMPLETE traces, i.e. the bounded explorer and the fuzz gate, which
+    replay with ``strict_terminal=True``."""
+    cached = getattr(ctx, "_protocol_violations", None)
+    if cached is not None:
+        return cached
+    events, _lost = pe.collect_events(ctx)
+    violations = replay(events, strict_terminal=False)
+    try:
+        ctx._protocol_violations = violations
+    except Exception:
+        pass
+    return violations
+
+
+def _lifecycle_findings(ctx: AnalysisContext,
+                        rule_name: str) -> List[Finding]:
+    return [Finding(rule="", subject=v.subject, severity="error",
+                    source=v.provenance, message=v.message,
+                    hint=v.format_subtrace())
+            for v in _protocol_replay(ctx) if v.rule == rule_name]
+
+
+@rule(RULE_PAGE)
+def _page_lifecycle_violation(ctx: AnalysisContext) -> List[Finding]:
+    """Page lifecycle (free→allocated→cached→host-staged→free, trash
+    page immutable) replayed over the event stream; one finding per
+    broken page, carrying the page's own event subtrace."""
+    return _lifecycle_findings(ctx, RULE_PAGE)
+
+
+@rule(RULE_REQUEST)
+def _request_lifecycle_violation(ctx: AnalysisContext) -> List[Finding]:
+    """Request lifecycle (queued→running→preempted/handoff-staged→
+    adopted→finished|shed): no double-adopt, no write / re-queue after
+    finish, no adoption without a stage."""
+    return _lifecycle_findings(ctx, RULE_REQUEST)
+
+
+@rule(RULE_FENCE)
+def _fence_regression(ctx: AnalysisContext) -> List[Finding]:
+    """Fence epochs are monotone per replica and no stale-epoch
+    completion/adoption is ever accepted past the death sweep."""
+    return _lifecycle_findings(ctx, RULE_FENCE)
+
+
+@rule(RULE_REFCOUNT)
+def _refcount_leak(ctx: AnalysisContext) -> List[Finding]:
+    """Prefix-cache sharer conservation: unshare never dips below zero
+    and no cached page is dropped while sharers still read it (terminal
+    conservation over complete traces lives in the explorer/fuzz gate,
+    not here — live executables end mid-flight)."""
+    return _lifecycle_findings(ctx, RULE_REFCOUNT)
+
+
+# Every trace-replay rule → the event kinds it inspects.  The vacuity
+# meta-test (tests/test_protocol.py) walks this registry and asserts the
+# registered gate executables' traces contain at least one event of a
+# kind each rule inspects — a rule whose input vocabulary never occurs
+# in any gate trace is vacuous and its green is meaningless.  ``None``
+# marks a rule that replays a RECORD plane (meta hook) rather than the
+# event stream; the meta-test skips it with that reason.
+TRACE_RULE_EVENT_KINDS: Dict[str, Optional[Tuple[str, ...]]] = {
+    "trash-page-write": (pe.PAGE_WRITE,),
+    "kv-handoff-unpriced": (pe.WIRE_INJECT,),
+    "host-offload-unpriced": (pe.HOST_STAGE, pe.HOST_REFETCH),
+    "unfenced-handoff": (pe.WIRE_INJECT, pe.REQ_ADOPT),
+    "cow-page-write": (pe.PAGE_WRITE,),
+    "spec-rewind-leak": (pe.REQ_WRITE,),
+    RULE_PAGE: (pe.PAGE_ALLOC, pe.PAGE_FREE, pe.PAGE_CACHE,
+                pe.HOST_STAGE, pe.HOST_REFETCH, pe.POOL_RESET),
+    RULE_REQUEST: (pe.REQ_QUEUED, pe.REQ_ADMIT, pe.REQ_FINISH,
+                   pe.REQ_SHED, pe.REQ_STAGE, pe.REQ_ADOPT),
+    RULE_FENCE: (pe.FENCE_BUMP, pe.FENCE_COMPLETE, pe.FENCE_STALE_DROP,
+                 pe.REQ_ADOPT, pe.WIRE_INJECT),
+    RULE_REFCOUNT: (pe.PAGE_SHARE, pe.PAGE_UNSHARE),
+    # record-plane rule: checkpoint restore records come from the meta
+    # hook, not the serving event stream
+    "unverified-restore": None,
+}

@@ -69,6 +69,7 @@ from ...fault.backoff import RetryPolicy
 from ...obs.tracer import PrefixedTracer, get_tracer
 from ...utils.metrics import make_instrument, merge_prometheus_texts
 from ..engine import Engine
+from ..kv_pool import protocol_seq
 from ..slo.backlog import ClassBacklog
 from ..slo.classes import SLO_CLASSES, class_rank
 from .replica import DECODE, PREFILL, UNIFIED, Replica
@@ -238,6 +239,27 @@ class EngineCluster:
                     h.meta["adoptions"] = \
                         (lambda c=self, d=r.idx:
                          [a for a in c._adoptions if a["dst"] == d])
+        # every replica's executable additionally sees the cluster's
+        # control-plane protocol events (and the chaos audit log when a
+        # controller is wired) — the protocol lifecycle rules replay
+        # fences/sheds/adoptions against the engine-local planes
+        from ...graph.graph import get_executable as _get_exe
+        prefills = [r for r in self.replicas if r.role == PREFILL]
+        for r in self.replicas:
+            try:
+                h = _get_exe(f"{r.engine.name}/unified")
+            except KeyError:
+                continue
+            h.meta["protocol"] = (lambda c=self: list(c.protocol_log))
+            if self.chaos is not None:
+                h.meta["chaos"] = \
+                    (lambda ch=self.chaos: list(ch.injected))
+            if len(prefills) == 1 and r is prefills[0]:
+                # page ids are pool-local: the extract log only joins
+                # the stream whose pool the extracts actually read
+                h.meta["extract_log"] = \
+                    (lambda t=self.transport:
+                     list(getattr(t, "extract_log", ())))
 
         self.router = Router(policy=policy,
                              max_queue_depth=max_queue_depth,
@@ -273,6 +295,10 @@ class EngineCluster:
         # mid-flight adoption audit trail (the unfenced-handoff rule
         # reads these through the decode replicas' executable meta)
         self._adoptions: List[Dict[str, Any]] = []
+        # cluster-plane protocol events (req.queued/stage/shed/finish,
+        # fence.bump/complete/stale_drop) for the analysis event
+        # stream — the control-plane half the engine logs can't see
+        self.protocol_log: List[Dict[str, Any]] = []
         # reset-robust per-replica counter accumulation (see
         # metrics_summary): replica -> counter -> (base, last_seen)
         self._counter_acc: Dict[int, Dict[str, List[float]]] = \
@@ -293,7 +319,12 @@ class EngineCluster:
                           # bench), autoscaler actions
                           *(f"shed_{c}" for c in SLO_CLASSES),
                           "class_inversions", "scale_ups",
-                          "scale_downs")}
+                          "scale_downs",
+                          # drain completions deferred because a
+                          # chaos-delayed handoff was still in flight
+                          # TO the draining replica (the interaction
+                          # bug the protocol explorer surfaced)
+                          "drains_deferred_inflight")}
         self.histograms = {k: make_instrument("histogram", k, m) for k in
                            ("ttft", "tbt", "request_latency",
                             # per-class latency tails: the SLO targets
@@ -365,6 +396,9 @@ class EngineCluster:
                 self._shed(creq, "backlog_full", now)
                 return creq
         self._backlog.push(creq)
+        self.protocol_log.append({"ev": "req.queued",
+                                  "key": f"creq:{creq.req_id}",
+                                  "seq": protocol_seq()})
         tr = self.tracer
         if tr.enabled:
             tr.instant("enqueue", track="router", ts=creq.submit_time,
@@ -383,6 +417,9 @@ class EngineCluster:
         creq.reject_reason = reason
         creq.finish_time = now
         self.shed[creq.req_id] = creq
+        self.protocol_log.append({"ev": "req.shed",
+                                  "key": f"creq:{creq.req_id}",
+                                  "seq": protocol_seq()})
         self.counters["requests_shed"].inc()
         self.counters[f"shed_{creq.slo_class}"].inc()
         # inversion detector: shedding this class while a LOWER class
@@ -482,6 +519,10 @@ class EngineCluster:
             # fence the epoch: anything this replica delivers from here
             # on (it may be a zombie still stepping) is stale
             self._fence[r.idx] += 1
+            self.protocol_log.append({"ev": "fence.bump",
+                                      "key": f"r{r.idx}",
+                                      "epoch": self._fence[r.idx],
+                                      "seq": protocol_seq()})
             self.counters["replica_deaths"].inc()
             tr = self.tracer
             if tr.enabled:
@@ -591,6 +632,11 @@ class EngineCluster:
         n = pool.pages_for(ereq.pos)
         staged = self.transport.extract(pool, ereq.pages[:n])
         creq.handoff_pending = True
+        epoch = self._next_stage_epoch()
+        self.protocol_log.append({"ev": "req.stage",
+                                  "key": f"creq:{creq.req_id}",
+                                  "epoch": epoch,
+                                  "seq": protocol_seq()})
         self._pending_handoffs.append(
             {"creq": creq, "staged": staged, "src": src_idx,
              "first": int(first_tok), "pos": int(ereq.pos),
@@ -599,7 +645,7 @@ class EngineCluster:
              # second half), and the in-flight pin (set while a delayed
              # transfer has a destination + pages reserved)
              "attempt": 0, "not_before": float("-inf"),
-             "epoch": self._next_stage_epoch(),
+             "epoch": epoch,
              "dst": None, "dst_pages": None, "lands_at": None,
              "redelivery": False})
         tr = self.tracer
@@ -789,7 +835,8 @@ class EngineCluster:
         self._injected.add((creq.req_id, h["epoch"]))
         self._adoptions.append({"req_id": creq.req_id,
                                 "epoch": h["epoch"], "dst": rep.idx,
-                                "fence_epoch": fence})
+                                "fence_epoch": fence,
+                                "seq": protocol_seq()})
         creq.handoff_pending = False
         creq.replica = rep.idx
         creq.stage = "final"
@@ -843,6 +890,10 @@ class EngineCluster:
                 self._finish(creq, ereq)
 
     def _drop_stale(self, ridx: int, erid: int) -> None:
+        self.protocol_log.append({"ev": "fence.stale_drop",
+                                  "key": f"r{ridx}",
+                                  "epoch": self._fence[ridx],
+                                  "seq": protocol_seq()})
         self.counters["stale_completions_dropped"].inc()
         tr = self.tracer
         if tr.enabled:
@@ -854,6 +905,18 @@ class EngineCluster:
         creq.out_tokens = list(ereq.out_tokens)
         creq.finish_time = self._time()
         self.finished[creq.req_id] = creq
+        if creq.replica is not None:
+            # the completion was accepted under the replica's CURRENT
+            # fence (_collect_finished dropped it otherwise) — record
+            # the acceptance so the fence machine can audit it
+            self.protocol_log.append(
+                {"ev": "fence.complete", "key": f"r{creq.replica}",
+                 "epoch": self._fence.get(creq.replica),
+                 "replica": f"r{creq.replica}",
+                 "seq": protocol_seq()})
+        self.protocol_log.append({"ev": "req.finish",
+                                  "key": f"creq:{creq.req_id}",
+                                  "seq": protocol_seq()})
         self.counters["requests_completed"].inc()
         if creq.token_times:
             ttft = creq.token_times[0] - creq.submit_time

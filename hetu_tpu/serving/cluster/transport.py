@@ -36,7 +36,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from ..kv_pool import PagedKVPool
+from ..kv_pool import PagedKVPool, protocol_seq
 
 
 class PageTransport:
@@ -78,6 +78,10 @@ class LocalPageTransport(PageTransport):
             cluster_spec = ClusterSpec()
         self.cluster_spec = cluster_spec
         self.records: List[Dict[str, Any]] = []
+        # wire.extract events ``(seq, src_pages)`` for the protocol
+        # verifier: extraction reads the source pages, so a page that
+        # was already reclaimed at extract time ships garbage KV
+        self.extract_log: List[Any] = []
 
     # -- the two wire phases -------------------------------------------------
 
@@ -89,6 +93,8 @@ class LocalPageTransport(PageTransport):
         taken NOW, so the source engine may free/retire the pages the
         moment this returns."""
         idx = np.asarray(list(src_pages), np.int32)
+        self.extract_log.append((protocol_seq(),
+                                 tuple(int(p) for p in idx)))
         k = [np.asarray(p[idx]) for p in src_pool.k_pages]
         v = [np.asarray(p[idx]) for p in src_pool.v_pages]
         return {"k": k, "v": v, "n_pages": len(idx),
@@ -136,6 +142,7 @@ class LocalPageTransport(PageTransport):
                           int(staged["payload_bytes"]),
                           src_replica, dst_replica, wall)
         rec["epoch"] = None if epoch is None else int(epoch)
+        rec["seq"] = protocol_seq()
         self.records.append(rec)
         return rec
 

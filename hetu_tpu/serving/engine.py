@@ -79,7 +79,7 @@ from ..models.gpt import GPTConfig
 from ..obs.tracer import get_tracer
 from ..utils.metrics import make_instrument, render_prometheus
 from .decode import build_unified_step_fn
-from .kv_pool import TRASH_PAGE, PagedKVPool
+from .kv_pool import TRASH_PAGE, PagedKVPool, protocol_seq
 from .prefix_cache import PrefixCache
 from .request import FINISHED, RUNNING, Request, RequestQueue
 from .scheduler import Scheduler
@@ -118,6 +118,11 @@ class Engine:
         # consumed by the trash-page-write lint (hetu_tpu/analysis)
         self.tap: Optional[deque] = deque(maxlen=128) if analysis_tap \
             else None
+        # engine-plane request-lifecycle events (req.queued / req.admit
+        # / req.finish) for the analysis event stream.  Preempt/rewind
+        # ride the tap and adopt rides the cluster's adoption records,
+        # so every transition is emitted by exactly one plane.
+        self.protocol_log: List[Dict[str, Any]] = []
         # a new engine owns its analysis namespace: stale handles from a
         # discarded same-name engine would otherwise mix dead pool
         # snapshots into analyze_registered(name) — and pin that
@@ -333,6 +338,9 @@ class Engine:
         req.trace_t0 = req.submit_time      # queued segment opens here
         self._next_id += 1
         self.queue.push(req)
+        self.protocol_log.append({"ev": "req.queued",
+                                  "key": f"req:{req.req_id}",
+                                  "seq": protocol_seq()})
         tr = self.tracer
         if tr.enabled:
             tr.instant("enqueue", track=f"req {req.req_id}",
@@ -413,6 +421,9 @@ class Engine:
         req.trace_t0 = req.submit_time
         self._next_id += 1
         self.queue.push(req)
+        self.protocol_log.append({"ev": "req.queued",
+                                  "key": f"req:{req.req_id}",
+                                  "seq": protocol_seq()})
         tr = self.tracer
         if tr.enabled:
             tr.instant("adopt", track=f"req {req.req_id}",
@@ -472,7 +483,8 @@ class Engine:
                 # the rewind lint's validity tracking: preemption drops
                 # every written KV slot (the pages themselves returned
                 # to the pool)
-                self.tap.append({"kind": "kv_drop", "req": req.req_id})
+                self.tap.append({"kind": "kv_drop", "req": req.req_id,
+                                 "seq": protocol_seq()})
             t = self._now()
             if tr.enabled:
                 # the running segment ends here; a fresh queued segment
@@ -621,6 +633,9 @@ class Engine:
         req.state = RUNNING
         self.counters[f"admitted_{req.slo_class}"].inc()
         self.running.append(req)
+        self.protocol_log.append({"ev": "req.admit",
+                                  "key": f"req:{req.req_id}",
+                                  "seq": protocol_seq()})
         t = self._now()
         if tr.enabled:
             # close the queued segment and open running at the same
@@ -767,6 +782,7 @@ class Engine:
         if self.tap is not None:
             self.tap.append({
                 "kind": "unified",
+                "seq": protocol_seq(),
                 "rows": [(row, req.pos, qlen) for req, qlen, row in rows],
                 # per-request read extent for the spec-rewind-leak lint:
                 # this step WRITES [pos, pos+qlen) and READS [0, ctx) —
@@ -908,6 +924,7 @@ class Engine:
                        bonus=int(emitted > committed_drafts))
         if self.tap is not None and committed_drafts < spec_len:
             self.tap.append({"kind": "spec_rewind", "req": req.req_id,
+                             "seq": protocol_seq(),
                              "valid_upto": int(req.pos),
                              "written_upto": int(n0 + spec_len)})
         self._maybe_finish(req)
@@ -943,6 +960,9 @@ class Engine:
         req.pages = []
         req.state = FINISHED
         req.finish_time = self._now()
+        self.protocol_log.append({"ev": "req.finish",
+                                  "key": f"req:{req.req_id}",
+                                  "seq": protocol_seq()})
         tr = self.tracer
         if tr.enabled:
             tr.complete("running", req.trace_t0,
@@ -1000,7 +1020,11 @@ class Engine:
             "scalar_fetches": 0,
             "serving": lambda: {"pool": self.pool,
                                 "page_size": self.pool.page_size,
-                                "tap": list(self.tap or ())},
+                                "tap": list(self.tap or ()),
+                                # the page + engine-request planes of
+                                # the protocol event stream
+                                "pool_log": list(self.pool.event_log),
+                                "protocol": list(self.protocol_log)},
         }
         if self.host_tier is not None:
             # host-tier page-move records for the host-offload-unpriced

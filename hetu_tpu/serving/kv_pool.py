@@ -30,12 +30,27 @@ ahead of the scheduler's recompute-preemption fallback.
 """
 from __future__ import annotations
 
+import itertools
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 TRASH_PAGE = 0
+
+# Process-global protocol sequence counter.  Every record plane the
+# analysis event stream merges (pool ops, engine tap, host-tier
+# records, transport extract/inject, cluster adoptions/fences) stamps
+# its records with the next value at record time, so events from
+# DIFFERENT planes interleave in true causal order when
+# ``analysis/events.normalize`` merges them — per-plane indices alone
+# cannot order a pool free against the host-tier stage that caused it.
+_PROTOCOL_SEQ = itertools.count(1)
+
+
+def protocol_seq() -> int:
+    """Next value of the process-global event sequence counter."""
+    return next(_PROTOCOL_SEQ)
 
 # page_quant codes for the layout tag (order is part of the tag)
 _QUANT_CODES = {None: 0, "int8": 1, "nf4": 2}
@@ -157,6 +172,13 @@ class PagedKVPool:
         # O(num_pages) invariant rebuilds are opt-in: tests/engines set
         # debug=True (or pass force=) — bench/production paths skip them
         self.debug = bool(debug)
+        # append-only op log ``(seq, op, pages)`` — the page plane of
+        # the analysis event stream (analysis/events.py normalizes it
+        # into page.alloc/free/cache/... events).  Always on: one tuple
+        # append per allocator op is noise next to the page bookkeeping
+        # itself, and a conditional log would make the protocol lint
+        # silently vacuous on production-configured pools.
+        self.event_log: List[Tuple[int, str, List[int]]] = []
 
     # -- allocator -----------------------------------------------------------
 
@@ -205,6 +227,8 @@ class PagedKVPool:
             return None
         pages = [self._free.pop() for _ in range(n)]
         self._allocated.update(pages)
+        if pages:
+            self.event_log.append((protocol_seq(), "alloc", list(pages)))
         return pages
 
     def free(self, pages: Sequence[int]) -> None:
@@ -213,6 +237,9 @@ class PagedKVPool:
                 raise ValueError(f"double free / foreign page {pg}")
             self._allocated.remove(pg)
             self._free.append(pg)
+        pages = list(pages)
+        if pages:
+            self.event_log.append((protocol_seq(), "free", pages))
 
     # -- cached (read-only, refcounted) pages --------------------------------
 
@@ -241,17 +268,20 @@ class PagedKVPool:
             raise ValueError(f"cannot cache non-allocated page {pg}")
         self._allocated.remove(pg)
         self._cached[pg] = 0
+        self.event_log.append((protocol_seq(), "cache", [pg]))
 
     def share_page(self, pg: int) -> None:
         """A live request attached this cached page to its page table."""
         if pg not in self._cached:
             raise ValueError(f"cannot share non-cached page {pg}")
         self._cached[pg] += 1
+        self.event_log.append((protocol_seq(), "share", [pg]))
 
     def unshare_page(self, pg: int) -> None:
         if self._cached.get(pg, 0) < 1:
             raise ValueError(f"unshare of page {pg} with no sharers")
         self._cached[pg] -= 1
+        self.event_log.append((protocol_seq(), "unshare", [pg]))
 
     def uncache_page(self, pg: int) -> None:
         """cached (refcount 0) -> free: the cache evicted the entry; the
@@ -264,6 +294,7 @@ class PagedKVPool:
                              f"{self._cached[pg]} live sharers")
         del self._cached[pg]
         self._free.append(pg)
+        self.event_log.append((protocol_seq(), "uncache", [pg]))
 
     def reset(self, clear_pages: bool = False) -> None:
         """Return the pool to its post-construction allocator state.
@@ -281,6 +312,7 @@ class PagedKVPool:
         self._free = list(range(self.num_pages - 1, 0, -1))
         self._allocated = set()
         self._cached = {}
+        self.event_log = [(protocol_seq(), "reset", [])]
         if clear_pages:
             self.k_pages = tuple(jnp.zeros_like(p) for p in self.k_pages)
             self.v_pages = tuple(jnp.zeros_like(p) for p in self.v_pages)
@@ -295,19 +327,15 @@ class PagedKVPool:
         production paths skip it on every scheduling storm."""
         if not (self.debug or force):
             return
-        free = set(self._free)
-        cached = set(self._cached)
-        assert len(free) == len(self._free), "free list holds duplicates"
-        assert not (free & self._allocated), "page both free and allocated"
-        assert not (free & cached), "page both free and cached"
-        assert not (self._allocated & cached), \
-            "page both allocated and cached"
-        assert free | self._allocated | cached \
-            == set(range(1, self.num_pages)), "pages leaked or invented"
-        assert TRASH_PAGE not in free and TRASH_PAGE not in self._allocated
-        assert TRASH_PAGE not in cached, "trash page entered the cache"
-        assert all(rc >= 0 for rc in self._cached.values()), \
-            "negative cached-page refcount"
+        # one implementation: the protocol verifier's snapshot predicate
+        # (analysis/protocol.py) owns the invariant logic; this wrapper
+        # keeps the debug/force gating and assert-style reporting every
+        # existing call site relies on (imported lazily — the analysis
+        # package must stay optional for serving)
+        from ..analysis.protocol import page_partition_problems
+        problems = page_partition_problems(
+            self.num_pages, self._free, self._allocated, self._cached)
+        assert not problems, "; ".join(problems)
 
     # -- accounting ----------------------------------------------------------
 

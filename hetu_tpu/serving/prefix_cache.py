@@ -369,30 +369,11 @@ class PrefixCache:
         pool's: runs only under ``pool.debug`` or ``force``."""
         if not (self.pool.debug or force):
             return
-        assert len(self._index) == len(self._by_id)
-        per_page_refs: Dict[int, int] = {}
-        children: Dict[int, int] = {}
-        for e in self._index.values():
-            assert self._by_id[e.eid] is e
-            assert e.refs >= 0, f"negative refcount on entry {e.eid}"
-            per_page_refs[e.page] = e.refs
-            if e.parent != ROOT:
-                parent = self._by_id.get(e.parent)
-                assert parent is not None, \
-                    f"entry {e.eid} orphaned: parent {e.parent} evicted"
-                assert parent.depth == e.depth - 1
-                assert parent.refs >= e.refs, \
-                    "child page outlives its parent's sharers"
-                children[e.parent] = children.get(e.parent, 0) + 1
-        for e in self._index.values():
-            assert e.children == children.get(e.eid, 0)
-        # the pool's cached partition and the index agree page-for-page
-        assert per_page_refs == dict(self.pool._cached), \
-            "cache index and pool cached-page partition diverged"
-        attached_refs: Dict[int, int] = {}
-        for entries in self._attached.values():
-            for e in entries:
-                attached_refs[e.eid] = attached_refs.get(e.eid, 0) + 1
-        for e in self._index.values():
-            assert e.refs == attached_refs.get(e.eid, 0), \
-                f"entry {e.eid} refcount {e.refs} != attached references"
+        # one implementation: the protocol verifier's snapshot predicate
+        # (analysis/protocol.py) owns the invariant logic; this wrapper
+        # keeps the debug/force gating and assert-style reporting every
+        # existing call site relies on (imported lazily — the analysis
+        # package must stay optional for serving)
+        from ..analysis.protocol import cache_index_problems
+        problems = cache_index_problems(self, self.pool)
+        assert not problems, "; ".join(problems)

@@ -180,6 +180,19 @@ class Autoscaler:
                 continue
             busy = r.engine.has_work \
                 or any(k[0] == idx for k in cluster._placed)
+            if not busy and any(h.get("dst") == idx
+                                for h in cluster._pending_handoffs):
+                # a chaos-delayed handoff is IN FLIGHT to this replica
+                # (destination pinned, pages reserved): the engine looks
+                # idle and nothing is placed yet, but fencing it now
+                # would kill the transfer mid-air and force a restage —
+                # breaking the graceful-drain contract ("in-flight
+                # requests finish where they are").  Surfaced by the
+                # protocol explorer (analysis/protocol.py, bug flag
+                # 'drain_inflight'); defer until the handoff lands or
+                # re-routes
+                cluster.counters["drains_deferred_inflight"].inc()
+                busy = True
             if busy:
                 continue
             r.draining = False

@@ -229,9 +229,10 @@ class HostTier:
                 "tag": "host_offload", "origin": "declared"}
         predicted_s = collective_time("ppermute", float(payload_bytes),
                                       2, self.transport.cluster_spec)
+        from ..kv_pool import protocol_seq
         return {"dir": direction, "pages": int(n_pages),
                 "payload_bytes": int(payload_bytes),
                 "page_bytes": int(self.pool.page_bytes),
                 "chain_hash": int(chain_h), "edge": edge,
                 "predicted_s": float(predicted_s),
-                "wall_s": float(wall_s)}
+                "wall_s": float(wall_s), "seq": protocol_seq()}

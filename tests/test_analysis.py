@@ -709,6 +709,35 @@ def test_lint_graph_gate_passes_on_clean_tree():
         assert cost.get("xla_flops", 0) > 0, (name, cost)
         assert cost.get("xla_bytes_accessed", 0) > 0, (name, cost)
         assert cost.get("xla_flops_delta_pct") is not None, (name, cost)
+        # ISSUE 18: the serving-protocol gate rides the same tier-1
+        # marker — every gated executable carries protocol coverage
+        # (events/kinds/violations/lost_hooks), the lifecycle machines
+        # replay every trace with ZERO violations, and no record plane
+        # silently fell out of the stream
+        proto = ex.get("protocol")
+        assert proto is not None, (name, "protocol section missing")
+        assert proto["violations"] == 0, (name, proto)
+        assert proto["lost_hooks"] == [], (name, proto)
+        if name.startswith("gate_serving"):
+            # serving gates MUST emit a real event stream — an empty
+            # one means the taps/pool logs vanished and every trace
+            # rule went vacuously green
+            assert proto["events"] > 0, (name, proto)
+            assert proto["kinds"], (name, proto)
+        else:
+            # train/TP/pipe/MoE gates pin an EMPTY stream: a train plan
+            # that suddenly emits serving events is itself a surprise
+            assert proto["events"] == 0, (name, proto)
+    # the serving family's union vocabulary covers every plane the
+    # trace rules inspect (the per-rule version of this is the vacuity
+    # meta-test in tests/test_protocol.py)
+    union = set()
+    for name, ex in exes.items():
+        union |= set(ex["protocol"]["kinds"])
+    for kind in ("page.write", "page.share", "page.unshare",
+                 "host.stage", "host.refetch", "wire.inject",
+                 "req.adopt", "req.write", "fence.complete"):
+        assert kind in union, (kind, sorted(union))
     # --explain printed the per-executable edge sections after the JSON
     assert "predicted edges" in proc.stdout
     assert "=== gate_tp/plan0 ===" in proc.stdout

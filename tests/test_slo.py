@@ -353,6 +353,57 @@ def test_chaos_death_during_scale_down_no_double_drain(model_state,
     cl.close()
 
 
+def test_drain_deferred_while_handoff_inflight(model_state, shared_fn):
+    """Regression for the interaction bug the protocol explorer
+    surfaced (analysis/protocol.py, bug flag 'drain_inflight'): a
+    chaos-delayed handoff is IN FLIGHT to a draining replica whose
+    engine looks idle — finishing the drain at that instant kills the
+    replica and fences its epoch, so the transfer lands stamped with a
+    stale epoch (fence-regression).  The autoscaler must DEFER the
+    kill until the handoff lands or re-routes, and count the
+    deferral."""
+    state, cfg = model_state
+    auto = Autoscaler(min_replicas=1, backlog_high=99, backlog_low=99,
+                      hysteresis_steps=2, cooldown_steps=50,
+                      ttft_target=None)
+    cl = _make_cluster(state, cfg, shared_fn, num_replicas=2,
+                       name="slo_drain_inflight", policy="load",
+                       autoscaler=auto)
+    try:
+        # drain intent on an idle replica 1...
+        auto._draining.add(1)
+        cl.replicas[1].draining = True
+        assert not cl.replicas[1].engine.has_work
+        assert not any(k[0] == 1 for k in cl._placed)
+        # ...with a delayed transfer pinned to it (destination chosen,
+        # pages reserved, landing later — the shape _land_handoff sets
+        # while a chaos net_delay holds the wire)
+        cl._pending_handoffs.append(
+            {"creq": None, "staged": None, "src": 0, "dst": 1,
+             "dst_pages": (), "lands_at": 999.0, "attempt": 0,
+             "not_before": float("-inf"), "epoch": 7})
+        auto._finish_drains(cl, now=0.0)
+        assert cl.replicas[1].alive and cl.replicas[1].serving, \
+            "drain killed the replica under an in-flight handoff"
+        assert cl.replicas[1].draining and 1 in auto._draining
+        assert cl.counters["drains_deferred_inflight"].value == 1
+        assert cl.counters["scale_downs"].value == 0
+        # the transfer lands (or re-routes): the NEXT sweep completes
+        # the drain exactly once
+        cl._pending_handoffs.clear()
+        auto._finish_drains(cl, now=1.0)
+        # kill() stops serving NOW; the alive verdict lands via the
+        # cluster's death sweep — the drain-completion fact here is
+        # that heartbeats/serving stopped and the intent cleared
+        assert not cl.replicas[1].serving
+        assert not cl.replicas[1].draining and 1 not in auto._draining
+        assert cl.counters["scale_downs"].value == 1
+        assert cl.metrics_summary()["cluster_drains_deferred_inflight"] \
+            == 1
+    finally:
+        cl.close()
+
+
 # ---------------------------------------------------------------------------
 # host tier: evict -> refetch bitwise across layouts
 # ---------------------------------------------------------------------------
