@@ -454,6 +454,21 @@ def bench_comm_microbench() -> dict:
         "                                        tag='param_comm')\n"
         "        return tuple(out[i] for i in range(len(vals)))\n"
         "    return f\n"
+        # zero3_flat: params sharded AT REST (ZeRO-3) — the step opens
+        # with the just-in-time param all-gather (tagged param_gather),
+        # then RS -> chunk-local update, and ENDS on the 1/dp chunk:
+        # no post-update regather, the next step's gather replaces it
+        "def zero3_flat(transport):\n"
+        "    def f(*vals):\n"
+        "        g = {i: v for i, v in enumerate(vals)}\n"
+        "        chunks, layout = comm.reduce_scatter_coalesced(\n"
+        "            g, 'dp', op='mean', bucket_mb=4.0,\n"
+        "            transport=transport)\n"
+        "        chunks = [c * 0.999 for c in chunks]\n"
+        "        full = comm.all_gather_coalesced(chunks, layout, 'dp',\n"
+        "                                         tag='param_gather')\n"
+        "        return tuple(full[i] for i in range(len(vals)))\n"
+        "    return f\n"
         "def measure(fn):\n"
         "    jf = jax.jit(comm.shard_map(fn, mesh, reps, reps))\n"
         "    with comm.comm_stats() as s:\n"
@@ -466,12 +481,19 @@ def bench_comm_microbench() -> dict:
         "    jax.block_until_ready(out)\n"
         "    dt = (time.perf_counter() - t0) / 5\n"
         "    grad_wire = sum(r.wire_bytes for r in s.records\n"
-        "                    if not r.tag.startswith('param_comm'))\n"
-        "    return {'collective_calls': s.num_collectives,\n"
-        "            'wire_mb_per_rank': round(s.total_wire_bytes / 2**20,\n"
-        "                                      3),\n"
-        "            'grad_wire_mb_per_rank': round(grad_wire / 2**20, 3),\n"
-        "            'step_time_ms': round(dt * 1e3, 2)}\n"
+        "                    if not r.tag.startswith(('param_comm',\n"
+        "                                             'param_gather')))\n"
+        "    pg_wire = sum(r.wire_bytes for r in s.records\n"
+        "                  if r.tag.startswith('param_gather'))\n"
+        "    out = {'collective_calls': s.num_collectives,\n"
+        "           'wire_mb_per_rank': round(s.total_wire_bytes / 2**20,\n"
+        "                                     3),\n"
+        "           'grad_wire_mb_per_rank': round(grad_wire / 2**20, 3),\n"
+        "           'step_time_ms': round(dt * 1e3, 2)}\n"
+        "    if pg_wire:\n"
+        "        out['param_gather_wire_mb_per_rank'] = round(\n"
+        "            pg_wire / 2**20, 3)\n"
+        "    return out\n"
         "res = {'grad_tensors': len(shapes),\n"
         "       'grad_mb': round(sum(g.nbytes for g in grads) / 2**20, 2),\n"
         "       'per_tensor_fp32': measure(per_tensor)}\n"
@@ -481,6 +503,18 @@ def bench_comm_microbench() -> dict:
         "    res['grad_wire_ratio_allreduce_vs_zero2flat_' + tr] = round(\n"
         "        res['bucketed_' + tr]['grad_wire_mb_per_rank'] /\n"
         "        res['zero2_flat_' + tr]['grad_wire_mb_per_rank'], 2)\n"
+        "    res['zero3_flat_' + tr] = measure(zero3_flat(tr))\n"
+        # ZeRO-3 at-rest accounting: zero2 keeps every param replicated
+        # per rank PLUS its 1/dp fp32 master chunk; zero3 keeps ONLY
+        # the chunk (the just-in-time gather is transient)
+        "P = sum(g.nbytes for g in grads)\n"
+        "res['at_rest_param_mb_per_rank_zero2'] = round(\n"
+        "    P * (1 + 1 / 8) / 2**20, 3)\n"
+        "res['at_rest_param_mb_per_rank_zero3'] = round(\n"
+        "    P / 8 / 2**20, 3)\n"
+        "res['at_rest_saving_zero3_vs_zero2'] = round(\n"
+        "    res['at_rest_param_mb_per_rank_zero2'] /\n"
+        "    res['at_rest_param_mb_per_rank_zero3'], 2)\n"
         "pt = res['per_tensor_fp32']\n"
         "q = res['bucketed_int8']\n"
         "res['calls_ratio_per_tensor_vs_int8'] = round(\n"

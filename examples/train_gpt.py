@@ -45,10 +45,13 @@ def parse_args():
                         "(None keeps the implicit GSPMD per-tensor sync)")
     p.add_argument("--flat-state", action="store_true",
                    help="flat dp-sharded optimizer state + reduce-"
-                        "scatter-only ZeRO-2 sync (needs --grad-comm "
-                        "and --zero 1/2; half the gradient wire bytes)")
+                        "scatter-only sync (needs --grad-comm and "
+                        "--zero 1/2/3; half the gradient wire bytes)")
     p.add_argument("--zero", type=int, default=0, choices=[0, 1, 2, 3],
-                   help="ZeRO level for optimizer state/grad/param sharding")
+                   help="ZeRO level for optimizer state/grad/param "
+                        "sharding; 3 with --flat-state shards params AT "
+                        "REST (1/dp fp32 masters only, just-in-time "
+                        "bucket all-gather each step)")
     p.add_argument("--ds-config", type=str, default=None,
                    help="ds_parallel_config JSON path (overrides dp/tp/pp)")
     p.add_argument("--auto-parallel", action="store_true",

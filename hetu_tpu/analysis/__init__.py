@@ -245,7 +245,9 @@ def grad_comm_prediction(handle: ExecutableHandle):
     return predict_update_step_collectives(
         entries, gc["device_num"], transport=gc["transport"],
         bucket_mb=gc["bucket_mb"], scalar_fetches=gc["scalar_fetches"],
-        flat=gc.get("flat", False), clip=gc.get("clip", False))
+        flat=gc.get("flat", False), clip=gc.get("clip", False),
+        zero=int(gc.get("zero", 2) or 2),
+        opt_extra=gc.get("opt_extra"))
 
 
 def verify_grad_comm(handle: ExecutableHandle) -> None:

@@ -4,7 +4,11 @@ Builds the canonical executables — five gated families, all scaled down
 so the gate runs on CPU in CI:
 
 * ``gate_train``   — GPT-2-small-shaped train step, pure-dp mesh,
-  ZeRO-2 + flat state + explicit int8 grad sync;
+  ZeRO-2 + flat state + explicit int8 grad sync, PLUS the same model
+  under ZeRO-3 params-sharded-at-rest (``gate_train@zero3``): the flat
+  masters keep the only parameter copy, the forward all-gathers each
+  bucket just-in-time (priced ``param_gather`` edges), and the memory
+  section pins the at-rest saving;
 * ``gate_serving`` — the unified ragged prefill+decode step of a small
   continuous-batching engine over the paged KV pool (ONE executable;
   the v1 bucketed prefill/decode grid is gone), PLUS a disaggregated
@@ -157,6 +161,32 @@ def build_gate_executables():
                                        labels: np.roll(IDS, -1, axis=1)})
         assert g._grad_comm_active, g._grad_comm_fallback
     names.append("gate_train/plan0")
+
+    # -- ZeRO-3 train step: the SAME model and shapes with the params
+    # sharded at rest — the flat fp32 masters hold the only copy, the
+    # forward all-gathers each bucket just-in-time (tagged
+    # param_gather), and after the chunk-local update only the 1/dp
+    # shard remains.  The baseline pins the new priced edge family and
+    # the memory section's at-rest param bytes (zero vs gate_train's
+    # replicated set) --------------------------------------------------
+    ht.set_seed(0)
+    g3 = DefineAndRunGraph("gate_train@zero3")
+    g3.mesh = create_mesh({"dp": 8}, devices)
+    with ht.graph(g3):
+        ids = ht.parallel_placeholder("int32", (8, 32),
+                                      pspec=P("dp", None), name="ids")
+        labels = ht.parallel_placeholder("int32", (8, 32),
+                                         pspec=P("dp", None), name="labels")
+        model = GPTLMHeadModel(cfg)
+        loss = model(ids, labels)
+        train_op = optim.AdamOptimizer(lr=1e-2, zero=3, grad_comm="int8",
+                                       flat_state=True).minimize(loss)
+        rng = np.random.RandomState(0)
+        IDS = rng.randint(0, 256, (8, 32)).astype(np.int32)
+        g3.run(loss, [loss, train_op], {ids: IDS,
+                                        labels: np.roll(IDS, -1, axis=1)})
+        assert g3._grad_comm_active, g3._grad_comm_fallback
+    names.append("gate_train@zero3/plan0")
 
     # -- TP/SP train graph: dp=2 x tp=4, Megatron-SP parallel layers,
     # implicit GSPMD sync — every GSPMD-inserted collective must be

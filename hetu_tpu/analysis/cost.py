@@ -561,6 +561,16 @@ def cost_walk(jaxpr, scale: float = 1.0, upcast: bool = False,
                    if hasattr(iv, "count")}:
             consumers.setdefault(ri, []).append(cls)
 
+    # repeated 1-D narrow-float unpack slices (the flat param-gather's
+    # per-param dynamic_slice fan-out under ZeRO-3): when the sliced
+    # values are consumed IN-program, XLA's fusion pass hoists the
+    # operand's bf16<->f32 convert above the slices and duplicates the
+    # FULL-buffer convert into every consuming fusion — visible in the
+    # optimized HLO as one buffer-wide convert pair per unpacked param.
+    # Charged per slice beyond the first on the same operand (ZeRO-2's
+    # unpack escapes as plan outputs — zero consumers, zero charge).
+    unpack_seen: Dict[int, int] = {}
+
     for eqn in j.eqns:
         name = eqn.primitive.name
         cls = _classify(eqn)
@@ -614,6 +624,24 @@ def cost_walk(jaxpr, scale: float = 1.0, upcast: bool = False,
                 nb = 2.0 * sum(_aval_bytes(ov.aval, upcast)
                                for ov in eqn.outvars
                                if hasattr(ov, "aval")) * scale
+                big_av = getattr(eqn.invars[0], "aval", None) \
+                    if eqn.invars and hasattr(eqn.invars[0], "count") \
+                    else None
+                if (upcast and big_av is not None
+                        and _is_narrow_float(big_av)
+                        and len(getattr(big_av, "shape", ())) == 1
+                        and any(consumers.get(resolve(ov))
+                                for ov in eqn.outvars
+                                if hasattr(ov, "count"))):
+                    key = resolve(eqn.invars[0])
+                    if key in unpack_seen:
+                        # both widths of the hoisted buffer convert,
+                        # duplicated into this consumer's fusion
+                        dup = 2.0 * CPU_CONVERT_DUP * _elems(big_av) \
+                            * scale
+                        flops += dup
+                        nb += dup
+                    unpack_seen[key] = unpack_seen.get(key, 0) + 1
             elif name in ("gather", "scatter", "scatter-add",
                           "scatter_add") and eqn.invars:
                 # big operand at the calibrated fusion utilization;
@@ -785,8 +813,11 @@ def price_edges(edges, mesh_axes: Dict[str, int],
 #: edge origins the overlap model may hide under compute when the plan
 #: declares overlap scheduling: the coalesced grad sync and its
 #: sidecars/param regather are bucketed exactly so the latency-hiding
-#: scheduler can run them behind the backward/update math
-OVERLAPPABLE_ORIGINS = frozenset({"grad_comm", "param_comm"})
+#: scheduler can run them behind the backward/update math; the ZeRO-3
+#: just-in-time weight gather (param_gather) is per-bucket for the same
+#: reason — bucket b+1's gather overlaps bucket b's forward compute
+OVERLAPPABLE_ORIGINS = frozenset({"grad_comm", "param_comm",
+                                  "param_gather"})
 
 
 # ---------------------------------------------------------------------------

@@ -176,7 +176,8 @@ def grad_comm_edges(gc: Dict[str, Any]) -> List[CommEdge]:
     """Edges for the explicit coalesced gradient sync: one edge per
     predicted collective of ``dstates.predict_update_step_collectives``,
     tagged the way ``comm.py`` tags the emission sites (``grad_comm`` /
-    ``scales`` sidecars / the flat path's ``param_comm`` regather)."""
+    ``scales`` sidecars / the flat path's ``param_comm`` regather / the
+    ZeRO-3 just-in-time ``param_gather``)."""
     from ..parallel.dstates import predict_update_step_collectives
     entries = [(name, tuple(shape), dtype)
                for name, shape, dtype in gc["entries"]]
@@ -185,11 +186,17 @@ def grad_comm_edges(gc: Dict[str, Any]) -> List[CommEdge]:
     preds, extra = predict_update_step_collectives(
         entries, gc["device_num"], transport=transport,
         bucket_mb=gc["bucket_mb"], scalar_fetches=gc["scalar_fetches"],
-        flat=flat, clip=gc.get("clip", False))
+        flat=flat, clip=gc.get("clip", False),
+        zero=int(gc.get("zero", 2) or 2),
+        opt_extra=gc.get("opt_extra"))
     edges: List[CommEdge] = []
     for p in preds:
         quantized = transport in ("bf16", "int8")
-        if flat and p["kind"] == "all_gather":
+        if flat and p.get("tag") == "param_gather":
+            tag, origin = "param_gather", "param_gather"
+            desc = ("working params gathered just-in-time from the "
+                    "flat master (ZeRO-3, weight dtype)")
+        elif flat and p["kind"] == "all_gather":
             tag, origin = "param_comm", "param_comm"
             desc = "updated params regathered in the weight dtype"
         elif quantized and p["dtype"] == "float32":
@@ -207,7 +214,9 @@ def grad_comm_edges(gc: Dict[str, Any]) -> List[CommEdge]:
     for kind, n in (extra or {}).items():
         edges.append(CommEdge(
             kind=kind, tensor="scalar_fetch", producer="loss/clip",
-            consumer="pmean of scalar fetches + flat global-norm clip",
+            consumer="pmean of scalar fetches + flat global-norm clip + "
+                     "optimizer-declared in-region reductions "
+                     "(Adafactor factored stats)",
             src_spec="partial(dp)", dst_spec="replicated",
             axes=(gc.get("dp_axis", "dp"),), payload_bytes=4, count=n,
             origin="fetch"))

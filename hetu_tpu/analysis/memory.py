@@ -658,6 +658,24 @@ def predict_memory(handle, xla: bool = False) -> MemoryReport:
         rep.buffers.append(MemoryBuffer(
             kind="activation", name=prim, nbytes=int(b),
             source=src if ":" in src else "", detail=src))
+    if flat and zero >= 3:
+        # ZeRO-3's just-in-time param gather: the per-bucket gathered
+        # weight-dtype buffers AND their unpacked per-param views stay
+        # live through fwd+bwd — at FULL size, not dp-sharded (the
+        # liveness walk prices param-shaped intermediates at 1/dp,
+        # right for weight grads but not for the gathered copies).
+        # Transient, so by_kind keeps them out of the at-rest "param"
+        # class the replicated-state-under-shard rule polices.  Bucket
+        # padding is ignored (<= dp*block elems per bucket).
+        gath = 2 * sum(
+            int(np.prod(s) if s else 1) * np.dtype(d).itemsize
+            for _, s, d in gc.get("entries", ()))
+        if gath:
+            rep.activation_peak_bytes += gath
+            rep.buffers.append(MemoryBuffer(
+                kind="activation", name="param_gather", nbytes=gath,
+                detail="just-in-time gathered params + unpacked views "
+                       "(full size, transient)"))
     rep.peak_bytes = (rep.resident_bytes + rep.activation_peak_bytes
                       + rep.output_extra_bytes)
 
@@ -668,8 +686,10 @@ def predict_memory(handle, xla: bool = False) -> MemoryReport:
         cmp_walk = liveness_walk(jaxpr, scale=scale, upcast=True,
                                  param_shapes=param_shapes,
                                  param_scale=pscale)
+        gath = sum(b.nbytes for b in rep.buffers
+                   if b.name == "param_gather")
         rep.cmp_peak_bytes = (rep.resident_bytes + int(cmp_walk.peak)
-                              + rep.output_extra_bytes)
+                              + gath + rep.output_extra_bytes)
     else:
         rep.cmp_peak_bytes = rep.peak_bytes
 

@@ -86,8 +86,23 @@ Rule catalog (DESIGN.md §9 for the rationale of each):
                              — the regression back to the double-wire
                              all-reduce path must fail CI.  The scale
                              sidecars of the quantized transport
-                             (tagged ``scales``) and the updated-param
-                             gather (tagged ``param_comm``) are exempt.
+                             (tagged ``scales``), the updated-param
+                             gather (tagged ``param_comm``) and the
+                             ZeRO-3 just-in-time weight gather (tagged
+                             ``param_gather``) are exempt.  Under flat
+                             ``zero>=3`` the rule also checks the
+                             at-rest side: a full working parameter
+                             resident in the step's argument set means
+                             the params-sharded-at-rest contract is
+                             broken (the memory saving silently gone).
+``param-gather-unpriced``    a ``param_gather``-tagged collective (the
+                             ZeRO-3 just-in-time weight gather) the
+                             predicted edge set does not price: every
+                             per-bucket gather must ride a
+                             ``param_gather`` CommEdge with its payload
+                             bytes, or the wire cost of
+                             params-sharded-at-rest is invisible to the
+                             planner and the step-time linter.
 ``unexplained-collective``   an emitted collective the per-edge
                              DS-transition attribution (analysis/edges)
                              cannot explain: an explicit record no
@@ -475,9 +490,10 @@ def _unreduced_psum_scalar(ctx: AnalysisContext) -> List[Finding]:
 def _grad_allgather_under_zero2(ctx: AnalysisContext) -> List[Finding]:
     gc = (ctx.meta or {}).get("grad_comm") or {}
     flat = bool(gc.get("flat", False))
-    # in scope: any ZeRO-2 plan, and any plan declaring the flat
+    zero = int(gc.get("zero", 0) or 0)
+    # in scope: any ZeRO-2+ plan, and any plan declaring the flat
     # reduce-scatter-only contract (flat zero=1 included)
-    if int(gc.get("zero", 0)) < 2 and not flat:
+    if zero < 2 and not flat:
         return []
     out = []
     for r in ctx.records:
@@ -487,7 +503,8 @@ def _grad_allgather_under_zero2(ctx: AnalysisContext) -> List[Finding]:
             continue
         # fp32 gradient regather is always a ZeRO-2 bug; under the flat
         # reduce-scatter-only contract ANY gradient regather is (the
-        # param gather rides the param_comm tag and stays exempt)
+        # param gathers ride the param_comm / param_gather tags and
+        # stay exempt: their scope never contains grad_comm)
         if r.dtype in WIDE_DTYPES or flat:
             out.append(Finding(
                 rule="", subject=f"all_gather:{r.dtype}",
@@ -502,6 +519,89 @@ def _grad_allgather_under_zero2(ctx: AnalysisContext) -> List[Finding]:
                      "flat_state=True) updates the locally-owned flat "
                      "chunk and regathers PARAMS (weight dtype, tag "
                      "param_comm), never gradients"))
+    # the zero-3 at-rest side of the contract: params live ONLY as the
+    # flat master's 1/dp chunks, so a full working parameter resident
+    # in the step's argument set (matching a grad-comm entry's global
+    # shape+dtype) means the memory saving is silently gone — the
+    # per-bucket forward AGs (tag param_gather) are the EXPECTED shape,
+    # a resident param is the new finding
+    if flat and zero >= 3 and ctx.args_info is not None:
+        import jax
+        entry_sigs = {(tuple(int(d) for d in shape), str(dtype)): name
+                      for name, shape, dtype in gc.get("entries", ())}
+        try:
+            var_info = ctx.args_info[0]
+            leaves = jax.tree_util.tree_leaves(var_info)
+        except Exception:
+            leaves = []
+        for leaf in leaves:
+            if not hasattr(leaf, "shape"):
+                continue
+            sig = (tuple(int(d) for d in leaf.shape),
+                   np.dtype(leaf.dtype).name)
+            name = entry_sigs.get(sig)
+            if name is None:
+                continue
+            out.append(Finding(
+                rule="", subject=f"resident:{name}",
+                severity="error",
+                message=f"ZeRO-3 plan declares params sharded at rest "
+                        f"but working parameter {name} {sig[0]} "
+                        f"({sig[1]}) is resident in the step's argument "
+                        f"set at full size — every rank holds the "
+                        f"replica the flat master's 1/dp chunks exist "
+                        f"to replace",
+                hint="drop the trainable from var_state before the jit "
+                     "step (the flat zero-3 path gathers it "
+                     "just-in-time from flat_master, tag param_gather) "
+                     "— a resident copy both wastes the HBM and risks "
+                     "training from stale weights"))
+    return out
+
+
+@rule("param-gather-unpriced")
+def _param_gather_unpriced(ctx: AnalysisContext) -> List[Finding]:
+    """Every emitted ``param_gather`` collective (the ZeRO-3
+    just-in-time weight gather) must be priced by a predicted
+    ``param_gather`` edge carrying its payload bytes — otherwise the
+    wire cost of params-sharded-at-rest is invisible to the planner,
+    the step-time linter and the baseline gate."""
+    recs = [r for r in ctx.records
+            if "param_gather" in r.scope.split("/")]
+    if not recs:
+        return []
+    edges = [e for e in (ctx.edges or ())
+             if getattr(e, "tag", "") == "param_gather"
+             and getattr(e, "payload_bytes", 0) > 0]
+    budget = sum(int(getattr(e, "count", 1)) for e in edges)
+    out: List[Finding] = []
+    for i, r in enumerate(recs):
+        if r.kind != "all_gather":
+            out.append(Finding(
+                rule="", subject=f"{r.kind}:param_gather",
+                severity="error", source=r.source,
+                message=f"{r.dtype} {r.kind} rides the param_gather "
+                        f"tag but the ZeRO-3 weight gather is an "
+                        f"all_gather by contract — a different "
+                        f"collective under this tag is mis-attributed "
+                        f"wire traffic",
+                hint="emit the weight gather through "
+                     "comm.all_gather_coalesced(..., "
+                     "tag='param_gather') only"))
+            continue
+        if i >= budget:
+            out.append(Finding(
+                rule="", subject=f"all_gather:param_gather@{i}",
+                severity="error", source=r.source,
+                message=f"param_gather all_gather of "
+                        f"{r.payload_bytes} B ({r.dtype}) has no "
+                        f"priced edge: the predicted edge set claims "
+                        f"{budget} param_gather collective(s) but the "
+                        f"program emits {len(recs)}",
+                hint="register the plan with grad_comm zero=3 so "
+                     "grad_comm_edges prices one param_gather edge "
+                     "per bucket (payload = n * chunk * weight "
+                     "itemsize), or remove the rogue gather"))
     return out
 
 
@@ -729,7 +829,29 @@ def _replicated_state_under_shard(ctx: AnalysisContext) -> List[Finding]:
     zero = int(meta.get("zero", gc.get("zero", 0)) or 0)
     flat = bool(meta.get("flat_state", gc.get("flat", False)))
     if zero >= 1 or flat:
-        return []       # the state IS dp-sharded (by contract)
+        # the state IS dp-sharded (by contract) — but zero>=3 claims
+        # MORE: the working params shard too.  Resident param bytes at
+        # (or above) the full replicated size mean the claim is hollow
+        # while the memory pass keeps predicting the 1/dp saving.
+        if zero >= 3:
+            full = sum(p.nbytes for p in ctx.params if p.trainable)
+            resident = int(ctx.memory.by_kind.get("param", 0))
+            if full >= int(ctx.opt("param_bytes_threshold")) \
+                    and resident >= full:
+                return [Finding(
+                    rule="", subject="param",
+                    message=f"zero={zero} declares params sharded at "
+                            f"rest, yet {_fmt_mem(resident)} of param "
+                            f"buffers stay resident per rank (the "
+                            f"trainable set is {_fmt_mem(full)} "
+                            f"replicated): the at-rest saving the "
+                            f"ZeRO-3 gather pays wire bytes for never "
+                            f"materializes",
+                    hint=f"keep only the flat master's P(dp) chunks "
+                         f"resident (1/{dp} of these bytes) and gather "
+                         f"working weights just-in-time (flat_state="
+                         f"True routes this through param_gather)")]
+        return []
     state_bytes = int(ctx.memory.by_kind.get("opt-state", 0))
     if state_bytes < int(ctx.opt("param_bytes_threshold")):
         return []

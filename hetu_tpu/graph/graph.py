@@ -574,6 +574,23 @@ class DefineAndRunGraph(Graph):
         # only, so injections never retrace
         self._sentry_tensor: Optional[Tensor] = None
         self._sentry_next_code: int = 0
+        # ZeRO-3 flat: (optimizer, xs) whose per-param working copies
+        # went stale at the last update step (the flat fp32 master is
+        # the authoritative storage); refreshed lazily on first read
+        self._stale_flat_params: Optional[Tuple[Any, list]] = None
+
+    def _refresh_stale_params(self) -> None:
+        """Materialize ZeRO-3 flat working params from the flat master
+        (bitwise the in-region gather's values), then clear the flag."""
+        stale = self._stale_flat_params
+        if stale is not None:
+            self._stale_flat_params = None
+            stale[0].materialize_flat_params(self, stale[1])
+
+    def get_tensor_value(self, t: Tensor):
+        if self._stale_flat_params is not None:
+            self._refresh_stale_params()
+        return super().get_tensor_value(t)
 
     # -- numeric sentry (resilience/sentry.py) -------------------------------
 
@@ -821,7 +838,10 @@ class DefineAndRunGraph(Graph):
                           f"({dpa!r},): explicit path needs a pure-dp mesh")
         if mesh.shape[dpa] <= 1:
             return None, "dp axis has size 1 (nothing to sync)"
-        if opt.zero >= 3:
+        if opt.zero >= 3 and not getattr(opt, "flat_state", False):
+            # per-param ZeRO-3 rides GSPMD (partitioner-inserted
+            # gathers); the FLAT layout owns its gathers explicitly
+            # (param_gather buckets), so flat zero-3 stays on this path
             return None, "zero-3 (FSDP) keeps params dp-sharded at rest"
 
         def _refs_dp(spec) -> bool:
@@ -1080,6 +1100,15 @@ class DefineAndRunGraph(Graph):
                 def flat_phase(vstate, fmb, fstate, gaccum):
                     graph._manual_axes = (dpa,)
                     try:
+                        if opt_flat.zero >= 3:
+                            # ZeRO-3: working params exist only as 1/dp
+                            # master chunks at rest — gather each bucket
+                            # just-in-time in the weight dtype
+                            # (param_gather) before the fwd+bwd reads it
+                            vstate = {**vstate,
+                                      **opt_flat._flat_gather_params(
+                                          fstate,
+                                          update_node.attrs["xs"], dpa)}
                         fv, acc = compute_grads(vstate, fmb)
                         if gaccum:
                             # persistent GRAD-level grads arrive already
@@ -1458,7 +1487,11 @@ class DefineAndRunGraph(Graph):
                 # reduce-scatter-only sync: the updated params leave the
                 # manual region fully gathered, so the per-param
                 # all-gather allowance is ZERO — any GSPMD regather is a
-                # regression the implicit-reshard rule must flag
+                # regression the implicit-reshard rule must flag.
+                # Optimizer-declared in-region collectives (Adafactor's
+                # factored-stat psums) are EXPLICIT lowered emissions,
+                # accounted through grad_comm's opt_extra below, so the
+                # GSPMD-insert claim stays exactly zero
                 meta["allowed_gspmd"] = {}
             elif gc_state[0] and opt.zero in (1, 2):
                 # ZeRO-1/2 keeps optimizer state dp-sharded but params
@@ -1499,6 +1532,11 @@ class DefineAndRunGraph(Graph):
                     # each scalar fetch is pmean'd inside the manual
                     # region (one explicit all_reduce apiece)
                     "scalar_fetches": meta["scalar_fetches"],
+                    # optimizer-declared in-region collectives beyond
+                    # the grad/param chains (Adafactor's factored-stat
+                    # psums) — folded into the predictor's "extra"
+                    "opt_extra": dict(opt._flat_comm_extra())
+                    if flat_mode else {},
                 }
         register_executable(name, jit_step, self._abstract_pool[key], meta)
 
@@ -1540,6 +1578,10 @@ class DefineAndRunGraph(Graph):
         DefineAndRunGraph plan-change -> SwitchExecGraph::SwitchParams,
         define_and_run_graph.cc:1073-1129).  Returns a SwitchProfile."""
         from ..parallel.switch import SwitchExecGraph, SwitchMode
+        # ZeRO-3 flat keeps working params stale between update steps;
+        # the switch migrates _var_data, so materialize first (bitwise
+        # vs the in-region gather — the continuation stays exact)
+        self._refresh_stale_params()
         if mode is None:
             mode = SwitchMode.ORIGIN_PARAM if optimizer is None \
                 else SwitchMode.ORIGIN_PARAM_AND_OPTIMIZER
@@ -1680,9 +1722,19 @@ class DefineAndRunGraph(Graph):
             tr.end(feed_sp, n_feeds=len(feed_dict),
                    micro_batches=num_micro_batches)
 
+        # ZeRO-3 flat leaves per-param working copies stale between
+        # update steps (the flat master is authoritative); any OTHER
+        # plan about to read parameter values must refresh them first
+        stale = getattr(self, "_stale_flat_params", None)
+        if stale is not None and not (
+                flat_mode and update_node is not None
+                and update_node.attrs["optimizer"] is stale[0]):
+            self._refresh_stale_params()
+
         var_state = dict(self._var_data)
         opt_state = {}
         scaler = None
+        zero3_flat = False
         if update_node is not None:
             opt = update_node.attrs["optimizer"]
             if flat_mode:
@@ -1691,6 +1743,13 @@ class DefineAndRunGraph(Graph):
                 # per-param checkpoints on the way
                 opt_state = dict(opt._ensure_flat_state(
                     var_state, update_node.attrs["xs"], self))
+                zero3_flat = opt.zero >= 3
+                if zero3_flat:
+                    # params at rest = the 1/dp flat master chunks; the
+                    # full working copies never enter the step (the
+                    # region re-gathers them per bucket, param_gather)
+                    for t in update_node.attrs["xs"]:
+                        var_state.pop(t.id, None)
             else:
                 opt_state = dict(opt._ensure_state(
                     var_state, update_node.attrs["xs"], self))
@@ -1747,7 +1806,17 @@ class DefineAndRunGraph(Graph):
 
         commit_sp = tr.begin("commit", track="train") if tr.enabled \
             else None
-        self._var_data = dict(new_vars)
+        if zero3_flat:
+            # the step returns no trainables (they live only in the flat
+            # master now): keep the existing dp-sharded working copies —
+            # STALE until _refresh_stale_params materializes from master
+            merged = dict(self._var_data)
+            merged.update(new_vars)
+            self._var_data = merged
+            self._stale_flat_params = (update_node.attrs["optimizer"],
+                                       list(update_node.attrs["xs"]))
+        else:
+            self._var_data = dict(new_vars)
         if update_node is not None:
             new_opt = dict(new_opt)
             if scaler is not None and "_scaler" in new_opt:

@@ -76,6 +76,18 @@ class FlatStateLayout:
         """Global flat length per bucket (``device_num * chunk``)."""
         return tuple(self.device_num * c for c in self.chunks)
 
+    def comm_layout(self):
+        """The :class:`~hetu_tpu.parallel.comm.CoalescedLayout` view of
+        this state geometry — the very layout
+        ``reduce_scatter_coalesced`` would return for the same entries,
+        buildable WITHOUT running a reduce-scatter first.  ZeRO-3 uses it
+        to all-gather the working parameters just-in-time from the flat
+        master chunks (``all_gather_coalesced`` rides the bucket's weight
+        dtype) before any gradient collective has run this step."""
+        from ..parallel.comm import CoalescedLayout
+        return CoalescedLayout(tuple(self.buckets), tuple(self.chunks),
+                               False)
+
     def same_geometry(self, other: "FlatStateLayout") -> bool:
         return (other is not None and self.entries == other.entries
                 and self.device_num == other.device_num

@@ -90,10 +90,11 @@ class Optimizer:
                 raise ValueError(
                     "flat_state=True needs the explicit grad-comm path: "
                     "pass grad_comm='fp32'|'bf16'|'int8'")
-            if self.zero not in (1, 2):
+            if self.zero not in (1, 2, 3):
                 raise ValueError(
-                    f"flat_state=True implies dp-sharded state with "
-                    f"replicated params (ZeRO 1/2); got zero={self.zero}")
+                    f"flat_state=True needs dp-sharded state (ZeRO "
+                    f"1/2) or fully sharded params (ZeRO 3); got "
+                    f"zero={self.zero}")
         # numeric sentry (resilience/sentry.py): on-device finite/spike
         # verdict fused into every UPDATE-level step, anomalous updates
         # skipped with bitwise-zero residue.  True / SentryConfig /
@@ -283,11 +284,47 @@ class Optimizer:
         raise NotImplementedError(
             f"{type(self).__name__} does not support flat_state=True")
 
-    def _flat_update(self, p, slots, g, step, lr):
+    def _flat_update(self, p, slots, g, step, lr, **ctx):
         """Elementwise update on local fp32 chunks: (master, {slot:
-        chunk}, grad, step, lr) -> (new master, {slot: new chunk})."""
+        chunk}, grad, step, lr) -> (new master, {slot: new chunk}).
+        ``ctx`` carries ``bucket`` (index), ``axis`` (the manual dp axis)
+        and ``fstate`` (the full local flat state) for optimizers whose
+        update needs cross-chunk reductions (Adafactor's factored
+        stats); plain elementwise optimizers ignore it."""
         raise NotImplementedError(
             f"{type(self).__name__} does not support flat_state=True")
+
+    def _flat_extra_update(self, fstate) -> Dict[str, Any]:
+        """New values for non-chunk state entries (anything outside the
+        ``flat_*`` slots, e.g. Adafactor's replicated factored stats),
+        collected after the per-bucket update loop.  Base: none."""
+        return {}
+
+    def _flat_repack_extra(self, key: str, val, old_lay, new_lay):
+        """Hot-switch repack of one non-chunk state entry across a flat
+        geometry change (dp resize).  Base: pass through unchanged —
+        right for geometry-independent extras like the step counter AND
+        for per-bucket extras like Adafactor's factored stats (bucket
+        planning depends only on the entry set and bucket_mb, not on dp,
+        so a dp resize leaves the bucket partition — and with it every
+        row/col slot — untouched)."""
+        return val
+
+    def _flat_extra_init(self, lay, st: Dict[str, Any]) -> Dict[str, Any]:
+        """Initial values for non-chunk state entries when the flat
+        state is (re)built under layout ``lay`` (``st`` is the per-param
+        starting point — a checkpoint or the unpacked previous state).
+        Base: none."""
+        return {}
+
+    def _flat_comm_extra(self) -> Dict[str, int]:
+        """Collectives the flat update emits in-region BEYOND the
+        predicted grad/param chains, as ``{kind: count}`` per step
+        (Adafactor's factored-stat psums).  Registered as the plan's
+        ``grad_comm.opt_extra`` and folded into the emission
+        predictor's/edge pass's ``extra``.  Base: none — the
+        registration stays strict."""
+        return {}
 
     def _flat_entries(self, xs: Sequence[Tensor], var_state):
         """(key, shape, dtype) of the gradient set in SYNC order
@@ -318,6 +355,12 @@ class Optimizer:
         entries = self._flat_entries(xs, var_state)
         dp = mesh.shape[self.dp_axis]
         st = dict(self._state)
+        # restored-but-ungrafted non-param state (a checkpoint's
+        # ``@@leaf`` entries — Adafactor's per-bucket factored EMAs)
+        # joins the starting point so _flat_extra_init can reuse it
+        for k, v in (getattr(self, "_pending_tree_state", None)
+                     or {}).items():
+            st.setdefault(k, v)
         is_flat = any(k.startswith("flat_") for k in st)
         writes = getattr(graph, "_var_writes", 0)
 
@@ -401,6 +444,7 @@ class Optimizer:
         }
         for s in slots:
             flat[f"flat_{s}"] = new_lay.pack(_per_param(st.get(s), zeros))
+        flat.update(self._flat_extra_init(new_lay, st))
         sh = NamedSharding(mesh, PartitionSpec(self.dp_axis))
         for key, bufs in flat.items():
             if key.startswith("flat_"):
@@ -409,6 +453,23 @@ class Optimizer:
         self._state = flat
         self._pending_tree_state = None
         self._packed_var_writes = writes
+        if self.zero >= 3:
+            # ZeRO-3 at rest: the flat fp32 master IS the authoritative
+            # parameter storage; the per-param working copies stay
+            # dp-sharded (dim-0 when divisible) so nothing replicated
+            # remains resident between steps
+            for t in sync_order(xs):
+                arr = var_state.get(t.id)
+                if arr is None or not hasattr(arr, "shape"):
+                    continue
+                psh = self._param_shardings.get(t.id)
+                if psh is None:
+                    psh = self._state_sharding(t, arr, graph)
+                    if psh is None:
+                        continue
+                    self._param_shardings[t.id] = psh
+                var_state[t.id] = jax.device_put(arr, psh)
+                graph._var_data[t.id] = var_state[t.id]
         return self._state
 
     def _flat_state_pspecs(self, opt_state: Dict[str, Any]):
@@ -418,6 +479,47 @@ class Optimizer:
         return {k: ([PartitionSpec(self.dp_axis)] * len(v)
                     if k.startswith("flat_") else PartitionSpec())
                 for k, v in opt_state.items()}
+
+    def _flat_gather_params(self, fstate, xs: Sequence[Tensor], axis: str):
+        """ZeRO-3 just-in-time parameter materialization: all-gather
+        every bucket of the flat fp32 master in the bucket's WEIGHT
+        dtype (``all_gather_coalesced`` casts the chunk before the
+        collective), tagged ``param_gather`` so parameter-gather traffic
+        stays separable from gradient and param_comm traffic.  Returns
+        ``{tid: full param}`` — bitwise the arrays ZeRO-2's post-update
+        all-gather produced, since the chunks ARE the same fp32 master.
+        Must run inside the shard_map manual region."""
+        from ..parallel import comm
+        lay = self._flat_layout
+        return comm.all_gather_coalesced(
+            list(fstate["flat_master"]), lay.comm_layout(), axis,
+            tag="param_gather")
+
+    def materialize_flat_params(self, graph: Graph,
+                                xs: Sequence[Tensor]) -> None:
+        """Refresh the per-param working copies from the flat fp32
+        master (ZeRO-3's authoritative storage).  Called lazily when a
+        consumer outside the flat update loop needs parameter VALUES —
+        eval plans, checkpoint saves, hot switches — and stored back
+        dp-sharded so the at-rest footprint stays 1/dp.  The cast
+        fp32 -> weight dtype is exactly the in-region gather's, so a
+        continuation from the materialized copies is bitwise."""
+        lay = self._flat_layout
+        if lay is None or "flat_master" not in self._state:
+            return
+        per = lay.unpack(self._state["flat_master"])
+        for t in xs:
+            if t.id not in per:
+                continue
+            arr = jnp.asarray(per[t.id]).astype(t.dtype.to_jnp())
+            sh = self._param_shardings.get(t.id)
+            if sh is None and self.zero >= 3:
+                sh = self._state_sharding(t, arr, graph)
+                if sh is not None:
+                    self._param_shardings[t.id] = sh
+            if sh is not None:
+                arr = jax.device_put(arr, sh)
+            graph._var_data[t.id] = arr
 
     def _flat_sync_and_update(self, var_state, fstate, grads,
                               xs: Sequence[Tensor], axis: str,
@@ -464,10 +566,26 @@ class Optimizer:
         for bi, g in enumerate(chunks):
             p = fstate["flat_master"][bi]
             cur = {s: fstate[f"flat_{s}"][bi] for s in slots}
-            p_new, cur_new = self._flat_update(p, cur, g, step, lr)
+            p_new, cur_new = self._flat_update(p, cur, g, step, lr,
+                                               bucket=bi, axis=axis,
+                                               fstate=fstate)
             new_master.append(p_new)
             for s in slots:
                 new_slots[s].append(cur_new[s])
+        out: Dict[str, Any] = {"flat_master": new_master}
+        for s in slots:
+            out[f"flat_{s}"] = new_slots[s]
+        for k, v in self._flat_extra_update(fstate).items():
+            out[k] = v
+        if self.zero >= 3:
+            # ZeRO-3: nothing but the 1/dp master chunks survives the
+            # step — the next step's forward re-gathers just-in-time
+            # (param_gather), so there is no post-update all-gather and
+            # the trainables drop out of the returned var set entirely
+            xs_ids = {t.id for t in xs_sorted}
+            new_vars = {k: v for k, v in var_state.items()
+                        if k not in xs_ids}
+            return new_vars, out, sq_norm
         # updated params ride the WEIGHT dtype across the wire (bucket
         # dtype == param dtype), tagged param_comm — gradient bytes and
         # parameter bytes stay separable in the accounting
@@ -476,9 +594,6 @@ class Optimizer:
         new_vars = dict(var_state)
         for t in xs_sorted:
             new_vars[t.id] = gathered[t.id]
-        out: Dict[str, Any] = {"flat_master": new_master}
-        for s in slots:
-            out[f"flat_{s}"] = new_slots[s]
         return new_vars, out, sq_norm
 
     def _c_param(self, tid: int, p):
@@ -590,7 +705,7 @@ class SGDOptimizer(Optimizer):
     def _flat_slots(self):
         return ("velocity",) if self.momentum != 0.0 else ()
 
-    def _flat_update(self, p, slots, g, step, lr):
+    def _flat_update(self, p, slots, g, step, lr, **ctx):
         if self.momentum == 0.0:
             return p - lr * g, {}
         v = self.momentum * slots["velocity"] + g
@@ -654,7 +769,7 @@ class AdamOptimizer(Optimizer):
     def _flat_slots(self):
         return ("m", "v")
 
-    def _flat_update(self, p, slots, g, step, lr):
+    def _flat_update(self, p, slots, g, step, lr, **ctx):
         # same math as _apply_updates on fp32 chunks; padding lanes have
         # g == 0 and p == 0, so every term stays exactly 0 there
         b1, b2 = self.beta1, self.beta2
@@ -707,13 +822,26 @@ class AdafactorOptimizer(Optimizer):
     row/col EMAs, so optimizer state is O(rows+cols) per matrix instead
     of O(rows*cols).  Beyond the reference (SGD/Adam only).
 
-    Delegates the update math to ``optax.adafactor`` (public, baked-in)
-    under this framework's graph-update machinery, so it composes with
-    define-and-run graphs, donation, and checkpointing like the native
-    optimizers.  ZeRO state sharding is intentionally not applied — the
-    factored state is the memory win already.  ``lr`` may be a float or
-    an ``optim.schedules`` callable (1-based steps, adapted to optax's
+    The per-param path delegates the update math to ``optax.adafactor``
+    (public, baked-in) under this framework's graph-update machinery, so
+    it composes with define-and-run graphs, donation, and checkpointing
+    like the native optimizers.  ``lr`` may be a float or an
+    ``optim.schedules`` callable (1-based steps, adapted to optax's
     0-based count).
+
+    ``flat_state=True`` is supported natively (same optax semantics,
+    reimplemented on bucket chunks): the full second moment rides the
+    flat dp-sharded ``v`` slot ONLY for parameters too small to factor;
+    factored parameters keep row/col EMA vectors packed per-bucket in
+    replicated ``fac_row``/``fac_col`` state (O(rows+cols) — tiny), and
+    their lanes of ``v`` stay zero.  The factored stats need global
+    row/col means of the squared gradient, which each rank computes from
+    its chunk via static segment-sum plans plus ONE fp32 psum per bucket
+    (a second when ``clipping_threshold`` adds the per-block update-RMS
+    reduction); those extra collectives are declared through
+    ``_flat_comm_extra`` so the strict emission verifier still holds
+    exactly.  Deviation from optax: only 2-D parameters factor (ndim>2
+    falls back to the full second moment).
     """
 
     def __init__(self, params=None, lr=None, min_dim_size_to_factor=128,
@@ -724,6 +852,15 @@ class AdafactorOptimizer(Optimizer):
                  max_grad_norm: Optional[float] = None, **kw):
         super().__init__(params, lr, max_grad_norm=max_grad_norm, **kw)
         import optax
+        self.min_dim_size_to_factor = int(min_dim_size_to_factor)
+        self.decay_rate = float(decay_rate)
+        self.clipping_threshold = clipping_threshold
+        self.momentum = momentum
+        self.weight_decay_rate = weight_decay_rate
+        self.multiply_by_parameter_scale = multiply_by_parameter_scale
+        self.eps = 1e-30            # optax factorized epsilon[0]
+        self._fac_cache = None      # (layout, per-bucket segment plans)
+        self._pending_fac = None
         if callable(lr):
             schedule = lambda count: lr(count + 1)  # noqa: E731
         else:
@@ -740,6 +877,205 @@ class AdafactorOptimizer(Optimizer):
     def _init_state(self, var_state, xs):
         params = {t.id: var_state[t.id].astype(jnp.float32) for t in xs}
         return {"optax": self._tx.init(params)}
+
+    # -- flat_state support ---------------------------------------------------
+
+    def _factored_dims(self, shape):
+        """(d1, d0) = (second-largest, largest) dim index when ``shape``
+        factors — optax's rule restricted to ndim==2 (the flat plans
+        index rows/cols of matrices; higher-rank tensors keep the full
+        second moment)."""
+        if len(shape) != 2 or min(shape) < self.min_dim_size_to_factor:
+            return None
+        order = np.argsort(shape)     # stable: square -> d1=0, d0=1
+        return int(order[-2]), int(order[-1])
+
+    def _flat_slots(self):
+        return ("v",) + (("m",) if self.momentum else ())
+
+    def _fac_plan(self, lay):
+        """Per-bucket static segment plans mapping every flat-buffer
+        lane to its factored row/col slot and owning param.  Pure numpy
+        from the layout index (cached per layout object); rank-local
+        views are sliced inside the update by ``axis_index``.
+
+        Slot spaces per bucket (each with one trailing TRASH slot that
+        absorbs padding lanes and non-factored params):
+        ``row``  — concatenated per-factored-param vectors of length
+        ``shape[d1]`` (the axis that survives the mean over d0);
+        ``col``  — same with d0/d1 swapped; ``pid`` — one slot per
+        param (clip blocks + parameter-scale RMS)."""
+        if self._fac_cache is not None and self._fac_cache[0] is lay:
+            return self._fac_cache[1]
+        plans = []
+        n = lay.device_num
+        for bi, b in enumerate(lay.buckets):
+            size = n * lay.chunks[bi]
+            nparams = len(b.keys)
+            row_div, rowslot_pid, col_div = [], [], []
+            p_nrows = np.ones(nparams + 1, np.float32)
+            p_numel = np.ones(nparams + 1, np.float32)
+            # first pass: count row/col slots so trash ids are known
+            n_rows = n_cols = 0
+            facd = []
+            for shape in b.shapes:
+                fd = self._factored_dims(shape)
+                facd.append(fd)
+                if fd is not None:
+                    d1, d0 = fd
+                    n_rows += shape[d1]
+                    n_cols += shape[d0]
+            n_rows += 1               # trash slots
+            n_cols += 1
+            pid = np.full(size, nparams, np.int32)
+            row_id = np.full(size, n_rows - 1, np.int32)
+            col_id = np.full(size, n_cols - 1, np.int32)
+            fac = np.zeros(size, np.float32)
+            real = np.zeros(size, np.float32)
+            off = row_base = col_base = 0
+            for idx, (shape, numel, fd) in enumerate(
+                    zip(b.shapes, b.numels, facd)):
+                sl = slice(off, off + numel)
+                real[sl] = 1.0
+                pid[sl] = idx
+                p_numel[idx] = numel
+                if fd is not None:
+                    d1, d0 = fd
+                    q = np.arange(numel)
+                    i, j = q // shape[1], q % shape[1]
+                    row_id[sl] = row_base + (i if d0 == 1 else j)
+                    col_id[sl] = col_base + (j if d0 == 1 else i)
+                    fac[sl] = 1.0
+                    row_div.extend([shape[d0]] * shape[d1])
+                    rowslot_pid.extend([idx] * shape[d1])
+                    col_div.extend([shape[d1]] * shape[d0])
+                    p_nrows[idx] = shape[d1]
+                    row_base += shape[d1]
+                    col_base += shape[d0]
+                off += numel
+            row_div.append(1)
+            rowslot_pid.append(nparams)
+            col_div.append(1)
+            plans.append({
+                "pid": pid, "row_id": row_id, "col_id": col_id,
+                "fac": fac, "real": real,
+                "n_rows": n_rows, "n_cols": n_cols,
+                "nparams": nparams,
+                "row_div": np.asarray(row_div, np.float32),
+                "rowslot_pid": np.asarray(rowslot_pid, np.int32),
+                "col_div": np.asarray(col_div, np.float32),
+                "p_nrows": p_nrows, "p_numel": p_numel,
+            })
+        self._fac_cache = (lay, plans)
+        return plans
+
+    def _flat_update(self, p, slots, g, step, lr, **ctx):
+        """optax.adafactor's exact chain on one bucket's local chunk —
+        factored stats via segment sums + one psum (two with clipping):
+        scale_by_factored_rms -> clip_by_block_rms -> lr ->
+        scale_by_param_block_rms -> ema(momentum) ->
+        add_decayed_weights -> descent."""
+        import jax.ops
+        bi, axis = ctx["bucket"], ctx["axis"]
+        fstate = ctx["fstate"]
+        lay = self._flat_layout
+        plan = self._fac_plan(lay)[bi]
+        if bi == 0:
+            self._pending_fac = ([], [])
+        chunk = lay.chunks[bi]
+        r = jax.lax.axis_index(axis)
+
+        def local(arr):
+            return jax.lax.dynamic_slice_in_dim(
+                jnp.asarray(arr), r * chunk, chunk)
+
+        pid_l = local(plan["pid"])
+        row_l = local(plan["row_id"])
+        col_l = local(plan["col_id"])
+        fac_l = local(plan["fac"])
+        real_l = local(plan["real"])
+        n_rows, n_cols = plan["n_rows"], plan["n_cols"]
+        nseg = plan["nparams"] + 1
+        t = step.astype(jnp.float32)
+        d = 1.0 - t ** (-self.decay_rate)      # decay_rate_t, 1-based t
+        gsq = g * g + self.eps
+        # round 1: rank-local segment sums -> ONE fp32 psum (row sums,
+        # col sums, and pre-update param sq-norms ride one buffer)
+        row_s = jax.ops.segment_sum(gsq, row_l, num_segments=n_rows)
+        col_s = jax.ops.segment_sum(gsq, col_l, num_segments=n_cols)
+        psq = jax.ops.segment_sum(p * p, pid_l, num_segments=nseg)
+        stats = jax.lax.psum(jnp.concatenate([row_s, col_s, psq]), axis)
+        row_s = stats[:n_rows]
+        col_s = stats[n_rows:n_rows + n_cols]
+        psq = stats[n_rows + n_cols:]
+        # factored row/col EMAs (replicated — every rank computed the
+        # same psum) and the factored update
+        vr = d * fstate["fac_row"][bi] + (1 - d) * (row_s / plan["row_div"])
+        vc = d * fstate["fac_col"][bi] + (1 - d) * (col_s / plan["col_div"])
+        self._pending_fac[0].append(vr)
+        self._pending_fac[1].append(vc)
+        rsum = jax.ops.segment_sum(vr, jnp.asarray(plan["rowslot_pid"]),
+                                   num_segments=nseg)
+        rmean = rsum / plan["p_nrows"]
+        rf = (jnp.maximum(vr, self.eps)
+              / jnp.maximum(rmean[plan["rowslot_pid"]], self.eps)) ** -0.5
+        cf = jnp.maximum(vc, self.eps) ** -0.5
+        u_fac = g * rf[row_l] * cf[col_l]
+        # non-factored lanes: full second moment on the flat v slot
+        # (kept exactly zero on factored/padding lanes)
+        vfull = d * slots["v"] + (1 - d) * gsq
+        u_nf = g * jax.lax.rsqrt(jnp.maximum(vfull, self.eps))
+        u = jnp.where(fac_l > 0, u_fac, u_nf)
+        out = {"v": vfull * real_l * (1.0 - fac_l)}
+        if self.clipping_threshold is not None:
+            # round 2: per-param block RMS of the update
+            usq = jax.lax.psum(
+                jax.ops.segment_sum(u * u, pid_l, num_segments=nseg), axis)
+            rms_u = jnp.sqrt(usq / plan["p_numel"])
+            u = u / jnp.maximum(
+                1.0, rms_u / self.clipping_threshold)[pid_l]
+        if lr is not None:
+            u = u * lr
+        if self.multiply_by_parameter_scale:
+            pscale = jnp.maximum(jnp.sqrt(psq / plan["p_numel"]), 1e-3)
+            u = u * pscale[pid_l]
+        if self.momentum:
+            m = self.momentum * slots["m"] + (1 - self.momentum) * u
+            u = m
+            out["m"] = m * real_l
+        if self.weight_decay_rate:
+            u = u + self.weight_decay_rate * p
+        u = u * real_l
+        return p - u, out
+
+    def _flat_extra_update(self, fstate):
+        fr, fc = self._pending_fac
+        self._pending_fac = None
+        return {"fac_row": fr, "fac_col": fc}
+
+    def _flat_extra_init(self, lay, st):
+        """Zero row/col EMA vectors per bucket (reusing shape-matching
+        vectors from ``st`` when a rebuild preserved them)."""
+        plans = self._fac_plan(lay)
+        out = {}
+        for key, n_key in (("fac_row", "n_rows"), ("fac_col", "n_cols")):
+            old = st.get(key)
+            vecs = []
+            for bi, plan in enumerate(plans):
+                want = plan[n_key]
+                if (isinstance(old, (list, tuple)) and bi < len(old)
+                        and np.shape(old[bi]) == (want,)):
+                    vecs.append(jnp.asarray(old[bi], jnp.float32))
+                else:
+                    vecs.append(jnp.zeros((want,), jnp.float32))
+            out[key] = vecs
+        return out
+
+    def _flat_comm_extra(self):
+        lay = self._flat_layout
+        nb = len(lay.buckets) if lay is not None else 0
+        per_bucket = 2 if self.clipping_threshold is not None else 1
+        return {"all_reduce": nb * per_bucket} if nb else {}
 
     def _apply_updates(self, var_state, opt_state, grads, xs):
         grads = self._clip_grads(grads, xs)

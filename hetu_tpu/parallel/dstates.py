@@ -598,17 +598,26 @@ def verify_grad_comm_emission(hlo_text: str, prediction: List[dict],
 def predict_flat_update_collectives(entries, device_num: int,
                                     bucket_mb: float = 4.0,
                                     transport: str = "fp32",
-                                    block: Optional[int] = None
-                                    ) -> List[dict]:
-    """Predict the collectives of one reduce-scatter-only ZeRO-2 sync
+                                    block: Optional[int] = None,
+                                    zero: int = 2) -> List[dict]:
+    """Predict the collectives of one reduce-scatter-only flat sync
     (flat dp-sharded optimizer state, ``Optimizer(flat_state=True)``).
 
-    Per bucket: ONE reduce-scatter chain carrying the gradients (fp32:
-    a single ``psum_scatter``; bf16/int8: the phase-1 quantized exchange
-    only — the phase-2 regather of the all-reduce path is gone) plus ONE
-    all-gather of the updated parameters riding the bucket's WEIGHT
-    dtype.  Zero gradient all-gathers, ever — exactly half the gradient
-    wire bytes of the all-reduce path at the same transport.
+    ``zero <= 2`` (params replicated at rest): per bucket, ONE
+    reduce-scatter chain carrying the gradients (fp32: a single
+    ``psum_scatter``; bf16/int8: the phase-1 quantized exchange only —
+    the phase-2 regather of the all-reduce path is gone) plus ONE
+    all-gather of the UPDATED parameters riding the bucket's WEIGHT
+    dtype (tag ``param_comm``).  Zero gradient all-gathers, ever —
+    exactly half the gradient wire bytes of the all-reduce path at the
+    same transport.
+
+    ``zero >= 3`` (params sharded at rest): the per-bucket all-gather
+    moves to the FRONT of the step — the just-in-time ``param_gather``
+    that materializes the working weights from the flat fp32 master
+    before the forward — and the post-update gather disappears (only
+    the 1/dp shard stays resident).  Same collective kinds, counts and
+    wire bytes as ``zero=2``; only the tag/position differ.
     """
     from .comm import (INT8_BLOCK, plan_buckets, quantized_chunk,
                        ring_wire_bytes)
@@ -616,14 +625,23 @@ def predict_flat_update_collectives(entries, device_num: int,
     n = device_num
     preds: List[dict] = []
 
-    def _emit(kind, payload, dtype):
-        preds.append({"kind": kind, "payload_bytes": int(payload),
-                      "wire_bytes": ring_wire_bytes(kind, payload, n),
-                      "dtype": dtype})
+    def _emit(kind, payload, dtype, tag=None):
+        p = {"kind": kind, "payload_bytes": int(payload),
+             "wire_bytes": ring_wire_bytes(kind, payload, n),
+             "dtype": dtype}
+        if tag is not None:
+            p["tag"] = tag
+        preds.append(p)
 
     for b in plan_buckets(entries, bucket_mb):
         numel = sum(b.numels)
         chunk = quantized_chunk(numel, n, block)
+        itemsize = np.dtype(b.dtype).itemsize
+        if zero >= 3:
+            # just-in-time weight gather from the flat master, before
+            # any gradient exchange this step
+            _emit("all_gather", n * chunk * itemsize, b.dtype,
+                  tag="param_gather")
         if transport == "fp32":
             _emit("reduce_scatter", n * chunk * 4, "float32")
         elif transport == "bf16":
@@ -633,9 +651,10 @@ def predict_flat_update_collectives(entries, device_num: int,
             _emit("all_to_all", n * (chunk // block) * 4, "float32")
         else:
             raise ValueError(f"unknown transport {transport!r}")
-        # updated-param gather in the weight dtype (tag param_comm)
-        itemsize = np.dtype(b.dtype).itemsize
-        _emit("all_gather", n * chunk * itemsize, b.dtype)
+        if zero < 3:
+            # updated-param gather in the weight dtype (tag param_comm)
+            _emit("all_gather", n * chunk * itemsize, b.dtype,
+                  tag="param_comm")
     return preds
 
 
@@ -645,28 +664,38 @@ def predict_update_step_collectives(entries, device_num: int,
                                     block: Optional[int] = None,
                                     scalar_fetches: int = 1,
                                     flat: bool = False,
-                                    clip: bool = False):
+                                    clip: bool = False,
+                                    zero: int = 2,
+                                    opt_extra: Optional[Dict[str, int]]
+                                    = None):
     """Step-level prediction for an explicit-grad-comm training
     executable: the coalesced gradient-sync collectives
     (:func:`predict_grad_comm_collectives`, or
     :func:`predict_flat_update_collectives` when ``flat`` — the
-    reduce-scatter-only ZeRO-2 path) plus one all_reduce (the scalar
-    pmean) per scalar fetch, plus the global-norm-clip psum when the
-    flat path clips (``clip``; the all-reduce path clips on full local
-    grads with no collective).  Returns ``(prediction, extra)`` in
-    exactly the form :func:`verify_grad_comm_emission` consumes, so the
-    general analysis pass (``hetu_tpu.analysis``) and direct HLO
-    assertions share one predictor."""
+    reduce-scatter-only ZeRO-2/3 path, ``zero`` selecting whether the
+    per-bucket weight gather is the post-update ``param_comm`` or the
+    just-in-time ``param_gather`` of params-sharded-at-rest) plus one
+    all_reduce (the scalar pmean) per scalar fetch, plus the
+    global-norm-clip psum when the flat path clips (``clip``; the
+    all-reduce path clips on full local grads with no collective).
+    Returns ``(prediction, extra)`` in exactly the form
+    :func:`verify_grad_comm_emission` consumes, so the general analysis
+    pass (``hetu_tpu.analysis``) and direct HLO assertions share one
+    predictor."""
     if flat:
         preds = predict_flat_update_collectives(
             entries, device_num, bucket_mb=bucket_mb,
-            transport=transport, block=block)
+            transport=transport, block=block, zero=zero)
     else:
         preds = predict_grad_comm_collectives(
             entries, device_num, bucket_mb=bucket_mb,
             transport=transport, block=block)
     n_ar = int(scalar_fetches) + (1 if (flat and clip) else 0)
     extra = {"all_reduce": n_ar} if n_ar else {}
+    # optimizer-declared in-region collectives beyond the grad/param
+    # chains (e.g. Adafactor's factored-stat psums)
+    for k, v in (opt_extra or {}).items():
+        extra[k] = extra.get(k, 0) + int(v)
     return preds, extra
 
 

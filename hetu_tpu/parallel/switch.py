@@ -105,12 +105,16 @@ class SwitchProfile:
         self.num_tensors = 0
         self.total_bytes = 0
         self.moved_bytes = 0
+        # bytes routed through a flat-state unpack -> migrate -> repack
+        # (dp resize of per-bucket dp-sharded optimizer buffers)
+        self.repack_bytes = 0
         self.seconds = 0.0
 
     def as_dict(self) -> Dict[str, float]:
         return {"num_tensors": self.num_tensors,
                 "total_bytes": self.total_bytes,
                 "moved_bytes": self.moved_bytes,
+                "repack_bytes": self.repack_bytes,
                 "seconds": self.seconds}
 
 
@@ -215,6 +219,10 @@ class SwitchExecGraph:
             if sh is not None:
                 dsts[tid] = sh
                 fixed_specs[t] = sh.spec
+            else:
+                # no pspec means replicated — the array must still leave
+                # the old device set when the mesh shrinks/moves
+                dsts[tid] = NamedSharding(self.new_mesh, PartitionSpec())
         dtype = self.dtype if self.mode in (
             SwitchMode.TRANSFER_PARAM,
             SwitchMode.TRANSFER_PARAM_AND_OPTIMIZER) else None
@@ -232,56 +240,14 @@ class SwitchExecGraph:
             old_mesh = g.mesh
             g.mesh = self.new_mesh
             try:
-                new_state: Dict[str, Any] = {}
-                optimizer._shardings = {}
-                for slot, tree in optimizer._state.items():
-                    if not isinstance(tree, dict):
-                        # non-dict slots — scalar step counters AND
-                        # structured pytrees (Adafactor's optax state) —
-                        # are committed to the old device set after a
-                        # run.  Param-shaped leaves keyed by tensor id
-                        # (e.g. optax momentum) follow their param's
-                        # sharding; everything else (factored vectors,
-                        # counters) replicates — so a momentum-bearing
-                        # Adafactor can't materialize a full replicated
-                        # state copy per device mid-switch.
-                        repl = NamedSharding(self.new_mesh, PartitionSpec())
-
-                        def _place(path, a):
-                            if not isinstance(a, jax.Array):
-                                return a
-                            sh = repl
-                            for k in reversed(path):
-                                if isinstance(k, jax.tree_util.DictKey):
-                                    t = tensors.get(k.key)
-                                    if t is not None \
-                                            and tuple(t.concrete_shape()) \
-                                            == tuple(a.shape):
-                                        cand = optimizer._state_sharding(
-                                            t, a, g)
-                                        if cand is not None:
-                                            sh = cand
-                                    break
-                            return jax.device_put(a, sh)
-                        tree = jax.tree_util.tree_map_with_path(_place, tree)
-                        new_state[slot] = tree
-                        continue
-                    slot_dsts = {}
-                    for tid, arr in tree.items():
-                        t = tensors.get(tid)
-                        if t is None:
-                            continue
-                        sh = optimizer._state_sharding(t, arr, g)
-                        if sh is None:
-                            # fully-replicated on the NEW device set — the
-                            # state must still leave the old mesh
-                            sh = NamedSharding(self.new_mesh,
-                                               PartitionSpec())
-                        slot_dsts[tid] = sh
-                        optimizer._shardings[tid] = sh
-                    new_state[slot] = switch_state(tree, slot_dsts,
-                                                   profile=self.profile)
-                optimizer._state = new_state
+                if any(k.startswith("flat_") for k in optimizer._state) \
+                        and getattr(optimizer, "_flat_layout", None) \
+                        is not None:
+                    # flat dp-sharded state: repack through the layout
+                    # index instead of bailing to per-param state
+                    self._switch_flat(optimizer, tensors)
+                else:
+                    self._switch_per_param(optimizer, tensors)
             finally:
                 g.mesh = old_mesh
         # grads: pending accumulations must always follow the params off
@@ -292,3 +258,153 @@ class SwitchExecGraph:
                                          profile=self.profile)
         g.mesh = self.new_mesh
         return self.profile
+
+    def _switch_per_param(self, optimizer, tensors) -> None:
+        """Per-parameter optimizer-state migration (graph mesh already
+        set to the new mesh by the caller)."""
+        g = self.graph
+        new_state: Dict[str, Any] = {}
+        optimizer._shardings = {}
+        for slot, tree in optimizer._state.items():
+            if not isinstance(tree, dict):
+                # non-dict slots — scalar step counters AND
+                # structured pytrees (Adafactor's optax state) —
+                # are committed to the old device set after a
+                # run.  Param-shaped leaves keyed by tensor id
+                # (e.g. optax momentum) follow their param's
+                # sharding; everything else (factored vectors,
+                # counters) replicates — so a momentum-bearing
+                # Adafactor can't materialize a full replicated
+                # state copy per device mid-switch.
+                repl = NamedSharding(self.new_mesh, PartitionSpec())
+
+                def _place(path, a):
+                    if not isinstance(a, jax.Array):
+                        return a
+                    sh = repl
+                    for k in reversed(path):
+                        if isinstance(k, jax.tree_util.DictKey):
+                            t = tensors.get(k.key)
+                            if t is not None \
+                                    and tuple(t.concrete_shape()) \
+                                    == tuple(a.shape):
+                                cand = optimizer._state_sharding(
+                                    t, a, g)
+                                if cand is not None:
+                                    sh = cand
+                            break
+                    return jax.device_put(a, sh)
+                tree = jax.tree_util.tree_map_with_path(_place, tree)
+                new_state[slot] = tree
+                continue
+            slot_dsts = {}
+            for tid, arr in tree.items():
+                t = tensors.get(tid)
+                if t is None:
+                    continue
+                sh = optimizer._state_sharding(t, arr, g)
+                if sh is None:
+                    # fully-replicated on the NEW device set — the
+                    # state must still leave the old mesh
+                    sh = NamedSharding(self.new_mesh,
+                                       PartitionSpec())
+                slot_dsts[tid] = sh
+                optimizer._shardings[tid] = sh
+            new_state[slot] = switch_state(tree, slot_dsts,
+                                           profile=self.profile)
+        optimizer._state = new_state
+
+    def _switch_flat(self, optimizer, tensors) -> None:
+        """Flat dp-sharded optimizer state across a mesh change (graph
+        mesh already set to the new mesh by the caller).
+
+        A dp resize changes the bucket chunk quantization, so the flat
+        buffers cannot simply be resharded: each per-bucket buffer is
+        unpacked through the OLD :class:`FlatStateLayout` index into the
+        per-param view, those arrays migrate onto the new device set
+        (with the usual :class:`SwitchPlan` wire accounting), and the
+        state is repacked under the NEW dp's layout — it never leaves
+        the flat regime, so the next train step's reduce-scatter
+        geometry is immediately valid with no per-param fallback step.
+        The repacked payload is counted in ``profile.repack_bytes``.
+        """
+        from ..optim.flat_state import FlatStateLayout, sync_order
+        g = self.graph
+        old_lay = optimizer._flat_layout
+        st = optimizer._state
+        dp_axis = optimizer.dp_axis
+        if dp_axis not in self.new_mesh.axis_names:
+            raise ValueError(
+                f"flat_state optimizer needs axis {dp_axis!r} on the new "
+                f"mesh; got {self.new_mesh.axis_names}")
+        dp = self.new_mesh.shape[dp_axis]
+        slots = sorted(k[len("flat_"):] for k in st
+                       if k.startswith("flat_") and k != "flat_master")
+        xs = sync_order([tensors[k] for k in old_lay.index
+                         if k in tensors])
+        # per-param view through the OLD index (fp32, padding dropped)
+        per: Dict[str, Dict[Any, jax.Array]] = {
+            "master": old_lay.unpack(st["flat_master"])}
+        for s in slots:
+            per[s] = old_lay.unpack(st[f"flat_{s}"])
+        # each per-param piece follows its param's (ZeRO re-deduced)
+        # sharding on the new mesh for the wire trip, replicated when
+        # no dp split applies
+        slot_dsts = {}
+        for t in xs:
+            arr = per["master"].get(t.id)
+            if arr is None:
+                continue
+            sh = optimizer._state_sharding(t, arr, g)
+            slot_dsts[t.id] = sh if sh is not None else NamedSharding(
+                self.new_mesh, PartitionSpec())
+        for name in per:
+            per[name] = switch_state(per[name], slot_dsts,
+                                     profile=self.profile)
+            self.profile.repack_bytes += sum(
+                a.nbytes for a in per[name].values()
+                if isinstance(a, jax.Array))
+        # repack under the new dp: same entries, new chunk quantization
+        new_lay = FlatStateLayout(old_lay.entries, dp,
+                                  bucket_mb=old_lay.bucket_mb,
+                                  block=old_lay.block)
+        sh_flat = NamedSharding(self.new_mesh, PartitionSpec(dp_axis))
+        repl = NamedSharding(self.new_mesh, PartitionSpec())
+        new_state: Dict[str, Any] = {}
+        for key, val in st.items():
+            if key == "flat_master":
+                new_state[key] = [jax.device_put(a, sh_flat)
+                                  for a in new_lay.pack(per["master"])]
+            elif key.startswith("flat_"):
+                new_state[key] = [
+                    jax.device_put(a, sh_flat)
+                    for a in new_lay.pack(per[key[len("flat_"):]])]
+            else:
+                # step counter + any replicated extra state (e.g.
+                # Adafactor's factored stats): optimizers whose extras
+                # depend on the bucket geometry re-derive them via the
+                # repack hook
+                val = optimizer._flat_repack_extra(key, val, old_lay,
+                                                   new_lay)
+                new_state[key] = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, repl)
+                    if isinstance(a, jax.Array) else a, val)
+        # mesh-bound caches from the old topology must not leak through
+        optimizer._shardings = {}
+        optimizer._param_shardings = {}
+        optimizer._param_base_shardings = {}
+        optimizer._flat_layout = new_lay
+        optimizer._state = new_state
+        optimizer._packed_var_writes = getattr(g, "_var_writes", 0)
+        if optimizer.zero >= 3:
+            # ZeRO-3 at rest: the migrated working copies go back to
+            # their dp-sharded resting layout on the new mesh
+            for t in xs:
+                arr = g._var_data.get(t.id)
+                if arr is None or not hasattr(arr, "shape"):
+                    continue
+                sh = optimizer._state_sharding(t, arr, g)
+                if sh is None:
+                    continue
+                optimizer._param_shardings[t.id] = sh
+                g._var_data[t.id] = jax.device_put(arr, sh)

@@ -374,7 +374,7 @@ class SearchEngine:
         """Per-layer choices for a fixed (dp, tp) layout: ZeRO stage and
         recompute flag — the per-layer degrees of freedom Galvatron's DP
         optimizes (sdp/ckpt columns of its strategy table)."""
-        zeros = [0, 1, 2] if (self.allow_zero and dp > 1) else [0]
+        zeros = [0, 1, 2, 3] if (self.allow_zero and dp > 1) else [0]
         ckpts = [False, True] if self.allow_recompute else [False]
         return [Strategy(dp=dp, tp=tp, zero=z, recompute=ck)
                 for z, ck in itertools.product(zeros, ckpts)]

@@ -545,7 +545,15 @@ def _opt_state_items(optimizer, tid_to_name):
         # survive per-param training into a later flat restore.
         for key, val in state.items():
             if not key.startswith("flat_"):
-                yield f"opt.{key}", val, key, None
+                if isinstance(val, (list, tuple)):
+                    # per-bucket replicated extras (Adafactor's factored
+                    # row/col EMAs): leaves-by-index, regrafted through
+                    # _pending_tree_state at the reader's next flat
+                    # state rebuild (shape-matched, else reset)
+                    for i, leaf in enumerate(val):
+                        yield f"opt.{key}@@leaf{i:04d}", leaf, key, None
+                else:
+                    yield f"opt.{key}", val, key, None
                 continue
             slot = key[len("flat_"):]
             # slice the LIVE buffers through the index (device-side) and

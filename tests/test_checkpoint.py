@@ -351,7 +351,7 @@ class TestFlatStateCheckpoint:
     curve exactly (fp32 flat math == per-param math)."""
 
     def _train(self, devices8, flat, steps, load_from=None, dp=8,
-               opt_cls=None, **opt_kw):
+               zero=2, opt_cls=None, **opt_kw):
         from hetu_tpu.graph import ctor
         from hetu_tpu.models import GPTLMHeadModel, llama_config
         from hetu_tpu.parallel import create_mesh
@@ -368,7 +368,7 @@ class TestFlatStateCheckpoint:
             model = GPTLMHeadModel(cfg)
             loss = model(ids, labels)
             opt = (opt_cls or ht.optim.AdamOptimizer)(
-                lr=1e-2, zero=2, grad_comm="fp32", flat_state=flat,
+                lr=1e-2, zero=zero, grad_comm="fp32", flat_state=flat,
                 **opt_kw)
             train_op = opt.minimize(loss)
             if load_from is not None:
@@ -457,6 +457,68 @@ class TestFlatStateCheckpoint:
                                     load_from=d2, opt_cls=sgd,
                                     momentum=0.9)
         np.testing.assert_allclose(cont, ref[4:], rtol=1e-6)
+
+    def test_zero3_checkpoint_roundtrips_through_per_param(
+            self, devices8, tmp_path):
+        """flat ZeRO-3 -> per-param -> flat ZeRO-2: the params-sharded-
+        at-rest checkpoint is per-parameter keyed like every other, so
+        it chains through any reader and the loss curve never forks
+        (save-time ``get_tensor_value`` refreshes the stale working
+        params from the flat master first)."""
+        _, model, opt, _ = self._train(devices8, flat=True, steps=2,
+                                       zero=3)
+        d1 = str(tmp_path / "z3_ck")
+        save_checkpoint(model, opt, d1, step=2)
+        state = load_split(d1)
+        assert not any("flat_" in k for k in state)
+        ref, _, _, _ = self._train(devices8, flat=True, steps=6, zero=3)
+        # hop 1: per-param reader continues the curve
+        _, model2, opt2, _ = self._train(devices8, flat=False, steps=2,
+                                         zero=0, load_from=d1)
+        d2 = str(tmp_path / "pp_ck")
+        save_checkpoint(model2, opt2, d2, step=4)
+        # hop 2: flat ZeRO-2 reader continues from the re-save
+        cont, _, _, _ = self._train(devices8, flat=True, steps=2,
+                                    zero=2, load_from=d2)
+        np.testing.assert_allclose(cont, ref[4:], rtol=1e-6)
+
+    def test_zero3_dp8_checkpoint_restores_at_dp4(self, devices8,
+                                                  tmp_path):
+        """A dp=8 ZeRO-3 checkpoint restores into dp=4 runs: chunk
+        quantization differs, the per-param index bridges it, and the
+        ZeRO-3 continuation is BITWISE the ZeRO-2 continuation (same
+        fp32 master, same collectives modulo the gather's position)."""
+        _, model, opt, _ = self._train(devices8, flat=True, steps=2,
+                                       zero=3)
+        d = str(tmp_path / "z3_dp8_ck")
+        save_checkpoint(model, opt, d, step=2)
+        c2, _, _, _ = self._train(devices8, flat=True, steps=2, zero=2,
+                                  load_from=d, dp=4)
+        c3, _, opt4, _ = self._train(devices8, flat=True, steps=2,
+                                     zero=3, load_from=d, dp=4)
+        assert c2 == c3            # bitwise, not merely close
+        assert opt4._flat_layout.device_num == 4
+
+    def test_adafactor_flat_checkpoint_preserves_factored_stats(
+            self, devices8, tmp_path):
+        """Adafactor's per-bucket factored row/col EMAs ride the
+        checkpoint as ``opt.fac_row@@leaf*`` entries and regraft on
+        restore, so a flat continuation is bitwise the uninterrupted
+        run."""
+        af = ht.optim.AdafactorOptimizer
+        kw = dict(opt_cls=af, min_dim_size_to_factor=16)
+        _, model, opt, _ = self._train(devices8, flat=True, steps=2,
+                                       **kw)
+        d = str(tmp_path / "af_ck")
+        save_checkpoint(model, opt, d, step=2)
+        assert any(k.startswith("opt.fac_row@@leaf")
+                   for k in load_split(d))
+        ref, _, _, _ = self._train(devices8, flat=True, steps=4, **kw)
+        cont, _, opt2, _ = self._train(devices8, flat=True, steps=2,
+                                       load_from=d, **kw)
+        assert cont == ref[2:]     # factored stats survived: bitwise
+        assert any(float(np.abs(np.asarray(v)).max()) > 0
+                   for v in opt2._state["fac_row"])
 
     def test_flat_checkpoint_is_per_param_keyed(self, devices8,
                                                 tmp_path):
