@@ -50,9 +50,13 @@ from .memory import (MemoryBuffer, MemoryReport, has_remat_region,
                      predict_memory, xla_memory_stats)
 from .report import (AnalysisReport, CollectiveRecord, ExecutableReport,
                      Finding, load_baseline, save_baseline)
-from .rules import (DEFAULT_OPTIONS, RULES, TRACE_RULE_EVENT_KINDS,
-                    AnalysisContext, ParamInfo, _protocol_replay, rule,
-                    run_rules)
+from .rules import (DEFAULT_OPTIONS, RULES, SCHEDULE_RULE_OP_KINDS,
+                    TRACE_RULE_EVENT_KINDS, AnalysisContext, ParamInfo,
+                    _protocol_replay, rule, run_rules)
+from .schedule import (SCHEDULE_RULES, CommOp, ProgramSpec,
+                       ScheduleViolation, extract_schedules,
+                       schedule_summary, seeded_bug_corpus,
+                       spec_from_meta, strategy_grid, verify_schedules)
 
 __all__ = [
     "AnalysisContext", "AnalysisReport", "CollectiveRecord", "CommEdge",
@@ -72,6 +76,11 @@ __all__ = [
     "ALL_KINDS", "Event", "ExploreConfig", "ExploreResult",
     "TRACE_RULE_EVENT_KINDS", "Violation", "collect_events", "explore",
     "fuzz_trace", "kind_counts", "machine_summary", "replay",
+    # cross-rank collective-schedule verifier (DESIGN.md §25)
+    "CommOp", "ProgramSpec", "SCHEDULE_RULES", "SCHEDULE_RULE_OP_KINDS",
+    "ScheduleViolation", "extract_schedules", "schedule_summary",
+    "seeded_bug_corpus", "spec_from_meta", "strategy_grid",
+    "verify_schedules",
 ]
 
 
@@ -208,6 +217,12 @@ def analyze_handle(handle: ExecutableHandle, compile: bool = False,
         "lost_hooks": sorted(lost),
         "machines": machine_summary(events),
     }
+    # cross-rank schedule verdict: every executable gets a section
+    # (uniform baseline keys; 0 ranks = this registration makes no
+    # multi-rank claim).  Per-violation findings ride in rep.findings
+    # via the six schedule rules, which share this pass's memoized
+    # extraction + verification.
+    rep.meta["schedule"] = schedule_summary(ctx)
     return rep
 
 

@@ -61,6 +61,14 @@ emits; then:
   controls the text section, ``--format json`` always carries the
   ``protocol`` dict.  Lifecycle findings carry the violating event
   subtrace, printed by ``--explain``.
+* ``--schedule``: print the cross-rank schedule verifier section per
+  executable (per-rank symbolic op inventory, collective/p2p/switch
+  plane sizes, hang-freedom verdict — DESIGN.md §25).  Same contract
+  again: always computed and gated (the baseline pins per-executable
+  schedule coverage and the rule vocabulary); the flag only controls
+  the text section, ``--format json`` always carries the ``schedule``
+  dict.  Schedule findings carry the divergent per-rank subtraces side
+  by side, printed by ``--explain``.
 * ``--hbm-budget``: device HBM budget in GiB for the ``oom-risk`` rule
   (default: the rule's v5p budget).
 
@@ -524,11 +532,39 @@ def protocol_section(report, out=sys.stdout) -> None:
             print(f"    kinds: {ks}", file=out)
 
 
+def schedule_section(report, out=sys.stdout) -> None:
+    """--schedule: the cross-rank schedule verifier per executable —
+    rank count, op inventory, plane sizes and the hang-freedom verdict
+    (DESIGN.md §25).  Divergent per-rank subtraces ride --explain: each
+    schedule finding's hint is the side-by-side window around the
+    divergence point on every implicated rank."""
+    print("\ncross-rank schedule verifier (analysis/schedule):",
+          file=out)
+    for name, rep in sorted(report.executables.items()):
+        s = rep.meta.get("schedule")
+        if s is None:
+            print(f"  {name}: (schedule pass unavailable)", file=out)
+            continue
+        if not s.get("ranks"):
+            print(f"  {name}: no multi-rank claim", file=out)
+            continue
+        verdict = "hang-free" if not s["violations"] \
+            else f"{s['violations']} VIOLATION(S) {s['violation_rules']}"
+        print(f"  {name}: {s['ranks']} ranks x {s['ops']} ops "
+              f"({s['collectives']} collective, {s['p2p']} p2p, "
+              f"{s['switch']} switch) — {verdict}", file=out)
+        if s.get("kinds"):
+            ks = ", ".join(f"{k} x{v}"
+                           for k, v in sorted(s["kinds"].items()))
+            print(f"    kinds: {ks}", file=out)
+
+
 def run_gate(baseline_path: str = BASELINE_DEFAULT,
              tolerance: float = 0.1, update: bool = False,
              as_json: bool = False, compile: bool = True,
              explain: bool = False, memory: bool = False,
              cost: bool = False, protocol: bool = False,
+             schedule: bool = False,
              hbm_budget_gib: float = None, out=sys.stdout) -> int:
     """Build, analyze, gate.  Returns the process exit code
     (0 clean / 1 findings / 2 baseline missing)."""
@@ -627,6 +663,8 @@ def run_gate(baseline_path: str = BASELINE_DEFAULT,
             cost_section(report, out=out)
         if protocol:
             protocol_section(report, out=out)
+        if schedule:
+            schedule_section(report, out=out)
     if explain:
         explain_report(report, out=out, memory=memory, cost=cost)
     if update:
@@ -686,6 +724,11 @@ def main(argv=None) -> int:
                          "(event stream size, kind vocabulary, machine "
                          "coverage, lifecycle violations; --explain "
                          "prints each violation's event subtrace)")
+    ap.add_argument("--schedule", action="store_true",
+                    help="print the cross-rank schedule verifier "
+                         "section (per-rank op inventory, hang-freedom "
+                         "verdict; --explain prints each divergence's "
+                         "per-rank subtraces side by side)")
     ap.add_argument("--hbm-budget", type=float, default=None,
                     metavar="GIB",
                     help="device HBM budget in GiB for the oom-risk "
@@ -706,6 +749,7 @@ def main(argv=None) -> int:
                     memory=args.memory,
                     cost=args.cost,
                     protocol=args.protocol,
+                    schedule=args.schedule,
                     hbm_budget_gib=args.hbm_budget)
 
 

@@ -118,6 +118,11 @@ class EdgeMatch:
     """Result of matching an executable's emissions against its edges."""
     explained: List[Tuple[Any, CommEdge]] = dataclasses.field(
         default_factory=list)          # (CollectiveRecord, edge)
+    #: records explained by RE-claiming a param_gather edge past its
+    #: count — the ZeRO-3 weight gather replayed inside a fused forward
+    #: scope (lazy materialization re-emits it per fused region)
+    replayed: List[Tuple[Any, CommEdge]] = dataclasses.field(
+        default_factory=list)
     unexplained_records: List[Any] = dataclasses.field(default_factory=list)
     gspmd_explained: Dict[str, Tuple[int, List[CommEdge]]] = \
         dataclasses.field(default_factory=dict)    # kind -> (count, edges)
@@ -127,13 +132,14 @@ class EdgeMatch:
 
     @property
     def total(self) -> int:
-        return (len(self.explained) + len(self.unexplained_records)
+        return (len(self.explained) + len(self.replayed)
+                + len(self.unexplained_records)
                 + sum(n for n, _ in self.gspmd_explained.values())
                 + sum(e for e, _ in self.gspmd_unexplained.values()))
 
     @property
     def explained_count(self) -> int:
-        return (len(self.explained)
+        return (len(self.explained) + len(self.replayed)
                 + sum(n for n, _ in self.gspmd_explained.values()))
 
     def coverage(self) -> Dict[str, int]:
@@ -370,13 +376,25 @@ def match_edges(records, lowered_text: str, compiled_text: str,
         edge = _pick(tagged, rec, need_tag=True)       # 1: tag + kind
         if edge is None:                               # 2: untagged
             edge = _pick(untagged, rec, need_tag=False)
-        # NO third tier: a tagged edge must find its tag in the
-        # record's scope — letting it absorb arbitrary same-kind
-        # records would make the explicit-record half of
-        # unexplained-collective vacuous (a rogue untagged ppermute in
-        # a pipeline program must fire, not ride the hop edge)
         if edge is not None:
             m.explained.append((rec, edge))
+            continue
+        # NO general third tier: a tagged edge must find its tag in
+        # the record's scope — letting it absorb arbitrary same-kind
+        # records would make the explicit-record half of
+        # unexplained-collective vacuous (a rogue untagged ppermute in
+        # a pipeline program must fire, not ride the hop edge).  One
+        # bounded exception: the ZeRO-3 param_gather is re-emitted per
+        # fused forward region under lazy materialization, so a record
+        # whose scope DOES carry the param_gather tag may re-claim
+        # that edge past its count — tracked separately as a replay,
+        # never absorbing records of other tags or out-of-scope kinds.
+        replay = next(
+            (e for e in tagged
+             if e.tag == "param_gather" and e.covers(rec.kind, train)
+             and _tag_in_scope(e.tag, rec.scope)), None)
+        if replay is not None:
+            m.replayed.append((rec, replay))
         else:
             m.unexplained_records.append(rec)
 

@@ -131,6 +131,13 @@ class ExecutableReport:
         # vacuously green, which is the regression class this pins
         if "protocol" in self.meta:
             d["protocol"] = dict(self.meta["protocol"])
+        # cross-rank schedule coverage (analysis/schedule): the baseline
+        # pins rank/op counts, the op-kind inventory, the verifier's
+        # violation count (0 on a clean tree) and the rule vocabulary
+        # available at freeze time — a vanished rule or a collapsed
+        # schedule turns the hang-freedom verdict vacuously green
+        if "schedule" in self.meta:
+            d["schedule"] = dict(self.meta["schedule"])
         if records:
             d["records"] = [r.to_dict() for r in self.records]
         return d
@@ -334,6 +341,48 @@ class AnalysisReport:
                             f"{name}: protocol event stream shrank "
                             f"{w_e:.0f} -> {g_e:.0f} events "
                             f"(> {tolerance:.0%} tolerance — protocol "
+                            f"coverage drop)")
+            # cross-rank schedule coverage: violations may not grow (a
+            # clean tree verifies hang-free — any divergence is a
+            # regression), no rule pinned at freeze time may vanish
+            # from the registry (a vanished rule un-checks its
+            # invariant), and the extracted schedule may not collapse
+            # (ranks drop to zero / ops shrink beyond the tolerance —
+            # stopping to extract IS the regression)
+            want_s = base.get("schedule")
+            got_s = rep.meta.get("schedule")
+            if want_s:
+                if got_s is None:
+                    problems.append(
+                        f"{name}: baseline records schedule coverage "
+                        f"but the report has none (extraction lost?)")
+                else:
+                    w_v = int(want_s.get("violations", 0))
+                    g_v = int(got_s.get("violations", 0))
+                    if g_v > w_v:
+                        problems.append(
+                            f"{name}: schedule violations regressed "
+                            f"{w_v} -> {g_v} "
+                            f"({got_s.get('violation_rules')})")
+                    from .rules import RULES as _rules
+                    gone = sorted(set(want_s.get("rules_available", ()))
+                                  - set(_rules))
+                    if gone:
+                        problems.append(
+                            f"{name}: schedule rules vanished from the "
+                            f"registry: {gone}")
+                    if int(want_s.get("ranks", 0)) > 0 \
+                            and int(got_s.get("ranks", 0)) == 0:
+                        problems.append(
+                            f"{name}: schedule extraction collapsed "
+                            f"({want_s.get('ranks')} ranks -> 0)")
+                    w_o = float(want_s.get("ops", 0))
+                    g_o = float(got_s.get("ops", 0))
+                    if g_o < w_o * (1.0 - tolerance) and w_o - g_o > 1:
+                        problems.append(
+                            f"{name}: schedule op inventory shrank "
+                            f"{w_o:.0f} -> {g_o:.0f} ops "
+                            f"(> {tolerance:.0%} tolerance — schedule "
                             f"coverage drop)")
             for field, value in (("payload_bytes", rep.total_payload_bytes),
                                  ("wire_bytes", rep.total_wire_bytes)):

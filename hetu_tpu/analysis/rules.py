@@ -574,6 +574,17 @@ def _param_gather_unpriced(ctx: AnalysisContext) -> List[Finding]:
              if getattr(e, "tag", "") == "param_gather"
              and getattr(e, "payload_bytes", 0) > 0]
     budget = sum(int(getattr(e, "count", 1)) for e in edges)
+    # lazy materialization replays the gather inside each fused forward
+    # region: records the edge matcher attributed to a priced
+    # param_gather edge (including the bounded replay tier) are priced,
+    # not rogue — only an emission beyond both the budget AND the
+    # attribution is unexplained wire traffic
+    em = ctx.edge_match()
+    attributed = set()
+    if em is not None:
+        for mrec, medge in list(em.explained) + list(em.replayed):
+            if getattr(medge, "tag", "") == "param_gather":
+                attributed.add(id(mrec))
     out: List[Finding] = []
     for i, r in enumerate(recs):
         if r.kind != "all_gather":
@@ -589,7 +600,7 @@ def _param_gather_unpriced(ctx: AnalysisContext) -> List[Finding]:
                      "comm.all_gather_coalesced(..., "
                      "tag='param_gather') only"))
             continue
-        if i >= budget:
+        if i >= budget and id(r) not in attributed:
             out.append(Finding(
                 rule="", subject=f"all_gather:param_gather@{i}",
                 severity="error", source=r.source,
@@ -1471,4 +1482,97 @@ TRACE_RULE_EVENT_KINDS: Dict[str, Optional[Tuple[str, ...]]] = {
     # record-plane rule: checkpoint restore records come from the meta
     # hook, not the serving event stream
     "unverified-restore": None,
+}
+
+
+# ---------------------------------------------------------------------------
+# cross-rank collective-schedule rules (DESIGN.md §25)
+# ---------------------------------------------------------------------------
+
+from .schedule import (COLLECTIVE_KINDS as _SCHED_COLLECTIVES,  # noqa: E402
+                       P2P_KINDS as _SCHED_P2P,
+                       RULE_DEADLOCK as SCHED_RULE_DEADLOCK,
+                       RULE_GROUP as SCHED_RULE_GROUP,
+                       RULE_ORDER as SCHED_RULE_ORDER,
+                       RULE_PAYLOAD as SCHED_RULE_PAYLOAD,
+                       RULE_SWITCH as SCHED_RULE_SWITCH,
+                       RULE_UNPAIRED as SCHED_RULE_UNPAIRED,
+                       verify_context as _schedule_replay)
+
+
+def _schedule_findings(ctx: AnalysisContext,
+                       rule_name: str) -> List[Finding]:
+    """The six schedule rules share ONE extraction + verification pass
+    (memoized on the context by ``schedule.verify_context``), exactly
+    like the lifecycle rules share one protocol replay."""
+    return [Finding(rule="", subject=v.subject, severity="error",
+                    source=v.provenance, message=v.message,
+                    hint=v.format_subtrace())
+            for v in _schedule_replay(ctx) if v.rule == rule_name]
+
+
+@rule(SCHED_RULE_ORDER)
+def _collective_order_mismatch(ctx: AnalysisContext) -> List[Finding]:
+    """Every rank in a communicator group must issue the same
+    collectives in the same order — a rank whose stream diverges in
+    kind or count leaves its peers blocked in a rendezvous that never
+    completes."""
+    return _schedule_findings(ctx, SCHED_RULE_ORDER)
+
+
+@rule(SCHED_RULE_GROUP)
+def _collective_group_mismatch(ctx: AnalysisContext) -> List[Finding]:
+    """Group tuples must agree across the members of every collective:
+    two ranks that disagree on who participates each wait for a member
+    that never arrives."""
+    return _schedule_findings(ctx, SCHED_RULE_GROUP)
+
+
+@rule(SCHED_RULE_PAYLOAD)
+def _collective_payload_mismatch(ctx: AnalysisContext) -> List[Finding]:
+    """Payload bytes / dtype / reduction must agree at every aligned
+    position — shape disagreement hangs, dtype disagreement (one rank
+    quantizing an EQuARX-style collective its peers run full-precision)
+    silently corrupts the exchange."""
+    return _schedule_findings(ctx, SCHED_RULE_PAYLOAD)
+
+
+@rule(SCHED_RULE_UNPAIRED)
+def _p2p_unpaired(ctx: AnalysisContext) -> List[Finding]:
+    """Every pipeline p2p send must pair with a recv on the destination
+    rank (per channel, by tag/payload/dtype) and vice versa — the
+    unmatched side blocks forever."""
+    return _schedule_findings(ctx, SCHED_RULE_UNPAIRED)
+
+
+@rule(SCHED_RULE_DEADLOCK)
+def _pipeline_deadlock(ctx: AnalysisContext) -> List[Finding]:
+    """The per-rank schedules are simulated under rendezvous-collective
+    / buffered-send / blocking-recv semantics; a stall is reported with
+    the wait-for cycle over pipeline stages x collectives."""
+    return _schedule_findings(ctx, SCHED_RULE_DEADLOCK)
+
+
+@rule(SCHED_RULE_SWITCH)
+def _switch_repack_divergence(ctx: AnalysisContext) -> List[Finding]:
+    """Hot-switch repack transfers (flat-state dp resize) must agree
+    between the sending and receiving side — a divergent plan leaves
+    stale or missing optimizer shards after the switch."""
+    return _schedule_findings(ctx, SCHED_RULE_SWITCH)
+
+
+# Every schedule rule → the CommOp kinds it inspects, mirroring
+# TRACE_RULE_EVENT_KINDS: the vacuity meta-test
+# (tests/test_schedule_verifier.py) asserts each rule (a) fires on its
+# seeded-bug corpus entry and ONLY that rule fires there, (b) stays
+# silent on the frozen clean strategy grid, and (c) inspects op kinds
+# that actually occur in the gate schedules — a rule whose input
+# vocabulary never occurs is vacuously green.
+SCHEDULE_RULE_OP_KINDS: Dict[str, Tuple[str, ...]] = {
+    SCHED_RULE_ORDER: _SCHED_COLLECTIVES,
+    SCHED_RULE_GROUP: _SCHED_COLLECTIVES,
+    SCHED_RULE_PAYLOAD: _SCHED_COLLECTIVES,
+    SCHED_RULE_UNPAIRED: _SCHED_P2P,
+    SCHED_RULE_DEADLOCK: _SCHED_P2P + _SCHED_COLLECTIVES,
+    SCHED_RULE_SWITCH: _SCHED_P2P + ("copy",),
 }

@@ -326,6 +326,32 @@ class Optimizer:
         registration stays strict."""
         return {}
 
+    def predicted_step_collectives(self, entries, device_num: int,
+                                   scalar_fetches: int = 1):
+        """The exact collective sequence ONE update step of this
+        optimizer (as configured: transport, bucket size, clipping,
+        ZeRO level, flat extras) emits over ``device_num`` dp shards —
+        ``(predictions, extra)`` per
+        ``dstates.predict_update_step_collectives``.
+
+        Single source of truth for every consumer of the optimizer's
+        comm contract: the graph's ``grad_comm`` registration, the edge
+        pass that prices it, and the cross-rank schedule verifier
+        (``analysis/schedule``) that checks it for rank consistency —
+        so a config change here cannot drift from what the analysis
+        plane verifies."""
+        from ..parallel.dstates import predict_update_step_collectives
+        return predict_update_step_collectives(
+            list(entries), int(device_num),
+            transport=self.grad_comm or "fp32",
+            bucket_mb=self.bucket_mb,
+            scalar_fetches=int(scalar_fetches),
+            flat=self.flat_state,
+            clip=self.max_grad_norm is not None,
+            zero=self.zero,
+            opt_extra=self._flat_comm_extra() if self.flat_state
+            else None)
+
     def _flat_entries(self, xs: Sequence[Tensor], var_state):
         """(key, shape, dtype) of the gradient set in SYNC order
         (flat_state.sync_order — the one ordering every flat-geometry

@@ -135,6 +135,22 @@ def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], Any],
     return (out, aux) if with_aux else out
 
 
+def spmd_hop_schedule(num_micro_batches: int, num_stages: int):
+    """The symbolic collective sequence one SPMD pipeline step issues
+    per rank: ``M + S - 1`` tick-loop ``ppermute`` hops (the scanned
+    ``pipeline/hop`` site above) followed by the two ``pipeline/collect``
+    psums that broadcast the last stage's outputs and the aux scalar.
+
+    Every pp rank runs the same scanned program, so the sequence is
+    rank-uniform by construction — the schedule verifier
+    (:mod:`hetu_tpu.analysis.schedule`) consumes this to model the SPMD
+    pipeline's collective stream without tracing it.
+    """
+    T = num_micro_batches + num_stages - 1
+    return [("ppermute", "pipeline/hop")] * T \
+        + [("all_reduce", "pipeline/collect")] * 2
+
+
 def stack_stage_params(per_layer_params: list, num_stages: int):
     """Stack L homogeneous per-layer param pytrees into [S, L/S, ...] leaves
     (dim 0 to be sharded over pp); the reference's layer-range-to-stage

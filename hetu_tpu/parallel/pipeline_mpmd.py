@@ -177,6 +177,13 @@ class MPMDPipelineRuntime:
         # of host dispatch per eager call, so the whole table is built in
         # ONE jit call per step instead of 2 fold_ins per task
         self._fold_cache: Dict[Tuple, Any] = {}
+        # executed-order p2p tap: one ("send"|"recv", "F"|"B", pipe,
+        # stage, micro_batch, peer_stage) entry per stage-boundary
+        # transfer the controller actually performed, in execution
+        # order.  Reset each train_step.  The schedule verifier's
+        # symbolic projection (``schedule.p2p_events``) must match this
+        # log exactly — the tap is what makes that claim testable.
+        self.p2p_log: List[Tuple[str, str, int, int, int, int]] = []
 
     def _schedule(self, M: int) -> List[List[Task]]:
         if self.schedule_name == "interleaved":
@@ -221,6 +228,7 @@ class MPMDPipelineRuntime:
         if rng is None:
             rng = jax.random.PRNGKey(0)
 
+        self.p2p_log = []
         # seed stage-0 inputs
         for p in range(P_n):
             for m, (x_mb, _) in enumerate(data[p]):
@@ -255,6 +263,10 @@ class MPMDPipelineRuntime:
             stage = self.pipes[p][s]
             m = t.micro_batch
             if t.kind == "F":
+                if s > 0:
+                    # the popped activation arrived from stage s-1's
+                    # _put — the forward recv side of the boundary
+                    self.p2p_log.append(("recv", "F", p, s, m, s - 1))
                 x = acts.pop((p, s, m))
                 if stage.is_last:
                     # loss+grads fused into the B task; keep the input
@@ -268,6 +280,7 @@ class MPMDPipelineRuntime:
                                         stash_live[p][s] * _tree_bytes(x))
                 nxt = self.pipes[p][s + 1]
                 acts[(p, s + 1, m)] = _put(y, nxt.mesh, nxt.act_spec)
+                self.p2p_log.append(("send", "F", p, s, m, s + 1))
                 return
             # backward
             if stage.is_last:
@@ -279,6 +292,7 @@ class MPMDPipelineRuntime:
             else:
                 x = stash.pop((p, s, m))
                 stash_live[p][s] -= 1
+                self.p2p_log.append(("recv", "B", p, s, m, s + 1))
                 dy = gin.pop((p, s, m))
                 dp, dx = stage.bwd_jit(stage.params, x, mb_rng(p, m), dy)
             grads[p][s] = _scale_grads(dp, w_arr) \
@@ -289,6 +303,7 @@ class MPMDPipelineRuntime:
                 # it lands on the previous stage's submesh
                 prev = self.pipes[p][s - 1]
                 gin[(p, s - 1, m)] = _put(dx, prev.mesh, stage.act_spec)
+                self.p2p_log.append(("send", "B", p, s, m, s - 1))
 
         # controller loop: round-robin over (pipe, stage), executing the
         # next schedule task whenever its input is available (the

@@ -143,6 +143,40 @@ def generate_interleaved_1f1b_schedule(num_stages: int,
     return out
 
 
+def p2p_events(schedule: Sequence[Sequence[Task]]
+               ) -> List[List[tuple]]:
+    """Project a per-stage task schedule onto the stage-boundary P2P
+    events each stage issues, in program order.
+
+    Returns, per stage, ``("send"|"recv", "F"|"B", micro_batch,
+    peer_stage)`` tuples: a forward task at stage ``s`` first receives
+    the activation from ``s-1`` (s > 0), computes, then sends to
+    ``s+1`` (s < S-1); a backward task receives the output grad from
+    ``s+1`` and sends the input grad to ``s-1``.  This is the symbolic
+    order the MPMD runtime's ``p2p_log`` tap records at execution time
+    and the schedule verifier (:mod:`hetu_tpu.analysis.schedule`)
+    checks for cross-rank pairing — one projection, three consumers.
+    """
+    S = len(schedule)
+    out: List[List[tuple]] = []
+    for s, tasks in enumerate(schedule):
+        ev: List[tuple] = []
+        for t in tasks:
+            m = t.micro_batch
+            if t.kind == "F":
+                if s > 0:
+                    ev.append(("recv", "F", m, s - 1))
+                if s < S - 1:
+                    ev.append(("send", "F", m, s + 1))
+            else:
+                if s < S - 1:
+                    ev.append(("recv", "B", m, s + 1))
+                if s > 0:
+                    ev.append(("send", "B", m, s - 1))
+        out.append(ev)
+    return out
+
+
 def max_in_flight(stage_tasks: Sequence[Task]) -> int:
     """Peak number of micro-batches with forward done but backward not —
     the stage's activation-stash high-water mark."""

@@ -56,6 +56,32 @@ def _overlap(a, b, shape):
     return tuple(out)
 
 
+def symbolic_repack_transfers(numel: int, itemsize: int,
+                              src_ranges: Dict[int, Tuple[int, int]],
+                              dst_ranges: Dict[int, Tuple[int, int]]
+                              ) -> List[Tuple[int, int, Tuple[int, int],
+                                              int]]:
+    """Device-free twin of :class:`SwitchPlan` for the 1-D flat-state
+    repack (dp resize of the per-bucket dp-sharded optimizer buffers).
+
+    ``src_ranges`` / ``dst_ranges`` map rank -> half-open ``(lo, hi)``
+    interval of the flat buffer owned before / after the resize.
+    Returns ``(dst_rank, src_rank, (lo, hi), nbytes)`` transfers sorted
+    deterministically — every rank deriving this plan independently
+    must produce the same list, which is exactly the invariant the
+    schedule verifier's ``switch-repack-divergence`` rule checks.
+    """
+    transfers: List[Tuple[int, int, Tuple[int, int], int]] = []
+    for dst, (dlo, dhi) in sorted(dst_ranges.items()):
+        for src, (slo, shi) in sorted(src_ranges.items()):
+            lo, hi = max(dlo, slo), min(dhi, shi, numel)
+            if lo >= hi:
+                continue
+            transfers.append((dst, src, (lo, hi), (hi - lo) * itemsize))
+    transfers.sort()
+    return transfers
+
+
 class SwitchPlan:
     """ParamSlice/ParamBlock intersection of two shardings of one tensor.
 

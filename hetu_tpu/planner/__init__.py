@@ -23,7 +23,7 @@ from .profile_hardware import (Calibration, profile_and_calibrate,
                                profile_matmul, validate_step_prediction)
 from .search import (HAND_PLANS, PlanResult, SearchEngine,
                      gpt_layer_chain, hand_plan_times, plan_for_gpt,
-                     plan_summary)
+                     plan_summary, verify_plan_schedule)
 from .strategies import (BaseSearching, FlexFlowSearching, GPipeSearching,
                          OptCNNSearching, PipeDreamSearching,
                          PipeOptSearching, SearchResult)
@@ -43,6 +43,7 @@ __all__ = [
     "Calibration", "profile_and_calibrate", "profile_collectives",
     "profile_hbm", "profile_matmul", "validate_step_prediction",
     "PlanResult", "SearchEngine", "plan_for_gpt", "plan_summary",
+    "verify_plan_schedule",
     "BaseSearching", "FlexFlowSearching", "GPipeSearching",
     "OptCNNSearching", "PipeDreamSearching", "PipeOptSearching",
     "SearchResult",

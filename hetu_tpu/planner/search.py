@@ -297,6 +297,28 @@ def plan_for_gpt(cfg, global_batch: int, seq: int, n_chips: int,
     return best
 
 
+def verify_plan_schedule(plan: PlanResult):
+    """Cross-rank schedule verdict for a searched plan: build the
+    symbolic :class:`~hetu_tpu.analysis.schedule.ProgramSpec` the plan
+    implies (pp stages x dp x tp, ZeRO level, 1F1B micro-batching) and
+    run the collective-schedule verifier over all its ranks.  Returns
+    the violation list — empty means the plan's multi-rank program is
+    hang-free BEFORE anyone commits a pod to it, which is the planner's
+    side of the DESIGN.md §25 contract (a searched plan that deadlocks
+    on hardware is worse than a slow one)."""
+    from ..analysis.schedule import (ProgramSpec, extract_schedules,
+                                     verify_schedules)
+    first = plan.layer_strategies[0]
+    zero = max(s.zero for s in plan.layer_strategies)
+    spec = ProgramSpec(
+        dp=int(first.dp), tp=int(first.tp), pp=int(plan.pp),
+        zero=int(zero), flat=zero >= 2,
+        num_micro_batches=max(1, int(plan.num_microbatches)),
+        pipeline_mode="mpmd" if plan.pp > 1 else "none",
+        layers=len(plan.layer_strategies))
+    return verify_schedules(extract_schedules(spec))
+
+
 def plan_summary(plan: PlanResult) -> Dict:
     """Flat JSON-able description of a plan (bench `extra` reporting)."""
     from collections import Counter
@@ -314,6 +336,7 @@ def plan_summary(plan: PlanResult) -> Dict:
         "micro_batch": getattr(plan, "micro_batch", None),
         "est_step_time_ms": round(plan.time * 1e3, 3),
         "layer_strategy_counts": dict(sts),
+        "schedule_hang_free": not verify_plan_schedule(plan),
     }
 
 

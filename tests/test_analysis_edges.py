@@ -545,3 +545,48 @@ class TestMatchSemantics:
         loose = match_edges([], lowered, compiled, edges, train=True,
                             allowed_gspmd=None)
         assert "all_gather" in loose.gspmd_explained
+
+    def test_param_gather_replay_in_fused_scope_is_attributed(self):
+        """Satellite regression (ISSUE 20): under ZeRO-3 lazy
+        materialization a fused forward region re-emits the weight
+        gather PAST the param_gather edge's count.  Those replays must
+        be attributed (EdgeMatch.replayed), not flagged — while a rogue
+        collective of any other tag still fires."""
+        from hetu_tpu.analysis import CollectiveRecord
+        edge = CommEdge(kind="all_gather", tag="param_gather", count=1)
+
+        def _pg(scope):
+            return CollectiveRecord(
+                kind="all_gather", axes=("dp",), dtype="bfloat16",
+                payload_bytes=4096, wire_bytes=1.0, scope=scope)
+        first = _pg("step/param_gather/bucket0")
+        replay = _pg("step/fwd/fused0/param_gather/bucket0")
+        rogue = CollectiveRecord(
+            kind="all_gather", axes=("dp",), dtype="float32",
+            payload_bytes=64, wire_bytes=1.0, scope="step/fwd/rogue")
+        m = match_edges([first, replay, rogue], "", "", [edge],
+                        train=True)
+        assert [r for r, _ in m.explained] == [first]
+        assert [r for r, _ in m.replayed] == [replay]
+        assert m.unexplained_records == [rogue]
+        # replays count as explained coverage (the baseline ratio may
+        # not silently drop when lazy materialization lands)
+        assert m.coverage() == {"explained": 2, "total": 3}
+
+    def test_replay_never_absorbs_other_kinds_or_tags(self):
+        """The replay tier is the ONE bounded exception: same tag, a
+        covered kind.  An out-of-scope record or an uncovered kind
+        stays unexplained even when a param_gather edge is exhausted."""
+        from hetu_tpu.analysis import CollectiveRecord
+        edge = CommEdge(kind="all_gather", tag="param_gather", count=0)
+        wrong_tag = CollectiveRecord(
+            kind="all_gather", axes=("dp",), dtype="float32",
+            payload_bytes=8, wire_bytes=1.0, scope="step/param_comm/b0")
+        wrong_kind = CollectiveRecord(
+            kind="all_to_all", axes=("dp",), dtype="float32",
+            payload_bytes=8, wire_bytes=1.0,
+            scope="step/param_gather/b0")
+        m = match_edges([wrong_tag, wrong_kind], "", "", [edge],
+                        train=True)
+        assert m.replayed == []
+        assert m.unexplained_records == [wrong_tag, wrong_kind]
